@@ -1,0 +1,34 @@
+"""Timestamps and expiry semantics.
+
+Reference semantics: write timestamps are microseconds since epoch
+(cql3 'USING TIMESTAMP'); localDeletionTime is seconds since epoch
+(db/DeletionTime.java, db/LivenessInfo.java); NO_TTL=0, NO_EXPIRY handled
+via sentinel (db/LivenessInfo.java:36-50)."""
+from __future__ import annotations
+
+import threading
+import time
+
+NO_TIMESTAMP = -(1 << 63)          # LivenessInfo.NO_TIMESTAMP
+NO_TTL = 0
+NO_DELETION_TIME = 0x7FFFFFFF      # int max: "not deleted / never expires"
+LIVE_DELETION = (NO_TIMESTAMP, NO_DELETION_TIME)
+
+_last_micros = 0
+_micros_lock = threading.Lock()
+
+
+def now_micros() -> int:
+    """Monotonic-per-process microsecond clock (ClientState.getTimestamp
+    semantics: never returns the same value twice, even across threads)."""
+    global _last_micros
+    with _micros_lock:
+        t = time.time_ns() // 1000
+        if t <= _last_micros:
+            t = _last_micros + 1
+        _last_micros = t
+        return t
+
+
+def now_seconds() -> int:
+    return int(time.time())
