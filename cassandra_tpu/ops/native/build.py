@@ -1,0 +1,74 @@
+"""Build + load the native codec library (g++ -> .so, loaded with ctypes).
+
+Rebuilds automatically when the source is newer than the cached .so.
+pybind11 is not available in this image; the C ABI + ctypes keeps the
+binding layer dependency-free."""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "codec.cpp")
+_SO = os.path.join(_DIR, "libcodec.so")
+_STAMP = _SO + ".srchash"
+_lock = threading.Lock()
+_lib = None
+
+
+def _src_hash() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _build(h: str) -> None:
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+           "-o", _SO + ".tmp", _SRC]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(_SO + ".tmp", _SO)
+    with open(_STAMP, "w") as f:
+        f.write(h)
+
+
+def _stale(h: str) -> bool:
+    # source-hash stamp, not mtime: a -march=native binary from another
+    # machine (or a stale checkout) must never be loaded
+    if not os.path.exists(_SO) or not os.path.exists(_STAMP):
+        return True
+    with open(_STAMP) as f:
+        return f.read().strip() != h
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        h = _src_hash()
+        if _stale(h):
+            _build(h)
+        lib = ctypes.CDLL(_SO)
+        i64 = ctypes.c_int64
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        for fn in ("lz4_compress", "lz4_decompress",
+                   "snappy_compress", "snappy_decompress"):
+            f = getattr(lib, fn)
+            f.restype = i64
+            f.argtypes = [u8p, i64, u8p, i64]
+        for fn in ("lz4_max_compressed", "snappy_max_compressed"):
+            f = getattr(lib, fn)
+            f.restype = i64
+            f.argtypes = [i64]
+        for fn in ("lz4_compress_batch", "lz4_decompress_batch",
+                   "snappy_compress_batch", "snappy_decompress_batch"):
+            f = getattr(lib, fn)
+            f.restype = i64
+            f.argtypes = [u8p, i64p, u8p, i64p, i64p, i64]
+        _lib = lib
+        return _lib

@@ -1,0 +1,409 @@
+// Native chunk codecs for the SSTable I/O path.
+//
+// Role parity: the reference's chunk codecs are JNI libraries (lz4-java,
+// snappy-java, zstd-jni; see reference io/compress/LZ4Compressor.java:39,
+// SnappyCompressor.java:33). Here they are first-party C++: LZ4 block
+// format and Snappy raw format, implemented from the public format specs
+// (lz4_Block_format.md; snappy/format_description.txt), exposed via a C ABI
+// consumed with ctypes (ops/codec.py). Batch entry points compress many
+// chunks per call so the Python layer crosses the FFI once per flush, not
+// once per 16KiB chunk.
+//
+// Build: ops/native/build.py (g++ -O3 -shared -fPIC).
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+extern "C" {
+
+// ---------------------------------------------------------------- LZ4 -----
+// LZ4 block format: sequences of
+//   [token][lit-len ext*][literals][offset LE16][match-len ext*]
+// token = (lit_len<<4) | (match_len-4), nibble 15 => extension bytes.
+// Constraints honoured: last sequence is literals-only; matches end >= 12
+// bytes before the end; offset in [1, 65535].
+
+static const int MINMATCH = 4;
+static const int HASH_LOG = 14;
+
+static inline uint32_t lz4_hash(uint32_t v) {
+    return (v * 2654435761u) >> (32 - HASH_LOG);
+}
+
+static inline uint32_t read32(const uint8_t* p) {
+    uint32_t v;
+    memcpy(&v, p, 4);
+    return v;
+}
+
+// worst-case compressed size (same bound as LZ4_compressBound)
+int64_t lz4_max_compressed(int64_t n) {
+    return n + n / 255 + 16;
+}
+
+// returns compressed size, or -1 if dst too small
+int64_t lz4_compress(const uint8_t* src, int64_t srcLen,
+                     uint8_t* dst, int64_t dstCap) {
+    if (srcLen == 0) {
+        if (dstCap < 1) return -1;
+        dst[0] = 0;  // token: 0 literals, no match
+        return 1;
+    }
+    uint32_t table[1 << HASH_LOG];
+    memset(table, 0, sizeof(table));
+
+    const uint8_t* ip = src;
+    const uint8_t* anchor = src;
+    const uint8_t* iend = src + srcLen;
+    // matches may not cover the last 12 bytes (mflimit), and the final
+    // 5 bytes must be literals
+    const uint8_t* mflimit = srcLen > 12 ? iend - 12 : src;
+    uint8_t* op = dst;
+    uint8_t* oend = dst + dstCap;
+
+    if (srcLen > 12) {
+        ip++;  // first byte can't be a match target
+        while (ip < mflimit) {
+            uint32_t h = lz4_hash(read32(ip));
+            const uint8_t* match = src + table[h];
+            table[h] = (uint32_t)(ip - src);
+            if (match < ip && (ip - match) <= 65535 &&
+                read32(match) == read32(ip)) {
+                // extend match forward
+                const uint8_t* mi = match + MINMATCH;
+                const uint8_t* ii = ip + MINMATCH;
+                const uint8_t* matchlimit = iend - 5;
+                while (ii < matchlimit && *ii == *mi) { ii++; mi++; }
+                int64_t matchLen = (ii - ip);
+                int64_t litLen = ip - anchor;
+                // emit sequence
+                int64_t need = 1 + litLen / 255 + 1 + litLen + 2 +
+                               (matchLen - MINMATCH) / 255 + 1;
+                if (op + need > oend) return -1;
+                uint8_t* token = op++;
+                if (litLen >= 15) {
+                    *token = 15 << 4;
+                    int64_t l = litLen - 15;
+                    while (l >= 255) { *op++ = 255; l -= 255; }
+                    *op++ = (uint8_t)l;
+                } else {
+                    *token = (uint8_t)(litLen << 4);
+                }
+                memcpy(op, anchor, litLen);
+                op += litLen;
+                uint16_t off = (uint16_t)(ip - match);
+                *op++ = (uint8_t)off;
+                *op++ = (uint8_t)(off >> 8);
+                int64_t ml = matchLen - MINMATCH;
+                if (ml >= 15) {
+                    *token |= 15;
+                    ml -= 15;
+                    while (ml >= 255) { *op++ = 255; ml -= 255; }
+                    *op++ = (uint8_t)ml;
+                } else {
+                    *token |= (uint8_t)ml;
+                }
+                ip += matchLen;
+                anchor = ip;
+                if (ip < mflimit)
+                    table[lz4_hash(read32(ip - 2))] = (uint32_t)(ip - 2 - src);
+            } else {
+                ip++;
+            }
+        }
+    }
+    // final literals
+    int64_t litLen = iend - anchor;
+    int64_t need = 1 + litLen / 255 + 1 + litLen;
+    if (op + need > oend) return -1;
+    uint8_t* token = op++;
+    if (litLen >= 15) {
+        *token = 15 << 4;
+        int64_t l = litLen - 15;
+        while (l >= 255) { *op++ = 255; l -= 255; }
+        *op++ = (uint8_t)l;
+    } else {
+        *token = (uint8_t)(litLen << 4);
+    }
+    memcpy(op, anchor, litLen);
+    op += litLen;
+    return op - dst;
+}
+
+// returns decompressed size, or -1 on malformed input / overflow
+int64_t lz4_decompress(const uint8_t* src, int64_t srcLen,
+                       uint8_t* dst, int64_t dstCap) {
+    const uint8_t* ip = src;
+    const uint8_t* iend = src + srcLen;
+    uint8_t* op = dst;
+    uint8_t* oend = dst + dstCap;
+
+    while (ip < iend) {
+        uint8_t token = *ip++;
+        // literals
+        int64_t litLen = token >> 4;
+        if (litLen == 15) {
+            uint8_t b;
+            do {
+                if (ip >= iend) return -1;
+                b = *ip++;
+                litLen += b;
+            } while (b == 255);
+        }
+        if (ip + litLen > iend || op + litLen > oend) return -1;
+        memcpy(op, ip, litLen);
+        ip += litLen;
+        op += litLen;
+        if (ip >= iend) break;  // last sequence has no match
+        // match
+        if (ip + 2 > iend) return -1;
+        int64_t offset = ip[0] | (ip[1] << 8);
+        ip += 2;
+        if (offset == 0 || offset > op - dst) return -1;
+        int64_t matchLen = (token & 15) + MINMATCH;
+        if ((token & 15) == 15) {
+            uint8_t b;
+            do {
+                if (ip >= iend) return -1;
+                b = *ip++;
+                matchLen += b;
+            } while (b == 255);
+        }
+        if (op + matchLen > oend) return -1;
+        const uint8_t* match = op - offset;
+        // overlapping copy must be byte-wise
+        for (int64_t i = 0; i < matchLen; i++) op[i] = match[i];
+        op += matchLen;
+    }
+    return op - dst;
+}
+
+// -------------------------------------------------------------- Snappy ----
+// Raw snappy format: uvarint uncompressed length, then tagged elements:
+//   tag&3 == 0: literal, len-1 in tag>>2 (60..63 => that many extra LE
+//               length bytes)
+//   tag&3 == 1: copy, len = 4 + ((tag>>2)&7), offset = ((tag>>5)<<8) | byte
+//   tag&3 == 2: copy, len = 1 + (tag>>2), offset = LE16
+//   tag&3 == 3: copy, len = 1 + (tag>>2), offset = LE32
+
+int64_t snappy_max_compressed(int64_t n) {
+    return 32 + n + n / 6;
+}
+
+int64_t snappy_compress(const uint8_t* src, int64_t srcLen,
+                        uint8_t* dst, int64_t dstCap) {
+    uint8_t* op = dst;
+    uint8_t* oend = dst + dstCap;
+    // uvarint length
+    uint64_t v = (uint64_t)srcLen;
+    do {
+        if (op >= oend) return -1;
+        uint8_t b = v & 0x7F;
+        v >>= 7;
+        *op++ = b | (v ? 0x80 : 0);
+    } while (v);
+
+    uint32_t table[1 << HASH_LOG];
+    memset(table, 0, sizeof(table));
+    const uint8_t* ip = src;
+    const uint8_t* anchor = src;
+    const uint8_t* iend = src + srcLen;
+    const uint8_t* limit = srcLen > 15 ? iend - 15 : src;
+
+    auto emit_literal = [&](const uint8_t* from, int64_t len) -> bool {
+        while (len > 0) {
+            // largest emitted tag (62) carries 3 length bytes => n < 2^24
+            int64_t chunk = len < (1 << 24) ? len : (1 << 24);
+            int64_t n = chunk - 1;
+            if (n < 60) {
+                if (op + 1 + chunk > oend) return false;
+                *op++ = (uint8_t)(n << 2);
+            } else if (n < 256) {
+                if (op + 2 + chunk > oend) return false;
+                *op++ = 60 << 2;
+                *op++ = (uint8_t)n;
+            } else if (n < 65536) {
+                if (op + 3 + chunk > oend) return false;
+                *op++ = 61 << 2;
+                *op++ = (uint8_t)n;
+                *op++ = (uint8_t)(n >> 8);
+            } else {
+                if (op + 5 + chunk > oend) return false;
+                *op++ = 62 << 2;
+                *op++ = (uint8_t)n;
+                *op++ = (uint8_t)(n >> 8);
+                *op++ = (uint8_t)(n >> 16);
+            }
+            memcpy(op, from, chunk);
+            op += chunk;
+            from += chunk;
+            len -= chunk;
+        }
+        return true;
+    };
+    auto emit_copy = [&](int64_t offset, int64_t len) -> bool {
+        // len up to 64 per element; offset <= 65535 (we never match farther)
+        while (len >= 68) {
+            if (op + 3 > oend) return false;
+            *op++ = (63 << 2) | 2;
+            *op++ = (uint8_t)offset;
+            *op++ = (uint8_t)(offset >> 8);
+            len -= 64;
+        }
+        if (len > 64) {
+            // emit 60, leave >= 4
+            if (op + 3 > oend) return false;
+            *op++ = (59 << 2) | 2;
+            *op++ = (uint8_t)offset;
+            *op++ = (uint8_t)(offset >> 8);
+            len -= 60;
+        }
+        if (len >= 4 && len <= 11 && offset < 2048) {
+            if (op + 2 > oend) return false;
+            *op++ = (uint8_t)(((offset >> 8) << 5) | ((len - 4) << 2) | 1);
+            *op++ = (uint8_t)offset;
+        } else {
+            if (op + 3 > oend) return false;
+            *op++ = (uint8_t)(((len - 1) << 2) | 2);
+            *op++ = (uint8_t)offset;
+            *op++ = (uint8_t)(offset >> 8);
+        }
+        return true;
+    };
+
+    if (srcLen > 15) {
+        ip++;
+        while (ip < limit) {
+            uint32_t h = lz4_hash(read32(ip));
+            const uint8_t* match = src + table[h];
+            table[h] = (uint32_t)(ip - src);
+            if (match < ip && (ip - match) <= 65535 &&
+                read32(match) == read32(ip)) {
+                const uint8_t* mi = match + 4;
+                const uint8_t* ii = ip + 4;
+                while (ii < iend && *ii == *mi) { ii++; mi++; }
+                int64_t matchLen = ii - ip;
+                if (!emit_literal(anchor, ip - anchor)) return -1;
+                if (!emit_copy(ip - match, matchLen)) return -1;
+                ip += matchLen;
+                anchor = ip;
+                if (ip < limit)
+                    table[lz4_hash(read32(ip - 1))] = (uint32_t)(ip - 1 - src);
+            } else {
+                ip++;
+            }
+        }
+    }
+    if (iend > anchor && !emit_literal(anchor, iend - anchor)) return -1;
+    return op - dst;
+}
+
+// returns decompressed length or -1
+int64_t snappy_decompress(const uint8_t* src, int64_t srcLen,
+                          uint8_t* dst, int64_t dstCap) {
+    const uint8_t* ip = src;
+    const uint8_t* iend = src + srcLen;
+    // uvarint
+    uint64_t expected = 0;
+    int shift = 0;
+    while (true) {
+        if (ip >= iend || shift > 63) return -1;
+        uint8_t b = *ip++;
+        expected |= (uint64_t)(b & 0x7F) << shift;
+        if (!(b & 0x80)) break;
+        shift += 7;
+    }
+    if ((int64_t)expected > dstCap) return -1;
+    uint8_t* op = dst;
+    uint8_t* oend = dst + dstCap;
+
+    while (ip < iend) {
+        uint8_t tag = *ip++;
+        if ((tag & 3) == 0) {
+            int64_t len = (tag >> 2) + 1;
+            if (len > 60) {
+                int nb = (int)len - 60;
+                if (ip + nb > iend) return -1;
+                len = 0;
+                for (int i = 0; i < nb; i++) len |= (int64_t)ip[i] << (8 * i);
+                len += 1;
+                ip += nb;
+            }
+            if (ip + len > iend || op + len > oend) return -1;
+            memcpy(op, ip, len);
+            ip += len;
+            op += len;
+        } else {
+            int64_t len, offset;
+            if ((tag & 3) == 1) {
+                if (ip >= iend) return -1;
+                len = 4 + ((tag >> 2) & 7);
+                offset = ((int64_t)(tag >> 5) << 8) | *ip++;
+            } else if ((tag & 3) == 2) {
+                if (ip + 2 > iend) return -1;
+                len = (tag >> 2) + 1;
+                offset = ip[0] | ((int64_t)ip[1] << 8);
+                ip += 2;
+            } else {
+                if (ip + 4 > iend) return -1;
+                len = (tag >> 2) + 1;
+                offset = ip[0] | ((int64_t)ip[1] << 8) |
+                         ((int64_t)ip[2] << 16) | ((int64_t)ip[3] << 24);
+                ip += 4;
+            }
+            if (offset == 0 || offset > op - dst || op + len > oend) return -1;
+            const uint8_t* match = op - offset;
+            for (int64_t i = 0; i < len; i++) op[i] = match[i];
+            op += len;
+        }
+    }
+    if ((uint64_t)(op - dst) != expected) return -1;
+    return op - dst;
+}
+
+// --------------------------------------------------------------- batch ----
+// Compress/decompress n chunks in one call. srcs/dsts are packed buffers;
+// offsets are n+1 prefix arrays. Per-chunk results (compressed sizes) land
+// in outSizes; returns 0 or -1 (first failure aborts).
+
+typedef int64_t (*codec_fn)(const uint8_t*, int64_t, uint8_t*, int64_t);
+
+static int64_t run_batch(codec_fn fn, const uint8_t* src,
+                         const int64_t* srcOffs, uint8_t* dst,
+                         const int64_t* dstOffs, int64_t* outSizes,
+                         int64_t n) {
+    for (int64_t i = 0; i < n; i++) {
+        int64_t r = fn(src + srcOffs[i], srcOffs[i + 1] - srcOffs[i],
+                       dst + dstOffs[i], dstOffs[i + 1] - dstOffs[i]);
+        if (r < 0) return -1;
+        outSizes[i] = r;
+    }
+    return 0;
+}
+
+int64_t lz4_compress_batch(const uint8_t* src, const int64_t* srcOffs,
+                           uint8_t* dst, const int64_t* dstOffs,
+                           int64_t* outSizes, int64_t n) {
+    return run_batch(lz4_compress, src, srcOffs, dst, dstOffs, outSizes, n);
+}
+
+int64_t lz4_decompress_batch(const uint8_t* src, const int64_t* srcOffs,
+                             uint8_t* dst, const int64_t* dstOffs,
+                             int64_t* outSizes, int64_t n) {
+    return run_batch(lz4_decompress, src, srcOffs, dst, dstOffs, outSizes, n);
+}
+
+int64_t snappy_compress_batch(const uint8_t* src, const int64_t* srcOffs,
+                              uint8_t* dst, const int64_t* dstOffs,
+                              int64_t* outSizes, int64_t n) {
+    return run_batch(snappy_compress, src, srcOffs, dst, dstOffs, outSizes, n);
+}
+
+int64_t snappy_decompress_batch(const uint8_t* src, const int64_t* srcOffs,
+                                uint8_t* dst, const int64_t* dstOffs,
+                                int64_t* outSizes, int64_t n) {
+    return run_batch(snappy_decompress, src, srcOffs, dst, dstOffs, outSizes, n);
+}
+
+}  // extern "C"

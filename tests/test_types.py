@@ -1,0 +1,145 @@
+"""Type system tests: round-trips, byte-comparable order properties,
+type-string parsing (reference spec: db/marshal/* comparison semantics)."""
+import random
+import uuid
+from datetime import date, datetime, timezone
+from decimal import Decimal
+
+import pytest
+
+from cassandra_tpu.types import (
+    parse_type, ListType, SetType, MapType, TupleType, VectorType,
+    TextType, Int32Type, LongType, DoubleType, DecimalType, IntegerType,
+    UUIDType, TimeUUIDType, BooleanType, InetAddressType, DurationType,
+    TimestampType, SimpleDateType, TimeType,
+)
+
+
+def order_check(t, values):
+    """byte-comparable order must match python value order."""
+    ser = [(v, t.serialize(v)) for v in values]
+    by_val = [v for v, _ in sorted(ser, key=lambda p: p[0])]
+    by_bc = [v for v, s in sorted(ser, key=lambda p: t.to_bytecomp(p[1]))]
+    assert by_val == by_bc
+
+
+def roundtrip(t, values):
+    for v in values:
+        assert t.deserialize(t.serialize(v)) == v, (t, v)
+
+
+def test_int_types():
+    rng = random.Random(1)
+    for t, lo, hi in [(Int32Type(), -2**31, 2**31 - 1),
+                      (LongType(), -2**63, 2**63 - 1)]:
+        vals = sorted({rng.randrange(lo, hi + 1) for _ in range(100)} | {lo, hi, 0, -1, 1})
+        roundtrip(t, vals)
+        order_check(t, vals)
+
+
+def test_text_blob():
+    t = TextType()
+    vals = ["", "a", "abc", "ü", "z" * 100, "é中"]
+    roundtrip(t, vals)
+    # utf-8 byte order
+    ser = sorted(vals, key=lambda v: t.serialize(v))
+    bc = sorted(vals, key=lambda v: t.to_bytecomp(t.serialize(v)))
+    assert ser == bc
+
+
+def test_double_order():
+    rng = random.Random(2)
+    vals = sorted({rng.uniform(-1e6, 1e6) for _ in range(100)} | {0.0, 1.5, -2.25, float("inf"), float("-inf")})
+    t = DoubleType()
+    roundtrip(t, vals)
+    order_check(t, vals)
+
+
+def test_decimal():
+    t = DecimalType()
+    vals = [Decimal("0"), Decimal("1.5"), Decimal("-1.5"), Decimal("100"),
+            Decimal("0.001"), Decimal("-0.001"), Decimal("123456.789"),
+            Decimal("-123456.789"), Decimal("1E+10"), Decimal("-1E+10"),
+            Decimal("9.99"), Decimal("10.01")]
+    roundtrip(t, vals)
+    order_check(t, sorted(set(vals)))
+
+
+def test_varint_type():
+    t = IntegerType()
+    vals = [0, 1, -1, 127, 128, -128, -129, 2**70, -2**70, 255, 256]
+    roundtrip(t, vals)
+    order_check(t, sorted(set(vals)))
+
+
+def test_timestamp_date_time():
+    ts = TimestampType()
+    d = datetime(2024, 5, 1, 12, 30, tzinfo=timezone.utc)
+    assert ts.deserialize(ts.serialize(d)) == d
+    sd = SimpleDateType()
+    assert sd.deserialize(sd.serialize(date(2024, 5, 1))) == date(2024, 5, 1)
+    order_check(sd, [date(1969, 1, 1), date(1970, 1, 1), date(2024, 5, 1)])
+    tt = TimeType()
+    roundtrip(tt, [0, 1, 86399999999999])
+    order_check(tt, [0, 1, 86399999999999])
+
+
+def test_uuid_types():
+    t = UUIDType()
+    u = uuid.uuid4()
+    assert t.deserialize(t.serialize(u)) == u
+    # v1 ordering by timestamp
+    tu = TimeUUIDType()
+    a = uuid.uuid1(clock_seq=5)
+    b = uuid.uuid1(clock_seq=3)
+    assert tu.to_bytecomp(tu.serialize(a)) < tu.to_bytecomp(tu.serialize(b)) or a.time <= b.time
+    with pytest.raises(ValueError):
+        tu.validate(uuid.uuid4().bytes)
+
+
+def test_inet_duration_boolean():
+    t = InetAddressType()
+    for addr in ["127.0.0.1", "10.0.0.1", "::1", "2001:db8::1"]:
+        assert t.deserialize(t.serialize(addr)) == addr
+    d = DurationType()
+    assert d.deserialize(d.serialize((1, 2, 3))) == (1, 2, 3)
+    assert d.deserialize(d.serialize((-1, -2, -3))) == (-1, -2, -3)
+    b = BooleanType()
+    assert b.deserialize(b.serialize(True)) is True
+    assert b.deserialize(b.serialize(False)) is False
+
+
+def test_collections():
+    lt = parse_type("list<int>")
+    assert lt.deserialize(lt.serialize([1, 2, 3])) == [1, 2, 3]
+    st = parse_type("set<text>")
+    assert st.deserialize(st.serialize({"b", "a"})) == {"a", "b"}
+    mt = parse_type("map<text, int>")
+    assert mt.deserialize(mt.serialize({"x": 1, "y": 2})) == {"x": 1, "y": 2}
+    # frozen list ordering: prefix rule
+    fl = parse_type("frozen<list<int>>")
+    a = fl.to_bytecomp(fl.serialize([1, 2]))
+    b = fl.to_bytecomp(fl.serialize([1, 2, 3]))
+    c = fl.to_bytecomp(fl.serialize([2]))
+    assert a < b < c
+
+
+def test_tuple_and_vector():
+    tt = parse_type("tuple<int, text>")
+    assert tt.deserialize(tt.serialize((1, "a"))) == (1, "a")
+    assert tt.deserialize(tt.serialize((None, "a"))) == (None, "a")
+    vt = parse_type("vector<float, 3>")
+    out = vt.deserialize(vt.serialize([1.0, 2.0, 3.0]))
+    assert out == [1.0, 2.0, 3.0]
+    with pytest.raises(ValueError):
+        vt.serialize([1.0])
+
+
+def test_parse_nested():
+    t = parse_type("map<text, frozen<list<int>>>")
+    v = {"a": [1, 2], "b": []}
+    assert t.deserialize(t.serialize(v)) == v
+    assert t.is_multicell
+    assert not parse_type("frozen<map<text, int>>").is_multicell
+    with pytest.raises(ValueError):
+        parse_type("wat")
