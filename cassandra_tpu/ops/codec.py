@@ -234,8 +234,13 @@ class CompressionParams:
 
     @classmethod
     def from_dict(cls, d: dict) -> "CompressionParams":
-        if not d or not d.get("enabled", True):
+        if not d:
             return cls("NoopCompressor", enabled=False)
-        return cls(d.get("class", "LZ4Compressor").rsplit(".", 1)[-1],
-                   int(d.get("chunk_length_in_kb", 16)) * 1024,
-                   float(d.get("min_compress_ratio", 0.0)))
+        p = cls(d.get("class", "LZ4Compressor").rsplit(".", 1)[-1],
+                int(d.get("chunk_length_in_kb", 16)) * 1024,
+                float(d.get("min_compress_ratio", 0.0)),
+                enabled=bool(d.get("enabled", True)))
+        return p
+
+    def compressor_or_noop(self) -> Compressor:
+        return self.compressor() if self.enabled else get_compressor("NoopCompressor")

@@ -1,0 +1,267 @@
+"""Device merge/reconcile kernel — the TPU form of the compaction pipeline.
+
+The reference merges k sorted SSTable scanners through a binary heap one row
+at a time (utils/MergeIterator.java:23, CompactionIterator.java:90). The
+TPU formulation: concatenate the runs' identity lanes, run ONE stable
+variadic sort (jax.lax.sort), then compute winners / deletion shadowing /
+purge as masks with segmented scans (lax.associative_scan). Everything is
+uint32 lanes — 64-bit quantities travel as (hi, lo) pairs and compare
+pairwise — so the kernel maps directly onto TPU vector units with no 64-bit
+emulation.
+
+Outputs are a permutation + keep mask; the host applies them to the
+variable-length payload with numpy gathers (storage/cellbatch.py). Value
+tie-breaks beyond the 4-byte prefix lane are flagged in an `ambiguous` mask
+for the host to resolve exactly (rare; Cells.reconcile full-value compare).
+
+Shapes are padded to buckets so jit traces once per bucket size, not per
+batch (XLA static-shape discipline).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..storage.cellbatch import (FLAG_EXPIRING, FLAG_PARTITION_DEL,
+                                 FLAG_ROW_DEL, FLAG_TOMBSTONE, CellBatch)
+from ..schema import COL_PARTITION_DEL, COL_ROW_DEL
+
+_U32_MAX = jnp.uint32(0xFFFFFFFF)
+
+
+def _le_pair(ah, al, bh, bl):
+    """(ah,al) <= (bh,bl) as unsigned 64-bit pairs."""
+    return (ah < bh) | ((ah == bh) & (al <= bl))
+
+
+def _lt_pair(ah, al, bh, bl):
+    return (ah < bh) | ((ah == bh) & (al < bl))
+
+
+def _seg_carry_pair(vh, vl, is_start):
+    """Forward-fill the (vh, vl) value from each segment start across the
+    segment: positions where is_start is True supply the value, others
+    inherit the most recent start's value."""
+
+    def combine(a, b):
+        ah, al, a_s = a
+        bh, bl, b_s = b
+        h = jnp.where(b_s, bh, ah)
+        l = jnp.where(b_s, bl, al)
+        return h, l, a_s | b_s
+
+    h, l, _ = jax.lax.associative_scan(combine, (vh, vl, is_start))
+    return h, l
+
+
+@jax.jit
+def merge_reconcile_kernel(operands):
+    """Core kernel. `operands` is a dict of arrays, all length N (padded):
+      lanes:   uint32 [N, K]  identity lanes (column lane at K-3)
+      valid:   uint32 [N]     0 for real cells, 1 for padding
+      ts_h/ts_l: uint32       biased write timestamp (desc tie-break + shadow)
+      death:   uint32         1 if record is any kind of deletion
+      vp:      uint32         4-byte value prefix (tie-break)
+      ldt:     int32          local deletion / expiry seconds
+      expiring: uint32        1 if cell has TTL
+      purge_h/purge_l: uint32 biased per-cell max-purgeable timestamp
+      gc_before, now: int32 scalars
+    Returns (perm, keep, ambiguous) — all length N.
+    """
+    lanes = operands["lanes"]
+    N, K = lanes.shape
+    ts_h, ts_l = operands["ts_h"], operands["ts_l"]
+    death = operands["death"]
+    vp = operands["vp"]
+
+    # ---- 1. one big stable sort ------------------------------------------
+    keys = [operands["valid"]]
+    keys += [lanes[:, k] for k in range(K)]
+    keys += [_U32_MAX - ts_h, _U32_MAX - ts_l,        # ts desc
+             jnp.uint32(1) - death,                   # tombstone first
+             _U32_MAX - vp]                           # larger value first
+    idx = jnp.arange(N, dtype=jnp.uint32)
+    out = jax.lax.sort(tuple(keys) + (idx,), num_keys=len(keys),
+                       is_stable=True)
+    perm = out[-1].astype(jnp.int32)
+
+    g = lambda a: a[perm]
+    lanes = lanes[perm]
+    ts_h, ts_l = g(ts_h), g(ts_l)
+    death, vp = g(death), g(vp)
+    valid = g(operands["valid"]) == 0
+    ldt = g(operands["ldt"])
+    expiring = g(operands["expiring"]) == 1
+    purge_h, purge_l = g(operands["purge_h"]), g(operands["purge_l"])
+
+    # ---- 2. boundaries ----------------------------------------------------
+    prev = jnp.concatenate([jnp.full((1, K), 0xFFFFFFFF, dtype=jnp.uint32),
+                            lanes[:-1]], axis=0)
+    diff = lanes != prev
+    first = jnp.zeros(N, dtype=bool).at[0].set(True)
+    part_new = first | diff[:, :4].any(axis=1)
+    row_new = part_new | diff[:, 4:K - 3].any(axis=1)
+    cell_new = row_new | diff[:, K - 3:].any(axis=1)
+
+    col = lanes[:, K - 3]
+    winner = cell_new & valid
+
+    # ---- 3. deletion shadowing -------------------------------------------
+    is_pd = col == COL_PARTITION_DEL
+    is_rd = col == COL_ROW_DEL
+    zero = jnp.uint32(0)
+    # partition deletions sort first in their partition; the partition-start
+    # record is the pd winner when one exists
+    pd_h = jnp.where(part_new & is_pd, ts_h, zero)
+    pd_l = jnp.where(part_new & is_pd, ts_l, zero)
+    pd_h, pd_l = _seg_carry_pair(pd_h, pd_l, part_new)
+    # row deletions sort first in their row
+    rd_h = jnp.where(row_new & is_rd, ts_h, zero)
+    rd_l = jnp.where(row_new & is_rd, ts_l, zero)
+    rd_h, rd_l = _seg_carry_pair(rd_h, rd_l, row_new)
+    # effective deletion over a plain cell = max(pd, rd)
+    use_pd = _lt_pair(rd_h, rd_l, pd_h, pd_l)
+    del_h = jnp.where(use_pd, pd_h, rd_h)
+    del_l = jnp.where(use_pd, pd_l, rd_l)
+
+    plain = ~is_pd & ~is_rd
+    shadowed = jnp.where(
+        plain, _le_pair(ts_h, ts_l, del_h, del_l),
+        jnp.where(is_rd, _le_pair(ts_h, ts_l, pd_h, pd_l), False))
+
+    # ---- 4. TTL expiry + purge -------------------------------------------
+    now = operands["now"]
+    gc_before = operands["gc_before"]
+    expired = expiring & (ldt <= now)
+    death_eff = (death == 1) | expired
+    purgeable = _lt_pair(ts_h, ts_l, purge_h, purge_l)
+    purged = death_eff & (ldt < gc_before) & purgeable
+
+    keep = winner & ~shadowed & ~purged
+
+    # ---- 5. ambiguous value ties (host resolves with full bytes) ---------
+    same_meta = (~cell_new) & (ts_h == prev_eq(ts_h)) & (ts_l == prev_eq(ts_l)) \
+        & (death == prev_eq(death)) & (vp == prev_eq(vp))
+    ambiguous = same_meta & valid
+    return perm, keep, ambiguous, expired, shadowed
+
+
+def prev_eq(a):
+    """a shifted by one (first element compares unequal)."""
+    return jnp.concatenate([jnp.full((1,), ~a[0], dtype=a.dtype), a[:-1]])
+
+
+# ----------------------------------------------------------------- wrapper --
+
+def _bucket(n: int) -> int:
+    """Pad to power-of-two buckets >= 1024 so jit compiles once per bucket."""
+    b = 1024
+    while b < n:
+        b <<= 1
+    return b
+
+
+def merge_sorted_device(batches: list[CellBatch], gc_before: int = 0,
+                        now: int = 0, purgeable_ts_fn=None) -> CellBatch:
+    """Drop-in equivalent of storage.cellbatch.merge_sorted running the
+    sort/reconcile on the default JAX device."""
+    cat = CellBatch.concat(batches)
+    n = len(cat)
+    if n == 0:
+        return cat
+    N = _bucket(n)
+    K = cat.n_lanes
+
+    lanes = np.full((N, K), 0xFFFFFFFF, dtype=np.uint32)
+    lanes[:n] = cat.lanes
+    valid = np.ones(N, dtype=np.uint32)
+    valid[:n] = 0
+    with np.errstate(over="ignore"):
+        uts = cat.ts.astype(np.uint64) ^ np.uint64(1 << 63)
+    ts_h = np.zeros(N, dtype=np.uint32)
+    ts_l = np.zeros(N, dtype=np.uint32)
+    ts_h[:n] = (uts >> np.uint64(32)).astype(np.uint32)
+    ts_l[:n] = (uts & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    death = np.zeros(N, dtype=np.uint32)
+    death[:n] = (cat.flags & (FLAG_TOMBSTONE | FLAG_PARTITION_DEL
+                              | FLAG_ROW_DEL)) != 0
+    vp = np.zeros(N, dtype=np.uint32)
+    vp[:n] = cat._value_prefix_lane()
+    ldt = np.zeros(N, dtype=np.int32)
+    ldt[:n] = cat.ldt
+    expiring = np.zeros(N, dtype=np.uint32)
+    expiring[:n] = (cat.flags & FLAG_EXPIRING) != 0
+
+    if purgeable_ts_fn is not None:
+        pts = purgeable_ts_fn(cat).astype(np.int64)
+        with np.errstate(over="ignore"):
+            upts = pts.astype(np.uint64) ^ np.uint64(1 << 63)
+        purge_h = np.zeros(N, dtype=np.uint32)
+        purge_l = np.zeros(N, dtype=np.uint32)
+        purge_h[:n] = (upts >> np.uint64(32)).astype(np.uint32)
+        purge_l[:n] = (upts & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    else:
+        purge_h = np.full(N, 0xFFFFFFFF, dtype=np.uint32)
+        purge_l = np.full(N, 0xFFFFFFFF, dtype=np.uint32)
+
+    operands = {
+        "lanes": jnp.asarray(lanes), "valid": jnp.asarray(valid),
+        "ts_h": jnp.asarray(ts_h), "ts_l": jnp.asarray(ts_l),
+        "death": jnp.asarray(death), "vp": jnp.asarray(vp),
+        "ldt": jnp.asarray(ldt), "expiring": jnp.asarray(expiring),
+        "purge_h": jnp.asarray(purge_h), "purge_l": jnp.asarray(purge_l),
+        "gc_before": jnp.int32(gc_before), "now": jnp.int32(now),
+    }
+    perm, keep, ambiguous, expired, shadowed = merge_reconcile_kernel(operands)
+    perm = np.asarray(perm)
+    keep = np.array(keep)          # writable copy: host fix-up mutates it
+    ambiguous = np.asarray(ambiguous)
+    expired = np.asarray(expired)
+    shadowed = np.asarray(shadowed)
+
+    # strip padding; padded entries sort last (valid is the primary key)
+    perm_real = perm[:n]
+    s = cat.apply_permutation(perm_real)
+    keep = keep[:n]
+    expired = expired[:n]
+    # expired-TTL conversion (mirrors numpy reconcile step 2)
+    s.flags[expired] |= FLAG_TOMBSTONE
+
+    # host-exact value tie-break (device flagged the candidate runs);
+    # mirrors the numpy path: winner moves to the largest full value, then
+    # shadow/purge apply at the new winner (ts/death equal across the run,
+    # so only the ldt-dependent purge needs re-evaluation)
+    amb = ambiguous[:n]
+    if amb.any():
+        if purgeable_ts_fn is not None:
+            pts_sorted = purgeable_ts_fn(cat).astype(np.int64)[perm_real]
+        else:
+            pts_sorted = None
+        death_s = ((s.flags & (FLAG_TOMBSTONE | FLAG_PARTITION_DEL
+                               | FLAG_ROW_DEL)) != 0)
+        shadow_n = shadowed[:n]
+        idxs = np.flatnonzero(amb)
+        prev_i = -2
+        runs = []
+        for i in idxs:
+            if i != prev_i + 1:
+                runs.append([i - 1, i])
+            else:
+                runs[-1][1] = i
+            prev_i = i
+        _, _, cell_new = s.boundaries()
+        for lo, hi in runs:
+            if not cell_new[lo]:
+                continue  # run of older duplicates below the winner
+            best = max(range(lo, hi + 1), key=s.cell_value)
+            keep[lo:hi + 1] = False
+            purgeable = pts_sorted is None or s.ts[best] < pts_sorted[best]
+            purged = bool(death_s[best]) and s.ldt[best] < gc_before \
+                and purgeable
+            keep[best] = not (shadow_n[best] or purged)
+    out = s.apply_permutation(np.flatnonzero(keep))
+    out.sorted = True
+    return out

@@ -8,6 +8,7 @@ from __future__ import annotations
 import ctypes
 import hashlib
 import os
+import platform
 import subprocess
 import threading
 
@@ -21,16 +22,22 @@ _lib = None
 
 def _src_hash() -> str:
     with open(_SRC, "rb") as f:
-        return hashlib.sha256(f.read()).hexdigest()
+        src = f.read()
+    # stamp covers source AND host (a -march=native binary from a different
+    # CPU must never be loaded: SIGILL)
+    host = f"{platform.machine()}|{platform.processor()}|{platform.node()}"
+    return hashlib.sha256(src + host.encode()).hexdigest()
 
 
 def _build(h: str) -> None:
+    tmp = f"{_SO}.tmp.{os.getpid()}"  # unique per process: no build races
     cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-           "-o", _SO + ".tmp", _SRC]
+           "-o", tmp, _SRC]
     subprocess.run(cmd, check=True, capture_output=True)
-    os.replace(_SO + ".tmp", _SO)
-    with open(_STAMP, "w") as f:
+    os.replace(tmp, _SO)
+    with open(_STAMP + f".{os.getpid()}", "w") as f:
         f.write(h)
+    os.replace(_STAMP + f".{os.getpid()}", _STAMP)
 
 
 def _stale(h: str) -> bool:

@@ -1,0 +1,471 @@
+"""CellBatch — the columnar cell representation the whole data plane runs on.
+
+This replaces the reference's pull-based row iterators (db/rows/*,
+utils/MergeIterator.java) with sorted fixed-width arrays: a batch of N cells
+is K uint32 *identity lanes* plus metadata lanes plus a variable-length
+payload blob. Lexicographic order over the identity lanes equals storage
+order, so k-way merge + reconcile becomes: concatenate runs -> stable sort
+-> segmented scans -> boolean keep mask. That formulation runs unchanged on
+numpy (host reference implementation, this module) and on TPU via
+jax.lax.sort + masks (ops/merge.py).
+
+Identity lanes (uint32, big-endian packing), K = 9 + C:
+  0  token_hi      biased partition token (token + 2^63)
+  1  token_lo
+  2  pkh_hi        murmur3 h2 of the partition key (disambiguates token
+  3  pkh_lo        collisions; full pk bytes kept per partition)
+  4..4+C-1        clustering prefix: first 4*C bytes of the byte-comparable
+                   clustering composite (C = table clustering_prefix_bytes/4)
+  4+C  ckh_hi      murmur3 h1 of the FULL clustering composite — exactness
+  5+C  ckh_lo      guard when the prefix truncates
+  6+C  column      sentinels: 0 partition-deletion, 1 row-deletion,
+                   2 row-liveness; real columns from 8 (schema.py)
+  7+C  path_prefix first 4 bytes of the multicell path (collections)
+  8+C  path_hash   murmur3 h1 low 32 of the path
+
+Merge tie-break lanes (computed at sort time, not identity):
+  ~ts (descending), ~death-rank (tombstone beats live at equal ts),
+  ~value-prefix (larger value wins at equal ts; Cells.reconcile semantics,
+  reference db/rows/Cells.java:68).
+
+Reconcile semantics mirrored from the reference:
+  - newest timestamp wins per cell (Cells.reconcile)
+  - deletions shadow anything with ts <= deletion ts
+    (DeletionTime.deletes, db/DeletionTime.java)
+  - expired TTL cells become tombstones (AbstractCell.purge path)
+  - tombstones older than gcBefore whose ts is below the partition's
+    max-purgeable timestamp are dropped (CompactionIterator.Purger /
+    PurgeFunction, db/partitions/PurgeFunction.java)
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..schema import (COL_PARTITION_DEL, COL_REGULAR_BASE, COL_ROW_DEL,
+                      COL_ROW_LIVENESS, TableMetadata)
+from ..utils import murmur3
+from ..utils.timeutil import NO_DELETION_TIME, NO_TIMESTAMP
+from ..utils import varint as vi
+
+# flags
+FLAG_TOMBSTONE = 1       # cell-level deletion
+FLAG_EXPIRING = 2        # has TTL
+FLAG_PARTITION_DEL = 4
+FLAG_ROW_DEL = 8
+FLAG_ROW_LIVENESS = 16
+FLAG_RANGE_START = 32    # reserved: range tombstone bound
+FLAG_RANGE_END = 64
+
+_BIAS = 1 << 63
+_U32 = 0xFFFFFFFF
+
+
+def lanes_for_table(table: TableMetadata) -> int:
+    return 9 + table.clustering_lanes
+
+
+def _pack_prefix(data: bytes, nlanes: int) -> list[int]:
+    """Big-endian pack of the first 4*nlanes bytes, zero-padded."""
+    padded = data[: 4 * nlanes].ljust(4 * nlanes, b"\x00")
+    return [int.from_bytes(padded[4 * i: 4 * i + 4], "big")
+            for i in range(nlanes)]
+
+
+@dataclass
+class CellBatch:
+    """A (possibly sorted) batch of cells for one table."""
+    lanes: np.ndarray          # uint32 [N, K]
+    ts: np.ndarray             # int64 [N]
+    ldt: np.ndarray            # int32 [N]  local deletion / expiry seconds
+    ttl: np.ndarray            # int32 [N]
+    flags: np.ndarray          # uint8 [N]
+    off: np.ndarray            # int64 [N+1] frame offsets into payload
+    val_start: np.ndarray      # int64 [N] where the value begins in payload
+    payload: np.ndarray        # uint8 blob: per cell [vint ck_len][ck]
+                               #   [vint path_len][path][value...]
+    pk_map: dict[bytes, bytes] = field(default_factory=dict)
+    # maps the 16-byte (token,pkh) lane prefix -> full partition key bytes
+    sorted: bool = False
+
+    def __len__(self) -> int:
+        return len(self.ts)
+
+    @property
+    def n_lanes(self) -> int:
+        return self.lanes.shape[1]
+
+    # ---------------------------------------------------------- payload ---
+
+    def cell_payload(self, i: int) -> tuple[bytes, bytes, bytes]:
+        """(clustering bytes, path bytes, value bytes) of cell i."""
+        raw = self.payload[self.off[i]:self.off[i + 1]].tobytes()
+        ck_len, pos = vi.read_unsigned_vint(raw, 0)
+        ck = raw[pos:pos + ck_len]
+        pos += ck_len
+        p_len, pos = vi.read_unsigned_vint(raw, pos)
+        path = raw[pos:pos + p_len]
+        pos += p_len
+        return ck, path, raw[pos:]
+
+    def cell_value(self, i: int) -> bytes:
+        return self.payload[self.val_start[i]:self.off[i + 1]].tobytes()
+
+    def partition_key(self, i: int) -> bytes:
+        return self.pk_map[self.lanes[i, :4].astype(">u4").tobytes()]
+
+    # ------------------------------------------------------------- sort ---
+
+    def sort_permutation(self) -> np.ndarray:
+        """Stable sort order: identity lanes asc, then ts desc, death desc,
+        value-prefix desc (newest-wins reconcile order)."""
+        # np.lexsort: LAST key is the primary -> least-significant first
+        keys = [_U32 - self._value_prefix_lane(),            # value desc
+                np.uint8(1) - self._death_lane()]            # death desc
+        with np.errstate(over="ignore"):
+            # two's-complement reinterpret + sign-bit flip = biased unsigned
+            uts = self.ts.astype(np.uint64) ^ np.uint64(_BIAS)
+            keys.append(np.iinfo(np.uint64).max - uts)       # ts desc
+        for k in range(self.n_lanes - 1, -1, -1):
+            keys.append(self.lanes[:, k])
+        return np.lexsort(keys)
+
+    def _death_lane(self) -> np.ndarray:
+        return ((self.flags & (FLAG_TOMBSTONE | FLAG_PARTITION_DEL
+                               | FLAG_ROW_DEL)) != 0).astype(np.uint8)
+
+    def _value_prefix_lane(self) -> np.ndarray:
+        """First 4 bytes of each value, big-endian, zero-padded
+        (vectorised gather; bytes past the cell's end read as 0)."""
+        n = len(self)
+        if n == 0:
+            return np.zeros(0, dtype=np.uint32)
+        pay = self.payload
+        idx = self.val_start[:, None] + np.arange(4)[None, :]
+        valid = idx < self.off[1:, None]
+        idx = np.minimum(idx, max(len(pay) - 1, 0))
+        b = np.where(valid, pay[idx], 0).astype(np.uint32)
+        return (b[:, 0] << 24) | (b[:, 1] << 16) | (b[:, 2] << 8) | b[:, 3]
+
+    def apply_permutation(self, perm: np.ndarray) -> "CellBatch":
+        perm = np.asarray(perm, dtype=np.int64)
+        n = len(perm)
+        starts = self.off[:-1][perm]
+        lens = (self.off[1:] - self.off[:-1])[perm]
+        new_off = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(lens, out=new_off[1:])
+        total = int(new_off[-1])
+        # vectorised ragged gather of payload frames
+        if total:
+            pos_in_cell = np.arange(total, dtype=np.int64) - \
+                np.repeat(new_off[:-1], lens)
+            flat_idx = np.repeat(starts, lens) + pos_in_cell
+            new_payload = self.payload[flat_idx]
+        else:
+            new_payload = np.zeros(0, dtype=np.uint8)
+        new_val_start = new_off[:-1] + (self.val_start - self.off[:-1])[perm]
+        return CellBatch(self.lanes[perm], self.ts[perm], self.ldt[perm],
+                         self.ttl[perm], self.flags[perm], new_off,
+                         new_val_start, new_payload, dict(self.pk_map),
+                         sorted=True)
+
+    # ------------------------------------------------------------ concat --
+
+    @staticmethod
+    def concat(batches: list["CellBatch"]) -> "CellBatch":
+        K = batches[0].n_lanes if batches else 13
+        batches = [b for b in batches if len(b)]
+        if not batches:
+            return CellBatch.empty(K)
+        assert all(b.n_lanes == K for b in batches)
+        lanes = np.concatenate([b.lanes for b in batches])
+        ts = np.concatenate([b.ts for b in batches])
+        ldt = np.concatenate([b.ldt for b in batches])
+        ttl = np.concatenate([b.ttl for b in batches])
+        flags = np.concatenate([b.flags for b in batches])
+        payload = np.concatenate([b.payload for b in batches])
+        offs = [np.zeros(1, dtype=np.int64)]
+        vstarts = []
+        base = 0
+        for b in batches:
+            offs.append(b.off[1:] + base)
+            vstarts.append(b.val_start + base)
+            base += int(b.off[-1])
+        off = np.concatenate(offs)
+        val_start = np.concatenate(vstarts)
+        pk_map: dict[bytes, bytes] = {}
+        for b in batches:
+            for k, v in b.pk_map.items():
+                prev = pk_map.get(k)
+                if prev is not None and prev != v:
+                    raise RuntimeError("128-bit partition-key hash collision")
+                pk_map[k] = v
+        return CellBatch(lanes, ts, ldt, ttl, flags, off, val_start, payload,
+                         pk_map, sorted=False)
+
+    @staticmethod
+    def empty(n_lanes: int = 13) -> "CellBatch":
+        return CellBatch(np.zeros((0, n_lanes), dtype=np.uint32),
+                         np.zeros(0, dtype=np.int64),
+                         np.zeros(0, dtype=np.int32),
+                         np.zeros(0, dtype=np.int32),
+                         np.zeros(0, dtype=np.uint8),
+                         np.zeros(1, dtype=np.int64),
+                         np.zeros(0, dtype=np.int64),
+                         np.zeros(0, dtype=np.uint8), {}, sorted=True)
+
+    # --------------------------------------------------------- reconcile --
+
+    def boundaries(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(part_new, row_new, cell_new) boolean arrays; batch must be
+        sorted. row identity = partition + clustering lanes (incl. full-ck
+        hash); cell identity = row + column + path lanes."""
+        assert self.sorted
+        n = len(self)
+        if n == 0:
+            z = np.zeros(0, dtype=bool)
+            return z, z, z
+        K = self.n_lanes
+        C = K - 9
+        diff = self.lanes[1:] != self.lanes[:-1]
+        part_new = np.ones(n, dtype=bool)
+        part_new[1:] = diff[:, :4].any(axis=1)
+        row_new = part_new.copy()
+        row_new[1:] |= diff[:, 4:6 + C].any(axis=1)
+        cell_new = row_new.copy()
+        cell_new[1:] |= diff[:, 6 + C:].any(axis=1)
+        return part_new, row_new, cell_new
+
+    def reconcile(self, gc_before: int = 0, now: int = 0,
+                  purgeable_ts: np.ndarray | None = None) -> np.ndarray:
+        """Compute the keep mask over a SORTED batch.
+
+        gc_before: seconds; tombstones with ldt < gc_before are candidates
+        for purging. now: seconds, for TTL expiry. purgeable_ts: per-cell
+        int64 — a tombstone is only dropped if its ts < purgeable_ts[i]
+        (the min timestamp any overlapping non-compacting source could
+        contain for that partition; +inf when no overlap). Returns keep
+        mask; also rewrites flags/ldt in place for expired cells
+        (TTL -> tombstone conversion)."""
+        n = len(self)
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        part_new, row_new, cell_new = self.boundaries()
+        K = self.n_lanes
+        C = K - 9
+        col = self.lanes[:, 6 + C]
+
+        # 1. newest-version-wins: the first record of each cell run
+        winner = cell_new.copy()
+
+        # 1b. exact value tie-break: the sort separates equal-(identity, ts,
+        # death) records only by a 4-byte value prefix; when full values
+        # differ beyond it, pick the lexicographically largest value
+        # (Cells.reconcile compares whole values). Host fix-up, rare.
+        vp = self._value_prefix_lane()
+        death = self._death_lane()
+        tie = np.zeros(n, dtype=bool)
+        if n > 1:
+            tie[1:] = (~cell_new[1:]) & (self.ts[1:] == self.ts[:-1]) & \
+                (death[1:] == death[:-1]) & (vp[1:] == vp[:-1])
+        if tie.any():
+            idxs = np.flatnonzero(tie)
+            run_start = None
+            prev = -2
+            runs = []
+            for i in idxs:
+                if i != prev + 1:
+                    runs.append([i - 1, i])
+                else:
+                    runs[-1][1] = i
+                prev = i
+            for lo, hi in runs:
+                if not cell_new[lo]:
+                    # the tie run sits below the cell's winner (older
+                    # duplicates) — losers stay losers
+                    continue
+                best = max(range(lo, hi + 1), key=self.cell_value)
+                if best != lo:
+                    winner[lo] = False
+                    winner[best] = True
+
+        # 2. TTL expiry: expired cells act as tombstones from `now` on
+        expired = ((self.flags & FLAG_EXPIRING) != 0) & (self.ldt <= now)
+        self.flags[expired] |= FLAG_TOMBSTONE
+
+        # 3. deletion shadowing
+        part_id = np.cumsum(part_new) - 1
+        row_id = np.cumsum(row_new) - 1
+        n_part = int(part_id[-1]) + 1
+        n_row = int(row_id[-1]) + 1
+        pd_ts = np.full(n_part, NO_TIMESTAMP, dtype=np.int64)
+        pd_lead = winner & (col == COL_PARTITION_DEL)
+        pd_ts[part_id[pd_lead]] = self.ts[pd_lead]
+        rd_ts = np.full(n_row, NO_TIMESTAMP, dtype=np.int64)
+        rd_lead = winner & (col == COL_ROW_DEL)
+        rd_ts[row_id[rd_lead]] = self.ts[rd_lead]
+
+        pd_of = pd_ts[part_id]
+        rd_of = np.maximum(rd_ts[row_id], pd_of)
+        is_pd = col == COL_PARTITION_DEL
+        is_rd = col == COL_ROW_DEL
+        shadowed = np.zeros(n, dtype=bool)
+        # cells and liveness: deleted if ts <= enclosing deletion ts
+        plain = ~is_pd & ~is_rd
+        shadowed[plain] = self.ts[plain] <= rd_of[plain]
+        # row deletions superseded by the partition deletion
+        shadowed[is_rd] = self.ts[is_rd] <= pd_of[is_rd]
+
+        # 4. purge gc-able tombstones (incl. expired-TTL converted ones)
+        death = ((self.flags & (FLAG_TOMBSTONE | FLAG_PARTITION_DEL
+                                | FLAG_ROW_DEL)) != 0)
+        if purgeable_ts is None:
+            purgeable = np.ones(n, dtype=bool)
+        else:
+            purgeable = self.ts < purgeable_ts
+        purged = death & (self.ldt < gc_before) & purgeable
+
+        return winner & ~shadowed & ~purged
+
+
+class CellBatchBuilder:
+    """Append-oriented builder used by the memtable and by decoders.
+    Appends are O(1) python-level; `seal()` produces numpy arrays."""
+
+    def __init__(self, table: TableMetadata):
+        self.table = table
+        self.C = table.clustering_lanes
+        self.K = lanes_for_table(table)
+        self._lanes: list[tuple] = []
+        self._ts: list[int] = []
+        self._ldt: list[int] = []
+        self._ttl: list[int] = []
+        self._flags: list[int] = []
+        self._payload = bytearray()
+        self._value_off: list[int] = [0]
+        self._val_start: list[int] = []
+        self.pk_map: dict[bytes, bytes] = {}
+
+    def __len__(self):
+        return len(self._ts)
+
+    # ------------------------------------------------------------ low level
+
+    def _pk_lanes(self, pk: bytes) -> tuple:
+        token = murmur3.token_of(pk)
+        _, h2 = murmur3.hash128(pk)
+        t = token + _BIAS
+        lanes = (t >> 32, t & _U32, h2 >> 32, h2 & _U32)
+        key16 = b"".join(int(x).to_bytes(4, "big") for x in lanes)
+        existing = self.pk_map.get(key16)
+        if existing is None:
+            self.pk_map[key16] = pk
+        elif existing != pk:
+            raise RuntimeError("128-bit partition-key hash collision")
+        return lanes
+
+    def _ck_lanes(self, ck: bytes) -> tuple:
+        pref = _pack_prefix(ck, self.C)
+        if ck:
+            h1, _ = murmur3.hash128(ck)
+        else:
+            h1 = 0
+        return (*pref, h1 >> 32, h1 & _U32)
+
+    def _path_lanes(self, path: bytes) -> tuple:
+        if not path:
+            return (0, 0)
+        pp = int.from_bytes(path[:4].ljust(4, b"\x00"), "big")
+        h1, _ = murmur3.hash128(path)
+        return (pp, h1 & _U32)
+
+    def append_raw(self, pk: bytes, ck: bytes, column: int, path: bytes,
+                   value: bytes, ts: int, ldt: int = NO_DELETION_TIME,
+                   ttl: int = 0, flags: int = 0) -> None:
+        lanes = (*self._pk_lanes(pk), *self._ck_lanes(ck), column,
+                 *self._path_lanes(path))
+        assert len(lanes) == self.K
+        self._lanes.append(lanes)
+        self._ts.append(ts)
+        self._ldt.append(ldt)
+        self._ttl.append(ttl)
+        self._flags.append(flags)
+        frame = bytearray()
+        vi.write_unsigned_vint(len(ck), frame)
+        frame += ck
+        vi.write_unsigned_vint(len(path), frame)
+        frame += path
+        self._val_start.append(len(self._payload) + len(frame))
+        frame += value
+        self._payload += frame
+        self._value_off.append(len(self._payload))
+
+    # ----------------------------------------------------------- high level
+
+    def add_cell(self, pk: bytes, ck: bytes, column_id: int, value: bytes,
+                 ts: int, ttl: int = 0, now: int = 0, path: bytes = b"") -> None:
+        if ttl > 0:
+            self.append_raw(pk, ck, column_id, path, value, ts,
+                            ldt=now + ttl, ttl=ttl, flags=FLAG_EXPIRING)
+        else:
+            self.append_raw(pk, ck, column_id, path, value, ts)
+
+    def add_tombstone(self, pk: bytes, ck: bytes, column_id: int, ts: int,
+                      ldt: int, path: bytes = b"") -> None:
+        self.append_raw(pk, ck, column_id, path, b"", ts, ldt=ldt,
+                        flags=FLAG_TOMBSTONE)
+
+    def add_row_liveness(self, pk: bytes, ck: bytes, ts: int,
+                         ttl: int = 0, now: int = 0) -> None:
+        if ttl > 0:
+            self.append_raw(pk, ck, COL_ROW_LIVENESS, b"", b"", ts,
+                            ldt=now + ttl, ttl=ttl,
+                            flags=FLAG_ROW_LIVENESS | FLAG_EXPIRING)
+        else:
+            self.append_raw(pk, ck, COL_ROW_LIVENESS, b"", b"", ts,
+                            flags=FLAG_ROW_LIVENESS)
+
+    def add_row_deletion(self, pk: bytes, ck: bytes, ts: int, ldt: int) -> None:
+        self.append_raw(pk, ck, COL_ROW_DEL, b"", b"", ts, ldt=ldt,
+                        flags=FLAG_ROW_DEL)
+
+    def add_partition_deletion(self, pk: bytes, ts: int, ldt: int) -> None:
+        self.append_raw(pk, b"", COL_PARTITION_DEL, b"", b"", ts, ldt=ldt,
+                        flags=FLAG_PARTITION_DEL)
+
+    # --------------------------------------------------------------- seal --
+
+    def seal(self) -> CellBatch:
+        n = len(self._ts)
+        lanes = np.array(self._lanes, dtype=np.uint32).reshape(n, self.K)
+        return CellBatch(
+            lanes,
+            np.array(self._ts, dtype=np.int64),
+            np.array(self._ldt, dtype=np.int32),
+            np.array(self._ttl, dtype=np.int32),
+            np.array(self._flags, dtype=np.uint8),
+            np.array(self._value_off, dtype=np.int64),
+            np.array(self._val_start, dtype=np.int64),
+            np.frombuffer(bytes(self._payload), dtype=np.uint8).copy(),
+            dict(self.pk_map))
+
+
+def merge_sorted(batches: list[CellBatch], gc_before: int = 0, now: int = 0,
+                 purgeable_ts_fn=None) -> CellBatch:
+    """Host (numpy) reference merge: concat -> sort -> reconcile -> compact.
+    The device path (ops/merge.py) must produce identical results."""
+    cat = CellBatch.concat(batches)
+    if len(cat) == 0:
+        return cat
+    perm = cat.sort_permutation()
+    s = cat.apply_permutation(perm)
+    if purgeable_ts_fn is not None:
+        purgeable_ts = purgeable_ts_fn(s)
+    else:
+        purgeable_ts = None
+    keep = s.reconcile(gc_before=gc_before, now=now, purgeable_ts=purgeable_ts)
+    out = s.apply_permutation(np.flatnonzero(keep))
+    out.sorted = True
+    # expired-TTL cells were converted to tombstones: drop their values
+    return out

@@ -18,10 +18,11 @@ import ipaddress
 import socket
 import struct
 import uuid as uuid_mod
-from datetime import date, datetime, timezone
+from datetime import date, datetime, timedelta, timezone
 from decimal import Decimal
 
 from ..utils import bytecomp
+from ..utils import varint as vi
 
 _EPOCH_DATE_BIAS = 1 << 31  # SimpleDateType: unsigned days with 2^31 = 1970-01-01
 
@@ -177,7 +178,7 @@ class SimpleDateType(CQLType):
 
     def deserialize(self, data: bytes):
         days = int.from_bytes(data, "big") - _EPOCH_DATE_BIAS
-        return date(1970, 1, 1) + __import__("datetime").timedelta(days=days)
+        return date(1970, 1, 1) + timedelta(days=days)
 
     def to_bytecomp(self, data: bytes) -> bytes:
         return data  # already unsigned big-endian
@@ -344,7 +345,6 @@ class DurationType(CQLType):
 
     def serialize(self, value) -> bytes:
         months, days, nanos = value
-        from ..utils import varint as vi
         out = bytearray()
         vi.write_signed_vint(months, out)
         vi.write_signed_vint(days, out)
@@ -352,7 +352,6 @@ class DurationType(CQLType):
         return bytes(out)
 
     def deserialize(self, data: bytes):
-        from ..utils import varint as vi
         months, pos = vi.read_signed_vint(data, 0)
         days, pos = vi.read_signed_vint(data, pos)
         nanos, _ = vi.read_signed_vint(data, pos)
@@ -369,6 +368,8 @@ class EmptyType(CQLType):
         return b""
 
     def deserialize(self, data: bytes):
+        if data:
+            raise ValueError("empty type must have zero-length value")
         return None
 
 
@@ -473,8 +474,10 @@ class MapType(CQLType):
         return MapType(self.key, self.val, frozen=True)
 
     def serialize(self, value) -> bytes:
-        items = sorted((self.key.serialize(k), self.val.serialize(v))
-                       for k, v in value.items())
+        # comparator (byte-comparable) key order, like SetType
+        items = sorted(((self.key.serialize(k), self.val.serialize(v))
+                        for k, v in value.items()),
+                       key=lambda kv: self.key.to_bytecomp(kv[0]))
         out = bytearray(struct.pack(">i", len(items)))
         for k, v in items:
             out += struct.pack(">i", len(k)) + k

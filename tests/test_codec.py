@@ -104,7 +104,12 @@ def test_compression_params():
     assert p2.chunk_length == p.chunk_length
     with pytest.raises(ValueError):
         codec.CompressionParams(chunk_length=1000)
-    disabled = codec.CompressionParams.from_dict({"enabled": False})
-    assert disabled.compressor().name == "NoopCompressor"
+    # disabled params round-trip their configured codec but act as noop
+    disabled = codec.CompressionParams.from_dict(
+        {"class": "ZstdCompressor", "chunk_length_in_kb": 64, "enabled": False})
+    assert disabled.compressor_or_noop().name == "NoopCompressor"
+    rt = codec.CompressionParams.from_dict(disabled.to_dict())
+    assert rt.compressor_name == "ZstdCompressor" and rt.chunk_length == 65536
+    assert not rt.enabled
     ratio = codec.CompressionParams(min_compress_ratio=1.1)
     assert ratio.max_compressed_length == int(16384 / 1.1)
