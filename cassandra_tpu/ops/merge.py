@@ -25,7 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..storage.cellbatch import (FLAG_EXPIRING, FLAG_PARTITION_DEL,
+from ..storage.cellbatch import (DEATH_FLAGS, FLAG_COMPLEX_DEL,
+                                 FLAG_EXPIRING, FLAG_PARTITION_DEL,
                                  FLAG_ROW_DEL, FLAG_TOMBSTONE, CellBatch)
 from ..schema import COL_PARTITION_DEL, COL_ROW_DEL
 
@@ -104,7 +105,8 @@ def merge_reconcile_kernel(operands):
     first = jnp.zeros(N, dtype=bool).at[0].set(True)
     part_new = first | diff[:, :4].any(axis=1)
     row_new = part_new | diff[:, 4:K - 3].any(axis=1)
-    cell_new = row_new | diff[:, K - 3:].any(axis=1)
+    col_new = row_new | diff[:, K - 3]
+    cell_new = col_new | diff[:, K - 2:].any(axis=1)
 
     col = lanes[:, K - 3]
     winner = cell_new & valid
@@ -112,6 +114,7 @@ def merge_reconcile_kernel(operands):
     # ---- 3. deletion shadowing -------------------------------------------
     is_pd = col == COL_PARTITION_DEL
     is_rd = col == COL_ROW_DEL
+    is_cd = g(operands["cdel"]) == 1
     zero = jnp.uint32(0)
     # partition deletions sort first in their partition; the partition-start
     # record is the pd winner when one exists
@@ -122,15 +125,24 @@ def merge_reconcile_kernel(operands):
     rd_h = jnp.where(row_new & is_rd, ts_h, zero)
     rd_l = jnp.where(row_new & is_rd, ts_l, zero)
     rd_h, rd_l = _seg_carry_pair(rd_h, rd_l, row_new)
-    # effective deletion over a plain cell = max(pd, rd)
+    # effective row-scope deletion = max(pd, rd)
     use_pd = _lt_pair(rd_h, rd_l, pd_h, pd_l)
     del_h = jnp.where(use_pd, pd_h, rd_h)
     del_l = jnp.where(use_pd, pd_l, rd_l)
+    # complex (collection) deletions sort first in their (row, column)
+    cd_h = jnp.where(col_new & is_cd, ts_h, zero)
+    cd_l = jnp.where(col_new & is_cd, ts_l, zero)
+    cd_h, cd_l = _seg_carry_pair(cd_h, cd_l, col_new)
+    use_cd = _lt_pair(del_h, del_l, cd_h, cd_l)
+    cdel_h = jnp.where(use_cd, cd_h, del_h)
+    cdel_l = jnp.where(use_cd, cd_l, del_l)
 
-    plain = ~is_pd & ~is_rd
+    plain = ~is_pd & ~is_rd & ~is_cd
     shadowed = jnp.where(
-        plain, _le_pair(ts_h, ts_l, del_h, del_l),
-        jnp.where(is_rd, _le_pair(ts_h, ts_l, pd_h, pd_l), False))
+        plain, _le_pair(ts_h, ts_l, cdel_h, cdel_l),
+        jnp.where(is_rd, _le_pair(ts_h, ts_l, pd_h, pd_l),
+                  jnp.where(is_cd, _le_pair(ts_h, ts_l, del_h, del_l),
+                            False)))
 
     # ---- 4. TTL expiry + purge -------------------------------------------
     now = operands["now"]
@@ -186,8 +198,9 @@ def merge_sorted_device(batches: list[CellBatch], gc_before: int = 0,
     ts_h[:n] = (uts >> np.uint64(32)).astype(np.uint32)
     ts_l[:n] = (uts & np.uint64(0xFFFFFFFF)).astype(np.uint32)
     death = np.zeros(N, dtype=np.uint32)
-    death[:n] = (cat.flags & (FLAG_TOMBSTONE | FLAG_PARTITION_DEL
-                              | FLAG_ROW_DEL)) != 0
+    death[:n] = (cat.flags & DEATH_FLAGS) != 0
+    cdel = np.zeros(N, dtype=np.uint32)
+    cdel[:n] = (cat.flags & FLAG_COMPLEX_DEL) != 0
     vp = np.zeros(N, dtype=np.uint32)
     vp[:n] = cat._value_prefix_lane()
     ldt = np.zeros(N, dtype=np.int32)
@@ -211,6 +224,7 @@ def merge_sorted_device(batches: list[CellBatch], gc_before: int = 0,
         "lanes": jnp.asarray(lanes), "valid": jnp.asarray(valid),
         "ts_h": jnp.asarray(ts_h), "ts_l": jnp.asarray(ts_l),
         "death": jnp.asarray(death), "vp": jnp.asarray(vp),
+        "cdel": jnp.asarray(cdel),
         "ldt": jnp.asarray(ldt), "expiring": jnp.asarray(expiring),
         "purge_h": jnp.asarray(purge_h), "purge_l": jnp.asarray(purge_l),
         "gc_before": jnp.int32(gc_before), "now": jnp.int32(now),
@@ -240,8 +254,7 @@ def merge_sorted_device(batches: list[CellBatch], gc_before: int = 0,
             pts_sorted = purgeable_ts_fn(cat).astype(np.int64)[perm_real]
         else:
             pts_sorted = None
-        death_s = ((s.flags & (FLAG_TOMBSTONE | FLAG_PARTITION_DEL
-                               | FLAG_ROW_DEL)) != 0)
+        death_s = ((s.flags & DEATH_FLAGS) != 0)
         shadow_n = shadowed[:n]
         idxs = np.flatnonzero(amb)
         prev_i = -2
@@ -264,4 +277,8 @@ def merge_sorted_device(batches: list[CellBatch], gc_before: int = 0,
             keep[best] = not (shadow_n[best] or purged)
     out = s.apply_permutation(np.flatnonzero(keep))
     out.sorted = True
-    return out
+    # expired-TTL -> tombstone conversion drops the dead value (mirrors
+    # the numpy path exactly)
+    converted = ((out.flags & FLAG_EXPIRING) != 0) & \
+        ((out.flags & FLAG_TOMBSTONE) != 0)
+    return out.drop_values(converted)

@@ -131,6 +131,46 @@ class TableMetadata:
             pos += 2 + ln + 1
         return out
 
+    def serialize_clustering(self, values: list) -> bytes:
+        """Clustering tuple as a vint-length-framed concatenation of the
+        serialized values — the form stored in cell payloads (invertible,
+        unlike the byte-comparable form)."""
+        from .utils import varint as vi
+        out = bytearray()
+        for c, v in zip(self.clustering_columns, values):
+            b = c.cql_type.serialize(v)
+            vi.write_unsigned_vint(len(b), out)
+            out += b
+        return bytes(out)
+
+    def split_clustering(self, frame: bytes) -> list[bytes]:
+        """Serialized clustering values from a payload frame (may be a
+        prefix of the full clustering)."""
+        from .utils import varint as vi
+        vals = []
+        pos = 0
+        for _ in self.clustering_columns:
+            if pos >= len(frame):
+                break
+            n, pos = vi.read_unsigned_vint(frame, pos)
+            vals.append(bytes(frame[pos:pos + n]))
+            pos += n
+        return vals
+
+    def deserialize_clustering(self, frame: bytes) -> list:
+        return [c.cql_type.deserialize(b) for c, b in
+                zip(self.clustering_columns, self.split_clustering(frame))]
+
+    def clustering_comp(self, frame: bytes) -> bytes:
+        """Byte-comparable composite for a serialized clustering frame."""
+        from .utils import bytecomp
+        comps = []
+        desc = []
+        for c, b in zip(self.clustering_columns, self.split_clustering(frame)):
+            comps.append(c.cql_type.to_bytecomp(b))
+            desc.append(c.reversed)
+        return bytecomp.encode_composite(comps, desc)
+
     def clustering_bytecomp(self, values: list) -> bytes:
         """Byte-comparable composite of clustering values (full precision)."""
         from .utils import bytecomp

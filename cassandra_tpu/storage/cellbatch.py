@@ -55,8 +55,14 @@ FLAG_EXPIRING = 2        # has TTL
 FLAG_PARTITION_DEL = 4
 FLAG_ROW_DEL = 8
 FLAG_ROW_LIVENESS = 16
-FLAG_RANGE_START = 32    # reserved: range tombstone bound
-FLAG_RANGE_END = 64
+FLAG_COMPLEX_DEL = 32    # whole-collection deletion (column-scoped,
+                         # path-less; shadows older path cells — reference
+                         # ComplexColumnData complex deletion semantics)
+FLAG_RANGE_START = 64    # reserved: range tombstone bound
+FLAG_RANGE_END = 128
+
+DEATH_FLAGS = (FLAG_TOMBSTONE | FLAG_PARTITION_DEL | FLAG_ROW_DEL
+               | FLAG_COMPLEX_DEL)
 
 _BIAS = 1 << 63
 _U32 = 0xFFFFFFFF
@@ -64,6 +70,19 @@ _U32 = 0xFFFFFFFF
 
 def lanes_for_table(table: TableMetadata) -> int:
     return 9 + table.clustering_lanes
+
+
+def pk_lanes(pk: bytes) -> tuple[int, int, int, int]:
+    """The four partition lanes of a key: biased token + murmur h2."""
+    token = murmur3.token_of(pk)
+    _, h2 = murmur3.hash128(pk)
+    t = token + _BIAS
+    return (t >> 32, t & _U32, h2 >> 32, h2 & _U32)
+
+
+def pk_lane_key(pk: bytes) -> bytes:
+    """16-byte big-endian packing of pk_lanes — the pk_map key."""
+    return b"".join(int(x).to_bytes(4, "big") for x in pk_lanes(pk))
 
 
 def _pack_prefix(data: bytes, nlanes: int) -> list[int]:
@@ -132,8 +151,7 @@ class CellBatch:
         return np.lexsort(keys)
 
     def _death_lane(self) -> np.ndarray:
-        return ((self.flags & (FLAG_TOMBSTONE | FLAG_PARTITION_DEL
-                               | FLAG_ROW_DEL)) != 0).astype(np.uint8)
+        return ((self.flags & DEATH_FLAGS) != 0).astype(np.uint8)
 
     def _value_prefix_lane(self) -> np.ndarray:
         """First 4 bytes of each value, big-endian, zero-padded
@@ -171,6 +189,27 @@ class CellBatch:
                          sorted=True)
 
     # ------------------------------------------------------------ concat --
+
+    def drop_values(self, mask: np.ndarray) -> "CellBatch":
+        """Rewrite the payload with value bytes removed for masked cells
+        (expired-TTL -> tombstone conversion drops the dead value)."""
+        if not mask.any():
+            return self
+        n = len(self)
+        lens = self.off[1:] - self.off[:-1]
+        vlens = self.off[1:] - self.val_start
+        new_lens = np.where(mask, lens - vlens, lens)
+        new_off = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(new_lens, out=new_off[1:])
+        total = int(new_off[-1])
+        pos_in_cell = np.arange(total, dtype=np.int64) - \
+            np.repeat(new_off[:-1], new_lens)
+        flat_idx = np.repeat(self.off[:-1], new_lens) + pos_in_cell
+        new_payload = self.payload[flat_idx]
+        header_lens = self.val_start - self.off[:-1]
+        return CellBatch(self.lanes, self.ts, self.ldt, self.ttl, self.flags,
+                         new_off, new_off[:-1] + header_lens,
+                         new_payload, dict(self.pk_map), sorted=self.sorted)
 
     @staticmethod
     def concat(batches: list["CellBatch"]) -> "CellBatch":
@@ -221,11 +260,17 @@ class CellBatch:
         """(part_new, row_new, cell_new) boolean arrays; batch must be
         sorted. row identity = partition + clustering lanes (incl. full-ck
         hash); cell identity = row + column + path lanes."""
+        part_new, row_new, _, cell_new = self.boundaries4()
+        return part_new, row_new, cell_new
+
+    def boundaries4(self):
+        """(part_new, row_new, col_new, cell_new); col = row + column lane
+        (the complex-deletion scope)."""
         assert self.sorted
         n = len(self)
         if n == 0:
             z = np.zeros(0, dtype=bool)
-            return z, z, z
+            return z, z, z, z
         K = self.n_lanes
         C = K - 9
         diff = self.lanes[1:] != self.lanes[:-1]
@@ -233,9 +278,11 @@ class CellBatch:
         part_new[1:] = diff[:, :4].any(axis=1)
         row_new = part_new.copy()
         row_new[1:] |= diff[:, 4:6 + C].any(axis=1)
-        cell_new = row_new.copy()
-        cell_new[1:] |= diff[:, 6 + C:].any(axis=1)
-        return part_new, row_new, cell_new
+        col_new = row_new.copy()
+        col_new[1:] |= diff[:, 6 + C]
+        cell_new = col_new.copy()
+        cell_new[1:] |= diff[:, 7 + C:].any(axis=1)
+        return part_new, row_new, col_new, cell_new
 
     def reconcile(self, gc_before: int = 0, now: int = 0,
                   purgeable_ts: np.ndarray | None = None) -> np.ndarray:
@@ -251,7 +298,7 @@ class CellBatch:
         n = len(self)
         if n == 0:
             return np.zeros(0, dtype=bool)
-        part_new, row_new, cell_new = self.boundaries()
+        part_new, row_new, col_new, cell_new = self.boundaries4()
         K = self.n_lanes
         C = K - 9
         col = self.lanes[:, 6 + C]
@@ -308,18 +355,29 @@ class CellBatch:
 
         pd_of = pd_ts[part_id]
         rd_of = np.maximum(rd_ts[row_id], pd_of)
+        # complex (collection) deletions: path-less markers at the start of
+        # their (row, column) segment shadow older path cells
+        col_id = np.cumsum(col_new) - 1
+        n_col = int(col_id[-1]) + 1
+        cd_ts = np.full(n_col, NO_TIMESTAMP, dtype=np.int64)
+        is_cd = (self.flags & FLAG_COMPLEX_DEL) != 0
+        cd_lead = winner & is_cd
+        cd_ts[col_id[cd_lead]] = self.ts[cd_lead]
+        cd_of = np.maximum(cd_ts[col_id], rd_of)
+
         is_pd = col == COL_PARTITION_DEL
         is_rd = col == COL_ROW_DEL
         shadowed = np.zeros(n, dtype=bool)
         # cells and liveness: deleted if ts <= enclosing deletion ts
-        plain = ~is_pd & ~is_rd
-        shadowed[plain] = self.ts[plain] <= rd_of[plain]
-        # row deletions superseded by the partition deletion
+        plain = ~is_pd & ~is_rd & ~is_cd
+        shadowed[plain] = self.ts[plain] <= cd_of[plain]
+        # row deletions superseded by the partition deletion; complex
+        # deletions superseded by row/partition deletions
         shadowed[is_rd] = self.ts[is_rd] <= pd_of[is_rd]
+        shadowed[is_cd] = self.ts[is_cd] <= rd_of[is_cd]
 
         # 4. purge gc-able tombstones (incl. expired-TTL converted ones)
-        death = ((self.flags & (FLAG_TOMBSTONE | FLAG_PARTITION_DEL
-                                | FLAG_ROW_DEL)) != 0)
+        death = ((self.flags & DEATH_FLAGS) != 0)
         if purgeable_ts is None:
             purgeable = np.ones(n, dtype=bool)
         else:
@@ -346,6 +404,7 @@ class CellBatchBuilder:
         self._value_off: list[int] = [0]
         self._val_start: list[int] = []
         self.pk_map: dict[bytes, bytes] = {}
+        self._comp_cache: dict[bytes, bytes] = {}
 
     def __len__(self):
         return len(self._ts)
@@ -353,10 +412,7 @@ class CellBatchBuilder:
     # ------------------------------------------------------------ low level
 
     def _pk_lanes(self, pk: bytes) -> tuple:
-        token = murmur3.token_of(pk)
-        _, h2 = murmur3.hash128(pk)
-        t = token + _BIAS
-        lanes = (t >> 32, t & _U32, h2 >> 32, h2 & _U32)
+        lanes = pk_lanes(pk)
         key16 = b"".join(int(x).to_bytes(4, "big") for x in lanes)
         existing = self.pk_map.get(key16)
         if existing is None:
@@ -365,12 +421,18 @@ class CellBatchBuilder:
             raise RuntimeError("128-bit partition-key hash collision")
         return lanes
 
-    def _ck_lanes(self, ck: bytes) -> tuple:
-        pref = _pack_prefix(ck, self.C)
-        if ck:
-            h1, _ = murmur3.hash128(ck)
-        else:
-            h1 = 0
+    def _ck_lanes(self, ck_frame: bytes) -> tuple:
+        """ck_frame is the SERIALIZED clustering tuple (payload form);
+        lanes come from its byte-comparable composite."""
+        if not ck_frame:
+            return (0,) * (self.C + 2)
+        comp = self._comp_cache.get(ck_frame)
+        if comp is None:
+            comp = self.table.clustering_comp(ck_frame)
+            if len(self._comp_cache) < 65536:
+                self._comp_cache[ck_frame] = comp
+        pref = _pack_prefix(comp, self.C)
+        h1, _ = murmur3.hash128(comp)
         return (*pref, h1 >> 32, h1 & _U32)
 
     def _path_lanes(self, path: bytes) -> tuple:
@@ -434,6 +496,12 @@ class CellBatchBuilder:
         self.append_raw(pk, b"", COL_PARTITION_DEL, b"", b"", ts, ldt=ldt,
                         flags=FLAG_PARTITION_DEL)
 
+    def add_complex_deletion(self, pk: bytes, ck: bytes, column_id: int,
+                             ts: int, ldt: int) -> None:
+        """Whole-collection deletion (UPDATE SET m = {...} overwrite)."""
+        self.append_raw(pk, ck, column_id, b"", b"", ts, ldt=ldt,
+                        flags=FLAG_COMPLEX_DEL)
+
     # --------------------------------------------------------------- seal --
 
     def seal(self) -> CellBatch:
@@ -468,4 +536,6 @@ def merge_sorted(batches: list[CellBatch], gc_before: int = 0, now: int = 0,
     out = s.apply_permutation(np.flatnonzero(keep))
     out.sorted = True
     # expired-TTL cells were converted to tombstones: drop their values
-    return out
+    converted = ((out.flags & FLAG_EXPIRING) != 0) & \
+        ((out.flags & FLAG_TOMBSTONE) != 0)
+    return out.drop_values(converted)

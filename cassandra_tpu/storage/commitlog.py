@@ -1,0 +1,185 @@
+"""Commitlog: segmented durable WAL with CRC-framed records and replay.
+
+Reference counterpart: db/commitlog/CommitLog.java:300 (add),
+CommitLogSegment, AbstractCommitLogSegmentManager (segment rotation,
+per-table dirty tracking), CommitLogReplayer (boot replay). Sync
+strategies: 'periodic' (buffered, background fsync every N ms) and 'batch'
+(fsync before ack) — conf/cassandra.yaml commitlog_sync options.
+
+Record frame: [u32 length][u32 crc32-of-payload][payload]. A zero length
+or short read terminates replay of a segment (torn tail after crash).
+"""
+from __future__ import annotations
+
+import os
+import re
+import struct
+import threading
+import zlib
+
+from .mutation import Mutation
+
+_SEG_RE = re.compile(r"^commitlog-(\d+)\.log$")
+
+
+class CommitLogPosition(tuple):
+    """(segment_id, offset) — totally ordered."""
+    def __new__(cls, segment_id: int, offset: int):
+        return super().__new__(cls, (segment_id, offset))
+
+    @property
+    def segment_id(self):
+        return self[0]
+
+    @property
+    def offset(self):
+        return self[1]
+
+
+class CommitLog:
+    def __init__(self, directory: str, segment_size: int = 32 * 1024 * 1024,
+                 sync_mode: str = "periodic", sync_period_ms: int = 1000):
+        self.directory = directory
+        self.segment_size = segment_size
+        self.sync_mode = sync_mode
+        self.sync_period_ms = sync_period_ms
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        existing = self.segment_ids()
+        self._seg_id = (existing[-1] + 1) if existing else 1
+        self._file = None
+        self._open_segment()
+        # dirty tracking: segment -> set of table ids with unflushed writes
+        self._dirty: dict[int, set] = {}
+        self._stop = threading.Event()
+        self._syncer = None
+        if sync_mode == "periodic":
+            self._syncer = threading.Thread(target=self._sync_loop,
+                                            daemon=True)
+            self._syncer.start()
+
+    # ------------------------------------------------------------ segments
+
+    def _seg_path(self, seg_id: int) -> str:
+        return os.path.join(self.directory, f"commitlog-{seg_id}.log")
+
+    def segment_ids(self) -> list[int]:
+        out = []
+        for fn in os.listdir(self.directory):
+            m = _SEG_RE.match(fn)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def _open_segment(self) -> None:
+        if self._file:
+            self._file.flush()
+            os.fsync(self._file.fileno())
+            self._file.close()
+        self._file = open(self._seg_path(self._seg_id), "ab")
+
+    # ----------------------------------------------------------------- add
+
+    def add(self, mutation: Mutation) -> CommitLogPosition:
+        """Append a mutation; returns its position. With sync_mode='batch'
+        the record is durable when this returns (CommitLog.add:300)."""
+        payload = mutation.serialize()
+        frame = struct.pack("<II", len(payload), zlib.crc32(payload)) + payload
+        with self._lock:
+            if self._file.tell() + len(frame) > self.segment_size:
+                self._seg_id += 1
+                self._open_segment()
+            pos = CommitLogPosition(self._seg_id, self._file.tell())
+            self._file.write(frame)
+            self._dirty.setdefault(self._seg_id, set()).add(mutation.table_id)
+            if self.sync_mode == "batch":
+                self._file.flush()
+                os.fsync(self._file.fileno())
+        return pos
+
+    def sync(self) -> None:
+        with self._lock:
+            self._file.flush()
+            os.fsync(self._file.fileno())
+
+    def _sync_loop(self) -> None:
+        while not self._stop.wait(self.sync_period_ms / 1000.0):
+            try:
+                self.sync()
+            except (OSError, ValueError):
+                return
+
+    # -------------------------------------------------------------- replay
+
+    def replay(self):
+        """Yield (position, Mutation) for every intact record on disk
+        (CommitLogReplayer semantics: stop a segment at the first torn
+        record)."""
+        for seg_id in self.segment_ids():
+            path = self._seg_path(seg_id)
+            with open(path, "rb") as f:
+                data = f.read()
+            pos = 0
+            while pos + 8 <= len(data):
+                length, crc = struct.unpack_from("<II", data, pos)
+                if length == 0 or pos + 8 + length > len(data):
+                    break  # torn tail
+                payload = data[pos + 8: pos + 8 + length]
+                if zlib.crc32(payload) != crc:
+                    break  # corrupt tail
+                yield CommitLogPosition(seg_id, pos), \
+                    Mutation.deserialize(payload)
+                pos += 8 + length
+
+    # ----------------------------------------------------- flush lifecycle
+
+    def discard_completed(self, table_id, upto: CommitLogPosition) -> None:
+        """Mark a table's writes flushed up to `upto`; delete segments no
+        table dirties anymore (CommitLog.discardCompletedSegments)."""
+        with self._lock:
+            # a segment at/after the flush point may hold post-switch writes
+            # for this table, so only older segments become clean
+            for seg_id in list(self._dirty):
+                if seg_id < upto.segment_id:
+                    self._dirty[seg_id].discard(table_id)
+                    if not self._dirty[seg_id] and seg_id != self._seg_id:
+                        try:
+                            os.remove(self._seg_path(seg_id))
+                        except FileNotFoundError:
+                            pass
+                        del self._dirty[seg_id]
+
+    def forget_table(self, table_id) -> None:
+        """A dropped table's writes no longer pin segments."""
+        with self._lock:
+            for seg_id in list(self._dirty):
+                self._dirty[seg_id].discard(table_id)
+                if not self._dirty[seg_id] and seg_id != self._seg_id:
+                    try:
+                        os.remove(self._seg_path(seg_id))
+                    except FileNotFoundError:
+                        pass
+                    del self._dirty[seg_id]
+
+    def current_position(self) -> CommitLogPosition:
+        with self._lock:
+            return CommitLogPosition(self._seg_id, self._file.tell())
+
+    def delete_segments_before(self, seg_id: int) -> None:
+        for s in self.segment_ids():
+            if s < seg_id:
+                try:
+                    os.remove(self._seg_path(s))
+                except FileNotFoundError:
+                    pass
+                self._dirty.pop(s, None)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._syncer:
+            self._syncer.join(timeout=2)
+        with self._lock:
+            if self._file and not self._file.closed:
+                self._file.flush()
+                os.fsync(self._file.fileno())
+                self._file.close()

@@ -1,0 +1,116 @@
+"""Node-local storage engine: schema + commitlog + per-table stores.
+
+Reference counterpart: the Keyspace.apply path (db/Keyspace.java:475 —
+commitlog add, then memtable put) plus CassandraDaemon.setup's commitlog
+recovery (service/CassandraDaemon.java:268,339).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from ..schema import Schema, TableMetadata
+from ..utils import timeutil
+from .commitlog import CommitLog
+from .mutation import Mutation
+from .table import ColumnFamilyStore
+
+
+class StorageEngine:
+    def __init__(self, data_dir: str, schema: Schema | None = None,
+                 durable_writes: bool = True,
+                 commitlog_sync: str = "periodic",
+                 flush_threshold: int | None = None):
+        self.data_dir = data_dir
+        self.schema = schema or Schema()
+        self.durable = durable_writes
+        self.flush_threshold = flush_threshold
+        os.makedirs(data_dir, exist_ok=True)
+        self.commitlog = CommitLog(os.path.join(data_dir, "commitlog"),
+                                   sync_mode=commitlog_sync) \
+            if durable_writes else None
+        self.stores: dict = {}  # table_id -> ColumnFamilyStore
+        self._lock = threading.RLock()
+        self._register_existing()
+        if self.commitlog:
+            self._replay()
+
+    def _register_existing(self) -> None:
+        for ks in self.schema.keyspaces.values():
+            for t in ks.tables.values():
+                self._open_store(t)
+
+    def _open_store(self, t: TableMetadata) -> ColumnFamilyStore:
+        cfs = ColumnFamilyStore(t, self.data_dir, self.commitlog,
+                                flush_threshold=self.flush_threshold)
+        self.stores[t.id] = cfs
+        return cfs
+
+    # ------------------------------------------------------------- schema --
+
+    def add_table(self, t: TableMetadata) -> ColumnFamilyStore:
+        with self._lock:
+            self.schema.add_table(t)
+            return self._open_store(t)
+
+    def drop_table(self, keyspace: str, name: str) -> None:
+        with self._lock:
+            t = self.schema.get_table(keyspace, name)
+            cfs = self.stores.pop(t.id)
+            cfs.truncate()
+            self.schema.drop_table(keyspace, name)
+            if self.commitlog:
+                self.commitlog.forget_table(t.id)
+
+    def store(self, keyspace: str, name: str) -> ColumnFamilyStore:
+        t = self.schema.get_table(keyspace, name)
+        return self.stores[t.id]
+
+    def store_by_id(self, table_id) -> ColumnFamilyStore:
+        return self.stores[table_id]
+
+    # -------------------------------------------------------------- write --
+
+    def apply(self, mutation: Mutation, durable: bool = True) -> None:
+        """Keyspace.apply: commitlog first, then memtable (one atomic unit
+        vs concurrent flushes); flush when the memtable crosses its
+        threshold."""
+        cfs = self.stores.get(mutation.table_id)
+        if cfs is None:
+            raise KeyError(f"unknown table id {mutation.table_id}")
+        cfs.apply(mutation, self.commitlog, durable)
+        if cfs.should_flush():
+            cfs.flush()
+
+    # ------------------------------------------------------------- replay --
+
+    def _replay(self) -> None:
+        """Boot recovery: re-apply intact commitlog records to memtables
+        (CommitLogReplayer semantics), then flush and clear the log."""
+        replayed = 0
+        for pos, mutation in self.commitlog.replay():
+            cfs = self.stores.get(mutation.table_id)
+            if cfs is None:
+                continue  # table dropped since the write
+            cfs.apply(mutation)
+            replayed += 1
+        for cfs in self.stores.values():
+            if not cfs.memtable.is_empty:
+                cfs.flush()
+        # everything recovered (or belonging to dropped tables) is dealt
+        # with; reclaim all pre-existing segments
+        self.commitlog.delete_segments_before(
+            self.commitlog.current_position().segment_id)
+
+    # --------------------------------------------------------------- misc --
+
+    def flush_all(self) -> None:
+        for cfs in list(self.stores.values()):
+            cfs.flush()
+
+    def close(self) -> None:
+        if self.commitlog:
+            self.commitlog.close()
+        for cfs in self.stores.values():
+            for sst in cfs.live_sstables():
+                sst.close()

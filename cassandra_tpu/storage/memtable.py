@@ -1,0 +1,101 @@
+"""Memtable: append-only columnar write buffer.
+
+Reference counterpart: db/memtable/Memtable.java:55 (pluggable interface;
+put:193, getFlushSet:299) and TrieMemtable. The reference maintains a
+sorted structure per write; the TPU-native design appends O(1) to columnar
+arrays and defers ALL ordering to the batch sort at read/flush time —
+sorting is what the device does best, and flush-time batch sort replaces
+per-write comparisons entirely.
+
+A per-partition hash index (dict lane4 -> cell indices) gives point reads
+their partition's cells without sorting the world; range scans and flush
+sort the whole buffer once (cached until the next write).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..schema import TableMetadata
+from .cellbatch import (CellBatch, CellBatchBuilder, merge_sorted,
+                        pk_lane_key)
+from .mutation import Mutation
+
+
+class Memtable:
+    def __init__(self, table: TableMetadata):
+        self.table = table
+        self._builder = CellBatchBuilder(table)
+        self._partitions: dict[bytes, list[int]] = {}
+        self._lock = threading.RLock()
+        self._sorted_cache: CellBatch | None = None
+        self.live_bytes = 0
+        self.ops = 0
+
+    def __len__(self):
+        return len(self._builder)
+
+    @property
+    def is_empty(self) -> bool:
+        return len(self._builder) == 0
+
+    # ------------------------------------------------------------- write --
+
+    def apply(self, mutation: Mutation) -> None:
+        with self._lock:
+            start = len(self._builder)
+            mutation.apply_to(self._builder)
+            end = len(self._builder)
+            if end == start:
+                return
+            lane4 = self._builder._lanes[start][:4]
+            key16 = b"".join(int(x).to_bytes(4, "big") for x in lane4)
+            self._partitions.setdefault(key16, []).extend(range(start, end))
+            # note: all ops of one mutation share the partition (one pk)
+            self.live_bytes += mutation.size
+            self.ops += len(mutation.ops)
+            self._sorted_cache = None
+
+    # -------------------------------------------------------------- read --
+
+    def _subset(self, indices: list[int]) -> CellBatch:
+        b = self._builder
+        sub = CellBatchBuilder(self.table)
+        for i in indices:
+            lanes = b._lanes[i]
+            frame = bytes(b._payload[b._value_off[i]:b._value_off[i + 1]])
+            sub._lanes.append(lanes)
+            sub._ts.append(b._ts[i])
+            sub._ldt.append(b._ldt[i])
+            sub._ttl.append(b._ttl[i])
+            sub._flags.append(b._flags[i])
+            sub._val_start.append(len(sub._payload)
+                                  + (b._val_start[i] - b._value_off[i]))
+            sub._payload += frame
+            sub._value_off.append(len(sub._payload))
+        sub.pk_map = self._builder.pk_map
+        return sub.seal()
+
+    def read_partition(self, pk: bytes) -> CellBatch | None:
+        """The partition's cells, reconciled (newest versions only)."""
+        key16 = pk_lane_key(pk)
+        with self._lock:
+            idx = self._partitions.get(key16)
+            if not idx:
+                return None
+            return merge_sorted([self._subset(idx)])
+
+    def scan(self) -> CellBatch:
+        """Whole memtable, sorted + reconciled (cached until next write)."""
+        with self._lock:
+            if self._sorted_cache is None:
+                self._sorted_cache = merge_sorted([self._builder.seal()])
+            return self._sorted_cache
+
+    # ------------------------------------------------------------- flush --
+
+    def flush_batch(self) -> CellBatch:
+        """Sorted, deduplicated cells for the flush writer
+        (Memtable.getFlushSet / Flushing.writeSortedContents role)."""
+        return self.scan()

@@ -1,0 +1,115 @@
+"""Row assembly: merged CellBatches -> typed rows.
+
+Reference counterpart: db/rows/Row.java / BTreeRow (a row as a sorted cell
+collection) and cql3 ResultSet building. Operates on RECONCILED batches
+(merge_sorted output): remaining cells are the newest versions; tombstone
+markers indicate absence.
+
+Multicell collections are reassembled from their path cells:
+  list: path = timeuuid-like 16B (ordering = insertion order)
+  set:  path = element's serialized bytes, value empty
+  map:  path = key's serialized bytes, value = value's serialized bytes
+(reference CellPath semantics, db/rows/CellPath.java).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..schema import (COL_PARTITION_DEL, COL_REGULAR_BASE, COL_ROW_DEL,
+                      COL_ROW_LIVENESS, TableMetadata)
+from ..types.marshal import ListType, MapType, SetType
+from .cellbatch import FLAG_COMPLEX_DEL, FLAG_TOMBSTONE, CellBatch
+
+
+@dataclass
+class RowData:
+    pk: bytes                     # serialized partition key
+    ck_frame: bytes               # serialized clustering frame
+    cells: dict = field(default_factory=dict)   # column_id -> value bytes|None
+    multicell: dict = field(default_factory=dict)  # column_id -> {path: bytes}
+    liveness_ts: int | None = None
+    max_ts: int = 0
+    is_static: bool = False
+
+    def has_live_data(self) -> bool:
+        return self.liveness_ts is not None or \
+            any(v is not None for v in self.cells.values()) or \
+            any(self.multicell.values())
+
+
+def rows_from_batch(table: TableMetadata, batch: CellBatch):
+    """Yield RowData for every row with live content, in storage order.
+    Input must be reconciled (deletions already applied by merge)."""
+    n = len(batch)
+    if n == 0:
+        return
+    C = batch.n_lanes - 9
+    col_lane = batch.lanes[:, 6 + C]
+    has_clustering = bool(table.clustering_columns)
+
+    current: RowData | None = None
+    for i in range(n):
+        col = int(col_lane[i])
+        if col == COL_PARTITION_DEL or col == COL_ROW_DEL:
+            continue  # markers only matter to merges; reads skip them
+        flags = int(batch.flags[i])
+        ck, path, value = batch.cell_payload(i)
+        pk = batch.partition_key(i)
+        if current is None or current.pk != pk or current.ck_frame != ck:
+            if current is not None and current.has_live_data():
+                yield current
+            current = RowData(pk, ck)
+            current.is_static = has_clustering and ck == b"" and \
+                col >= COL_REGULAR_BASE
+        current.max_ts = max(current.max_ts, int(batch.ts[i]))
+        if col == COL_ROW_LIVENESS:
+            if not (flags & FLAG_TOMBSTONE):
+                current.liveness_ts = int(batch.ts[i])
+            continue
+        if flags & FLAG_COMPLEX_DEL:
+            # collection overwrite marker: column present but reset
+            current.multicell.setdefault(col, {})
+            continue
+        meta = table.columns_by_id.get(col)
+        dead = bool(flags & FLAG_TOMBSTONE)
+        if meta is not None and meta.cql_type.is_multicell:
+            if path and not dead:
+                current.multicell.setdefault(col, {})[path] = value
+        else:
+            current.cells[col] = None if dead else value
+    if current is not None and current.has_live_data():
+        yield current
+
+
+def row_to_dict(table: TableMetadata, row: RowData) -> dict:
+    """Decode a RowData into {column_name: python value}."""
+    out: dict = {}
+    for c, v in zip(table.partition_key_columns,
+                    table.split_partition_key(row.pk)):
+        out[c.name] = v
+    if not row.is_static:
+        for c, v in zip(table.clustering_columns,
+                        table.deserialize_clustering(row.ck_frame)):
+            out[c.name] = v
+    for col in table.static_columns + table.regular_columns:
+        if col.cql_type.is_multicell and col.column_id in row.multicell:
+            paths = row.multicell[col.column_id]
+            t = col.cql_type
+            if isinstance(t, MapType):
+                out[col.name] = {t.key.deserialize(p): t.val.deserialize(v)
+                                 for p, v in sorted(paths.items())} or None
+            elif isinstance(t, SetType):
+                out[col.name] = {t.elem.deserialize(p)
+                                 for p in sorted(paths)} or None
+            elif isinstance(t, ListType):
+                out[col.name] = [t.elem.deserialize(v) for _, v in
+                                 sorted(paths.items())] or None
+            else:
+                out[col.name] = None
+        elif col.column_id in row.cells:
+            v = row.cells[col.column_id]
+            out[col.name] = None if v is None \
+                else col.cql_type.deserialize(v)
+        else:
+            out[col.name] = None
+    return out

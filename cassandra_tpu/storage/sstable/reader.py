@@ -1,0 +1,266 @@
+"""SSTable reader: ctpu components -> CellBatches.
+
+Reference counterpart: io/sstable/format/SSTableReader.java:152 (per-table
+reader with bloom/index/stats), BigTableScanner (compaction scanner),
+io/util/CompressedChunkReader.java:35 (chunk decompress on read).
+
+Point reads: bloom check -> binary search in the partition directory ->
+decode only the segments covering the partition's cell range. Compaction
+scans: sequential segment decode yielding device-ready CellBatches.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+
+import numpy as np
+
+from ...ops.codec import CompressionParams
+from ...utils import bloom as bloom_mod
+from ..cellbatch import CellBatch
+from .format import Component, Descriptor
+
+_BIAS = 1 << 63
+
+
+class CorruptSSTableError(Exception):
+    pass
+
+
+class SSTableReader:
+    def __init__(self, descriptor: Descriptor):
+        self.desc = descriptor
+        with open(descriptor.path(Component.STATS)) as f:
+            self.stats = json.load(f)
+        self.K = int(self.stats["n_lanes"])
+        self.n_cells = int(self.stats["n_cells"])
+        self.params = CompressionParams.from_dict(self.stats["compression"])
+        self.compressor = self.params.compressor_or_noop()
+
+        # index: fixed-width entries
+        with open(descriptor.path(Component.INDEX), "rb") as f:
+            raw = f.read()
+        n_seg, k, seg_cells = struct.unpack_from("<III", raw, 0)
+        if k != self.K:
+            raise CorruptSSTableError("index/stats lane mismatch")
+        self.segment_cells = seg_cells
+        entry_sz = 12 + 3 * 20 + 2 * 4 * self.K
+        self.n_segments = n_seg
+        self._seg_off = np.zeros(n_seg, dtype=np.int64)
+        self._seg_n = np.zeros(n_seg, dtype=np.int32)
+        self._blk = np.zeros((n_seg, 3, 3), dtype=np.int64)  # clen,ulen,crc
+        self._seg_first = np.zeros((n_seg, self.K), dtype=np.uint32)
+        self._seg_last = np.zeros((n_seg, self.K), dtype=np.uint32)
+        pos = 12
+        for i in range(n_seg):
+            off, n = struct.unpack_from("<QI", raw, pos)
+            self._seg_off[i] = off
+            self._seg_n[i] = n
+            p = pos + 12
+            for b in range(3):
+                cl, ul, crc = struct.unpack_from("<QQI", raw, p)
+                self._blk[i, b] = (cl, ul, crc)
+                p += 20
+            self._seg_first[i] = np.frombuffer(raw, dtype="<u4",
+                                               count=self.K, offset=p)
+            self._seg_last[i] = np.frombuffer(raw, dtype="<u4", count=self.K,
+                                              offset=p + 4 * self.K)
+            pos += entry_sz
+        # global first-cell index of each segment
+        self._seg_cell0 = np.zeros(n_seg + 1, dtype=np.int64)
+        np.cumsum(self._seg_n, out=self._seg_cell0[1:])
+
+        # partition directory
+        with open(descriptor.path(Component.PARTITIONS), "rb") as f:
+            praw = f.read()
+        (n_part,) = struct.unpack_from("<I", praw, 0)
+        self.n_partitions = n_part
+        o = 4
+        self._part_lane4 = np.frombuffer(
+            praw, dtype=">u4", count=n_part * 4, offset=o).reshape(n_part, 4)
+        o += n_part * 16
+        self._part_cell0 = np.frombuffer(praw, dtype="<i8", count=n_part,
+                                         offset=o)
+        o += n_part * 8
+        pk_off = np.frombuffer(praw, dtype="<i8", count=n_part + 1, offset=o)
+        o += (n_part + 1) * 8
+        self._pk_blob = praw[o:]
+        self._pk_off = pk_off
+
+        with open(descriptor.path(Component.FILTER), "rb") as f:
+            self.bloom = bloom_mod.BloomFilter.deserialize(f.read())
+
+        self._data = open(descriptor.path(Component.DATA), "rb")
+        self.data_size = os.fstat(self._data.fileno()).st_size
+        self.size_bytes = sum(
+            os.path.getsize(p) for p in descriptor.all_paths()
+            if os.path.exists(p))
+
+    # ------------------------------------------------------------ metadata
+
+    @property
+    def min_ts(self):
+        return self.stats["min_ts"]
+
+    @property
+    def max_ts(self):
+        return self.stats["max_ts"]
+
+    def partition_key_at(self, i: int) -> bytes:
+        return self._pk_blob[self._pk_off[i]:self._pk_off[i + 1]]
+
+    def partition_keys(self):
+        for i in range(self.n_partitions):
+            yield self.partition_key_at(i)
+
+    def min_token(self) -> int:
+        if self.n_partitions == 0:
+            return 0
+        l = self._part_lane4[0]
+        return ((int(l[0]) << 32) | int(l[1])) - _BIAS
+
+    def max_token(self) -> int:
+        if self.n_partitions == 0:
+            return 0
+        l = self._part_lane4[-1]
+        return ((int(l[0]) << 32) | int(l[1])) - _BIAS
+
+    def close(self):
+        if not self._data.closed:
+            self._data.close()
+
+    # ------------------------------------------------------------- decode
+
+    def _read_segment(self, i: int) -> CellBatch:
+        n = int(self._seg_n[i])
+        pos = int(self._seg_off[i])
+        blocks = []
+        lens = []
+        for b in range(3):
+            cl, ul, crc = (int(x) for x in self._blk[i, b])
+            # pread: stateless positional read — readers share this handle
+            # across threads (reference: FileHandle/RandomAccessReader are
+            # per-thread; pread avoids the seek/read race entirely)
+            raw = os.pread(self._data.fileno(), cl, pos)
+            pos += cl
+            if zlib.crc32(raw) != crc:
+                raise CorruptSSTableError(
+                    f"{self.desc}: segment {i} block {b} CRC mismatch")
+            blocks.append(raw)
+            lens.append(ul)
+        if self.params.enabled:
+            out = []
+            for raw, ul in zip(blocks, lens):
+                if len(raw) == ul:  # stored uncompressed (ratio fallback)
+                    out.append(raw)
+                else:
+                    out.append(self.compressor.uncompress(raw, ul))
+            blocks = out
+        meta, lanes_b, payload_b = blocks
+
+        ts = np.frombuffer(meta, dtype="<i8", count=n, offset=0)
+        o = 8 * n
+        ldt = np.frombuffer(meta, dtype="<i4", count=n, offset=o)
+        o += 4 * n
+        ttl = np.frombuffer(meta, dtype="<i4", count=n, offset=o)
+        o += 4 * n
+        flags = np.frombuffer(meta, dtype="u1", count=n, offset=o)
+        o += n
+        off = np.frombuffer(meta, dtype="<i8", count=n + 1, offset=o)
+        o += 8 * (n + 1)
+        val_start = np.frombuffer(meta, dtype="<i8", count=n, offset=o)
+        lanes = np.frombuffer(lanes_b, dtype="<u4").reshape(n, self.K)
+        payload = np.frombuffer(payload_b, dtype=np.uint8)
+
+        batch = CellBatch(
+            lanes.astype(np.uint32), ts.astype(np.int64),
+            ldt.astype(np.int32), ttl.astype(np.int32),
+            flags.astype(np.uint8), off.astype(np.int64),
+            val_start.astype(np.int64), payload.copy(), {}, sorted=True)
+        self._fill_pk_map(batch, i)
+        return batch
+
+    def _fill_pk_map(self, batch: CellBatch, seg_i: int) -> None:
+        """Attach pk bytes for every partition overlapping this segment."""
+        lo_cell = int(self._seg_cell0[seg_i])
+        hi_cell = int(self._seg_cell0[seg_i + 1])
+        lo = int(np.searchsorted(self._part_cell0, lo_cell, side="right")) - 1
+        hi = int(np.searchsorted(self._part_cell0, hi_cell, side="left"))
+        for p in range(max(lo, 0), hi):
+            key16 = self._part_lane4[p].astype(">u4").tobytes()
+            batch.pk_map[key16] = self.partition_key_at(p)
+
+    # ------------------------------------------------------------- reads --
+
+    def might_contain(self, pk: bytes) -> bool:
+        return self.bloom.might_contain(pk)
+
+    def _partition_index(self, pk: bytes) -> int | None:
+        from ..cellbatch import pk_lanes
+        target = pk_lanes(pk)
+        # binary search over big-endian-stored directory
+        view = self._part_lane4.astype(np.uint32)
+        lo, hi = 0, self.n_partitions
+        while lo < hi:
+            mid = (lo + hi) // 2
+            row = tuple(int(x) for x in view[mid])
+            if row < target:
+                lo = mid + 1
+            else:
+                hi = mid
+        if lo < self.n_partitions and tuple(int(x) for x in view[lo]) == target:
+            if self.partition_key_at(lo) != pk:
+                raise CorruptSSTableError("partition key hash collision")
+            return lo
+        return None
+
+    def read_partition(self, pk: bytes) -> CellBatch | None:
+        """All cells of one partition (None if absent)."""
+        if not self.might_contain(pk):
+            return None
+        p = self._partition_index(pk)
+        if p is None:
+            return None
+        c0 = int(self._part_cell0[p])
+        c1 = int(self._part_cell0[p + 1]) if p + 1 < self.n_partitions \
+            else self.n_cells
+        return self._cell_range(c0, c1)
+
+    def _cell_range(self, c0: int, c1: int) -> CellBatch:
+        s0 = int(np.searchsorted(self._seg_cell0, c0, side="right")) - 1
+        s1 = int(np.searchsorted(self._seg_cell0, c1, side="left"))
+        parts = []
+        for s in range(s0, max(s1, s0 + 1)):
+            seg = self._read_segment(s)
+            lo = max(c0 - int(self._seg_cell0[s]), 0)
+            hi = min(c1 - int(self._seg_cell0[s]), len(seg))
+            if lo > 0 or hi < len(seg):
+                sub = seg.apply_permutation(np.arange(lo, hi))
+                sub.pk_map = seg.pk_map
+                parts.append(sub)
+            else:
+                parts.append(seg)
+        out = CellBatch.concat(parts) if len(parts) > 1 else parts[0]
+        out.sorted = True
+        return out
+
+    def scanner(self):
+        """Sequential segment iterator for compaction/streaming
+        (BigTableScanner role). Yields sorted CellBatches."""
+        for i in range(self.n_segments):
+            yield self._read_segment(i)
+
+    def verify_digest(self) -> bool:
+        with open(self.desc.path(Component.DIGEST)) as f:
+            expected = int(f.read().strip())
+        crc = 0
+        pos = 0
+        while True:
+            chunk = os.pread(self._data.fileno(), 1 << 20, pos)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            pos += len(chunk)
+        return (crc & 0xFFFFFFFF) == expected

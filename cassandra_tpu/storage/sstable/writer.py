@@ -1,0 +1,266 @@
+"""SSTable writer: sorted CellBatches -> ctpu components.
+
+Reference counterpart: io/sstable/format/SortedTableWriter.java:76 (append
+loop), io/compress/CompressedSequentialWriter.java:43 (chunk+CRC write
+path), BigTableWriter.java:237-254 (bloom + index build during append).
+
+The writer consumes *sorted* batches (flush output or merge-kernel output),
+cuts fixed-size segments, compresses each segment's three blocks through
+the table codec's batch API (one FFI crossing per segment), and maintains
+the bloom filter / partition directory / stats as it goes.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+
+import numpy as np
+
+from ...ops.codec import CompressionParams
+from ...schema import TableMetadata
+from ...utils import bloom
+from ..cellbatch import CellBatch
+from .format import SEGMENT_CELLS, Component, Descriptor
+
+
+class SSTableWriter:
+    def __init__(self, descriptor: Descriptor, table: TableMetadata,
+                 estimated_partitions: int = 1024,
+                 segment_cells: int = SEGMENT_CELLS):
+        self.desc = descriptor
+        self.table = table
+        self.params: CompressionParams = table.params.compression
+        self.compressor = self.params.compressor_or_noop()
+        self.segment_cells = segment_cells
+        self.K = None  # lanes, learned from first batch
+
+        os.makedirs(descriptor.directory, exist_ok=True)
+        self._data = open(descriptor.tmp_path(Component.DATA), "wb")
+        self._data_crc = 0
+        self._data_off = 0
+        self._index_entries: list[bytes] = []
+        self._bloom = bloom.BloomFilter.create(max(estimated_partitions, 16))
+        # partition directory accumulators
+        self._part_lane4: list[bytes] = []
+        self._part_first_cell: list[int] = []
+        self._part_pk: list[bytes] = []
+        self._last_lane4: bytes | None = None
+        # pending cells not yet cut into a segment
+        self._pending: list[CellBatch] = []
+        self._pending_cells = 0
+        self._total_cells = 0
+        self._stats = {
+            "min_ts": None, "max_ts": None, "min_ldt": None, "max_ldt": None,
+            "tombstones": 0,
+        }
+        self._finished = False
+
+    # ---------------------------------------------------------------- api --
+
+    def append(self, batch: CellBatch) -> None:
+        """Append a sorted batch; cells must follow all previously appended
+        cells in identity-lane order (enforced cheaply at segment cut)."""
+        if len(batch) == 0:
+            return
+        if self.K is None:
+            self.K = batch.n_lanes
+        assert batch.n_lanes == self.K
+        self._pending.append(batch)
+        self._pending_cells += len(batch)
+        while self._pending_cells >= self.segment_cells:
+            self._cut_segment(self.segment_cells)
+
+    def finish(self) -> dict:
+        """Flush remaining cells, write all components, atomically rename.
+        Returns the stats dict."""
+        assert not self._finished
+        while self._pending_cells > 0:
+            self._cut_segment(min(self.segment_cells, self._pending_cells))
+        if self.K is None:
+            self.K = 13
+        self._data.flush()
+        os.fsync(self._data.fileno())
+        self._data.close()
+
+        self._write_index()
+        self._write_partitions()
+        self._write_filter()
+        stats = self._write_stats()
+        self._write_digest()
+        # TOC last, then atomic renames (TOC rename LAST = commit point)
+        with open(self.desc.tmp_path(Component.TOC), "w") as f:
+            f.write("\n".join(Component.ALL) + "\n")
+        for comp in Component.ALL:
+            if comp != Component.TOC:
+                os.replace(self.desc.tmp_path(comp), self.desc.path(comp))
+        os.replace(self.desc.tmp_path(Component.TOC),
+                   self.desc.path(Component.TOC))
+        self._finished = True
+        return stats
+
+    def abort(self) -> None:
+        if not self._data.closed:
+            self._data.close()
+        for comp in Component.ALL:
+            p = self.desc.tmp_path(comp)
+            if os.path.exists(p):
+                os.remove(p)
+
+    # ------------------------------------------------------------ internals
+
+    def _take(self, n: int) -> CellBatch:
+        """Pop exactly n cells from pending batches."""
+        taken = []
+        got = 0
+        while got < n:
+            b = self._pending[0]
+            need = n - got
+            if len(b) <= need:
+                taken.append(b)
+                self._pending.pop(0)
+                got += len(b)
+            else:
+                idx = np.arange(need)
+                head = b.apply_permutation(idx)
+                tail = b.apply_permutation(np.arange(need, len(b)))
+                tail.sorted = b.sorted
+                taken.append(head)
+                self._pending[0] = tail
+                got = n
+        self._pending_cells -= n
+        return CellBatch.concat(taken) if len(taken) > 1 else taken[0]
+
+    def _cut_segment(self, n: int) -> None:
+        seg = self._take(n)
+        # ordering guard: identity lanes must be lexicographically
+        # non-decreasing across the whole stream
+        first = seg.lanes[0].astype(">u4").tobytes()
+        if self._last_lane_end is not None and first < self._last_lane_end:
+            raise ValueError("appended cells out of order")
+        if n > 1:
+            a, b = seg.lanes[:-1], seg.lanes[1:]
+            neq = a != b
+            anyneq = neq.any(axis=1)
+            if anyneq.any():
+                fi = neq.argmax(axis=1)
+                rows = np.arange(n - 1)
+                if ((a[rows, fi] > b[rows, fi]) & anyneq).any():
+                    raise ValueError("appended cells out of order")
+
+        # --- partition directory + bloom
+        lane4 = np.ascontiguousarray(seg.lanes[:, :4])
+        part_new = np.ones(n, dtype=bool)
+        part_new[1:] = (lane4[1:] != lane4[:-1]).any(axis=1)
+        starts = np.flatnonzero(part_new)
+        new_keys = []
+        for s in starts:
+            l4 = lane4[s].astype(">u4").tobytes()
+            if l4 == self._last_lane4:
+                continue  # partition continues from previous segment
+            pk = seg.pk_map.get(l4)
+            if pk is None:
+                raise ValueError("pk_map missing partition key")
+            self._part_lane4.append(l4)
+            self._part_first_cell.append(self._total_cells + int(s))
+            self._part_pk.append(pk)
+            new_keys.append(pk)
+            self._last_lane4 = l4
+        self._bloom.add_batch(new_keys)
+
+        # --- stats
+        st = self._stats
+
+        def _lo(key, v):
+            st[key] = v if st[key] is None else min(st[key], v)
+
+        def _hi(key, v):
+            st[key] = v if st[key] is None else max(st[key], v)
+
+        _lo("min_ts", int(seg.ts.min()))
+        _hi("max_ts", int(seg.ts.max()))
+        _lo("min_ldt", int(seg.ldt.min()))
+        _hi("max_ldt", int(seg.ldt.max()))
+        from ..cellbatch import DEATH_FLAGS
+        self._stats["tombstones"] += int(
+            ((seg.flags & DEATH_FLAGS) != 0).sum())
+
+        # --- blocks
+        off_rel = (seg.off - seg.off[0]).astype(np.int64)
+        vs_rel = (seg.val_start - seg.off[0]).astype(np.int64)
+        meta = b"".join([
+            seg.ts.astype("<i8").tobytes(),
+            seg.ldt.astype("<i4").tobytes(),
+            seg.ttl.astype("<i4").tobytes(),
+            seg.flags.astype("u1").tobytes(),
+            off_rel.astype("<i8").tobytes(),
+            vs_rel.astype("<i8").tobytes(),
+        ])
+        lanes_b = seg.lanes.astype("<u4").tobytes()
+        payload_b = seg.payload.tobytes()
+        blocks = [meta, lanes_b, payload_b]
+        comp = self.compressor.compress_batch(blocks)
+        # min_compress_ratio fallback: store uncompressed when too poor
+        # (CompressedSequentialWriter.java:160-175 semantics)
+        maxlen = self.params.max_compressed_length
+        entry = struct.pack("<QI", self._data_off, n)
+        for raw, c in zip(blocks, comp):
+            if len(c) >= min(len(raw), maxlen):
+                c = raw
+            crc = zlib.crc32(c)
+            entry += struct.pack("<QQI", len(c), len(raw), crc)
+            self._data.write(c)
+            self._data_crc = zlib.crc32(c, self._data_crc)
+            self._data_off += len(c)
+        entry += seg.lanes[0].astype("<u4").tobytes()
+        entry += seg.lanes[-1].astype("<u4").tobytes()
+        self._index_entries.append(entry)
+        self._total_cells += n
+        self._last_lane_end = seg.lanes[-1].astype(">u4").tobytes()
+
+    _last_lane_end: bytes | None = None
+
+    def _write_index(self) -> None:
+        with open(self.desc.tmp_path(Component.INDEX), "wb") as f:
+            f.write(struct.pack("<III", len(self._index_entries), self.K,
+                                self.segment_cells))
+            for e in self._index_entries:
+                f.write(e)
+
+    def _write_partitions(self) -> None:
+        with open(self.desc.tmp_path(Component.PARTITIONS), "wb") as f:
+            np_count = len(self._part_lane4)
+            f.write(struct.pack("<I", np_count))
+            f.write(b"".join(self._part_lane4))
+            f.write(np.array(self._part_first_cell,
+                             dtype="<i8").tobytes())
+            pk_off = np.zeros(np_count + 1, dtype="<i8")
+            np.cumsum([len(p) for p in self._part_pk], out=pk_off[1:])
+            f.write(pk_off.tobytes())
+            f.write(b"".join(self._part_pk))
+
+    def _write_filter(self) -> None:
+        with open(self.desc.tmp_path(Component.FILTER), "wb") as f:
+            f.write(self._bloom.serialize())
+
+    def _write_stats(self) -> dict:
+        stats = {
+            "version": self.desc.version,
+            "keyspace": self.table.keyspace,
+            "table": self.table.name,
+            "table_id": str(self.table.id),
+            "n_lanes": self.K,
+            "segment_cells": self.segment_cells,
+            "n_cells": self._total_cells,
+            "n_partitions": len(self._part_lane4),
+            "compression": self.params.to_dict(),
+            **self._stats,
+        }
+        with open(self.desc.tmp_path(Component.STATS), "w") as f:
+            json.dump(stats, f)
+        return stats
+
+    def _write_digest(self) -> None:
+        with open(self.desc.tmp_path(Component.DIGEST), "w") as f:
+            f.write(f"{self._data_crc & 0xFFFFFFFF}\n")

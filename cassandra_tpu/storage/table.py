@@ -1,0 +1,174 @@
+"""ColumnFamilyStore equivalent: per-table store owning the memtable, the
+live SSTable set, and the flush machinery.
+
+Reference counterpart: db/ColumnFamilyStore.java (switchMemtable:1038,
+inner Flush:1180, forceFlush:1089), db/lifecycle/Tracker.java:85 (the
+atomic view of live memtables+sstables).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from ..schema import TableMetadata
+from ..utils import timeutil
+from .cellbatch import CellBatch, merge_sorted
+from .memtable import Memtable
+from .mutation import Mutation
+from .sstable import Descriptor, SSTableReader, SSTableWriter
+
+
+class Tracker:
+    """Atomic view of the live data sources (db/lifecycle/Tracker.java:85).
+    Mutated under a lock; readers grab a consistent snapshot list."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.sstables: list[SSTableReader] = []
+
+    def view(self) -> list[SSTableReader]:
+        with self._lock:
+            return list(self.sstables)
+
+    def add(self, reader: SSTableReader) -> None:
+        with self._lock:
+            self.sstables.append(reader)
+            self.sstables.sort(key=lambda r: r.desc.generation)
+
+    def replace(self, removed: list[SSTableReader],
+                added: list[SSTableReader]) -> None:
+        with self._lock:
+            keep = [s for s in self.sstables if s not in removed]
+            self.sstables = sorted(keep + added,
+                                   key=lambda r: r.desc.generation)
+
+
+class ColumnFamilyStore:
+    DEFAULT_FLUSH_THRESHOLD = 64 * 1024 * 1024  # bytes of live memtable data
+
+    def __init__(self, table: TableMetadata, data_dir: str,
+                 commitlog=None, flush_threshold: int | None = None):
+        self.table = table
+        self.directory = os.path.join(
+            data_dir, table.keyspace,
+            f"{table.name}-{table.id.hex[:8]}")
+        os.makedirs(self.directory, exist_ok=True)
+        self.commitlog = commitlog
+        self.flush_threshold = flush_threshold or self.DEFAULT_FLUSH_THRESHOLD
+        self.tracker = Tracker()
+        self.memtable = Memtable(table)
+        self._flush_lock = threading.Lock()
+        self._switch_lock = threading.RLock()
+        self.metrics = {"writes": 0, "reads": 0, "flushes": 0,
+                        "bytes_flushed": 0}
+        for desc in Descriptor.list_in(self.directory):
+            self.tracker.add(SSTableReader(desc))
+        self.compaction_listener = None  # set by CompactionManager
+
+    # ------------------------------------------------------------- write --
+
+    def apply(self, mutation: Mutation, commitlog=None,
+              durable: bool = True) -> None:
+        """Commitlog append + memtable put as one unit against a single
+        memtable epoch (Keyspace.applyInternal ordering). Holding the
+        switch lock across both makes every write either fully before a
+        flush's switch point (old memtable, CL position < flush position)
+        or fully after (new memtable, CL position >= flush position) —
+        the role of the reference's OpOrder write barrier
+        (db/ColumnFamilyStore.java:1180-1240)."""
+        with self._switch_lock:
+            if commitlog is not None and durable:
+                commitlog.add(mutation)
+            self.memtable.apply(mutation)
+            self.metrics["writes"] += 1
+
+    def should_flush(self) -> bool:
+        return self.memtable.live_bytes >= self.flush_threshold
+
+    # ------------------------------------------------------------- flush --
+
+    def flush(self) -> SSTableReader | None:
+        """Switch the memtable and write it out (ColumnFamilyStore.Flush).
+        Returns the new sstable reader (None if memtable was empty)."""
+        with self._flush_lock:
+            with self._switch_lock:
+                old = self.memtable
+                if old.is_empty:
+                    return None
+                flush_pos = self.commitlog.current_position() \
+                    if self.commitlog else None
+                self.memtable = Memtable(self.table)
+            batch = old.flush_batch()
+            gen = Descriptor.next_generation(self.directory)
+            desc = Descriptor(self.directory, gen)
+            writer = SSTableWriter(
+                desc, self.table,
+                estimated_partitions=len(old._partitions))
+            try:
+                writer.append(batch)
+                stats = writer.finish()
+            except BaseException:
+                writer.abort()
+                raise
+            reader = SSTableReader(desc)
+            self.tracker.add(reader)
+            self.metrics["flushes"] += 1
+            self.metrics["bytes_flushed"] += reader.data_size
+            if self.commitlog and flush_pos:
+                self.commitlog.discard_completed(self.table.id, flush_pos)
+            if self.compaction_listener:
+                self.compaction_listener(self)
+            return reader
+
+    # -------------------------------------------------------------- read --
+
+    def read_partition(self, pk: bytes, now: int | None = None) -> CellBatch:
+        """Merged view of one partition across memtable + sstables
+        (SinglePartitionReadCommand.queryMemtableAndDisk role)."""
+        self.metrics["reads"] += 1
+        now = now if now is not None else timeutil.now_seconds()
+        sources = []
+        with self._switch_lock:
+            mem = self.memtable
+        m = mem.read_partition(pk)
+        if m is not None:
+            sources.append(m)
+        for sst in self.tracker.view():
+            part = sst.read_partition(pk)
+            if part is not None:
+                sources.append(part)
+        if not sources:
+            from .cellbatch import lanes_for_table
+            return CellBatch.empty(lanes_for_table(self.table))
+        return merge_sorted(sources, now=now)
+
+    def scan_all(self, now: int | None = None) -> CellBatch:
+        """Full-table merged view (range-read building block; small data)."""
+        now = now if now is not None else timeutil.now_seconds()
+        sources = [self.memtable.scan()]
+        for sst in self.tracker.view():
+            segs = list(sst.scanner())
+            if segs:
+                cat = CellBatch.concat(segs)
+                cat.sorted = True
+                sources.append(cat)
+        return merge_sorted([s for s in sources if len(s)] or sources[:1],
+                            now=now)
+
+    # --------------------------------------------------------------- misc --
+
+    def live_sstables(self) -> list[SSTableReader]:
+        return self.tracker.view()
+
+    def truncate(self) -> None:
+        with self._switch_lock:
+            self.memtable = Memtable(self.table)
+            old = self.tracker.view()
+            self.tracker.replace(old, [])
+            for sst in old:
+                sst.close()
+                for p in sst.desc.all_paths():
+                    if os.path.exists(p):
+                        os.remove(p)

@@ -24,7 +24,7 @@ def pk(i):
 
 
 def ck(i):
-    return T.clustering_bytecomp([i])
+    return T.serialize_clustering([i])
 
 
 def build(cells):
@@ -188,7 +188,7 @@ def test_desc_clustering_order():
                     cols={"id": "int", "c": "int", "v": "text"})
     b = cb.CellBatchBuilder(Td)
     for c in (1, 3, 2):
-        b.add_cell(pk(7), Td.clustering_bytecomp([c]), COL_REGULAR_BASE,
+        b.add_cell(pk(7), Td.serialize_clustering([c]), COL_REGULAR_BASE,
                    str(c).encode(), 100)
     m = cb.merge_sorted([b.seal()])
     vals = [m.cell_payload(i)[2] for i in range(len(m))]
@@ -201,7 +201,7 @@ def test_static_row_sorts_first():
     b = cb.CellBatchBuilder(Ts)
     s_id = Ts.columns["s"].column_id
     v_id = Ts.columns["v"].column_id
-    b.add_cell(pk(1), Ts.clustering_bytecomp([0]), v_id, b"row", 100)
+    b.add_cell(pk(1), Ts.serialize_clustering([0]), v_id, b"row", 100)
     b.add_cell(pk(1), b"", s_id, b"static", 100)   # static: empty clustering
     m = cb.merge_sorted([b.seal()])
     first_ck, _, first_val = m.cell_payload(0)

@@ -20,7 +20,7 @@ def pk(i):
 
 
 def ck(i):
-    return T.clustering_bytecomp([i])
+    return T.serialize_clustering([i])
 
 
 def assert_equal_batches(a, b):

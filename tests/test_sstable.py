@@ -1,0 +1,182 @@
+"""SSTable write/read round-trips (reference test model:
+io/sstable/SSTableReaderTest, CompressedSequentialWriterTest)."""
+import os
+import random
+
+import numpy as np
+import pytest
+
+from cassandra_tpu.ops.codec import CompressionParams
+from cassandra_tpu.schema import COL_REGULAR_BASE, TableParams, make_table
+from cassandra_tpu.storage import cellbatch as cb
+from cassandra_tpu.storage.sstable import (Component, Descriptor,
+                                           SSTableReader, SSTableWriter)
+
+
+def make_t(compressor="LZ4Compressor"):
+    return make_table("ks", "t", pk=["id"], ck=["c"],
+                      cols={"id": "int", "c": "int", "v": "text"},
+                      params=TableParams(
+                          compression=CompressionParams(compressor)))
+
+
+def sorted_batch(table, n_parts=50, n_cks=20, seed=3):
+    rng = random.Random(seed)
+    b = cb.CellBatchBuilder(table)
+    idt = table.columns["id"].cql_type
+    for p in range(n_parts):
+        for c in range(n_cks):
+            b.add_cell(idt.serialize(p), table.serialize_clustering([c]),
+                       COL_REGULAR_BASE,
+                       f"value-{p}-{c}-{rng.random()}".encode(), 1000 + c)
+    return cb.merge_sorted([b.seal()])
+
+
+@pytest.mark.parametrize("compressor", ["LZ4Compressor", "SnappyCompressor",
+                                        "ZstdCompressor", "DeflateCompressor",
+                                        "NoopCompressor"])
+def test_roundtrip(tmp_path, compressor):
+    t = make_t(compressor)
+    batch = sorted_batch(t)
+    desc = Descriptor(str(tmp_path), 1)
+    w = SSTableWriter(desc, t, segment_cells=256)  # force many segments
+    w.append(batch)
+    stats = w.finish()
+    assert stats["n_cells"] == len(batch)
+    assert stats["n_partitions"] == 50
+
+    r = SSTableReader(desc)
+    assert r.n_cells == len(batch)
+    assert r.verify_digest()
+    # full scan == original batch
+    got = cb.CellBatch.concat(list(r.scanner()))
+    np.testing.assert_array_equal(got.lanes, batch.lanes)
+    np.testing.assert_array_equal(got.ts, batch.ts)
+    np.testing.assert_array_equal(got.payload, batch.payload)
+    r.close()
+
+
+def test_point_reads(tmp_path):
+    t = make_t()
+    batch = sorted_batch(t, n_parts=100, n_cks=10)
+    desc = Descriptor(str(tmp_path), 1)
+    w = SSTableWriter(desc, t, segment_cells=128)
+    w.append(batch)
+    w.finish()
+    r = SSTableReader(desc)
+    idt = t.columns["id"].cql_type
+    for p in (0, 7, 50, 99):
+        part = r.read_partition(idt.serialize(p))
+        assert part is not None and len(part) == 10
+        for i in range(len(part)):
+            assert part.partition_key(i) == idt.serialize(p)
+            assert part.cell_value(i).startswith(f"value-{p}-".encode())
+    assert r.read_partition(idt.serialize(100000)) is None
+    r.close()
+
+
+def test_partition_spanning_segments(tmp_path):
+    t = make_t()
+    # one huge partition crossing many segments
+    b = cb.CellBatchBuilder(t)
+    idt = t.columns["id"].cql_type
+    for c in range(1000):
+        b.add_cell(idt.serialize(1), t.serialize_clustering([c]),
+                   COL_REGULAR_BASE, f"v{c}".encode(), 1)
+    batch = cb.merge_sorted([b.seal()])
+    desc = Descriptor(str(tmp_path), 1)
+    w = SSTableWriter(desc, t, segment_cells=64)
+    w.append(batch)
+    stats = w.finish()
+    assert stats["n_partitions"] == 1
+    r = SSTableReader(desc)
+    part = r.read_partition(idt.serialize(1))
+    assert len(part) == 1000
+    vals = {part.cell_value(i) for i in range(1000)}
+    assert vals == {f"v{c}".encode() for c in range(1000)}
+    r.close()
+
+
+def test_multiple_appends_and_order_guard(tmp_path):
+    t = make_t()
+    batch = sorted_batch(t, n_parts=20, n_cks=5)
+    half = len(batch) // 2
+    first = batch.apply_permutation(np.arange(half))
+    first.pk_map = batch.pk_map
+    second = batch.apply_permutation(np.arange(half, len(batch)))
+    second.pk_map = batch.pk_map
+    desc = Descriptor(str(tmp_path), 1)
+    w = SSTableWriter(desc, t, segment_cells=32)
+    w.append(first)
+    w.append(second)
+    w.finish()
+    r = SSTableReader(desc)
+    got = cb.CellBatch.concat(list(r.scanner()))
+    np.testing.assert_array_equal(got.lanes, batch.lanes)
+    r.close()
+    # out-of-order append must raise
+    desc2 = Descriptor(str(tmp_path), 2)
+    w2 = SSTableWriter(desc2, t, segment_cells=32)
+    w2.append(second)
+    with pytest.raises(ValueError):
+        w2.append(first)
+        w2.finish()
+    w2.abort()
+
+
+def test_corruption_detected(tmp_path):
+    t = make_t()
+    desc = Descriptor(str(tmp_path), 1)
+    w = SSTableWriter(desc, t, segment_cells=256)
+    w.append(sorted_batch(t))
+    w.finish()
+    # flip a byte in Data.db
+    p = desc.path(Component.DATA)
+    data = bytearray(open(p, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(p, "wb").write(bytes(data))
+    r = SSTableReader(desc)
+    assert not r.verify_digest()
+    from cassandra_tpu.storage.sstable.reader import CorruptSSTableError
+    with pytest.raises((CorruptSSTableError, ValueError)):
+        list(r.scanner())
+    r.close()
+
+
+def test_discovery_and_generations(tmp_path):
+    t = make_t()
+    assert Descriptor.next_generation(str(tmp_path)) == 1
+    for gen in (1, 2):
+        w = SSTableWriter(Descriptor(str(tmp_path), gen), t)
+        w.append(sorted_batch(t, n_parts=5, n_cks=2, seed=gen))
+        w.finish()
+    descs = Descriptor.list_in(str(tmp_path))
+    assert [d.generation for d in descs] == [1, 2]
+    assert Descriptor.next_generation(str(tmp_path)) == 3
+    # aborted writer leaves no trace
+    w = SSTableWriter(Descriptor(str(tmp_path), 3), t)
+    w.append(sorted_batch(t, n_parts=3, n_cks=2))
+    w.abort()
+    assert [d.generation for d in Descriptor.list_in(str(tmp_path))] == [1, 2]
+
+
+def test_tombstones_and_stats(tmp_path):
+    t = make_t()
+    b = cb.CellBatchBuilder(t)
+    idt = t.columns["id"].cql_type
+    b.add_cell(idt.serialize(1), t.serialize_clustering([1]),
+               COL_REGULAR_BASE, b"x", 100)
+    b.add_tombstone(idt.serialize(1), t.serialize_clustering([2]),
+                    COL_REGULAR_BASE, 200, 5000)
+    batch = cb.merge_sorted([b.seal()])
+    desc = Descriptor(str(tmp_path), 1)
+    w = SSTableWriter(desc, t)
+    w.append(batch)
+    stats = w.finish()
+    assert stats["tombstones"] == 1
+    assert stats["min_ts"] == 100 and stats["max_ts"] == 200
+    r = SSTableReader(desc)
+    part = r.read_partition(idt.serialize(1))
+    assert len(part) == 2
+    assert bool(part.flags[1] & cb.FLAG_TOMBSTONE)
+    r.close()
