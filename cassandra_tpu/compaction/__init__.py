@@ -1,0 +1,2 @@
+from .manager import CompactionManager  # noqa: F401
+from .strategies import get_strategy  # noqa: F401

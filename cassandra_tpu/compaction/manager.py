@@ -1,0 +1,127 @@
+"""CompactionManager: background compaction scheduling + throughput gate.
+
+Reference counterpart: db/compaction/CompactionManager.java:142
+(submitBackground:237, CompactionExecutor:2042, rate limiting via
+compaction_throughput). One worker thread (this host has one core); tests
+drive it synchronously with run_pending().
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from .strategies import get_strategy
+
+
+class RateLimiter:
+    """Token-bucket MB/s limiter (compaction_throughput,
+    conf/cassandra.yaml:1243; 0 = unthrottled)."""
+
+    def __init__(self, mib_per_s: float = 0.0):
+        self.rate = mib_per_s * 2**20
+        self._allowance = self.rate
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def acquire(self, nbytes: int) -> None:
+        if self.rate <= 0:
+            return
+        with self._lock:
+            now = time.monotonic()
+            self._allowance = min(
+                self.rate, self._allowance + (now - self._last) * self.rate)
+            self._last = now
+            if nbytes > self._allowance:
+                time.sleep((nbytes - self._allowance) / self.rate)
+                self._allowance = 0
+            else:
+                self._allowance -= nbytes
+
+
+class CompactionManager:
+    def __init__(self, throughput_mib_s: float = 0.0, auto: bool = False):
+        self.limiter = RateLimiter(throughput_mib_s)
+        self.auto = auto
+        self._queue: queue.Queue = queue.Queue()
+        self._pending_cfs: set = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._worker: threading.Thread | None = None
+        self.completed: list[dict] = []
+        if auto:
+            self._worker = threading.Thread(target=self._run_loop,
+                                            daemon=True)
+            self._worker.start()
+
+    # ----------------------------------------------------------- register --
+
+    def register(self, cfs) -> None:
+        """Hook the CFS flush notification (Tracker -> strategy manager
+        notification path in the reference)."""
+        cfs.compaction_listener = self.submit_background
+
+    def submit_background(self, cfs) -> None:
+        with self._lock:
+            if cfs in self._pending_cfs:
+                return
+            self._pending_cfs.add(cfs)
+        self._queue.put(cfs)
+        if not self.auto:
+            return  # tests call run_pending() explicitly
+
+    # ------------------------------------------------------------ execute --
+
+    def run_pending(self, max_tasks: int = 100) -> int:
+        """Drain the queue synchronously; returns tasks executed."""
+        done = 0
+        while done < max_tasks:
+            try:
+                cfs = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            with self._lock:
+                self._pending_cfs.discard(cfs)
+            done += self._maybe_compact(cfs)
+        return done
+
+    def _maybe_compact(self, cfs) -> int:
+        strategy = get_strategy(cfs)
+        n = 0
+        while True:
+            task = strategy.next_background_task()
+            if task is None:
+                break
+            self.limiter.acquire(sum(r.data_size for r in task.inputs))
+            stats = task.execute()
+            self.completed.append(stats)
+            n += 1
+        return n
+
+    def major_compaction(self, cfs) -> dict | None:
+        """nodetool compact equivalent."""
+        task = get_strategy(cfs).major_task()
+        if task is None:
+            return None
+        stats = task.execute()
+        self.completed.append(stats)
+        return stats
+
+    def _run_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                cfs = self._queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            with self._lock:
+                self._pending_cfs.discard(cfs)
+            try:
+                self._maybe_compact(cfs)
+            except Exception:   # background task failure must not kill loop
+                import traceback
+                traceback.print_exc()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._worker:
+            self._worker.join(timeout=5)

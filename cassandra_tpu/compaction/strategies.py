@@ -1,0 +1,209 @@
+"""Compaction strategies: which sstables to merge next.
+
+Reference counterparts:
+  AbstractCompactionStrategy.java:65 (SPI: getNextBackgroundTask)
+  SizeTieredCompactionStrategy.java:41 (size buckets, :248 getBuckets)
+  LeveledCompactionStrategy.java:47 + LeveledManifest.java:54
+  TimeWindowCompactionStrategy.java:52 (windows :174, expired drop :128)
+
+Strategies only *select*; CompactionTask does the work. Selection reads
+each sstable's Statistics.db metadata (size, level, max timestamp,
+max local-deletion-time).
+"""
+from __future__ import annotations
+
+import time
+
+from ..storage.sstable import SSTableReader
+from ..utils import timeutil
+
+
+class AbstractCompactionStrategy:
+    def __init__(self, cfs, options: dict | None = None):
+        self.cfs = cfs
+        self.options = options or {}
+        self.min_threshold = int(self.options.get("min_threshold", 4))
+        self.max_threshold = int(self.options.get("max_threshold", 32))
+
+    def next_background_task(self):
+        """Return a CompactionTask or None (getNextBackgroundTask)."""
+        raise NotImplementedError
+
+    def major_task(self):
+        """Compact everything (nodetool compact / major compaction)."""
+        from .task import CompactionTask
+        live = self.cfs.live_sstables()
+        if len(live) < 1:
+            return None
+        return CompactionTask(self.cfs, live)
+
+    # ---- helpers
+
+    def _fully_expired(self) -> list[SSTableReader]:
+        """SSTables whose every cell is an expired tombstone older than
+        gc grace with no overlap concern (TWCS-style drop;
+        CompactionController.getFullyExpiredSSTables)."""
+        gc_before = timeutil.now_seconds() - \
+            self.cfs.table.params.gc_grace_seconds
+        out = []
+        live = self.cfs.live_sstables()
+        for s in live:
+            if s.max_ldt is None or s.max_ldt >= gc_before:
+                continue
+            if s.n_tombstones < s.n_cells:
+                continue  # has live data
+            # overlap guard: any other source with older data?
+            others = [o for o in live if o is not s]
+            if any(o.min_ts is not None and s.max_ts is not None
+                   and o.min_ts <= s.max_ts and self._token_overlap(o, s)
+                   for o in others):
+                continue
+            out.append(s)
+        return out
+
+    @staticmethod
+    def _token_overlap(a: SSTableReader, b: SSTableReader) -> bool:
+        return a.min_token() <= b.max_token() and b.min_token() <= a.max_token()
+
+
+class SizeTieredCompactionStrategy(AbstractCompactionStrategy):
+    """Bucket sstables of similar size; compact the biggest eligible
+    bucket (hottest-first is a refinement we skip: reference :116)."""
+
+    def __init__(self, cfs, options=None):
+        super().__init__(cfs, options)
+        self.bucket_low = float(self.options.get("bucket_low", 0.5))
+        self.bucket_high = float(self.options.get("bucket_high", 1.5))
+        self.min_sstable_size = int(self.options.get(
+            "min_sstable_size", 50 * 1024 * 1024))
+
+    def buckets(self) -> list[list[SSTableReader]]:
+        ssts = sorted(self.cfs.live_sstables(), key=lambda s: s.data_size)
+        buckets: list[tuple[float, list[SSTableReader]]] = []
+        for s in ssts:
+            size = s.data_size
+            for i, (avg, items) in enumerate(buckets):
+                if (self.bucket_low * avg <= size <= self.bucket_high * avg) \
+                        or (size < self.min_sstable_size
+                            and avg < self.min_sstable_size):
+                    items.append(s)
+                    buckets[i] = ((avg * (len(items) - 1) + size)
+                                  / len(items), items)
+                    break
+            else:
+                buckets.append((float(size), [s]))
+        return [items for _, items in buckets]
+
+    def next_background_task(self):
+        from .task import CompactionTask
+        candidates = [b for b in self.buckets()
+                      if len(b) >= self.min_threshold]
+        if not candidates:
+            return None
+        bucket = max(candidates, key=len)[: self.max_threshold]
+        return CompactionTask(self.cfs, bucket)
+
+
+class LeveledCompactionStrategy(AbstractCompactionStrategy):
+    """Simplified leveled strategy: L0 (flushes) -> L1..: non-overlapping
+    runs, each level `fanout` times larger (LeveledManifest semantics)."""
+
+    def __init__(self, cfs, options=None):
+        super().__init__(cfs, options)
+        self.max_sstable_bytes = int(float(self.options.get(
+            "sstable_size_in_mb", 160)) * 1024 * 1024)
+        self.fanout = int(self.options.get("fanout_size", 10))
+        self.l0_threshold = int(self.options.get("l0_threshold", 4))
+
+    def _levels(self) -> dict[int, list[SSTableReader]]:
+        levels: dict[int, list[SSTableReader]] = {}
+        for s in self.cfs.live_sstables():
+            levels.setdefault(s.level, []).append(s)
+        return levels
+
+    def _level_target_bytes(self, level: int) -> int:
+        return self.max_sstable_bytes * (self.fanout ** level)
+
+    def _overlapping(self, ssts, candidates):
+        lo = min(s.min_token() for s in ssts)
+        hi = max(s.max_token() for s in ssts)
+        return [c for c in candidates
+                if c.min_token() <= hi and lo <= c.max_token()]
+
+    def next_background_task(self):
+        from .task import CompactionTask
+        levels = self._levels()
+        # L0 -> L1 when enough flushes accumulated
+        l0 = levels.get(0, [])
+        if len(l0) >= self.l0_threshold:
+            inputs = l0[: self.max_threshold] + \
+                self._overlapping(l0, levels.get(1, []))
+            return CompactionTask(self.cfs, inputs,
+                                  max_output_bytes=self.max_sstable_bytes,
+                                  level=1)
+        # level overflow: push one sstable into the next level
+        for lvl in sorted(l for l in levels if l > 0):
+            total = sum(s.data_size for s in levels[lvl])
+            if total > self._level_target_bytes(lvl):
+                victim = max(levels[lvl], key=lambda s: s.data_size)
+                inputs = [victim] + self._overlapping([victim],
+                                                      levels.get(lvl + 1, []))
+                return CompactionTask(self.cfs, inputs,
+                                      max_output_bytes=self.max_sstable_bytes,
+                                      level=lvl + 1)
+        return None
+
+
+class TimeWindowCompactionStrategy(AbstractCompactionStrategy):
+    """Time-series strategy: bucket by write-time window; STCS inside the
+    current window, one sstable per older window, drop fully-expired
+    sstables first (TimeWindowCompactionStrategy.java:83,128,174)."""
+
+    _UNITS = {"MINUTES": 60, "HOURS": 3600, "DAYS": 86400}
+
+    def __init__(self, cfs, options=None):
+        super().__init__(cfs, options)
+        unit = str(self.options.get("compaction_window_unit",
+                                    "DAYS")).upper()
+        size = int(self.options.get("compaction_window_size", 1))
+        self.window_seconds = self._UNITS.get(unit, 86400) * size
+
+    def _window_of(self, sst: SSTableReader) -> int:
+        # max timestamp is micros; windows are in seconds
+        return int((sst.max_ts or 0) // 1_000_000 // self.window_seconds)
+
+    def next_background_task(self):
+        from .task import CompactionTask
+        expired = self._fully_expired()
+        if expired:
+            # dropping needs no merge: rewrite-free task over expired only
+            return CompactionTask(self.cfs, expired)
+        windows: dict[int, list[SSTableReader]] = {}
+        for s in self.cfs.live_sstables():
+            windows.setdefault(self._window_of(s), []).append(s)
+        if not windows:
+            return None
+        newest = max(windows)
+        for w, ssts in sorted(windows.items()):
+            if w == newest:
+                if len(ssts) >= self.min_threshold:
+                    return CompactionTask(self.cfs,
+                                          ssts[: self.max_threshold])
+            elif len(ssts) > 1:
+                return CompactionTask(self.cfs, ssts[: self.max_threshold])
+        return None
+
+
+STRATEGIES = {
+    "SizeTieredCompactionStrategy": SizeTieredCompactionStrategy,
+    "LeveledCompactionStrategy": LeveledCompactionStrategy,
+    "TimeWindowCompactionStrategy": TimeWindowCompactionStrategy,
+}
+
+
+def get_strategy(cfs) -> AbstractCompactionStrategy:
+    opts = dict(cfs.table.params.compaction)
+    name = opts.pop("class", "SizeTieredCompactionStrategy").rsplit(".", 1)[-1]
+    if name not in STRATEGIES:
+        raise ValueError(f"unknown compaction strategy {name}")
+    return STRATEGIES[name](cfs, opts)

@@ -1,0 +1,255 @@
+"""CompactionTask: the streaming device-merge rewrite of N sstables.
+
+Reference counterpart: db/compaction/CompactionTask.java:114 (runMayThrow;
+the hot loop :207-225 `while (ci.hasNext()) writer.append(ci.next())`),
+CompactionIterator.java:90 (merge + purge pipeline) and
+CompactionController.java:55 (purgeability from overlapping sources).
+
+TPU formulation: instead of a row-at-a-time heap, each round buffers one
+batch per input run, finds the safe merge boundary (min of the runs'
+buffered maxima), merges everything below it in ONE device kernel call
+(ops/merge.py), and appends the result to the output writer. Disk I/O
+(segment decode) and device merge alternate per round; batches are large
+(64K cells) so the device amortises.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..ops import merge as dmerge
+from ..storage import cellbatch as cb
+from ..storage.lifecycle import LifecycleTransaction, _delete_sstable_files
+from ..storage.sstable import Descriptor, SSTableReader, SSTableWriter
+from ..utils import timeutil
+
+
+def _lane_keys(batch: cb.CellBatch) -> np.ndarray:
+    """Rows as fixed-width byte strings (lexicographic == lane order)."""
+    K = batch.n_lanes
+    return np.ascontiguousarray(batch.lanes.astype(">u4")).view(
+        f"S{4 * K}").ravel()
+
+
+class _Cursor:
+    """Buffered scanner over one input sstable.
+
+    Merge rounds are PARTITION-ALIGNED: deletion markers sort at the start
+    of their partition/row, so reconcile is only correct when a round sees
+    whole partitions (the reference's CompactionIterator merges per
+    partition for the same reason). A partition larger than one segment is
+    buffered whole — acceptable for round 1; the reference streams within
+    partitions via its row index."""
+
+    def __init__(self, reader: SSTableReader):
+        self._it = reader.scanner()
+        self.buf: cb.CellBatch | None = None
+        self.exhausted = False
+        self._advance()
+
+    def _advance(self):
+        try:
+            self.buf = next(self._it)
+        except StopIteration:
+            self.buf = None
+            self.exhausted = True
+
+    def last_partition_prefix(self) -> bytes | None:
+        if self.buf is None or len(self.buf) == 0:
+            return None
+        return bytes(_lane_keys(self.buf)[-1])[:16]
+
+    def extend_past_partition(self, prefix16: bytes) -> None:
+        """Buffer more segments until the buffer no longer ENDS inside the
+        given partition (or input is exhausted)."""
+        while (self.buf is not None
+               and self.last_partition_prefix() == prefix16):
+            try:
+                nxt = next(self._it)
+            except StopIteration:
+                self.exhausted = True
+                return
+            merged = cb.CellBatch.concat([self.buf, nxt])
+            merged.sorted = True
+            self.buf = merged
+
+    def split_at(self, boundary: bytes) -> cb.CellBatch | None:
+        """Take cells with key <= boundary from the buffer; refill when the
+        whole buffer is consumed."""
+        if self.buf is None:
+            return None
+        keys = _lane_keys(self.buf)
+        idx = int(np.searchsorted(keys, np.bytes_(boundary), side="right"))
+        if idx == 0:
+            return None
+        if idx >= len(self.buf):
+            out = self.buf
+            self._advance()
+            return out
+        head = self.buf.apply_permutation(np.arange(idx))
+        head.pk_map = self.buf.pk_map
+        tail = self.buf.apply_permutation(np.arange(idx, len(self.buf)))
+        tail.pk_map = self.buf.pk_map
+        self.buf = tail
+        return head
+
+
+class CompactionController:
+    """Purge decisions: a tombstone may only be dropped if no source
+    OUTSIDE the compaction could still hold older shadowed data for its
+    partition (CompactionController.java:61-121 maxPurgeableTimestamp)."""
+
+    def __init__(self, cfs, compacting: list[SSTableReader]):
+        self.cfs = cfs
+        compacting_gens = {r.desc.generation for r in compacting}
+        self.overlapping = [s for s in cfs.live_sstables()
+                            if s.desc.generation not in compacting_gens]
+
+    def purgeable_ts_fn(self, batch: cb.CellBatch) -> np.ndarray:
+        n = len(batch)
+        out = np.full(n, np.iinfo(np.int64).max, dtype=np.int64)
+        if not self.overlapping and self.cfs.memtable.is_empty:
+            return out
+        lane4 = batch.lanes[:, :4]
+        part_new = np.ones(n, dtype=bool)
+        part_new[1:] = (lane4[1:] != lane4[:-1]).any(axis=1)
+        part_id = np.cumsum(part_new) - 1
+        starts = np.flatnonzero(part_new)
+        per_part = np.full(len(starts), np.iinfo(np.int64).max,
+                           dtype=np.int64)
+        mem = self.cfs.memtable
+        for j, s in enumerate(starts):
+            pk = batch.partition_key(int(s))
+            lo = np.iinfo(np.int64).max
+            for src in self.overlapping:
+                if src.might_contain(pk) and src.min_ts is not None:
+                    lo = min(lo, src.min_ts)
+            if not mem.is_empty and mem.contains(pk):
+                lo = min(lo, 0)  # memtable data is never purged against
+            per_part[j] = lo
+        return per_part[part_id]
+
+
+class CompactionTask:
+    def __init__(self, cfs, inputs: list[SSTableReader],
+                 max_output_bytes: int | None = None,
+                 level: int = 0, use_device: bool = True):
+        self.cfs = cfs
+        self.inputs = inputs
+        self.max_output_bytes = max_output_bytes
+        self.level = level
+        self.use_device = use_device
+
+    def execute(self) -> dict:
+        """Run the compaction; returns stats (reference logs these at
+        CompactionTask.java:252-266)."""
+        cfs = self.cfs
+        table = cfs.table
+        t0 = time.time()
+        gc_before = timeutil.now_seconds() - table.params.gc_grace_seconds
+        now = timeutil.now_seconds()
+        controller = CompactionController(cfs, self.inputs)
+        merge_fn = dmerge.merge_sorted_device if self.use_device \
+            else cb.merge_sorted
+
+        txn = LifecycleTransaction(cfs.directory)
+        writers: list[SSTableWriter] = []
+        new_readers: list[SSTableReader] = []
+        bytes_read = sum(r.data_size for r in self.inputs)
+        cells_read = sum(r.n_cells for r in self.inputs)
+        cells_written = 0
+
+        def new_writer() -> SSTableWriter:
+            gen = cfs.next_generation()
+            desc = Descriptor(cfs.directory, gen)
+            txn.track_new(gen)
+            w = SSTableWriter(desc, table,
+                              estimated_partitions=max(
+                                  sum(r.n_partitions for r in self.inputs), 16))
+            w.level = self.level
+            writers.append(w)
+            return w
+
+        try:
+            writer = new_writer()
+            cursors = [_Cursor(r) for r in self.inputs]
+            while True:
+                active = [c for c in cursors if c.buf is not None]
+                if not active:
+                    break
+                # partition-aligned round: find the minimal buffered-through
+                # key, then make sure no cursor's buffer ends INSIDE that
+                # key's partition, and merge everything up to the partition
+                # end (full key width padded with 0xFF)
+                prefix16 = min(bytes(_lane_keys(c.buf)[-1])
+                               for c in active)[:16]
+                for c in cursors:
+                    c.extend_past_partition(prefix16)
+                K = self.inputs[0].K
+                boundary = prefix16 + b"\xff" * (4 * K - 16)
+                slices = []
+                for c in cursors:
+                    s = c.split_at(boundary)
+                    if s is not None and len(s):
+                        slices.append(s)
+                if not slices:
+                    continue
+                merged = merge_fn(slices, gc_before=gc_before, now=now,
+                                  purgeable_ts_fn=controller.purgeable_ts_fn)
+                if len(merged):
+                    writer.append(merged)
+                    cells_written += len(merged)
+                if self.max_output_bytes and \
+                        writer._data_off >= self.max_output_bytes:
+                    # roll the output (MaxSSTableSizeWriter role)
+                    writer.finish()
+                    new_readers.append(SSTableReader(writer.desc))
+                    writer = new_writer()
+            writer.finish()
+            new_readers.append(SSTableReader(writer.desc))
+            for r in self.inputs:
+                txn.track_obsolete(r.desc.generation)
+            # empty outputs (everything purged) die in the same txn
+            live_new = []
+            for r in new_readers:
+                if r.n_cells > 0:
+                    live_new.append(r)
+                else:
+                    r.close()
+                    txn.track_obsolete(r.desc.generation)
+            # swap the live view, then commit; input readers are only
+            # RELEASED (their fds stay open for in-flight reads and close
+            # when the last reference drops — reference SSTableReader
+            # ref-counting, utils/concurrent/Ref)
+            cfs.tracker.replace(self.inputs, live_new)
+            txn.commit()
+            for r in self.inputs:
+                r.release()
+        except BaseException:
+            for w in writers:
+                try:
+                    w.abort()
+                except Exception:
+                    pass
+            for r in new_readers:
+                r.close()
+            txn.abort()   # no-op if the COMMIT record already landed
+            raise
+
+        dt = time.time() - t0
+        bytes_written = sum(r.data_size for r in new_readers)
+        stats = {
+            "inputs": len(self.inputs),
+            "outputs": len([r for r in new_readers if r.n_cells > 0]),
+            "bytes_read": bytes_read,
+            "bytes_written": bytes_written,
+            "cells_read": cells_read,
+            "cells_written": cells_written,
+            "seconds": dt,
+            "read_mib_s": bytes_read / dt / 2**20 if dt > 0 else 0,
+            "write_mib_s": bytes_written / dt / 2**20 if dt > 0 else 0,
+        }
+        if cfs.compaction_history is not None:
+            cfs.compaction_history.append(stats)
+        return stats

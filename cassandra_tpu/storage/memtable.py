@@ -77,6 +77,11 @@ class Memtable:
         sub.pk_map = self._builder.pk_map
         return sub.seal()
 
+    def contains(self, pk: bytes) -> bool:
+        """O(1) partition-presence check (compaction purge guard)."""
+        with self._lock:
+            return pk_lane_key(pk) in self._partitions
+
     def read_partition(self, pk: bytes) -> CellBatch | None:
         """The partition's cells, reconciled (newest versions only)."""
         key16 = pk_lane_key(pk)

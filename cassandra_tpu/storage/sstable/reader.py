@@ -108,6 +108,18 @@ class SSTableReader:
     def max_ts(self):
         return self.stats["max_ts"]
 
+    @property
+    def max_ldt(self):
+        return self.stats.get("max_ldt")
+
+    @property
+    def level(self) -> int:
+        return int(self.stats.get("level", 0))
+
+    @property
+    def n_tombstones(self) -> int:
+        return int(self.stats.get("tombstones", 0))
+
     def partition_key_at(self, i: int) -> bytes:
         return self._pk_blob[self._pk_off[i]:self._pk_off[i + 1]]
 
@@ -127,9 +139,24 @@ class SSTableReader:
         l = self._part_lane4[-1]
         return ((int(l[0]) << 32) | int(l[1])) - _BIAS
 
+    def release(self):
+        """Mark no longer live. The fd stays open so in-flight reads that
+        still hold this reader finish safely; it closes when the object is
+        collected (reference: ref-counted SSTableReader,
+        utils/concurrent/Ref). Use close() only when no reads can exist."""
+        self.released = True
+
+    released = False
+
     def close(self):
         if not self._data.closed:
             self._data.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     # ------------------------------------------------------------- decode
 

@@ -55,6 +55,7 @@ class SSTableWriter:
             "min_ts": None, "max_ts": None, "min_ldt": None, "max_ldt": None,
             "tombstones": 0,
         }
+        self.level = 0   # LCS level (recorded in Statistics.db)
         self._finished = False
 
     # ---------------------------------------------------------------- api --
@@ -255,6 +256,7 @@ class SSTableWriter:
             "n_cells": self._total_cells,
             "n_partitions": len(self._part_lane4),
             "compression": self.params.to_dict(),
+            "level": self.level,
             **self._stats,
         }
         with open(self.desc.tmp_path(Component.STATS), "w") as f:

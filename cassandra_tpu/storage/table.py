@@ -63,9 +63,24 @@ class ColumnFamilyStore:
         self._switch_lock = threading.RLock()
         self.metrics = {"writes": 0, "reads": 0, "flushes": 0,
                         "bytes_flushed": 0}
+        from .lifecycle import replay_directory
+        replay_directory(self.directory)
         for desc in Descriptor.list_in(self.directory):
             self.tracker.add(SSTableReader(desc))
         self.compaction_listener = None  # set by CompactionManager
+        self.compaction_history: list[dict] = []
+        self._gen_lock = threading.Lock()
+        self._last_gen = max(
+            [d.generation for d in Descriptor.list_in(self.directory)],
+            default=0)
+
+    def next_generation(self) -> int:
+        """Race-free generation allocation shared by flush + compaction
+        (a directory re-scan alone is a TOCTOU between writers)."""
+        with self._gen_lock:
+            self._last_gen = max(self._last_gen + 1,
+                                 Descriptor.next_generation(self.directory))
+            return self._last_gen
 
     # ------------------------------------------------------------- write --
 
@@ -101,7 +116,7 @@ class ColumnFamilyStore:
                     if self.commitlog else None
                 self.memtable = Memtable(self.table)
             batch = old.flush_batch()
-            gen = Descriptor.next_generation(self.directory)
+            gen = self.next_generation()
             desc = Descriptor(self.directory, gen)
             writer = SSTableWriter(
                 desc, self.table,

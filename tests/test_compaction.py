@@ -1,0 +1,223 @@
+"""Compaction tests: STCS/LCS/TWCS selection, task correctness (content
+preserved, tombstones purged per gc/overlap rules), lifecycle crash safety.
+(Reference model: CompactionsPurgeTest, CompactionTaskTest,
+LeveledCompactionStrategyTest, TimeWindowCompactionStrategyTest.)"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from cassandra_tpu.compaction import CompactionManager, get_strategy
+from cassandra_tpu.compaction.task import CompactionTask
+from cassandra_tpu.schema import (COL_ROW_LIVENESS, Schema, TableParams,
+                                  make_table)
+from cassandra_tpu.storage import cellbatch as cb
+from cassandra_tpu.storage.engine import StorageEngine
+from cassandra_tpu.storage.lifecycle import replay_directory
+from cassandra_tpu.storage.mutation import Mutation
+from cassandra_tpu.storage.rows import row_to_dict, rows_from_batch
+from cassandra_tpu.storage.sstable import Descriptor
+from cassandra_tpu.utils import timeutil
+
+
+def new_engine(tmp_path, compaction=None, gc_grace=864000):
+    schema = Schema()
+    schema.create_keyspace("ks")
+    params = TableParams(gc_grace_seconds=gc_grace)
+    if compaction:
+        params.compaction = compaction
+    t = make_table("ks", "t", pk=["id"], ck=["c"],
+                   cols={"id": "int", "c": "int", "v": "text"},
+                   params=params)
+    schema.add_table(t)
+    eng = StorageEngine(str(tmp_path / "data"), schema,
+                        commitlog_sync="batch")
+    return eng, t, eng.store("ks", "t")
+
+
+def put(eng, t, p, c, v, ts=None):
+    m = Mutation(t.id, t.columns["id"].cql_type.serialize(p))
+    ck = t.serialize_clustering([c])
+    ts = ts or timeutil.now_micros()
+    m.add(ck, COL_ROW_LIVENESS, b"", b"", ts)
+    m.add(ck, t.columns["v"].column_id, b"",
+          t.columns["v"].cql_type.serialize(v), ts)
+    eng.apply(m)
+
+
+def read_all(t, cfs):
+    return sorted(
+        (row_to_dict(t, r) for r in rows_from_batch(t, cfs.scan_all())),
+        key=lambda r: (r["id"], r["c"]))
+
+
+def test_stcs_selection_and_merge(tmp_path):
+    eng, t, cfs = new_engine(tmp_path)
+    # 4 flushes of similar size -> one STCS bucket
+    for gen in range(4):
+        for p in range(20):
+            put(eng, t, p, gen, f"g{gen}-p{p}")
+        cfs.flush()
+    assert len(cfs.live_sstables()) == 4
+    strat = get_strategy(cfs)
+    task = strat.next_background_task()
+    assert task is not None and len(task.inputs) == 4
+    stats = task.execute()
+    assert stats["outputs"] == 1
+    assert len(cfs.live_sstables()) == 1
+    rows = read_all(t, cfs)
+    assert len(rows) == 80
+    assert {r["v"] for r in rows} == {f"g{g}-p{p}" for g in range(4)
+                                      for p in range(20)}
+    # old files gone from disk
+    assert len(Descriptor.list_in(cfs.directory)) == 1
+    eng.close()
+
+
+def test_overwrites_deduplicated(tmp_path):
+    eng, t, cfs = new_engine(tmp_path)
+    for gen in range(4):
+        for p in range(10):
+            put(eng, t, p, 0, f"v{gen}", ts=1000 + gen)
+        cfs.flush()
+    task = get_strategy(cfs).major_task()
+    stats = task.execute()
+    rows = read_all(t, cfs)
+    assert len(rows) == 10 and all(r["v"] == "v3" for r in rows)
+    # 4 versions collapsed to 1
+    assert stats["cells_written"] < stats["cells_read"]
+    eng.close()
+
+
+def test_tombstone_purge_rules(tmp_path):
+    eng, t, cfs = new_engine(tmp_path, gc_grace=0)  # tombstones purgeable now
+    idt = t.columns["id"].cql_type
+    put(eng, t, 1, 0, "doomed", ts=100)
+    cfs.flush()
+    # delete the row
+    m = Mutation(t.id, idt.serialize(1))
+    m.add(t.serialize_clustering([0]), 1, b"", b"", 200,
+          timeutil.now_seconds() - 10, 0, cb.FLAG_ROW_DEL)
+    eng.apply(m)
+    cfs.flush()
+    assert len(cfs.live_sstables()) == 2
+    # major compaction includes both sstables: tombstone + shadowed data
+    # both disappear (gc_grace=0, no overlap outside the compaction)
+    get_strategy(cfs).major_task().execute()
+    assert read_all(t, cfs) == []
+    live = cfs.live_sstables()
+    assert sum(s.n_cells for s in live) == 0 or len(live) == 0
+    eng.close()
+
+
+def test_tombstone_kept_when_overlap_exists(tmp_path):
+    eng, t, cfs = new_engine(tmp_path, gc_grace=0)
+    idt = t.columns["id"].cql_type
+    put(eng, t, 1, 0, "old", ts=100)
+    cfs.flush()                      # sstable A: data
+    m = Mutation(t.id, idt.serialize(1))
+    m.add(t.serialize_clustering([0]), 1, b"", b"", 200,
+          timeutil.now_seconds() - 10, 0, cb.FLAG_ROW_DEL)
+    eng.apply(m)
+    cfs.flush()                      # sstable B: tombstone
+    a, b = cfs.live_sstables()
+    # compact ONLY the tombstone sstable: A still holds shadowed data, so
+    # the tombstone must survive (CompactionController.shouldPurge)
+    tomb = b if b.n_tombstones else a
+    CompactionTask(cfs, [tomb]).execute()
+    assert read_all(t, cfs) == []    # row still deleted
+    live = cfs.live_sstables()
+    assert any(s.n_tombstones for s in live), "tombstone wrongly purged"
+    eng.close()
+
+
+def test_lcs_levels(tmp_path):
+    eng, t, cfs = new_engine(
+        tmp_path,
+        compaction={"class": "LeveledCompactionStrategy",
+                    "sstable_size_in_mb": 1, "l0_threshold": 4})
+    for gen in range(4):
+        for p in range(30):
+            put(eng, t, p + gen * 30, 0, "x" * 100)
+        cfs.flush()
+    strat = get_strategy(cfs)
+    task = strat.next_background_task()
+    assert task is not None and task.level == 1
+    task.execute()
+    assert all(s.level == 1 for s in cfs.live_sstables())
+    assert read_all(t, cfs) and len(read_all(t, cfs)) == 120
+    eng.close()
+
+
+def test_twcs_windows(tmp_path):
+    eng, t, cfs = new_engine(
+        tmp_path,
+        compaction={"class": "TimeWindowCompactionStrategy",
+                    "compaction_window_unit": "HOURS",
+                    "compaction_window_size": 1})
+    now_us = timeutil.now_micros()
+    hour = 3600 * 1_000_000
+    # two sstables in an OLD window, two in the current window
+    for i, ts in enumerate([now_us - 5 * hour, now_us - 5 * hour + 1000]):
+        put(eng, t, i, 0, f"old{i}", ts=ts)
+        cfs.flush()
+    for i, ts in enumerate([now_us, now_us + 1000]):
+        put(eng, t, 10 + i, 0, f"new{i}", ts=ts)
+        cfs.flush()
+    strat = get_strategy(cfs)
+    task = strat.next_background_task()
+    assert task is not None
+    # must pick the old window (2 sstables there, below min_threshold=4
+    # in the current window)
+    wins = {strat._window_of(s) for s in task.inputs}
+    assert len(wins) == 1 and wins.pop() != max(
+        strat._window_of(s) for s in cfs.live_sstables())
+    task.execute()
+    assert len(read_all(t, cfs)) == 4
+    eng.close()
+
+
+def test_manager_auto_trigger(tmp_path):
+    eng, t, cfs = new_engine(tmp_path)
+    mgr = CompactionManager()
+    mgr.register(cfs)
+    for gen in range(4):
+        for p in range(10):
+            put(eng, t, p, gen, f"{gen}")
+        cfs.flush()
+    assert mgr.run_pending() >= 1
+    assert len(cfs.live_sstables()) == 1
+    assert mgr.completed and mgr.completed[0]["inputs"] == 4
+    eng.close()
+
+
+def test_lifecycle_crash_rollback(tmp_path):
+    eng, t, cfs = new_engine(tmp_path)
+    for gen in range(2):
+        put(eng, t, gen, 0, f"v{gen}")
+        cfs.flush()
+    # simulate a crash mid-compaction: txn log without COMMIT + a stray
+    # new-generation file
+    gen = Descriptor.next_generation(cfs.directory)
+    stray = os.path.join(cfs.directory, f"ca-{gen}-Data.db")
+    open(stray, "wb").write(b"partial")
+    with open(os.path.join(cfs.directory, "txn-deadbeef.log"), "w") as f:
+        f.write(f"ADD {gen}\n")
+    replay_directory(cfs.directory)
+    assert not os.path.exists(stray)
+    assert len(Descriptor.list_in(cfs.directory)) == 2  # originals intact
+    eng.close()
+
+
+def test_lifecycle_crash_rollforward(tmp_path):
+    eng, t, cfs = new_engine(tmp_path)
+    put(eng, t, 1, 0, "a")
+    cfs.flush()
+    old_gen = cfs.live_sstables()[0].desc.generation
+    # committed txn whose REMOVE deletions didn't finish
+    with open(os.path.join(cfs.directory, "txn-cafebabe.log"), "w") as f:
+        f.write(f"REMOVE {old_gen}\nCOMMIT\n")
+    replay_directory(cfs.directory)
+    assert Descriptor.list_in(cfs.directory) == []  # rolled forward
+    eng.close()
