@@ -85,10 +85,12 @@ class CompactionManager:
             done += self._maybe_compact(cfs)
         return done
 
+    MAX_TASKS_PER_SUBMISSION = 4  # bounds livelock if a strategy re-selects
+
     def _maybe_compact(self, cfs) -> int:
         strategy = get_strategy(cfs)
         n = 0
-        while True:
+        while n < self.MAX_TASKS_PER_SUBMISSION:
             task = strategy.next_background_task()
             if task is None:
                 break

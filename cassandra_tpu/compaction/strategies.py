@@ -47,6 +47,11 @@ class AbstractCompactionStrategy:
             self.cfs.table.params.gc_grace_seconds
         out = []
         live = self.cfs.live_sstables()
+        # the purge guard consults the memtable; dropping against a hot
+        # memtable could rewrite the sstable unchanged and re-select it
+        # forever (livelock) — wait for a flush instead
+        if not self.cfs.memtable.is_empty:
+            return out
         for s in live:
             if s.max_ldt is None or s.max_ldt >= gc_before:
                 continue
@@ -136,8 +141,8 @@ class LeveledCompactionStrategy(AbstractCompactionStrategy):
         # L0 -> L1 when enough flushes accumulated
         l0 = levels.get(0, [])
         if len(l0) >= self.l0_threshold:
-            inputs = l0[: self.max_threshold] + \
-                self._overlapping(l0, levels.get(1, []))
+            chosen = l0[: self.max_threshold]
+            inputs = chosen + self._overlapping(chosen, levels.get(1, []))
             return CompactionTask(self.cfs, inputs,
                                   max_output_bytes=self.max_sstable_bytes,
                                   level=1)

@@ -20,7 +20,7 @@ import numpy as np
 
 from ..ops import merge as dmerge
 from ..storage import cellbatch as cb
-from ..storage.lifecycle import LifecycleTransaction, _delete_sstable_files
+from ..storage.lifecycle import LifecycleTransaction
 from ..storage.sstable import Descriptor, SSTableReader, SSTableWriter
 from ..utils import timeutil
 
@@ -30,6 +30,14 @@ def _lane_keys(batch: cb.CellBatch) -> np.ndarray:
     K = batch.n_lanes
     return np.ascontiguousarray(batch.lanes.astype(">u4")).view(
         f"S{4 * K}").ravel()
+
+
+def _full_key(batch: cb.CellBatch, i: int) -> bytes:
+    """Row i's lane key as exactly 4*K bytes. numpy S-dtype strips trailing
+    NUL bytes; comparisons re-pad, but PREFIX SLICING must not see a
+    shortened string — always pad before [:16]."""
+    K = batch.n_lanes
+    return bytes(_lane_keys(batch)[i]).ljust(4 * K, b"\x00")
 
 
 class _Cursor:
@@ -44,72 +52,86 @@ class _Cursor:
 
     def __init__(self, reader: SSTableReader):
         self._it = reader.scanner()
-        self.buf: cb.CellBatch | None = None
+        self.bufs: list[cb.CellBatch] = []
         self.exhausted = False
-        self._advance()
+        self._fetch()
 
-    def _advance(self):
+    def _fetch(self) -> bool:
         try:
-            self.buf = next(self._it)
+            self.bufs.append(next(self._it))
+            return True
         except StopIteration:
-            self.buf = None
             self.exhausted = True
+            return False
 
-    def last_partition_prefix(self) -> bytes | None:
-        if self.buf is None or len(self.buf) == 0:
-            return None
-        return bytes(_lane_keys(self.buf)[-1])[:16]
+    @property
+    def has_data(self) -> bool:
+        return bool(self.bufs)
+
+    def last_key(self) -> bytes:
+        return _full_key(self.bufs[-1], -1)
 
     def extend_past_partition(self, prefix16: bytes) -> None:
-        """Buffer more segments until the buffer no longer ENDS inside the
-        given partition (or input is exhausted)."""
-        while (self.buf is not None
-               and self.last_partition_prefix() == prefix16):
-            try:
-                nxt = next(self._it)
-            except StopIteration:
-                self.exhausted = True
+        """Buffer more segments until the buffered data no longer ENDS
+        inside the given partition (or the input is exhausted). Segments
+        accumulate in a list — concat happens once, at slice time."""
+        while self.bufs and self.last_key()[:16] == prefix16:
+            if not self._fetch():
                 return
-            merged = cb.CellBatch.concat([self.buf, nxt])
-            merged.sorted = True
-            self.buf = merged
 
     def split_at(self, boundary: bytes) -> cb.CellBatch | None:
         """Take cells with key <= boundary from the buffer; refill when the
         whole buffer is consumed."""
-        if self.buf is None:
+        if not self.bufs:
             return None
-        keys = _lane_keys(self.buf)
+        buf = self.bufs[0] if len(self.bufs) == 1 \
+            else cb.CellBatch.concat(self.bufs)
+        buf.sorted = True
+        keys = _lane_keys(buf)
         idx = int(np.searchsorted(keys, np.bytes_(boundary), side="right"))
         if idx == 0:
+            self.bufs = [buf]
             return None
-        if idx >= len(self.buf):
-            out = self.buf
-            self._advance()
-            return out
-        head = self.buf.apply_permutation(np.arange(idx))
-        head.pk_map = self.buf.pk_map
-        tail = self.buf.apply_permutation(np.arange(idx, len(self.buf)))
-        tail.pk_map = self.buf.pk_map
-        self.buf = tail
+        if idx >= len(buf):
+            self.bufs = []
+            self._fetch()
+            return buf
+        head = buf.apply_permutation(np.arange(idx))
+        head.pk_map = buf.pk_map
+        tail = buf.apply_permutation(np.arange(idx, len(buf)))
+        tail.pk_map = buf.pk_map
+        self.bufs = [tail]
         return head
 
 
 class CompactionController:
     """Purge decisions: a tombstone may only be dropped if no source
     OUTSIDE the compaction could still hold older shadowed data for its
-    partition (CompactionController.java:61-121 maxPurgeableTimestamp)."""
+    partition (CompactionController.java:61-121 maxPurgeableTimestamp).
+
+    The overlap set is re-read per batch — a flush landing mid-compaction
+    produces a new sstable (and the construction-time memtable is checked
+    too), so concurrently-written older-timestamp data can never be purged
+    against (the reference refreshes overlaps once a minute for the same
+    reason)."""
 
     def __init__(self, cfs, compacting: list[SSTableReader]):
         self.cfs = cfs
-        compacting_gens = {r.desc.generation for r in compacting}
-        self.overlapping = [s for s in cfs.live_sstables()
-                            if s.desc.generation not in compacting_gens]
+        self.compacting_gens = {r.desc.generation for r in compacting}
+        self.memtable_at_start = cfs.memtable
+
+    def _overlapping(self) -> list[SSTableReader]:
+        return [s for s in self.cfs.live_sstables()
+                if s.desc.generation not in self.compacting_gens]
 
     def purgeable_ts_fn(self, batch: cb.CellBatch) -> np.ndarray:
         n = len(batch)
         out = np.full(n, np.iinfo(np.int64).max, dtype=np.int64)
-        if not self.overlapping and self.cfs.memtable.is_empty:
+        overlapping = self._overlapping()
+        mems = {id(m): m for m in (self.memtable_at_start,
+                                   self.cfs.memtable)}.values()
+        mems = [m for m in mems if not m.is_empty]
+        if not overlapping and not mems:
             return out
         lane4 = batch.lanes[:, :4]
         part_new = np.ones(n, dtype=bool)
@@ -118,14 +140,13 @@ class CompactionController:
         starts = np.flatnonzero(part_new)
         per_part = np.full(len(starts), np.iinfo(np.int64).max,
                            dtype=np.int64)
-        mem = self.cfs.memtable
         for j, s in enumerate(starts):
             pk = batch.partition_key(int(s))
             lo = np.iinfo(np.int64).max
-            for src in self.overlapping:
+            for src in overlapping:
                 if src.might_contain(pk) and src.min_ts is not None:
                     lo = min(lo, src.min_ts)
-            if not mem.is_empty and mem.contains(pk):
+            if any(m.contains(pk) for m in mems):
                 lo = min(lo, 0)  # memtable data is never purged against
             per_part[j] = lo
         return per_part[part_id]
@@ -175,15 +196,14 @@ class CompactionTask:
             writer = new_writer()
             cursors = [_Cursor(r) for r in self.inputs]
             while True:
-                active = [c for c in cursors if c.buf is not None]
+                active = [c for c in cursors if c.has_data]
                 if not active:
                     break
                 # partition-aligned round: find the minimal buffered-through
                 # key, then make sure no cursor's buffer ends INSIDE that
                 # key's partition, and merge everything up to the partition
                 # end (full key width padded with 0xFF)
-                prefix16 = min(bytes(_lane_keys(c.buf)[-1])
-                               for c in active)[:16]
+                prefix16 = min(c.last_key() for c in active)[:16]
                 for c in cursors:
                     c.extend_past_partition(prefix16)
                 K = self.inputs[0].K
@@ -218,12 +238,13 @@ class CompactionTask:
                 else:
                     r.close()
                     txn.track_obsolete(r.desc.generation)
-            # swap the live view, then commit; input readers are only
-            # RELEASED (their fds stay open for in-flight reads and close
-            # when the last reference drops — reference SSTableReader
-            # ref-counting, utils/concurrent/Ref)
-            cfs.tracker.replace(self.inputs, live_new)
+            # COMMIT first (a failure here must roll back cleanly while the
+            # tracker still serves the inputs), then swap the live view;
+            # input files may already be unlinked but their open fds keep
+            # serving in-flight reads. Inputs are RELEASED, not closed
+            # (reference SSTableReader ref-counting, utils/concurrent/Ref).
             txn.commit()
+            cfs.tracker.replace(self.inputs, live_new)
             for r in self.inputs:
                 r.release()
         except BaseException:

@@ -1,0 +1,806 @@
+"""CQL statement execution against a storage backend.
+
+Reference counterpart: cql3/statements/*Statement.execute —
+SelectStatement.java:287, ModificationStatement.java:496 (getMutations:526),
+and the schema statements under cql3/statements/schema/. The backend here
+is the node-local StorageEngine; the coordination layer substitutes a
+distributed proxy with the same apply/read surface.
+"""
+from __future__ import annotations
+
+import time
+import uuid as uuid_mod
+
+from .. import schema as schema_mod
+from ..schema import (COL_ROW_LIVENESS, KeyspaceParams, TableParams,
+                      make_table)
+from ..ops.codec import CompressionParams
+from ..storage import cellbatch as cb
+from ..storage.mutation import Mutation
+from ..storage.rows import RowData, row_to_dict, rows_from_batch
+from ..types import parse_type
+from ..types.marshal import ListType, MapType, SetType
+from ..utils import timeutil
+from . import ast
+
+
+class InvalidRequest(ValueError):
+    pass
+
+
+class ResultSet:
+    def __init__(self, columns: list[str], rows: list[tuple]):
+        self.column_names = columns
+        self.rows = rows
+
+    def __iter__(self):
+        return iter(self.rows)
+
+    def __len__(self):
+        return len(self.rows)
+
+    def dicts(self) -> list[dict]:
+        return [dict(zip(self.column_names, r)) for r in self.rows]
+
+    def one(self):
+        return self.rows[0] if self.rows else None
+
+
+APPLIED = ResultSet(["[applied]"], [(True,)])
+
+
+# ------------------------------------------------------------ term binding --
+
+def bind_term(term, cql_type, params):
+    """Evaluate a parsed term to a Python value of the target type."""
+    if isinstance(term, ast.BindMarker):
+        if isinstance(params, dict):
+            if term.name is None or term.name not in params:
+                raise InvalidRequest(f"missing named parameter {term.name}")
+            return params[term.name]
+        if term.index >= len(params):
+            raise InvalidRequest("not enough bind parameters")
+        return params[term.index]
+    if isinstance(term, ast.Literal):
+        if term.kind == "null":
+            return None
+        if term.kind == "ident":
+            raise InvalidRequest(f"unexpected identifier {term.value!r}")
+        return term.value
+    if isinstance(term, ast.CollectionLiteral):
+        if term.kind == "map":
+            kt = getattr(cql_type, "key", None)
+            vt = getattr(cql_type, "val", None)
+            return {bind_term(k, kt, params): bind_term(v, vt, params)
+                    for k, v in term.items}
+        et = getattr(cql_type, "elem", None)
+        vals = [bind_term(x, et, params) for x in term.items]
+        if term.kind == "set":
+            if isinstance(cql_type, MapType):  # {} parsed as map
+                return dict()
+            return set(vals)
+        if term.kind == "tuple":
+            return tuple(vals)
+        return vals
+    if isinstance(term, ast.FunctionCall):
+        return _call_function(term, params)
+    return term
+
+
+def _call_function(fn: ast.FunctionCall, params):
+    name = fn.name.lower()
+    if name == "now":
+        return uuid_mod.uuid1()
+    if name == "uuid":
+        return uuid_mod.uuid4()
+    if name == "totimestamp":
+        v = bind_term(fn.args[0], None, params)
+        if isinstance(v, uuid_mod.UUID):
+            ms = (v.time - 0x01B21DD213814000) // 10000
+            from datetime import datetime, timezone
+            return datetime.fromtimestamp(ms / 1000.0, tz=timezone.utc)
+        return v
+    if name == "currenttimestamp":
+        from datetime import datetime, timezone
+        return datetime.now(tz=timezone.utc)
+    raise InvalidRequest(f"unknown function {fn.name}")
+
+
+# ---------------------------------------------------------------- executor --
+
+class Executor:
+    """Executes parsed statements. `backend` must provide: schema,
+    apply(mutation), store(ks, table) with read_partition/scan_all, and
+    add_table/drop_table/create-keyspace hooks (StorageEngine satisfies
+    this; the distributed StorageProxy will too)."""
+
+    def __init__(self, backend):
+        self.backend = backend
+
+    @property
+    def schema(self):
+        return self.backend.schema
+
+    def execute(self, stmt, params=(), keyspace: str | None = None,
+                now_micros: int | None = None) -> ResultSet:
+        m = getattr(self, f"_exec_{type(stmt).__name__}", None)
+        if m is None:
+            raise InvalidRequest(f"cannot execute {type(stmt).__name__}")
+        return m(stmt, params, keyspace, now_micros)
+
+    # ------------------------------------------------------------- helpers
+
+    def _table(self, stmt, keyspace):
+        ks = stmt.keyspace or keyspace
+        if ks is None:
+            raise InvalidRequest("no keyspace specified")
+        try:
+            return self.schema.get_table(ks, stmt.table
+                                         if hasattr(stmt, "table")
+                                         else stmt.name)
+        except KeyError as e:
+            raise InvalidRequest(str(e))
+
+    def _split_where(self, table, where, params):
+        """Classify WHERE relations into pk equality, clustering
+        restrictions, and regular-column filters
+        (cql3/restrictions/StatementRestrictions role)."""
+        pk_vals: dict[str, list] = {}
+        ck_rel: dict[str, list] = {}
+        filters = []
+        names = {c.name: c for c in table.columns.values()}
+        for rel in where:
+            col = names.get(rel.column)
+            if col is None:
+                raise InvalidRequest(f"unknown column {rel.column}")
+            t = col.cql_type
+            if col.kind == schema_mod.ColumnKind.PARTITION_KEY:
+                if rel.op == "=":
+                    pk_vals[col.name] = [bind_term(rel.value, t, params)]
+                elif rel.op == "IN":
+                    pk_vals[col.name] = [bind_term(v, t, params)
+                                         for v in rel.value]
+                else:
+                    raise InvalidRequest(
+                        f"only =/IN allowed on partition key {col.name}")
+            elif col.kind == schema_mod.ColumnKind.CLUSTERING:
+                if rel.op == "IN":
+                    vals = [bind_term(v, t, params) for v in rel.value]
+                    ck_rel.setdefault(col.name, []).append(("IN", vals))
+                else:
+                    ck_rel.setdefault(col.name, []).append(
+                        (rel.op, bind_term(rel.value, t, params)))
+            else:
+                filters.append((col, rel.op,
+                                bind_term(rel.value, t, params)
+                                if rel.op not in ("IN",)
+                                else [bind_term(v, t, params)
+                                      for v in rel.value]))
+        return pk_vals, ck_rel, filters
+
+    def _pk_bytes_list(self, table, pk_vals) -> list[bytes]:
+        cols = table.partition_key_columns
+        if len(pk_vals) != len(cols):
+            raise InvalidRequest("incomplete partition key")
+        combos = [[]]
+        for c in cols:
+            vals = pk_vals[c.name]
+            combos = [prev + [v] for prev in combos for v in vals]
+        return [table.serialize_partition_key(c) for c in combos]
+
+    def _full_ck(self, table, ck_rel, params=()):
+        """Full-equality clustering frame (for writes)."""
+        vals = []
+        for c in table.clustering_columns:
+            rels = ck_rel.get(c.name)
+            if not rels or rels[0][0] != "=":
+                raise InvalidRequest(
+                    f"write requires full clustering (missing {c.name})")
+            vals.append(rels[0][1])
+        return table.serialize_clustering(vals)
+
+    # ----------------------------------------------------------------- DDL
+
+    def _exec_CreateKeyspaceStatement(self, s, params, ks, now):
+        self.schema.create_keyspace(
+            s.name, KeyspaceParams(replication=s.replication,
+                                   durable_writes=s.durable_writes),
+            if_not_exists=s.if_not_exists)
+        return ResultSet([], [])
+
+    def _exec_CreateTableStatement(self, s, params, keyspace, now):
+        ks = s.keyspace or keyspace
+        if ks is None:
+            raise InvalidRequest("no keyspace for CREATE TABLE")
+        if ks not in self.schema.keyspaces:
+            raise InvalidRequest(f"unknown keyspace {ks}")
+        if s.name in self.schema.keyspaces[ks].tables:
+            if s.if_not_exists:
+                return ResultSet([], [])
+            raise InvalidRequest(f"table {ks}.{s.name} exists")
+        if not s.partition_key:
+            raise InvalidRequest("missing PRIMARY KEY")
+        udts = self.schema.keyspaces[ks].user_types
+        cols = {n: t for n, t, _ in s.columns}
+        statics = {n for n, _, st in s.columns if st}
+        params_obj = self._table_params(s.options)
+        pkc = [(n, parse_type(cols[n], udts)) for n in s.partition_key]
+        ckc = [(n, parse_type(cols[n], udts),
+                bool(s.clustering_order.get(n, False)))
+               for n in s.clustering]
+        other = [(n, parse_type(t, udts)) for n, t, st in s.columns
+                 if n not in s.partition_key and n not in s.clustering
+                 and not st]
+        stat = [(n, parse_type(cols[n], udts)) for n in statics]
+        t = schema_mod.TableMetadata(ks, s.name, pkc, ckc, other, stat,
+                                     params_obj)
+        self.backend.add_table(t)
+        return ResultSet([], [])
+
+    def _table_params(self, options: dict) -> TableParams:
+        p = TableParams()
+        if "compression" in options:
+            p.compression = CompressionParams.from_dict(options["compression"])
+        if "compaction" in options:
+            p.compaction = dict(options["compaction"])
+        if "gc_grace_seconds" in options:
+            p.gc_grace_seconds = int(options["gc_grace_seconds"])
+        if "default_time_to_live" in options:
+            p.default_ttl = int(options["default_time_to_live"])
+        if "comment" in options:
+            p.comment = str(options["comment"])
+        return p
+
+    def _exec_CreateTypeStatement(self, s, params, keyspace, now):
+        ks = s.keyspace or keyspace
+        ksm = self.schema.keyspaces.get(ks)
+        if ksm is None:
+            raise InvalidRequest(f"unknown keyspace {ks}")
+        if s.name in ksm.user_types:
+            if s.if_not_exists:
+                return ResultSet([], [])
+            raise InvalidRequest(f"type {s.name} exists")
+        from ..types.marshal import UserType
+        ftypes = [parse_type(t, ksm.user_types) for _, t in s.fields]
+        ksm.user_types[s.name] = UserType(ks, s.name,
+                                          [n for n, _ in s.fields], ftypes)
+        return ResultSet([], [])
+
+    def _exec_CreateIndexStatement(self, s, params, keyspace, now):
+        t = self._table(s, keyspace)
+        if s.column not in t.columns:
+            raise InvalidRequest(f"unknown column {s.column}")
+        registry = getattr(self.backend, "indexes", None)
+        if registry is not None:
+            registry.create(t, s.column, s.name, s.custom_class)
+        return ResultSet([], [])
+
+    def _exec_DropStatement(self, s, params, keyspace, now):
+        ks = s.keyspace or keyspace
+        try:
+            if s.what == "keyspace":
+                ksm = self.schema.keyspaces.get(s.name)
+                if ksm is None:
+                    raise KeyError(s.name)
+                for tname in list(ksm.tables):
+                    self.backend.drop_table(s.name, tname)
+                self.schema.drop_keyspace(s.name)
+            elif s.what == "table":
+                self.backend.drop_table(ks, s.name)
+            elif s.what == "type":
+                del self.schema.keyspaces[ks].user_types[s.name]
+            elif s.what == "index":
+                registry = getattr(self.backend, "indexes", None)
+                if registry is not None:
+                    registry.drop(ks, s.name)
+        except KeyError:
+            if not s.if_exists:
+                raise InvalidRequest(f"unknown {s.what} {s.name}")
+        return ResultSet([], [])
+
+    def _exec_AlterTableStatement(self, s, params, keyspace, now):
+        ks = s.keyspace or keyspace
+        t = self.schema.get_table(ks, s.name)
+        if s.action == "add":
+            for cname, ctype in s.columns:
+                if cname in t.columns:
+                    raise InvalidRequest(f"column {cname} exists")
+                next_id = max(t.columns_by_id, default=7) + 1
+                col = schema_mod.ColumnMetadata(
+                    cname, parse_type(ctype), schema_mod.ColumnKind.REGULAR,
+                    len(t.regular_columns), column_id=next_id)
+                t.regular_columns.append(col)
+                t.columns[cname] = col
+                t.columns_by_id[next_id] = col
+        elif s.action == "drop":
+            for cname in s.columns:
+                col = t.columns.get(cname)
+                if col is None or col.kind != schema_mod.ColumnKind.REGULAR:
+                    raise InvalidRequest(f"cannot drop {cname}")
+                t.regular_columns.remove(col)
+                del t.columns[cname]
+                del t.columns_by_id[col.column_id]
+        elif s.action == "with":
+            p = self._table_params(s.options)
+            if "compaction" in s.options:
+                t.params.compaction = p.compaction
+            if "compression" in s.options:
+                t.params.compression = p.compression
+            if "gc_grace_seconds" in s.options:
+                t.params.gc_grace_seconds = p.gc_grace_seconds
+            if "default_time_to_live" in s.options:
+                t.params.default_ttl = p.default_ttl
+        self.schema.version += 1
+        return ResultSet([], [])
+
+    def _exec_TruncateStatement(self, s, params, keyspace, now):
+        t = self._table(s, keyspace)
+        self.backend.store(t.keyspace, t.name).truncate()
+        return ResultSet([], [])
+
+    def _exec_UseStatement(self, s, params, keyspace, now):
+        if s.keyspace not in self.schema.keyspaces:
+            raise InvalidRequest(f"unknown keyspace {s.keyspace}")
+        rs = ResultSet([], [])
+        rs.keyspace = s.keyspace
+        return rs
+
+    # ----------------------------------------------------------------- DML
+
+    def _exec_InsertStatement(self, s, params, keyspace, now):
+        t = self._table(s, keyspace)
+        now = now or timeutil.now_micros()
+        ts = now if s.timestamp is None \
+            else int(bind_term(s.timestamp, None, params))
+        ttl = 0 if s.ttl is None else int(bind_term(s.ttl, None, params))
+        ttl = ttl or t.params.default_ttl
+        values = {}
+        for cname, term in zip(s.columns, s.values):
+            col = t.columns.get(cname)
+            if col is None:
+                raise InvalidRequest(f"unknown column {cname}")
+            values[cname] = bind_term(term, col.cql_type, params)
+        for c in t.partition_key_columns:
+            if values.get(c.name) is None:
+                raise InvalidRequest(f"missing partition key column {c.name}")
+        # static-only inserts need no clustering (reference
+        # ModificationStatement static-row handling)
+        static_names = {c.name for c in t.static_columns}
+        static_only = t.clustering_columns and all(
+            cname in static_names or values.get(cname) is None
+            for cname in s.columns
+            if cname not in {c.name for c in t.partition_key_columns})
+        if not static_only:
+            for c in t.clustering_columns:
+                if values.get(c.name) is None:
+                    raise InvalidRequest(
+                        f"missing primary key column {c.name}")
+        pk = t.serialize_partition_key(
+            [values[c.name] for c in t.partition_key_columns])
+        ck = b"" if static_only else t.serialize_clustering(
+            [values[c.name] for c in t.clustering_columns])
+        if s.if_not_exists:
+            existing = self._read_row(t, pk, ck, now)
+            if existing is not None:
+                return self._not_applied(t, existing)
+        m = Mutation(t.id, pk)
+        now_s = timeutil.now_seconds()
+        if not static_only:
+            self._add_liveness(m, ck, ts, ttl, now_s)
+        for cname, v in values.items():
+            col = t.columns[cname]
+            if col.kind in (schema_mod.ColumnKind.PARTITION_KEY,
+                            schema_mod.ColumnKind.CLUSTERING):
+                continue
+            target_ck = b"" if col.kind == schema_mod.ColumnKind.STATIC else ck
+            self._add_cell_ops(m, t, col, target_ck, v, ts, ttl, now_s,
+                               overwrite_collection=True)
+        self.backend.apply(m)
+        return APPLIED if s.if_not_exists else ResultSet([], [])
+
+    def _add_liveness(self, m, ck, ts, ttl, now_s):
+        if ttl:
+            m.add(ck, COL_ROW_LIVENESS, b"", b"", ts, now_s + ttl, ttl,
+                  cb.FLAG_ROW_LIVENESS | cb.FLAG_EXPIRING)
+        else:
+            m.add(ck, COL_ROW_LIVENESS, b"", b"", ts,
+                  flags=cb.FLAG_ROW_LIVENESS)
+
+    def _add_cell_ops(self, m, t, col, ck, v, ts, ttl, now_s,
+                      overwrite_collection=False):
+        cid = col.column_id
+        typ = col.cql_type
+        flags = cb.FLAG_EXPIRING if ttl else 0
+        ldt = now_s + ttl if ttl else timeutil.NO_DELETION_TIME
+        if v is None:
+            m.add(ck, cid, b"", b"", ts, now_s, 0, cb.FLAG_TOMBSTONE)
+            return
+        if typ.is_multicell:
+            if overwrite_collection:
+                m.add(ck, cid, b"", b"", ts - 1, now_s, 0,
+                      cb.FLAG_COMPLEX_DEL)
+            self._add_collection_cells(m, t, col, ck, v, ts, ttl, now_s,
+                                       flags)
+            return
+        m.add(ck, cid, b"", typ.serialize(v), ts, ldt, ttl, flags)
+
+    def _add_collection_cells(self, m, t, col, ck, v, ts, ttl, now_s, flags):
+        typ = col.cql_type
+        cid = col.column_id
+        ldt = now_s + ttl if ttl else 0x7FFFFFFF
+        if isinstance(typ, MapType):
+            for k, val in v.items():
+                m.add(ck, cid, typ.key.serialize(k), typ.val.serialize(val),
+                      ts, ldt, ttl, flags)
+        elif isinstance(typ, SetType):
+            for el in v:
+                m.add(ck, cid, typ.elem.serialize(el), b"", ts, ldt, ttl,
+                      flags)
+        elif isinstance(typ, ListType):
+            for el in v:
+                path = uuid_mod.uuid1().bytes
+                m.add(ck, cid, path, typ.elem.serialize(el), ts, ldt, ttl,
+                      flags)
+        else:
+            raise InvalidRequest(f"bad collection assignment to {col.name}")
+
+    def _exec_UpdateStatement(self, s, params, keyspace, now):
+        t = self._table(s, keyspace)
+        now = now or timeutil.now_micros()
+        ts = now if s.timestamp is None \
+            else int(bind_term(s.timestamp, None, params))
+        ttl = 0 if s.ttl is None else int(bind_term(s.ttl, None, params))
+        ttl = ttl or t.params.default_ttl
+        pk_vals, ck_rel, filters = self._split_where(t, s.where, params)
+        if filters:
+            raise InvalidRequest("non-primary-key columns in UPDATE WHERE")
+        pks = self._pk_bytes_list(t, pk_vals)
+        ck = self._full_ck(t, ck_rel) if t.clustering_columns else b""
+        now_s = timeutil.now_seconds()
+        results = []
+        for pk in pks:
+            if s.if_exists or s.conditions:
+                existing = self._read_row(t, pk, ck, now)
+                if s.if_exists and existing is None:
+                    return ResultSet(["[applied]"], [(False,)])
+                if s.conditions and not self._check_conditions(
+                        t, existing, s.conditions, params):
+                    return self._not_applied(t, existing)
+            m = Mutation(t.id, pk)
+            is_counter = t.is_counter_table
+            if not is_counter:
+                # UPDATE does NOT create liveness (reference semantics:
+                # update of a non-existent row leaves no row marker)
+                pass
+            for op in s.ops:
+                self._apply_update_op(m, t, op, ck, ts, ttl, now_s, params)
+            self.backend.apply(m)
+        if s.if_exists or s.conditions:
+            return APPLIED
+        return ResultSet([], [])
+
+    def _apply_update_op(self, m, t, op: ast.UpdateOp, ck, ts, ttl, now_s,
+                         params):
+        col = t.columns.get(op.column)
+        if col is None:
+            raise InvalidRequest(f"unknown column {op.column}")
+        if col.kind in (schema_mod.ColumnKind.PARTITION_KEY,
+                        schema_mod.ColumnKind.CLUSTERING):
+            raise InvalidRequest(f"cannot SET primary key {op.column}")
+        target_ck = b"" if col.kind == schema_mod.ColumnKind.STATIC else ck
+        typ = col.cql_type
+        if typ.is_counter:
+            delta = bind_term(op.value, typ, params)
+            if op.op == "sub":
+                delta = -delta
+            m.add(target_ck, col.column_id, b"",
+                  typ.serialize(delta), ts, 0x7FFFFFFF, 0,
+                  cb.FLAG_COUNTER if hasattr(cb, "FLAG_COUNTER") else 0)
+            return
+        if op.op == "set":
+            v = bind_term(op.value, typ, params)
+            self._add_cell_ops(m, t, col, target_ck, v, ts, ttl, now_s,
+                               overwrite_collection=True)
+        elif op.op in ("add", "append"):
+            v = bind_term(op.value, typ, params)
+            if not typ.is_multicell:
+                raise InvalidRequest(f"+= on non-collection {col.name}")
+            self._add_collection_cells(m, t, col, target_ck, v, ts, ttl,
+                                       now_s, cb.FLAG_EXPIRING if ttl else 0)
+        elif op.op == "sub":
+            # remove elements/keys
+            if isinstance(typ, MapType):
+                keys = bind_term(op.value, SetType(typ.key), params)
+                for k in keys:
+                    m.add(target_ck, col.column_id, typ.key.serialize(k),
+                          b"", ts, now_s, 0, cb.FLAG_TOMBSTONE)
+            elif isinstance(typ, SetType):
+                els = bind_term(op.value, typ, params)
+                for el in els:
+                    m.add(target_ck, col.column_id, typ.elem.serialize(el),
+                          b"", ts, now_s, 0, cb.FLAG_TOMBSTONE)
+            else:
+                raise InvalidRequest("-= supported on set/map only")
+        elif op.op == "put_index":
+            if not isinstance(typ, MapType):
+                raise InvalidRequest("m[k] = v requires a map")
+            k = bind_term(op.key, typ.key, params)
+            v = bind_term(op.value, typ.val, params)
+            if v is None:
+                m.add(target_ck, col.column_id, typ.key.serialize(k), b"",
+                      ts, now_s, 0, cb.FLAG_TOMBSTONE)
+            else:
+                m.add(target_ck, col.column_id, typ.key.serialize(k),
+                      typ.val.serialize(v), ts,
+                      now_s + ttl if ttl else 0x7FFFFFFF, ttl,
+                      cb.FLAG_EXPIRING if ttl else 0)
+        elif op.op == "prepend":
+            v = bind_term(op.value, typ, params)
+            if not isinstance(typ, ListType):
+                raise InvalidRequest("prepend requires a list")
+            for el in reversed(v):
+                # reversed-time uuids sort before existing entries
+                u = uuid_mod.uuid1()
+                path = (0x0FFFFFFFFFFFFFFF - u.time).to_bytes(8, "big") + \
+                    u.bytes[8:]
+                m.add(target_ck, col.column_id, path, typ.elem.serialize(el),
+                      ts, 0x7FFFFFFF, 0, 0)
+        else:
+            raise InvalidRequest(f"unsupported update op {op.op}")
+
+    def _exec_DeleteStatement(self, s, params, keyspace, now):
+        t = self._table(s, keyspace)
+        now = now or timeutil.now_micros()
+        ts = now if s.timestamp is None \
+            else int(bind_term(s.timestamp, None, params))
+        now_s = timeutil.now_seconds()
+        pk_vals, ck_rel, filters = self._split_where(t, s.where, params)
+        if filters:
+            raise InvalidRequest("non-primary-key columns in DELETE WHERE")
+        pks = self._pk_bytes_list(t, pk_vals)
+        for pk in pks:
+            if s.if_exists or s.conditions:
+                ck = self._full_ck(t, ck_rel) if ck_rel else b""
+                existing = self._read_row(t, pk, ck, now)
+                if s.if_exists and existing is None:
+                    return ResultSet(["[applied]"], [(False,)])
+                if s.conditions and not self._check_conditions(
+                        t, existing, s.conditions, params):
+                    return self._not_applied(t, existing)
+            m = Mutation(t.id, pk)
+            if s.columns:
+                ck = self._full_ck(t, ck_rel) if t.clustering_columns else b""
+                for item in s.columns:
+                    if isinstance(item, tuple):
+                        cname, key_term = item
+                        col = t.columns[cname]
+                        k = bind_term(key_term, col.cql_type.key
+                                      if isinstance(col.cql_type, MapType)
+                                      else col.cql_type.elem, params)
+                        kb = (col.cql_type.key.serialize(k)
+                              if isinstance(col.cql_type, MapType)
+                              else col.cql_type.elem.serialize(k))
+                        m.add(ck, col.column_id, kb, b"", ts, now_s, 0,
+                              cb.FLAG_TOMBSTONE)
+                    else:
+                        col = t.columns.get(item)
+                        if col is None:
+                            raise InvalidRequest(f"unknown column {item}")
+                        tgt = b"" if col.kind == schema_mod.ColumnKind.STATIC \
+                            else ck
+                        if col.cql_type.is_multicell:
+                            m.add(tgt, col.column_id, b"", b"", ts, now_s, 0,
+                                  cb.FLAG_COMPLEX_DEL)
+                        else:
+                            m.add(tgt, col.column_id, b"", b"", ts, now_s, 0,
+                                  cb.FLAG_TOMBSTONE)
+            elif not ck_rel:
+                m.add(b"", schema_mod.COL_PARTITION_DEL, b"", b"", ts, now_s,
+                      0, cb.FLAG_PARTITION_DEL)
+            else:
+                ck = self._full_ck(t, ck_rel)
+                m.add(ck, schema_mod.COL_ROW_DEL, b"", b"", ts, now_s, 0,
+                      cb.FLAG_ROW_DEL)
+            self.backend.apply(m)
+        if s.if_exists or s.conditions:
+            return APPLIED
+        return ResultSet([], [])
+
+    def _exec_BatchStatement(self, s, params, keyspace, now):
+        now = now or timeutil.now_micros()
+        for sub in s.statements:
+            self.execute(sub, params, keyspace, now_micros=now)
+        return ResultSet([], [])
+
+    # -------------------------------------------------------------- SELECT
+
+    def _read_row(self, t, pk, ck, now_micros) -> dict | None:
+        cfs = self.backend.store(t.keyspace, t.name)
+        batch = cfs.read_partition(pk)
+        for r in rows_from_batch(t, batch):
+            if r.ck_frame == ck and not r.is_static:
+                return row_to_dict(t, r)
+        return None
+
+    def _check_conditions(self, t, existing, conditions, params) -> bool:
+        if existing is None:
+            return False
+        for rel in conditions:
+            col = t.columns.get(rel.column)
+            v = bind_term(rel.value, col.cql_type, params)
+            cur = existing.get(rel.column)
+            ok = {"=": cur == v, "!=": cur != v,
+                  "<": cur is not None and cur < v,
+                  "<=": cur is not None and cur <= v,
+                  ">": cur is not None and cur > v,
+                  ">=": cur is not None and cur >= v}.get(rel.op, False)
+            if not ok:
+                return False
+        return True
+
+    def _not_applied(self, t, existing) -> ResultSet:
+        if existing is None:
+            return ResultSet(["[applied]"], [(False,)])
+        cols = ["[applied]"] + list(existing.keys())
+        return ResultSet(cols, [(False, *existing.values())])
+
+    def _exec_SelectStatement(self, s, params, keyspace, now):
+        t = self._table(s, keyspace)
+        cfs = self.backend.store(t.keyspace, t.name)
+        pk_vals, ck_rel, filters = self._split_where(t, s.where, params)
+        if (filters or (ck_rel and not pk_vals)) and not s.allow_filtering:
+            indexed = self._indexed_lookup(t, filters)
+            if indexed is None and filters:
+                raise InvalidRequest(
+                    "filtering on non-key columns requires ALLOW FILTERING")
+
+        rows: list[dict] = []
+        statics_by_pk: dict[bytes, dict] = {}
+        if pk_vals:
+            batches = [(pk, cfs.read_partition(pk))
+                       for pk in self._pk_bytes_list(t, pk_vals)]
+        else:
+            batches = [(None, cfs.scan_all())]
+        for _, batch in batches:
+            for r in rows_from_batch(t, batch):
+                d = row_to_dict(t, r)
+                if r.is_static:
+                    statics_by_pk[r.pk] = d
+                    continue
+                d["__pk"] = r.pk
+                rows.append(d)
+        # join static values onto their partition's rows
+        for d in rows:
+            st = statics_by_pk.get(d.pop("__pk"), None)
+            if st:
+                for c in t.static_columns:
+                    if d.get(c.name) is None:
+                        d[c.name] = st.get(c.name)
+        # static-only partitions still produce one row in CQL
+        # (skipped for round 1 simplicity when regular rows exist)
+
+        rows = self._apply_ck_restrictions(t, rows, ck_rel)
+        for col, op, v in filters:
+            rows = [r for r in rows if self._match(r.get(col.name), op, v)]
+
+        if s.order_by:
+            col, desc = s.order_by[0]
+            rows.sort(key=lambda r: r[col], reverse=desc)
+
+        if s.per_partition_limit is not None:
+            limit = int(bind_term(s.per_partition_limit, None, params))
+            seen: dict[tuple, int] = {}
+            out = []
+            for r in rows:
+                key = tuple(r[c.name] for c in t.partition_key_columns)
+                seen[key] = seen.get(key, 0) + 1
+                if seen[key] <= limit:
+                    out.append(r)
+            rows = out
+        if s.limit is not None:
+            rows = rows[: int(bind_term(s.limit, None, params))]
+
+        return self._project(t, s, rows)
+
+    def _indexed_lookup(self, t, filters):
+        registry = getattr(self.backend, "indexes", None)
+        return None if registry is None else None  # placeholder round 1
+
+    def _apply_ck_restrictions(self, t, rows, ck_rel):
+        for cname, rels in ck_rel.items():
+            for op, v in rels:
+                if op == "IN":
+                    rows = [r for r in rows if r[cname] in v]
+                else:
+                    rows = [r for r in rows
+                            if self._match(r.get(cname), op, v)]
+        return rows
+
+    @staticmethod
+    def _match(cur, op, v) -> bool:
+        if op == "CONTAINS":
+            return cur is not None and v in cur
+        if op == "CONTAINS_KEY":
+            return isinstance(cur, dict) and v in cur
+        if op == "IN":
+            return cur in v
+        if cur is None:
+            return False
+        return {"=": cur == v, "!=": cur != v, "<": cur < v,
+                "<=": cur <= v, ">": cur > v, ">=": cur >= v}[op]
+
+    def _project(self, t, s, rows) -> ResultSet:
+        sel = s.selectors
+        if len(sel) == 1 and isinstance(sel[0][0], ast.FunctionCall) \
+                and sel[0][0].name.lower() == "count":
+            return ResultSet(["count"], [(len(rows),)])
+        if sel and sel[0][0] == "*":
+            names = [c.name for c in t.partition_key_columns
+                     + t.clustering_columns + t.static_columns
+                     + t.regular_columns]
+            if s.distinct:
+                names = [c.name for c in t.partition_key_columns]
+                seen = []
+                for r in rows:
+                    key = tuple(r[n] for n in names)
+                    if key not in seen:
+                        seen.append(key)
+                return ResultSet(names, seen)
+            return ResultSet(names,
+                             [tuple(r.get(n) for n in names) for r in rows])
+        names = []
+        exprs = []
+        for expr, alias in sel:
+            if isinstance(expr, ast.FunctionCall):
+                fname = expr.name.lower()
+                arg = expr.args[0] if expr.args else None
+                colname = arg if isinstance(arg, str) else \
+                    (arg.value if isinstance(arg, ast.Literal) else None)
+                names.append(alias or f"{fname}({colname})")
+                exprs.append((fname, colname))
+            else:
+                if expr not in t.columns:
+                    raise InvalidRequest(f"unknown column {expr}")
+                names.append(alias or expr)
+                exprs.append((None, expr))
+        agg_fns = {"count", "min", "max", "sum", "avg"}
+        if any(f in agg_fns for f, _ in exprs if f):
+            out = []
+            for f, cname in exprs:
+                vals = [r.get(cname) for r in rows
+                        if r.get(cname) is not None]
+                if f == "count":
+                    out.append(len(rows) if cname in ("*", None)
+                               else len(vals))
+                elif f == "min":
+                    out.append(min(vals) if vals else None)
+                elif f == "max":
+                    out.append(max(vals) if vals else None)
+                elif f == "sum":
+                    out.append(sum(vals) if vals else 0)
+                elif f == "avg":
+                    out.append(sum(vals) / len(vals) if vals else 0)
+                else:
+                    raise InvalidRequest(f"unknown aggregate {f}")
+            return ResultSet(names, [tuple(out)])
+        result_rows = []
+        for r in rows:
+            row = []
+            for f, cname in exprs:
+                if f == "token":
+                    from ..utils import murmur3
+                    pkb = t.serialize_partition_key(
+                        [r[c.name] for c in t.partition_key_columns])
+                    row.append(murmur3.token_of(pkb))
+                elif f in ("writetime", "ttl"):
+                    row.append(None)  # needs cell metadata: round 2
+                else:
+                    row.append(r.get(cname))
+            result_rows.append(tuple(row))
+        if s.distinct:
+            uniq = []
+            for row in result_rows:
+                if row not in uniq:
+                    uniq.append(row)
+            result_rows = uniq
+        return ResultSet(names, result_rows)

@@ -1,0 +1,686 @@
+"""Recursive-descent CQL parser.
+
+Reference counterpart: src/antlr/Parser.g (cql3 grammar). Covers the DML
+and DDL surface of this round: SELECT / INSERT / UPDATE / DELETE / BATCH /
+CREATE (KEYSPACE, TABLE, INDEX, TYPE) / DROP / ALTER TABLE / TRUNCATE /
+USE, with USING TTL/TIMESTAMP, IF [NOT] EXISTS, collections, bind markers.
+"""
+from __future__ import annotations
+
+from . import ast
+from .lexer import Token, tokenize
+
+
+class ParseError(ValueError):
+    pass
+
+
+class Parser:
+    def __init__(self, text: str):
+        self.toks = tokenize(text)
+        self.i = 0
+        self.n_markers = 0
+
+    # ------------------------------------------------------------ helpers --
+
+    def peek(self) -> Token:
+        return self.toks[self.i]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect_kw(self, *words: str) -> str:
+        t = self.next()
+        if t.kind != "KEYWORD" or t.value not in words:
+            raise ParseError(f"expected {'/'.join(words).upper()}, got {t}")
+        return t.value
+
+    def accept_kw(self, *words: str) -> str | None:
+        t = self.peek()
+        if t.kind == "KEYWORD" and t.value in words:
+            self.i += 1
+            return t.value
+        return None
+
+    def expect_op(self, op: str) -> None:
+        t = self.next()
+        if t.kind != "OP" or t.value != op:
+            raise ParseError(f"expected {op!r}, got {t}")
+
+    def accept_op(self, op: str) -> bool:
+        t = self.peek()
+        if t.kind == "OP" and t.value == op:
+            self.i += 1
+            return True
+        return False
+
+    def accept_ident(self, word: str) -> bool:
+        t = self.peek()
+        if t.kind == "IDENT" and t.value == word:
+            self.i += 1
+            return True
+        return False
+
+    def ident(self) -> str:
+        t = self.next()
+        if t.kind == "IDENT":
+            return t.value
+        if t.kind == "KEYWORD" and t.value in ("key", "type", "timestamp",
+                                               "ttl", "list", "index", "role",
+                                               "user", "counter", "token",
+                                               "options", "custom", "view"):
+            return t.value  # unreserved keywords usable as identifiers
+        raise ParseError(f"expected identifier, got {t}")
+
+    def qualified_name(self) -> tuple[str | None, str]:
+        a = self.ident()
+        if self.accept_op("."):
+            return a, self.ident()
+        return None, a
+
+    # --------------------------------------------------------------- terms --
+
+    def term(self):
+        t = self.peek()
+        if t.kind == "MARKER":
+            self.next()
+            m = ast.BindMarker(self.n_markers, t.value)
+            self.n_markers += 1
+            return m
+        if t.kind in ("INT", "FLOAT", "STRING", "UUID", "HEX"):
+            self.next()
+            return ast.Literal(t.value, t.kind.lower())
+        if t.kind == "KEYWORD" and t.value in ("null",):
+            self.next()
+            return ast.Literal(None, "null")
+        if t.kind == "IDENT" and t.value in ("true", "false"):
+            self.next()
+            return ast.Literal(t.value == "true", "bool")
+        if t.kind == "OP" and t.value == "[":
+            self.next()
+            items = self._term_list("]")
+            return ast.CollectionLiteral("list", items)
+        if t.kind == "OP" and t.value == "{":
+            self.next()
+            return self._map_or_set()
+        if t.kind == "OP" and t.value == "(":
+            self.next()
+            items = self._term_list(")")
+            return ast.CollectionLiteral("tuple", items)
+        if t.kind in ("IDENT", "KEYWORD"):
+            name = self.ident()
+            if self.accept_op("("):
+                args = self._term_list(")")
+                return ast.FunctionCall(name, args)
+            return ast.Literal(name, "ident")  # e.g. column ref in SET x = y
+        raise ParseError(f"unexpected term {t}")
+
+    def _term_list(self, closing: str) -> list:
+        items = []
+        if self.accept_op(closing):
+            return items
+        while True:
+            items.append(self.term())
+            if self.accept_op(closing):
+                return items
+            self.expect_op(",")
+
+    def _map_value_after_colon(self, first):
+        """Parse map pairs where the first key was already consumed. Note:
+        ':name' lexes as a named bind marker, which is exactly CQL's
+        meaning for an unquoted word in value position."""
+        pairs = [(first, self.term())]
+        while self.accept_op(","):
+            k = self.term()
+            if not self.accept_op(":"):
+                t = self.peek()
+                if t.kind == "MARKER" and t.value is not None:
+                    pass  # ':name' marker doubles as ': name'
+                else:
+                    raise ParseError(f"expected ':' in map literal, got {t}")
+            pairs.append((k, self.term()))
+        self.expect_op("}")
+        return ast.CollectionLiteral("map", pairs)
+
+    def _map_or_set(self):
+        if self.accept_op("}"):
+            return ast.CollectionLiteral("map", [])  # {} is empty map/set
+        first = self.term()
+        if self.accept_op(":"):
+            return self._map_value_after_colon(first)
+        t = self.peek()
+        if t.kind == "MARKER" and t.value is not None:
+            return self._map_value_after_colon(first)
+        items = [first]
+        while self.accept_op(","):
+            items.append(self.term())
+        self.expect_op("}")
+        return ast.CollectionLiteral("set", items)
+
+    # ---------------------------------------------------------- statements --
+
+    def parse_statement(self):
+        t = self.peek()
+        if t.kind != "KEYWORD":
+            raise ParseError(f"expected statement, got {t}")
+        kw = t.value
+        fn = {
+            "select": self.select, "insert": self.insert,
+            "update": self.update, "delete": self.delete,
+            "begin": self.batch, "create": self.create,
+            "drop": self.drop, "alter": self.alter,
+            "truncate": self.truncate, "use": self.use,
+        }.get(kw)
+        if fn is None:
+            raise ParseError(f"unsupported statement {kw.upper()}")
+        stmt = fn()
+        self.accept_op(";")
+        t = self.peek()
+        if t.kind != "EOF":
+            raise ParseError(f"trailing input at {t}")
+        return stmt
+
+    # SELECT
+    def select(self):
+        self.expect_kw("select")
+        json = False
+        distinct = bool(self.accept_kw("distinct"))
+        selectors = []
+        if self.accept_op("*"):
+            selectors.append(("*", None))
+        else:
+            while True:
+                sel = self._selector()
+                alias = None
+                if self.accept_kw("as"):
+                    alias = self.ident()
+                selectors.append((sel, alias))
+                if not self.accept_op(","):
+                    break
+        self.expect_kw("from")
+        ks, table = self.qualified_name()
+        where = []
+        if self.accept_kw("where"):
+            where = self._relations()
+        order = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            while True:
+                col = self.ident()
+                desc = False
+                if self.accept_kw("desc"):
+                    desc = True
+                else:
+                    self.accept_kw("asc")
+                order.append((col, desc))
+                if not self.accept_op(","):
+                    break
+        per_partition = None
+        limit = None
+        if self.accept_kw("per"):
+            self.expect_kw("partition")
+            self.expect_kw("limit")
+            per_partition = self.term()
+        if self.accept_kw("limit"):
+            limit = self.term()
+        allow = False
+        if self.accept_kw("allow"):
+            self.expect_kw("filtering")
+            allow = True
+        return ast.SelectStatement(ks, table, selectors, where, order,
+                                   limit, per_partition, allow, distinct,
+                                   json)
+
+    def _selector(self):
+        t = self.peek()
+        if t.kind in ("IDENT", "KEYWORD"):
+            name = self.ident()
+            if self.accept_op("("):
+                if self.accept_op("*"):
+                    self.expect_op(")")
+                    return ast.FunctionCall(name, ["*"])
+                args = self._term_list(")")
+                return ast.FunctionCall(name, args)
+            return name
+        raise ParseError(f"bad selector {t}")
+
+    def _relations(self) -> list:
+        rels = []
+        while True:
+            rels.append(self._relation())
+            if not self.accept_kw("and"):
+                break
+        return rels
+
+    def _relation(self):
+        col = self.ident()
+        key = None
+        if self.accept_op("["):
+            key = self.term()
+            self.expect_op("]")
+        t = self.next()
+        if t.kind == "KEYWORD" and t.value == "in":
+            self.expect_op("(")
+            vals = self._term_list(")")
+            return ast.Relation(col, "IN", vals)
+        if t.kind == "KEYWORD" and t.value == "contains":
+            if self.accept_kw("key"):
+                return ast.Relation(col, "CONTAINS_KEY", self.term())
+            return ast.Relation(col, "CONTAINS", self.term())
+        if t.kind == "OP" and t.value in ("=", "<", "<=", ">", ">=", "!="):
+            r = ast.Relation(col, t.value, self.term())
+            if key is not None:
+                r = ast.Relation(col, f"[{t.value}]", (key, r.value))
+            return r
+        raise ParseError(f"bad relation operator {t}")
+
+    # INSERT
+    def insert(self):
+        self.expect_kw("insert")
+        self.expect_kw("into")
+        ks, table = self.qualified_name()
+        self.expect_op("(")
+        cols = []
+        while True:
+            cols.append(self.ident())
+            if self.accept_op(")"):
+                break
+            self.expect_op(",")
+        self.expect_kw("values")
+        self.expect_op("(")
+        vals = self._term_list(")")
+        if len(vals) != len(cols):
+            raise ParseError("column/value count mismatch")
+        ine = False
+        if self.accept_kw("if"):
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            ine = True
+        ttl, ts = self._using()
+        return ast.InsertStatement(ks, table, cols, vals, ine, ttl, ts)
+
+    def _using(self):
+        ttl = ts = None
+        if self.accept_kw("using"):
+            while True:
+                w = self.expect_kw("ttl", "timestamp")
+                if w == "ttl":
+                    ttl = self.term()
+                else:
+                    ts = self.term()
+                if not self.accept_kw("and"):
+                    break
+        return ttl, ts
+
+    # UPDATE
+    def update(self):
+        self.expect_kw("update")
+        ks, table = self.qualified_name()
+        ttl, ts = self._using()
+        self.expect_kw("set")
+        ops = []
+        while True:
+            ops.append(self._update_op())
+            if not self.accept_op(","):
+                break
+        self.expect_kw("where")
+        where = self._relations()
+        if_exists = False
+        conditions = []
+        if self.accept_kw("if"):
+            if self.accept_kw("exists"):
+                if_exists = True
+            else:
+                conditions = self._relations()
+        return ast.UpdateStatement(ks, table, ops, where, if_exists,
+                                   conditions, ttl, ts)
+
+    def _update_op(self):
+        col = self.ident()
+        if self.accept_op("["):
+            key = self.term()
+            self.expect_op("]")
+            self.expect_op("=")
+            return ast.UpdateOp(col, "put_index", self.term(), key)
+        t = self.next()
+        if t.kind == "OP" and t.value == "=":
+            # col = col + x / col = col - x / col = x + col / col = x
+            save = self.i
+            first = self.term()
+            if isinstance(first, ast.Literal) and first.kind == "ident" \
+                    and first.value == col:
+                if self.accept_op("+"):
+                    return ast.UpdateOp(col, "add", self.term())
+                if self.accept_op("-"):
+                    return ast.UpdateOp(col, "sub", self.term())
+                self.i = save
+                first = self.term()
+                return ast.UpdateOp(col, "set", first)
+            if self.accept_op("+"):
+                self.term()  # the column ref on the right: x + col
+                return ast.UpdateOp(col, "prepend", first)
+            return ast.UpdateOp(col, "set", first)
+        if t.kind == "OP" and t.value in ("+=", "-="):
+            return ast.UpdateOp(col, "add" if t.value == "+=" else "sub",
+                                self.term())
+        raise ParseError(f"bad SET op {t}")
+
+    # DELETE
+    def delete(self):
+        self.expect_kw("delete")
+        cols = []
+        if not (self.peek().kind == "KEYWORD"
+                and self.peek().value == "from"):
+            while True:
+                name = self.ident()
+                if self.accept_op("["):
+                    key = self.term()
+                    self.expect_op("]")
+                    cols.append((name, key))
+                else:
+                    cols.append(name)
+                if not self.accept_op(","):
+                    break
+        self.expect_kw("from")
+        ks, table = self.qualified_name()
+        ts = None
+        if self.accept_kw("using"):
+            self.expect_kw("timestamp")
+            ts = self.term()
+        self.expect_kw("where")
+        where = self._relations()
+        if_exists = False
+        conditions = []
+        if self.accept_kw("if"):
+            if self.accept_kw("exists"):
+                if_exists = True
+            else:
+                conditions = self._relations()
+        return ast.DeleteStatement(ks, table, cols, where, if_exists,
+                                   conditions, ts)
+
+    # BATCH
+    def batch(self):
+        self.expect_kw("begin")
+        kind = self.accept_kw("unlogged", "counter", "logged") or "logged"
+        self.expect_kw("batch")
+        ttl, ts = self._using()
+        stmts = []
+        while not (self.peek().kind == "KEYWORD"
+                   and self.peek().value == "apply"):
+            kw = self.peek().value
+            fn = {"insert": self.insert, "update": self.update,
+                  "delete": self.delete}.get(kw)
+            if fn is None:
+                raise ParseError(f"only DML allowed in batch, got {kw}")
+            stmts.append(fn())
+            self.accept_op(";")
+        self.expect_kw("apply")
+        self.expect_kw("batch")
+        return ast.BatchStatement(kind, stmts, ts)
+
+    # CREATE
+    def create(self):
+        self.expect_kw("create")
+        what = self.next()
+        if what.kind == "KEYWORD" and what.value == "keyspace":
+            return self._create_keyspace()
+        if what.kind == "KEYWORD" and what.value == "table":
+            return self._create_table()
+        if what.kind == "KEYWORD" and what.value == "index":
+            return self._create_index(custom=False)
+        if what.kind == "KEYWORD" and what.value == "custom":
+            self.expect_kw("index")
+            return self._create_index(custom=True)
+        if what.kind == "KEYWORD" and what.value == "type":
+            return self._create_type()
+        raise ParseError(f"unsupported CREATE {what}")
+
+    def _if_not_exists(self) -> bool:
+        if self.accept_kw("if"):
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            return True
+        return False
+
+    def _create_keyspace(self):
+        ine = self._if_not_exists()
+        name = self.ident()
+        replication = {"class": "SimpleStrategy", "replication_factor": 1}
+        durable = True
+        if self.accept_kw("with"):
+            while True:
+                opt = self.ident()
+                self.expect_op("=")
+                val = self._option_value()
+                if opt == "replication":
+                    replication = val
+                elif opt == "durable_writes":
+                    durable = bool(val)
+                if not self.accept_kw("and"):
+                    break
+        return ast.CreateKeyspaceStatement(name, replication, durable, ine)
+
+    def _option_value(self):
+        t = self.peek()
+        if t.kind == "OP" and t.value == "{":
+            self.next()
+            out = {}
+            if self.accept_op("}"):
+                return out
+            while True:
+                k = self.next()
+                if k.kind not in ("STRING", "IDENT"):
+                    raise ParseError(f"bad option key {k}")
+                self._expect_colon_or_marker()
+                v = self.next()
+                if v.kind not in ("STRING", "INT", "FLOAT", "IDENT"):
+                    raise ParseError(f"bad option value {v}")
+                out[str(k.value)] = v.value
+                if self.accept_op("}"):
+                    return out
+                self.expect_op(",")
+        t = self.next()
+        if t.kind in ("STRING", "INT", "FLOAT"):
+            return t.value
+        if t.kind == "IDENT" and t.value in ("true", "false"):
+            return t.value == "true"
+        if t.kind in ("IDENT",):
+            return t.value
+        raise ParseError(f"bad option value {t}")
+
+    def _expect_colon_or_marker(self):
+        # ':' followed by an identifier-like value lexes as MARKER; undo it
+        t = self.next()
+        if t.kind == "OP" and t.value == ":":
+            return
+        if t.kind == "MARKER" and t.value is not None:
+            # re-inject the marker's name as an IDENT token
+            self.toks.insert(self.i, Token("IDENT", t.value, t.pos))
+            return
+        raise ParseError(f"expected ':', got {t}")
+
+    def _create_table(self):
+        ine = self._if_not_exists()
+        ks, name = self.qualified_name()
+        self.expect_op("(")
+        columns = []
+        pk: list[str] = []
+        ck: list[str] = []
+        while True:
+            if self.accept_kw("primary"):
+                self.expect_kw("key")
+                self.expect_op("(")
+                if self.accept_op("("):   # composite partition key
+                    while True:
+                        pk.append(self.ident())
+                        if self.accept_op(")"):
+                            break
+                        self.expect_op(",")
+                else:
+                    pk.append(self.ident())
+                while self.accept_op(","):
+                    ck.append(self.ident())
+                self.expect_op(")")
+            else:
+                cname = self.ident()
+                ctype = self._type_string()
+                static = bool(self.accept_kw("static"))
+                inline_pk = False
+                if self.accept_kw("primary"):
+                    self.expect_kw("key")
+                    pk.append(cname)
+                    inline_pk = True
+                columns.append((cname, ctype, static))
+            if self.accept_op(")"):
+                break
+            self.expect_op(",")
+        order = {}
+        options = {}
+        if self.accept_kw("with"):
+            while True:
+                if self.accept_ident("clustering"):
+                    self.expect_kw("order")
+                    self.expect_kw("by")
+                    self.expect_op("(")
+                    while True:
+                        col = self.ident()
+                        desc = bool(self.accept_kw("desc"))
+                        if not desc:
+                            self.accept_kw("asc")
+                        order[col] = desc
+                        if self.accept_op(")"):
+                            break
+                        self.expect_op(",")
+                else:
+                    opt = self.ident()
+                    self.expect_op("=")
+                    options[opt] = self._option_value()
+                if not self.accept_kw("and"):
+                    break
+        return ast.CreateTableStatement(ks, name, columns, pk, ck, order,
+                                        options, ine)
+
+    def _type_string(self) -> str:
+        """Consume a type expression, returning its flat string form."""
+        t = self.next()
+        if t.kind not in ("IDENT", "KEYWORD"):
+            raise ParseError(f"expected type, got {t}")
+        s = str(t.value)
+        if self.accept_op("<"):
+            parts = []
+            depth = 1
+            while depth:
+                tt = self.next()
+                if tt.kind == "OP" and tt.value == "<":
+                    depth += 1
+                    parts.append("<")
+                elif tt.kind == "OP" and tt.value == ">":
+                    depth -= 1
+                    if depth:
+                        parts.append(">")
+                elif tt.kind == "OP" and tt.value == ",":
+                    parts.append(", ")
+                elif tt.kind in ("IDENT", "KEYWORD", "INT"):
+                    parts.append(str(tt.value))
+                else:
+                    raise ParseError(f"bad type token {tt}")
+            s += "<" + "".join(parts) + ">"
+        return s
+
+    def _create_index(self, custom: bool):
+        ine = self._if_not_exists()
+        name = None
+        if not (self.peek().kind == "KEYWORD"
+                and self.peek().value == "on"):
+            name = self.ident()
+            ine = ine or self._if_not_exists()
+        self.expect_kw("on")
+        ks, table = self.qualified_name()
+        self.expect_op("(")
+        col = self.ident()
+        self.expect_op(")")
+        cls = None
+        if custom:
+            self.expect_kw("using")
+            cls = self.next().value
+        if self.accept_kw("with"):
+            self.expect_kw("options")
+            self.expect_op("=")
+            self._option_value()
+        return ast.CreateIndexStatement(name, ks, table, col, cls, ine)
+
+    def _create_type(self):
+        ine = self._if_not_exists()
+        ks, name = self.qualified_name()
+        self.expect_op("(")
+        fields = []
+        while True:
+            fname = self.ident()
+            ftype = self._type_string()
+            fields.append((fname, ftype))
+            if self.accept_op(")"):
+                break
+            self.expect_op(",")
+        return ast.CreateTypeStatement(ks, name, fields, ine)
+
+    # DROP / ALTER / TRUNCATE / USE
+    def drop(self):
+        self.expect_kw("drop")
+        what = self.next().value
+        if what not in ("keyspace", "table", "index", "type"):
+            raise ParseError(f"unsupported DROP {what}")
+        ife = False
+        if self.accept_kw("if"):
+            self.expect_kw("exists")
+            ife = True
+        ks, name = self.qualified_name()
+        return ast.DropStatement(what, ks, name, ife)
+
+    def alter(self):
+        self.expect_kw("alter")
+        self.expect_kw("table")
+        ks, name = self.qualified_name()
+        if self.accept_kw("add"):
+            cols = []
+            paren = self.accept_op("(")
+            while True:
+                cname = self.ident()
+                ctype = self._type_string()
+                cols.append((cname, ctype))
+                if not self.accept_op(","):
+                    break
+            if paren:
+                self.expect_op(")")
+            return ast.AlterTableStatement(ks, name, "add", cols)
+        if self.accept_kw("drop"):
+            cols = [self.ident()]
+            while self.accept_op(","):
+                cols.append(self.ident())
+            return ast.AlterTableStatement(ks, name, "drop", cols)
+        if self.accept_kw("with"):
+            options = {}
+            while True:
+                opt = self.ident()
+                self.expect_op("=")
+                options[opt] = self._option_value()
+                if not self.accept_kw("and"):
+                    break
+            return ast.AlterTableStatement(ks, name, "with", [], options)
+        raise ParseError("unsupported ALTER TABLE action")
+
+    def truncate(self):
+        self.expect_kw("truncate")
+        self.accept_kw("table")
+        ks, table = self.qualified_name()
+        return ast.TruncateStatement(ks, table)
+
+    def use(self):
+        self.expect_kw("use")
+        return ast.UseStatement(self.ident())
+
+
+def parse(text: str):
+    return Parser(text).parse_statement()

@@ -1,0 +1,71 @@
+"""QueryProcessor: parse -> prepare cache -> execute; plus the Session
+facade users interact with.
+
+Reference counterpart: cql3/QueryProcessor.java:109 (processStatement:276,
+parseStatement:382, MD5-keyed prepared cache) and the driver Session
+surface.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+
+from .execution import Executor, InvalidRequest, ResultSet
+from .parser import parse
+
+
+class Prepared:
+    def __init__(self, statement, query: str):
+        self.statement = statement
+        self.query = query
+
+
+class QueryProcessor:
+    def __init__(self, backend):
+        self.executor = Executor(backend)
+        self._prepared: dict[bytes, Prepared] = {}
+        self._lock = threading.Lock()
+
+    def parse(self, query: str):
+        return parse(query)
+
+    def prepare(self, query: str) -> bytes:
+        """Returns the statement id (MD5 of the query, like the reference)."""
+        qid = hashlib.md5(query.encode()).digest()
+        with self._lock:
+            if qid not in self._prepared:
+                self._prepared[qid] = Prepared(parse(query), query)
+        return qid
+
+    def execute_prepared(self, qid: bytes, params=(),
+                         keyspace: str | None = None) -> ResultSet:
+        with self._lock:
+            prep = self._prepared.get(qid)
+        if prep is None:
+            raise InvalidRequest("unknown prepared statement")
+        return self.executor.execute(prep.statement, params, keyspace)
+
+    def process(self, query: str, params=(),
+                keyspace: str | None = None) -> ResultSet:
+        return self.executor.execute(parse(query), params, keyspace)
+
+
+class Session:
+    """User-facing session: execute CQL strings against a backend
+    (StorageEngine locally; a coordinator in a cluster)."""
+
+    def __init__(self, backend, keyspace: str | None = None):
+        self.processor = QueryProcessor(backend)
+        self.keyspace = keyspace
+
+    def execute(self, query: str, params=()) -> ResultSet:
+        rs = self.processor.process(query, params, self.keyspace)
+        if hasattr(rs, "keyspace"):
+            self.keyspace = rs.keyspace
+        return rs
+
+    def prepare(self, query: str) -> bytes:
+        return self.processor.prepare(query)
+
+    def execute_prepared(self, qid: bytes, params=()) -> ResultSet:
+        return self.processor.execute_prepared(qid, params, self.keyspace)

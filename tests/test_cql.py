@@ -1,0 +1,268 @@
+"""CQL end-to-end tests — the CQLTester equivalent (reference:
+test/unit/org/apache/cassandra/cql3/CQLTester.java pattern: an embedded
+single node driven through real CQL)."""
+import time
+import uuid
+
+import pytest
+
+from cassandra_tpu.cql import Session
+from cassandra_tpu.schema import Schema
+from cassandra_tpu.storage.engine import StorageEngine
+
+
+@pytest.fixture
+def session(tmp_path):
+    eng = StorageEngine(str(tmp_path / "data"), Schema(),
+                        commitlog_sync="batch")
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    yield s
+    eng.close()
+
+
+def test_create_insert_select(session):
+    session.execute("""CREATE TABLE users (
+        id int, seq int, name text, age int,
+        PRIMARY KEY (id, seq))""")
+    session.execute("INSERT INTO users (id, seq, name, age) "
+                    "VALUES (1, 1, 'alice', 30)")
+    session.execute("INSERT INTO users (id, seq, name) VALUES (1, 2, 'bob')")
+    rs = session.execute("SELECT * FROM users WHERE id = 1")
+    assert rs.dicts() == [
+        {"id": 1, "seq": 1, "name": "alice", "age": 30},
+        {"id": 1, "seq": 2, "name": "bob", "age": None}]
+    rs = session.execute("SELECT name FROM users WHERE id = 1 AND seq = 2")
+    assert rs.rows == [("bob",)]
+    assert session.execute("SELECT * FROM users WHERE id = 99").rows == []
+
+
+def test_types_roundtrip(session):
+    session.execute("""CREATE TABLE t (
+        id uuid PRIMARY KEY, a bigint, b double, c boolean, d blob,
+        e timestamp, f varint, g decimal, h inet)""")
+    u = uuid.uuid4()
+    session.execute(
+        "INSERT INTO t (id, a, b, c, d, f, h) VALUES "
+        f"({u}, 9223372036854775807, 1.5, true, 0xdeadbeef, "
+        "123456789012345678901234567890, '10.1.2.3')")
+    row = session.execute(f"SELECT * FROM t WHERE id = {u}").dicts()[0]
+    assert row["a"] == 9223372036854775807
+    assert row["b"] == 1.5
+    assert row["c"] is True
+    assert row["d"] == bytes.fromhex("deadbeef")
+    assert row["f"] == 123456789012345678901234567890
+    assert row["h"] == "10.1.2.3"
+
+
+def test_bind_markers(session):
+    session.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    qid = session.prepare("INSERT INTO kv (k, v) VALUES (?, ?)")
+    for i in range(10):
+        session.execute_prepared(qid, (i, f"v{i}"))
+    rs = session.execute("SELECT v FROM kv WHERE k = ?", (7,))
+    assert rs.rows == [("v7",)]
+
+
+def test_update_and_delete(session):
+    session.execute("CREATE TABLE kv (k int, c int, v text, "
+                    "PRIMARY KEY (k, c))")
+    session.execute("INSERT INTO kv (k, c, v) VALUES (1, 1, 'a')")
+    session.execute("INSERT INTO kv (k, c, v) VALUES (1, 2, 'b')")
+    session.execute("UPDATE kv SET v = 'A' WHERE k = 1 AND c = 1")
+    assert session.execute(
+        "SELECT v FROM kv WHERE k = 1 AND c = 1").rows == [("A",)]
+    # cell delete
+    session.execute("DELETE v FROM kv WHERE k = 1 AND c = 1")
+    row = session.execute("SELECT * FROM kv WHERE k = 1 AND c = 1").dicts()
+    assert row and row[0]["v"] is None  # row survives (liveness)
+    # row delete
+    session.execute("DELETE FROM kv WHERE k = 1 AND c = 2")
+    assert session.execute(
+        "SELECT * FROM kv WHERE k = 1 AND c = 2").rows == []
+    # partition delete
+    session.execute("INSERT INTO kv (k, c, v) VALUES (2, 1, 'x')")
+    session.execute("DELETE FROM kv WHERE k = 2")
+    assert session.execute("SELECT * FROM kv WHERE k = 2").rows == []
+
+
+def test_update_without_insert_leaves_no_row_marker(session):
+    # reference semantics: UPDATE creates cells but no liveness; deleting
+    # the cell removes the row entirely
+    session.execute("CREATE TABLE kv (k int, c int, v text, "
+                    "PRIMARY KEY (k, c))")
+    session.execute("UPDATE kv SET v = 'x' WHERE k = 1 AND c = 1")
+    assert len(session.execute("SELECT * FROM kv WHERE k = 1").rows) == 1
+    session.execute("DELETE v FROM kv WHERE k = 1 AND c = 1")
+    assert session.execute("SELECT * FROM kv WHERE k = 1").rows == []
+
+
+def test_collections(session):
+    session.execute("""CREATE TABLE prefs (
+        id int PRIMARY KEY, tags map<text, text>, names set<text>,
+        items list<int>)""")
+    session.execute("INSERT INTO prefs (id, tags, names, items) VALUES "
+                    "(1, {'a': 'x', 'b': 'y'}, {'n1', 'n2'}, [3, 1, 2])")
+    row = session.execute("SELECT * FROM prefs WHERE id = 1").dicts()[0]
+    assert row["tags"] == {"a": "x", "b": "y"}
+    assert row["names"] == {"n1", "n2"}
+    assert row["items"] == [3, 1, 2]
+    # element ops
+    session.execute("UPDATE prefs SET tags['c'] = 'z' WHERE id = 1")
+    session.execute("UPDATE prefs SET names = names + {'n3'} WHERE id = 1")
+    session.execute("UPDATE prefs SET names = names - {'n1'} WHERE id = 1")
+    session.execute("UPDATE prefs SET items = items + [4] WHERE id = 1")
+    row = session.execute("SELECT * FROM prefs WHERE id = 1").dicts()[0]
+    assert row["tags"] == {"a": "x", "b": "y", "c": "z"}
+    assert row["names"] == {"n2", "n3"}
+    assert row["items"] == [3, 1, 2, 4]
+    # full overwrite
+    session.execute("UPDATE prefs SET tags = {'only': 'one'} WHERE id = 1")
+    row = session.execute("SELECT tags FROM prefs WHERE id = 1").dicts()[0]
+    assert row["tags"] == {"only": "one"}
+    # delete one key
+    session.execute("DELETE tags['only'] FROM prefs WHERE id = 1")
+    row = session.execute("SELECT tags FROM prefs WHERE id = 1").dicts()[0]
+    assert row["tags"] is None
+
+
+def test_ttl(session):
+    session.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    session.execute("INSERT INTO kv (k, v) VALUES (1, 'x') USING TTL 1")
+    assert session.execute("SELECT * FROM kv WHERE k = 1").rows
+    time.sleep(1.2)
+    assert session.execute("SELECT * FROM kv WHERE k = 1").rows == []
+
+
+def test_using_timestamp_lww(session):
+    session.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    session.execute("INSERT INTO kv (k, v) VALUES (1, 'new') "
+                    "USING TIMESTAMP 2000")
+    session.execute("INSERT INTO kv (k, v) VALUES (1, 'old') "
+                    "USING TIMESTAMP 1000")
+    assert session.execute("SELECT v FROM kv WHERE k = 1").rows == [("new",)]
+
+
+def test_batch(session):
+    session.execute("CREATE TABLE kv (k int, c int, v text, "
+                    "PRIMARY KEY (k, c))")
+    session.execute("""BEGIN BATCH
+        INSERT INTO kv (k, c, v) VALUES (1, 1, 'a');
+        INSERT INTO kv (k, c, v) VALUES (1, 2, 'b');
+        UPDATE kv SET v = 'c' WHERE k = 1 AND c = 3;
+        APPLY BATCH""")
+    assert len(session.execute("SELECT * FROM kv WHERE k = 1").rows) == 3
+
+
+def test_in_order_limit(session):
+    session.execute("CREATE TABLE ts (k int, c int, v int, "
+                    "PRIMARY KEY (k, c)) WITH CLUSTERING ORDER BY (c DESC)")
+    for c in range(10):
+        session.execute(f"INSERT INTO ts (k, c, v) VALUES (1, {c}, {c * 10})")
+    rs = session.execute("SELECT c FROM ts WHERE k = 1 LIMIT 3")
+    assert [r[0] for r in rs.rows] == [9, 8, 7]       # DESC storage order
+    rs = session.execute("SELECT c FROM ts WHERE k = 1 ORDER BY c ASC LIMIT 3")
+    assert [r[0] for r in rs.rows] == [0, 1, 2]
+    rs = session.execute("SELECT c FROM ts WHERE k = 1 AND c IN (2, 5)")
+    assert sorted(r[0] for r in rs.rows) == [2, 5]
+    rs = session.execute("SELECT c FROM ts WHERE k = 1 AND c >= 7")
+    assert sorted(r[0] for r in rs.rows) == [7, 8, 9]
+
+
+def test_allow_filtering_and_aggregates(session):
+    session.execute("CREATE TABLE e (k int, c int, v int, "
+                    "PRIMARY KEY (k, c))")
+    for k in range(3):
+        for c in range(4):
+            session.execute(
+                f"INSERT INTO e (k, c, v) VALUES ({k}, {c}, {k * 100 + c})")
+    with pytest.raises(Exception):
+        session.execute("SELECT * FROM e WHERE v = 102")
+    rs = session.execute("SELECT * FROM e WHERE v = 102 ALLOW FILTERING")
+    assert rs.dicts() == [{"k": 1, "c": 2, "v": 102}]
+    assert session.execute("SELECT count(*) FROM e").rows == [(12,)]
+    rs = session.execute("SELECT min(v), max(v), sum(v), avg(v) FROM e "
+                         "WHERE k = 1")
+    assert rs.rows == [(100, 103, 406, 101.5)]
+
+
+def test_static_columns(session):
+    session.execute("CREATE TABLE s (k int, c int, st text static, v int, "
+                    "PRIMARY KEY (k, c))")
+    session.execute("INSERT INTO s (k, st) VALUES (1, 'shared')")
+    session.execute("INSERT INTO s (k, c, v) VALUES (1, 1, 10)")
+    session.execute("INSERT INTO s (k, c, v) VALUES (1, 2, 20)")
+    rows = session.execute("SELECT * FROM s WHERE k = 1").dicts()
+    assert len(rows) == 2
+    assert all(r["st"] == "shared" for r in rows)
+
+
+def test_lwt_single_node(session):
+    session.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    rs = session.execute("INSERT INTO kv (k, v) VALUES (1, 'a') "
+                         "IF NOT EXISTS")
+    assert rs.rows[0][0] is True
+    rs = session.execute("INSERT INTO kv (k, v) VALUES (1, 'b') "
+                         "IF NOT EXISTS")
+    assert rs.rows[0][0] is False
+    assert session.execute("SELECT v FROM kv WHERE k = 1").rows == [("a",)]
+    rs = session.execute("UPDATE kv SET v = 'c' WHERE k = 1 IF v = 'a'")
+    assert rs.rows[0][0] is True
+    rs = session.execute("UPDATE kv SET v = 'd' WHERE k = 1 IF v = 'wrong'")
+    assert rs.rows[0][0] is False
+    assert session.execute("SELECT v FROM kv WHERE k = 1").rows == [("c",)]
+
+
+def test_ddl_alter_drop_truncate(session):
+    session.execute("CREATE TABLE t1 (k int PRIMARY KEY, v int)")
+    session.execute("ALTER TABLE t1 ADD extra text")
+    session.execute("INSERT INTO t1 (k, v, extra) VALUES (1, 2, 'e')")
+    assert session.execute("SELECT extra FROM t1 WHERE k = 1").rows == [("e",)]
+    session.execute("ALTER TABLE t1 DROP extra")
+    with pytest.raises(Exception):
+        session.execute("SELECT extra FROM t1 WHERE k = 1")
+    session.execute("TRUNCATE t1")
+    assert session.execute("SELECT * FROM t1").rows == []
+    session.execute("DROP TABLE t1")
+    with pytest.raises(Exception):
+        session.execute("SELECT * FROM t1")
+    session.execute("DROP TABLE IF EXISTS t1")  # no error
+    session.execute("CREATE TABLE IF NOT EXISTS t1 (k int PRIMARY KEY)")
+    session.execute("CREATE TABLE IF NOT EXISTS t1 (k int PRIMARY KEY)")
+
+
+def test_udt_and_tuple_vector(session):
+    session.execute("CREATE TYPE addr (street text, zip int)")
+    session.execute("CREATE TABLE u (k int PRIMARY KEY, a frozen<addr>, "
+                    "tp tuple<int, text>, vec vector<float, 3>)")
+    session.execute("INSERT INTO u (k, tp) VALUES (1, (5, 'five'))")
+    row = session.execute("SELECT tp FROM u WHERE k = 1").dicts()[0]
+    assert row["tp"] == (5, "five")
+
+
+def test_composite_partition_key(session):
+    session.execute("CREATE TABLE cp (a int, b int, c int, v text, "
+                    "PRIMARY KEY ((a, b), c))")
+    session.execute("INSERT INTO cp (a, b, c, v) VALUES (1, 2, 3, 'x')")
+    rs = session.execute("SELECT v FROM cp WHERE a = 1 AND b = 2")
+    assert rs.rows == [("x",)]
+    with pytest.raises(Exception):
+        session.execute("SELECT * FROM cp WHERE a = 1")  # incomplete pk
+
+
+def test_survives_flush_and_restart(tmp_path):
+    eng = StorageEngine(str(tmp_path / "d"), Schema(), commitlog_sync="batch")
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    for i in range(20):
+        s.execute(f"INSERT INTO kv (k, v) VALUES ({i}, 'v{i}')")
+    eng.store("ks", "kv").flush()
+    for i in range(20, 30):
+        s.execute(f"INSERT INTO kv (k, v) VALUES ({i}, 'v{i}')")
+    assert len(s.execute("SELECT * FROM kv").rows) == 30
+    eng.close()
