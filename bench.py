@@ -1,0 +1,111 @@
+"""Headline benchmark: STCS major-compaction throughput on one chip.
+
+Mirrors the reference's measurement (BASELINE.md): cassandra-stress-style
+data -> N sstables -> major compaction; throughput = input bytes / wall
+seconds, the "Read Throughput" the reference logs per compaction
+(db/compaction/CompactionTask.java:252-266). vs_baseline compares against
+the reference's default compaction_throughput throttle of 64 MiB/s
+(conf/cassandra.yaml:1243) — the reference repo publishes no absolute
+numbers (BASELINE.json.published = {}).
+
+Prints ONE json line. Runs on the default JAX device (the real TPU under
+the driver); the device kernel is warmed on a separate copy of the data so
+compile time is excluded.
+"""
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+N_RUNS = 4
+CELLS_PER_RUN = 262_144
+VALUE_BYTES = 64
+N_PARTITIONS = 4096
+
+
+def build_inputs(data_dir, table, seed):
+    from cassandra_tpu.storage import cellbatch as cb
+    from cassandra_tpu.storage.sstable import Descriptor, SSTableWriter
+    from cassandra_tpu.tools import bulk
+
+    rng = np.random.default_rng(seed)
+    os.makedirs(data_dir, exist_ok=True)
+    total = 0
+    for run in range(N_RUNS):
+        n = CELLS_PER_RUN
+        # zipf-ish overlap across runs: same partition space, random rows
+        pk = rng.integers(0, N_PARTITIONS, n)
+        ck = rng.integers(1, 10_000, n)
+        # text-like values (compressible, like stress defaults)
+        vals = rng.integers(97, 122, (n, VALUE_BYTES), dtype=np.uint8)
+        ts = rng.integers(1, 1 << 40, n).astype(np.int64)
+        batch = bulk.build_int_batch(table, pk, ck, vals, ts)
+        merged = cb.merge_sorted([batch])
+        w = SSTableWriter(Descriptor(data_dir, run + 1), table,
+                          estimated_partitions=N_PARTITIONS)
+        w.append(merged)
+        stats = w.finish()
+        total += stats["n_cells"]
+    return total
+
+
+def run_compaction(base_dir, table, seed):
+    from cassandra_tpu.compaction.task import CompactionTask
+    from cassandra_tpu.storage.table import ColumnFamilyStore
+
+    cfs = ColumnFamilyStore(table, base_dir, commitlog=None)
+    build_inputs(cfs.directory, table, seed)
+    cfs.reload_sstables()
+    inputs = cfs.tracker.view()
+    task = CompactionTask(cfs, inputs, use_device=True)
+    t0 = time.time()
+    stats = task.execute()
+    stats["wall"] = time.time() - t0
+    return stats
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from cassandra_tpu.ops.codec import CompressionParams
+    from cassandra_tpu.schema import TableParams, make_table
+
+    table = make_table(
+        "bench", "stress", pk=["id"], ck=["c"],
+        cols={"id": "int", "c": "int", "v": "blob"},
+        params=TableParams(compression=CompressionParams("LZ4Compressor")))
+
+    base = tempfile.mkdtemp(prefix="ctpu-bench-")
+    try:
+        run_compaction(os.path.join(base, "warm"), table, seed=1)  # compile
+        stats = run_compaction(os.path.join(base, "timed"), table, seed=2)
+        mib = stats["bytes_read"] / 2**20
+        mib_s = mib / stats["wall"]
+        result = {
+            "metric": "compaction MiB/s/chip (STCS major, 4-way, LZ4 16KiB)",
+            "value": round(mib_s, 2),
+            "unit": "MiB/s",
+            "vs_baseline": round(mib_s / 64.0, 2),
+            "detail": {
+                "cells_read": stats["cells_read"],
+                "cells_written": stats["cells_written"],
+                "bytes_read": stats["bytes_read"],
+                "bytes_written": stats["bytes_written"],
+                "seconds": round(stats["wall"], 3),
+            },
+        }
+        print(json.dumps(result))
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

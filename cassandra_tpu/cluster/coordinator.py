@@ -1,0 +1,266 @@
+"""StorageProxy: coordinator-side reads and writes with tunable
+consistency, hinted handoff, digest reads, and read repair.
+
+Reference counterpart: service/StorageProxy.java — mutate:875 /
+performWrite:1379 / sendToHintedReplicas:1480 (local apply + remote
+MUTATION_REQ + hint on failure), read:1819 / fetchRows:2060 with digest
+resolution (service/reads/DigestResolver) and blocking read repair
+(service/reads/repair/BlockingReadRepair).
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+
+from ..storage import cellbatch as cb
+from ..storage.mutation import Mutation
+from .messaging import MessagingService, Verb
+from .replication import ConsistencyLevel, ReplicationStrategy
+from .ring import Endpoint, Ring
+
+
+class UnavailableException(Exception):
+    """Not enough live replicas to even attempt the operation."""
+
+
+class TimeoutException(Exception):
+    """Live replicas did not ack within the timeout."""
+
+
+class _Await:
+    """Counts acks toward a blockFor target
+    (AbstractWriteResponseHandler / ReadCallback role)."""
+
+    def __init__(self, block_for: int):
+        self.block_for = block_for
+        self.responses: list = []
+        self.failures = 0
+        self._ev = threading.Event()
+        self._lock = threading.Lock()
+
+    def ack(self, payload=None) -> None:
+        with self._lock:
+            self.responses.append(payload)
+            if len(self.responses) >= self.block_for:
+                self._ev.set()
+
+    def fail(self) -> None:
+        with self._lock:
+            self.failures += 1
+
+    def await_(self, timeout: float) -> bool:
+        if self.block_for == 0:
+            return True
+        return self._ev.wait(timeout)
+
+
+class StorageProxy:
+    def __init__(self, node):
+        self.node = node
+        self.messaging: MessagingService = node.messaging
+        self.timeout = 5.0
+
+    # --------------------------------------------------------------- plan
+
+    def _plan(self, keyspace: str, pk: bytes) -> list[Endpoint]:
+        ks = self.node.schema.keyspaces[keyspace]
+        strat = ReplicationStrategy.create(ks.params.replication)
+        token = self.node.ring.token_of(pk)
+        replicas = strat.replicas(self.node.ring, token)
+        return replicas or [self.node.endpoint]
+
+    def _split_live(self, replicas):
+        live = [r for r in replicas if self.node.is_alive(r)]
+        dead = [r for r in replicas if r not in live]
+        return live, dead
+
+    # -------------------------------------------------------------- write
+
+    def mutate(self, keyspace: str, mutation: Mutation,
+               cl: str = ConsistencyLevel.ONE) -> None:
+        replicas = self._plan(keyspace, mutation.pk)
+        block_for = ConsistencyLevel.required(cl, replicas,
+                                              self.node.endpoint.dc)
+        live, dead = self._split_live(replicas)
+        if cl == ConsistencyLevel.ANY:
+            pass  # a hint alone satisfies ANY
+        elif len(live) < block_for:
+            raise UnavailableException(
+                f"{cl} requires {block_for} replicas, {len(live)} alive")
+        handler = _Await(block_for)
+        for target in dead:
+            self.node.hints.store(target, mutation)
+            if cl == ConsistencyLevel.ANY:
+                handler.ack()
+        for target in live:
+            if target == self.node.endpoint:
+                try:
+                    self.node.engine.apply(mutation)
+                    handler.ack()
+                except Exception:
+                    handler.fail()
+            else:
+                self.messaging.send_with_callback(
+                    Verb.MUTATION_REQ, mutation.serialize(), target,
+                    on_response=lambda m: handler.ack(),
+                    on_failure=lambda mid, t=target: self._write_timeout(
+                        handler, t, mutation),
+                    timeout=self.timeout)
+        if not handler.await_(self.timeout):
+            raise TimeoutException(
+                f"{len(handler.responses)}/{block_for} acks for {cl}")
+
+    def _write_timeout(self, handler, target, mutation):
+        handler.fail()
+        self.node.hints.store(target, mutation)
+
+    # --------------------------------------------------------------- read
+
+    @staticmethod
+    def _digest(batch: cb.CellBatch) -> bytes:
+        h = hashlib.md5()
+        h.update(batch.lanes.astype("<u4").tobytes())
+        h.update(batch.ts.astype("<i8").tobytes())
+        h.update(batch.flags.tobytes())
+        h.update(batch.payload.tobytes())
+        return h.digest()
+
+    def read_partition(self, keyspace: str, table_name: str, pk: bytes,
+                       cl: str = ConsistencyLevel.ONE) -> cb.CellBatch:
+        """Single-partition read: full data from one replica, digests from
+        the rest of the blockFor set; mismatch -> full-data round + repair
+        (AbstractReadExecutor + DigestResolver + DataResolver)."""
+        replicas = self._plan(keyspace, pk)
+        block_for = ConsistencyLevel.required(cl, replicas,
+                                              self.node.endpoint.dc)
+        live, _ = self._split_live(replicas)
+        if len(live) < block_for:
+            raise UnavailableException(
+                f"{cl} requires {block_for} replicas, {len(live)} alive")
+        # prefer self as the data replica
+        live.sort(key=lambda r: r != self.node.endpoint)
+        targets = live[:block_for]
+        results = self._fetch(keyspace, table_name, pk, targets)
+        if len(results) < block_for:
+            raise TimeoutException(
+                f"{len(results)}/{block_for} read responses")
+        digests = {self._digest(b) for _, b in results}
+        if len(digests) > 1:
+            self._read_repair(keyspace, table_name, results)
+        merged = cb.merge_sorted([b for _, b in results])
+        return merged
+
+    def _fetch(self, keyspace, table_name, pk, targets):
+        handler = _Await(len(targets))
+        results: list = []
+        lock = threading.Lock()
+
+        def local():
+            batch = self.node.engine.store(
+                keyspace, table_name).read_partition(pk)
+            with lock:
+                results.append((self.node.endpoint, batch))
+            handler.ack()
+
+        for target in targets:
+            if target == self.node.endpoint:
+                local()
+            else:
+                def on_rsp(m, t=target):
+                    with lock:
+                        results.append((t, cb_deserialize(m.payload)))
+                    handler.ack()
+                self.messaging.send_with_callback(
+                    Verb.READ_REQ, (keyspace, table_name, pk), target,
+                    on_response=on_rsp,
+                    on_failure=lambda mid: handler.fail(),
+                    timeout=self.timeout)
+        handler.await_(self.timeout)
+        with lock:
+            return list(results)
+
+    def _read_repair(self, keyspace, table_name, results) -> None:
+        """Blocking read repair: compute the merged truth and push it as a
+        mutation to replicas whose copy differed
+        (service/reads/repair/BlockingReadRepair)."""
+        merged = cb.merge_sorted([b for _, b in results])
+        want = self._digest(merged)
+        t = self.node.schema.get_table(keyspace, table_name)
+        for ep, batch in results:
+            if self._digest(batch) == want:
+                continue
+            m = batch_to_mutation(t, merged)
+            if m is None:
+                continue
+            if ep == self.node.endpoint:
+                self.node.engine.apply(m)
+            else:
+                self.messaging.send_one_way(
+                    Verb.MUTATION_REQ, m.serialize(), ep)
+
+    # --------------------------------------------------------- range read
+
+    def scan_all(self, keyspace: str, table_name: str,
+                 cl: str = ConsistencyLevel.ONE) -> cb.CellBatch:
+        """Full-range read across the cluster: every live node contributes
+        its local view; coordinator merges (RangeCommands.partitions,
+        simplified to a full-ring scan)."""
+        peers = [e for e in self.node.ring.endpoints
+                 if self.node.is_alive(e)]
+        handler = _Await(len(peers))
+        results = []
+        lock = threading.Lock()
+        for target in peers:
+            if target == self.node.endpoint:
+                batch = self.node.engine.store(
+                    keyspace, table_name).scan_all()
+                with lock:
+                    results.append(batch)
+                handler.ack()
+            else:
+                def on_rsp(m):
+                    with lock:
+                        results.append(cb_deserialize(m.payload))
+                    handler.ack()
+                self.messaging.send_with_callback(
+                    Verb.RANGE_REQ, (keyspace, table_name), target,
+                    on_response=on_rsp,
+                    on_failure=lambda mid: handler.fail(),
+                    timeout=self.timeout)
+        handler.await_(self.timeout)
+        with lock:
+            return cb.merge_sorted(results) if results else cb.CellBatch.empty()
+
+
+# -------------------------------------------------------------- serde -----
+
+def cb_serialize(batch: cb.CellBatch) -> dict:
+    """CellBatch as a plain dict (LocalTransport passes objects; a socket
+    transport would pack these arrays directly — they're already columnar)."""
+    return {
+        "lanes": batch.lanes, "ts": batch.ts, "ldt": batch.ldt,
+        "ttl": batch.ttl, "flags": batch.flags, "off": batch.off,
+        "val_start": batch.val_start, "payload": batch.payload,
+        "pk_map": dict(batch.pk_map), "sorted": batch.sorted,
+    }
+
+
+def cb_deserialize(d: dict) -> cb.CellBatch:
+    return cb.CellBatch(d["lanes"], d["ts"], d["ldt"], d["ttl"], d["flags"],
+                        d["off"], d["val_start"], d["payload"], d["pk_map"],
+                        d["sorted"])
+
+
+def batch_to_mutation(table, batch: cb.CellBatch) -> Mutation | None:
+    """Rebuild a mutation from a reconciled batch (read-repair payload).
+    Assumes a single partition."""
+    if len(batch) == 0:
+        return None
+    m = Mutation(table.id, batch.partition_key(0))
+    for i in range(len(batch)):
+        ck, path, value = batch.cell_payload(i)
+        C = batch.n_lanes - 9
+        m.add(ck, int(batch.lanes[i, 6 + C]), path, value,
+              int(batch.ts[i]), int(batch.ldt[i]), int(batch.ttl[i]),
+              int(batch.flags[i]))
+    return m

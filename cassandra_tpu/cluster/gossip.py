@@ -1,0 +1,181 @@
+"""Gossip liveness: heartbeat rounds + phi-accrual failure detection.
+
+Reference counterpart: gms/Gossiper.java:132 (1 Hz rounds, SYN/ACK digest
+exchange), gms/FailureDetector.java:71 (phi accrual over heartbeat
+inter-arrival times, convict threshold 8).
+"""
+from __future__ import annotations
+
+import math
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+from .messaging import MessagingService, Verb
+from .ring import Endpoint
+
+PHI_CONVICT_THRESHOLD = 8.0
+
+
+@dataclass
+class EndpointState:
+    generation: int
+    version: int = 0
+    alive: bool = True
+    arrival_intervals: list = field(default_factory=list)
+    last_heartbeat: float = 0.0
+    app_states: dict = field(default_factory=dict)  # status, tokens, ...
+
+
+class FailureDetector:
+    """Phi accrual: phi = -log10(P(no heartbeat for `elapsed`)) under an
+    exponential model of observed inter-arrival times."""
+
+    WINDOW = 100
+
+    def __init__(self):
+        self._states: dict[Endpoint, EndpointState] = {}
+
+    def report(self, ep: Endpoint, state: EndpointState,
+               now: float) -> None:
+        if state.last_heartbeat > 0:
+            state.arrival_intervals.append(now - state.last_heartbeat)
+            if len(state.arrival_intervals) > self.WINDOW:
+                state.arrival_intervals.pop(0)
+        state.last_heartbeat = now
+
+    def phi(self, state: EndpointState, now: float) -> float:
+        if not state.arrival_intervals or state.last_heartbeat == 0:
+            return 0.0
+        mean = sum(state.arrival_intervals) / len(state.arrival_intervals)
+        mean = max(mean, 1e-3)
+        elapsed = now - state.last_heartbeat
+        return (elapsed / mean) / math.log(10)
+
+    def is_alive(self, state: EndpointState, now: float) -> bool:
+        return self.phi(state, now) < PHI_CONVICT_THRESHOLD
+
+
+class Gossiper:
+    """Heartbeat exchange over the messaging service. interval configurable
+    so tests can run accelerated rounds (the reference gossips at 1 Hz)."""
+
+    def __init__(self, messaging: MessagingService, seeds: list[Endpoint],
+                 interval: float = 1.0, clock=time.monotonic):
+        self.messaging = messaging
+        self.ep = messaging.ep
+        self.seeds = [s for s in seeds if s != self.ep]
+        self.interval = interval
+        self.clock = clock
+        self.detector = FailureDetector()
+        self.states: dict[Endpoint, EndpointState] = {
+            self.ep: EndpointState(generation=int(time.time()))}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.on_alive = None    # callbacks for hint replay etc.
+        self.on_dead = None
+        messaging.register_handler(Verb.GOSSIP_SYN, self._handle_syn)
+        messaging.register_handler(Verb.GOSSIP_ACK, self._handle_ack_msg)
+
+    # ----------------------------------------------------------- protocol
+
+    def _digest(self) -> dict:
+        with self._lock:
+            me = self.states[self.ep]
+            me.version += 1
+            return {ep.name: (ep, st.generation, st.version,
+                              dict(st.app_states))
+                    for ep, st in self.states.items()}
+
+    def _merge(self, digest: dict) -> None:
+        now = self.clock()
+        with self._lock:
+            for name, (ep, gen, ver, apps) in digest.items():
+                st = self.states.get(ep)
+                if st is None:
+                    st = EndpointState(generation=gen, version=ver,
+                                       app_states=apps)
+                    self.states[ep] = st
+                    self.detector.report(ep, st, now)
+                elif (gen, ver) > (st.generation, st.version):
+                    st.generation, st.version = gen, ver
+                    st.app_states.update(apps)
+                    self.detector.report(ep, st, now)
+                    if not st.alive:
+                        st.alive = True
+                        if self.on_alive:
+                            self.on_alive(ep)
+
+    def _handle_syn(self, msg):
+        self._merge(msg.payload)
+        return Verb.GOSSIP_ACK, self._digest()
+
+    def _handle_ack_msg(self, msg):
+        self._merge(msg.payload)
+        return None
+
+    # ------------------------------------------------------------- rounds
+
+    def round(self) -> None:
+        """One gossip round: SYN a random live peer + maybe a seed, then
+        re-evaluate liveness (GossipTask semantics)."""
+        digest = self._digest()
+        with self._lock:
+            peers = [e for e in self.states if e != self.ep]
+        targets = []
+        if peers:
+            targets.append(random.choice(peers))
+        if self.seeds and (not targets or random.random() < 0.3):
+            targets.append(random.choice(self.seeds))
+        for t in set(targets):
+            self.messaging.send_with_callback(
+                Verb.GOSSIP_SYN, digest, t,
+                on_response=lambda m: self._merge(m.payload),
+                timeout=self.interval * 2)
+        self._check_liveness()
+
+    def _check_liveness(self) -> None:
+        now = self.clock()
+        with self._lock:
+            for ep, st in self.states.items():
+                if ep == self.ep:
+                    continue
+                alive = self.detector.is_alive(st, now)
+                if st.alive and not alive:
+                    st.alive = False
+                    if self.on_dead:
+                        self.on_dead(ep)
+                elif not st.alive and alive:
+                    st.alive = True
+                    if self.on_alive:
+                        self.on_alive(ep)
+
+    def live_endpoints(self) -> list[Endpoint]:
+        with self._lock:
+            return [ep for ep, st in self.states.items() if st.alive]
+
+    def is_alive(self, ep: Endpoint) -> bool:
+        with self._lock:
+            st = self.states.get(ep)
+            return bool(st and st.alive)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"gossip-{self.ep.name}")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.round()
+            except Exception:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)

@@ -1,0 +1,64 @@
+"""Hinted handoff: mutations for unreachable replicas, stored locally and
+replayed when the target comes back.
+
+Reference counterpart: hints/ (HintsBuffer/HintsWriter — per-host
+append-only files, HintsDispatchExecutor replay on recovery), entry via
+StorageProxy.submitHint.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+
+from ..storage.mutation import Mutation
+from .ring import Endpoint
+
+
+class HintsService:
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self.metrics = {"written": 0, "replayed": 0}
+
+    def _path(self, target: Endpoint) -> str:
+        return os.path.join(self.directory, f"hints-{target.name}.db")
+
+    def store(self, target: Endpoint, mutation: Mutation) -> None:
+        payload = mutation.serialize()
+        frame = struct.pack("<II", len(payload), zlib.crc32(payload)) + payload
+        with self._lock:
+            with open(self._path(target), "ab") as f:
+                f.write(frame)
+            self.metrics["written"] += 1
+
+    def has_hints(self, target: Endpoint) -> bool:
+        p = self._path(target)
+        return os.path.exists(p) and os.path.getsize(p) > 0
+
+    def dispatch(self, target: Endpoint, send_fn) -> int:
+        """Replay hints for a recovered target through send_fn(mutation);
+        the file is removed once fully dispatched."""
+        p = self._path(target)
+        with self._lock:
+            if not os.path.exists(p):
+                return 0
+            with open(p, "rb") as f:
+                data = f.read()
+            n = 0
+            pos = 0
+            while pos + 8 <= len(data):
+                length, crc = struct.unpack_from("<II", data, pos)
+                if length == 0 or pos + 8 + length > len(data):
+                    break
+                payload = data[pos + 8: pos + 8 + length]
+                pos += 8 + length
+                if zlib.crc32(payload) != crc:
+                    break
+                send_fn(Mutation.deserialize(payload))
+                n += 1
+            os.remove(p)
+            self.metrics["replayed"] += n
+            return n

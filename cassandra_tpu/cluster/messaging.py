@@ -1,0 +1,225 @@
+"""Internode messaging: verb-dispatched request/response with timeouts and
+test-controllable fault injection.
+
+Reference counterpart: net/MessagingService.java:208 (send/sendWithCallback),
+net/Verb.java:127 (verb registry with handlers + timeouts), and the in-JVM
+dtest MessageFilters (test/distributed/impl/AbstractCluster.java:796) that
+drop/intercept messages between in-process nodes.
+
+Transport is pluggable: LocalTransport routes in-process (the jvm-dtest
+model — our multi-node tests run N nodes in one process); a socket
+transport slots in behind the same send() seam for real deployments.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+
+from .ring import Endpoint
+
+
+class Verb:
+    MUTATION_REQ = "MUTATION_REQ"
+    MUTATION_RSP = "MUTATION_RSP"
+    READ_REQ = "READ_REQ"
+    READ_RSP = "READ_RSP"
+    RANGE_REQ = "RANGE_REQ"
+    RANGE_RSP = "RANGE_RSP"
+    HINT_REQ = "HINT_REQ"
+    ECHO_REQ = "ECHO_REQ"
+    ECHO_RSP = "ECHO_RSP"
+    GOSSIP_SYN = "GOSSIP_SYN"
+    GOSSIP_ACK = "GOSSIP_ACK"
+    SCHEMA_PUSH = "SCHEMA_PUSH"
+    STREAM_REQ = "STREAM_REQ"
+    STREAM_DATA = "STREAM_DATA"
+    REPAIR_VALIDATION_REQ = "REPAIR_VALIDATION_REQ"
+    REPAIR_VALIDATION_RSP = "REPAIR_VALIDATION_RSP"
+    REPAIR_SYNC_REQ = "REPAIR_SYNC_REQ"
+    FAILURE_RSP = "FAILURE_RSP"
+    TRUNCATE_REQ = "TRUNCATE_REQ"
+    TRUNCATE_RSP = "TRUNCATE_RSP"
+
+
+@dataclass
+class Message:
+    verb: str
+    payload: object
+    sender: Endpoint
+    to: Endpoint
+    id: int = 0
+    reply_to: int = 0
+
+
+class MessageFilters:
+    """Test hook: drop or intercept messages (jvm-dtest MessageFilters)."""
+
+    def __init__(self):
+        self._drop_rules: list = []
+        self._intercepts: list = []
+        self._lock = threading.Lock()
+
+    def drop(self, verb: str | None = None, frm: Endpoint | None = None,
+             to: Endpoint | None = None, count: int | None = None):
+        rule = {"verb": verb, "from": frm, "to": to,
+                "remaining": count if count is not None else float("inf")}
+        with self._lock:
+            self._drop_rules.append(rule)
+        return rule
+
+    def clear(self):
+        with self._lock:
+            self._drop_rules.clear()
+            self._intercepts.clear()
+
+    def intercept(self, fn):
+        with self._lock:
+            self._intercepts.append(fn)
+
+    def should_drop(self, msg: Message) -> bool:
+        with self._lock:
+            for fn in self._intercepts:
+                fn(msg)
+            for r in self._drop_rules:
+                if ((r["verb"] is None or r["verb"] == msg.verb)
+                        and (r["from"] is None or r["from"] == msg.sender)
+                        and (r["to"] is None or r["to"] == msg.to)
+                        and r["remaining"] > 0):
+                    r["remaining"] -= 1
+                    return True
+        return False
+
+
+class LocalTransport:
+    """In-process message routing between registered nodes; each node gets
+    a delivery thread (the reference's per-connection Netty event loop)."""
+
+    def __init__(self):
+        self.filters = MessageFilters()
+        self._nodes: dict[Endpoint, "MessagingService"] = {}
+        self._lock = threading.Lock()
+
+    def register(self, ep: Endpoint, svc: "MessagingService") -> None:
+        with self._lock:
+            self._nodes[ep] = svc
+
+    def unregister(self, ep: Endpoint) -> None:
+        with self._lock:
+            self._nodes.pop(ep, None)
+
+    def deliver(self, msg: Message) -> None:
+        if self.filters.should_drop(msg):
+            return
+        with self._lock:
+            target = self._nodes.get(msg.to)
+        if target is not None and not target.closed:
+            target.inbound(msg)
+
+
+class MessagingService:
+    """Per-node messaging endpoint: verb handlers + response callbacks with
+    timeouts (net/RequestCallbacks)."""
+
+    def __init__(self, ep: Endpoint, transport: LocalTransport):
+        self.ep = ep
+        self.transport = transport
+        self.handlers: dict[str, callable] = {}
+        self._callbacks: dict[int, tuple] = {}
+        self._ids = itertools.count(1)
+        self._cb_lock = threading.Lock()
+        self._queue: queue.Queue = queue.Queue()
+        self.closed = False
+        self.metrics = {"sent": 0, "received": 0, "dropped_timeout": 0}
+        transport.register(ep, self)
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name=f"msg-{ep.name}")
+        self._worker.start()
+        self._reaper = threading.Thread(target=self._reap, daemon=True)
+        self._reaper.start()
+
+    # ------------------------------------------------------------- sending
+
+    def register_handler(self, verb: str, fn) -> None:
+        """fn(message) -> response payload | None (one-way)."""
+        self.handlers[verb] = fn
+
+    def send_one_way(self, verb: str, payload, to: Endpoint) -> None:
+        msg = Message(verb, payload, self.ep, to, next(self._ids))
+        self.metrics["sent"] += 1
+        self.transport.deliver(msg)
+
+    def send_with_callback(self, verb: str, payload, to: Endpoint,
+                           on_response, on_failure=None,
+                           timeout: float = 5.0) -> int:
+        msg = Message(verb, payload, self.ep, to, next(self._ids))
+        with self._cb_lock:
+            self._callbacks[msg.id] = (on_response, on_failure,
+                                       time.monotonic() + timeout)
+        self.metrics["sent"] += 1
+        self.transport.deliver(msg)
+        return msg.id
+
+    def respond(self, original: Message, verb: str, payload) -> None:
+        msg = Message(verb, payload, self.ep, original.sender,
+                      next(self._ids), reply_to=original.id)
+        self.transport.deliver(msg)
+
+    # ------------------------------------------------------------ receiving
+
+    def inbound(self, msg: Message) -> None:
+        self._queue.put(msg)
+
+    def _run(self) -> None:
+        while not self.closed:
+            try:
+                msg = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            self.metrics["received"] += 1
+            if msg.reply_to:
+                with self._cb_lock:
+                    cb = self._callbacks.pop(msg.reply_to, None)
+                if cb is not None:
+                    on_response = cb[0]
+                    try:
+                        on_response(msg)
+                    except Exception:
+                        pass
+                continue
+            handler = self.handlers.get(msg.verb)
+            if handler is None:
+                continue
+            try:
+                result = handler(msg)
+            except Exception as e:
+                self.respond(msg, Verb.FAILURE_RSP, repr(e))
+                continue
+            if result is not None:
+                rsp_verb, payload = result
+                self.respond(msg, rsp_verb, payload)
+
+    def _reap(self) -> None:
+        """Expire callbacks whose responses never arrived."""
+        while not self.closed:
+            time.sleep(0.1)
+            now = time.monotonic()
+            expired = []
+            with self._cb_lock:
+                for mid, (ok, fail, deadline) in list(self._callbacks.items()):
+                    if now > deadline:
+                        expired.append((mid, fail))
+                        del self._callbacks[mid]
+            for mid, fail in expired:
+                self.metrics["dropped_timeout"] += 1
+                if fail is not None:
+                    try:
+                        fail(mid)
+                    except Exception:
+                        pass
+
+    def close(self) -> None:
+        self.closed = True
+        self.transport.unregister(self.ep)

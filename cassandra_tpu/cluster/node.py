@@ -1,0 +1,222 @@
+"""Node: one database instance (engine + messaging + gossip + coordinator)
+and LocalCluster: N nodes in one process with interceptable messaging —
+the jvm-dtest harness (reference test/distributed/impl/AbstractCluster.java:
+one Instance per classloader, in-memory message routing, MessageFilters).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from ..cql.execution import Executor
+from ..cql.processor import Session
+from ..schema import Schema
+from ..storage import cellbatch as cbmod
+from ..storage.engine import StorageEngine
+from ..storage.mutation import Mutation
+from .coordinator import StorageProxy, cb_serialize
+from .gossip import Gossiper
+from .hints import HintsService
+from .messaging import LocalTransport, MessagingService, Verb
+from .replication import ConsistencyLevel
+from .ring import Endpoint, Ring, even_tokens
+
+
+class Node:
+    def __init__(self, endpoint: Endpoint, data_dir: str, schema: Schema,
+                 ring: Ring, transport: LocalTransport,
+                 seeds: list[Endpoint], gossip_interval: float = 0.1):
+        self.endpoint = endpoint
+        self.schema = schema
+        self.ring = ring
+        self.engine = StorageEngine(data_dir, schema, commitlog_sync="batch")
+        self.messaging = MessagingService(endpoint, transport)
+        self.hints = HintsService(os.path.join(data_dir, "hints"))
+        self.gossiper = Gossiper(self.messaging, seeds,
+                                 interval=gossip_interval)
+        self.gossiper.on_alive = self._on_peer_alive
+        self.proxy = StorageProxy(self)
+        self._register_verbs()
+        self.default_cl = ConsistencyLevel.ONE
+
+    # ------------------------------------------------------------- verbs --
+
+    def _register_verbs(self):
+        ms = self.messaging
+        ms.register_handler(Verb.MUTATION_REQ, self._handle_mutation)
+        ms.register_handler(Verb.READ_REQ, self._handle_read)
+        ms.register_handler(Verb.RANGE_REQ, self._handle_range)
+        ms.register_handler(Verb.HINT_REQ, self._handle_mutation)
+        ms.register_handler(Verb.TRUNCATE_REQ, self._handle_truncate)
+
+    def _handle_mutation(self, msg):
+        mutation = Mutation.deserialize(msg.payload)
+        self.engine.apply(mutation)
+        return Verb.MUTATION_RSP, b""
+
+    def _handle_read(self, msg):
+        keyspace, table_name, pk = msg.payload
+        batch = self.engine.store(keyspace, table_name).read_partition(pk)
+        return Verb.READ_RSP, cb_serialize(batch)
+
+    def _handle_range(self, msg):
+        keyspace, table_name = msg.payload
+        batch = self.engine.store(keyspace, table_name).scan_all()
+        return Verb.RANGE_RSP, cb_serialize(batch)
+
+    def _handle_truncate(self, msg):
+        keyspace, table_name = msg.payload
+        self.engine.store(keyspace, table_name).truncate()
+        return Verb.TRUNCATE_RSP, b""
+
+    # ---------------------------------------------------------- liveness --
+
+    def is_alive(self, ep: Endpoint) -> bool:
+        return ep == self.endpoint or self.gossiper.is_alive(ep)
+
+    def _on_peer_alive(self, ep: Endpoint):
+        if self.hints.has_hints(ep):
+            self.hints.dispatch(
+                ep, lambda m: self.messaging.send_one_way(
+                    Verb.HINT_REQ, m.serialize(), ep))
+
+    # -------------------------------------------------- CQL backend role --
+
+    @property
+    def indexes(self):
+        return getattr(self.engine, "indexes", None)
+
+    def apply(self, mutation: Mutation, durable: bool = True) -> None:
+        ks = None
+        for k in self.schema.keyspaces.values():
+            for t in k.tables.values():
+                if t.id == mutation.table_id:
+                    ks = k.name
+                    break
+        if ks is None:
+            raise KeyError(f"unknown table id {mutation.table_id}")
+        self.proxy.mutate(ks, mutation, self.default_cl)
+
+    def store(self, keyspace: str, name: str):
+        return _DistributedStore(self, keyspace, name)
+
+    def add_table(self, t):
+        # shared-schema round 1: every node opens a store for the table
+        # (distributed schema agreement lands with the cluster-metadata log)
+        for node in self.cluster_nodes:
+            node.engine._open_store(t)
+        self.schema.add_table(t)
+
+    def drop_table(self, keyspace: str, name: str):
+        t = self.schema.get_table(keyspace, name)
+        for node in self.cluster_nodes:
+            cfs = node.engine.stores.pop(t.id, None)
+            if cfs:
+                cfs.truncate()
+        self.schema.drop_table(keyspace, name)
+
+    cluster_nodes: list = ()
+
+    def session(self) -> Session:
+        return Session(self)
+
+    def shutdown(self):
+        self.gossiper.stop()
+        self.messaging.close()
+        self.engine.close()
+
+
+class _DistributedStore:
+    """Read facade the CQL executor uses; routes through the coordinator."""
+
+    def __init__(self, node: Node, keyspace: str, name: str):
+        self.node = node
+        self.keyspace = keyspace
+        self.name = name
+
+    def read_partition(self, pk: bytes, now=None):
+        return self.node.proxy.read_partition(self.keyspace, self.name, pk,
+                                              self.node.default_cl)
+
+    def scan_all(self, now=None):
+        return self.node.proxy.scan_all(self.keyspace, self.name,
+                                        self.node.default_cl)
+
+    def truncate(self):
+        for ep in list(self.node.ring.endpoints):
+            if ep == self.node.endpoint:
+                self.node.engine.store(self.keyspace, self.name).truncate()
+            else:
+                self.node.messaging.send_one_way(
+                    Verb.TRUNCATE_REQ, (self.keyspace, self.name), ep)
+
+
+class LocalCluster:
+    """N in-process nodes sharing a transport with fault injection
+    (the jvm-dtest Cluster)."""
+
+    def __init__(self, n: int, base_dir: str, rf: int = 3,
+                 gossip_interval: float = 0.05,
+                 dcs: list[str] | None = None):
+        self.transport = LocalTransport()
+        self.schema = Schema()
+        self.ring = Ring()
+        self.nodes: list[Node] = []
+        endpoints = []
+        tokens = even_tokens(n, vnodes=4)
+        for i in range(n):
+            dc = dcs[i] if dcs else "dc1"
+            ep = Endpoint(f"node{i + 1}", dc=dc)
+            endpoints.append(ep)
+            self.ring.add_node(ep, tokens[i])
+        for i, ep in enumerate(endpoints):
+            node = Node(ep, os.path.join(base_dir, ep.name), self.schema,
+                        self.ring, self.transport, seeds=endpoints[:1],
+                        gossip_interval=gossip_interval)
+            self.nodes.append(node)
+        for node in self.nodes:
+            node.cluster_nodes = self.nodes
+            # seed full liveness so tests don't wait for convergence
+            for other in self.nodes:
+                if other.endpoint != node.endpoint:
+                    st = node.gossiper.states.setdefault(
+                        other.endpoint,
+                        type(node.gossiper.states[node.endpoint])(
+                            generation=1))
+                    node.gossiper.detector.report(
+                        other.endpoint, st, node.gossiper.clock())
+        for node in self.nodes:
+            node.gossiper.start()
+
+    @property
+    def filters(self):
+        return self.transport.filters
+
+    def node(self, i: int) -> Node:
+        return self.nodes[i - 1]
+
+    def session(self, i: int = 1) -> Session:
+        return self.nodes[i - 1].session()
+
+    def stop_node(self, i: int) -> None:
+        """Simulate a crash: stop gossip + messaging (data stays on disk)."""
+        n = self.nodes[i - 1]
+        n.gossiper.stop()
+        n.messaging.close()
+
+    def restart_node(self, i: int) -> None:
+        n = self.nodes[i - 1]
+        n.messaging = MessagingService(n.endpoint, self.transport)
+        n.gossiper = Gossiper(n.messaging, [self.nodes[0].endpoint],
+                              interval=n.gossiper.interval)
+        n.gossiper.on_alive = n._on_peer_alive
+        n._register_verbs()
+        n.proxy = StorageProxy(n)
+        n.gossiper.start()
+
+    def shutdown(self):
+        for n in self.nodes:
+            try:
+                n.shutdown()
+            except Exception:
+                pass

@@ -1,0 +1,116 @@
+"""Replication strategies: token -> replica set.
+
+Reference counterpart: locator/AbstractReplicationStrategy (SimpleStrategy,
+NetworkTopologyStrategy with per-DC RF and rack spreading, LocalStrategy),
+locator/ReplicaPlans (consistency-level math).
+"""
+from __future__ import annotations
+
+from .ring import Endpoint, Ring
+
+
+class ReplicationStrategy:
+    def __init__(self, options: dict):
+        self.options = options
+
+    def replicas(self, ring: Ring, token: int) -> list[Endpoint]:
+        raise NotImplementedError
+
+    @staticmethod
+    def create(options: dict) -> "ReplicationStrategy":
+        cls = str(options.get("class", "SimpleStrategy")).rsplit(".", 1)[-1]
+        if cls == "SimpleStrategy":
+            return SimpleStrategy(options)
+        if cls == "NetworkTopologyStrategy":
+            return NetworkTopologyStrategy(options)
+        if cls == "LocalStrategy":
+            return LocalStrategy(options)
+        raise ValueError(f"unknown replication strategy {cls}")
+
+
+class SimpleStrategy(ReplicationStrategy):
+    def replicas(self, ring: Ring, token: int) -> list[Endpoint]:
+        rf = int(self.options.get("replication_factor", 1))
+        out: list[Endpoint] = []
+        for ep in ring.successors(token):
+            if ep not in out:
+                out.append(ep)
+            if len(out) >= rf:
+                break
+        return out
+
+
+class NetworkTopologyStrategy(ReplicationStrategy):
+    """Per-DC replication factor, spreading across racks within a DC
+    (locator/NetworkTopologyStrategy.calculateNaturalReplicas)."""
+
+    def replicas(self, ring: Ring, token: int) -> list[Endpoint]:
+        rf_by_dc = {k: int(v) for k, v in self.options.items()
+                    if k != "class"}
+        chosen: list[Endpoint] = []
+        racks_seen: dict[str, set] = {}
+        per_dc: dict[str, int] = {}
+        skipped: dict[str, list[Endpoint]] = {}
+        for ep in ring.successors(token):
+            rf = rf_by_dc.get(ep.dc, 0)
+            if per_dc.get(ep.dc, 0) >= rf or ep in chosen:
+                continue
+            racks = racks_seen.setdefault(ep.dc, set())
+            if ep.rack in racks:
+                skipped.setdefault(ep.dc, []).append(ep)
+                continue
+            chosen.append(ep)
+            racks.add(ep.rack)
+            per_dc[ep.dc] = per_dc.get(ep.dc, 0) + 1
+            if all(per_dc.get(dc, 0) >= rf for dc, rf in rf_by_dc.items()):
+                break
+        # fill remaining slots from skipped same-rack nodes
+        for dc, rf in rf_by_dc.items():
+            for ep in skipped.get(dc, []):
+                if per_dc.get(dc, 0) >= rf:
+                    break
+                if ep not in chosen:
+                    chosen.append(ep)
+                    per_dc[dc] = per_dc.get(dc, 0) + 1
+        return chosen
+
+
+class LocalStrategy(ReplicationStrategy):
+    def replicas(self, ring: Ring, token: int) -> list[Endpoint]:
+        return []
+
+
+# ------------------------------------------------------ consistency levels --
+
+class ConsistencyLevel:
+    ANY = "ANY"
+    ONE = "ONE"
+    TWO = "TWO"
+    THREE = "THREE"
+    QUORUM = "QUORUM"
+    ALL = "ALL"
+    LOCAL_QUORUM = "LOCAL_QUORUM"
+    LOCAL_ONE = "LOCAL_ONE"
+    EACH_QUORUM = "EACH_QUORUM"
+
+    @staticmethod
+    def required(cl: str, replicas: list[Endpoint],
+                 local_dc: str = "dc1") -> int:
+        n = len(replicas)
+        if cl in ("ANY", "ONE", "LOCAL_ONE"):
+            return 1 if n else 0
+        if cl == "TWO":
+            return min(2, n)
+        if cl == "THREE":
+            return min(3, n)
+        if cl == "QUORUM":
+            return n // 2 + 1
+        if cl == "ALL":
+            return n
+        if cl == "LOCAL_QUORUM":
+            local = [r for r in replicas if r.dc == local_dc]
+            return len(local) // 2 + 1
+        if cl == "EACH_QUORUM":
+            # approximated as global quorum for the blocking count
+            return n // 2 + 1
+        raise ValueError(f"unknown consistency level {cl}")

@@ -1,0 +1,86 @@
+"""Token ring: node ownership of the murmur3 token space.
+
+Reference counterpart: dht/IPartitioner + Murmur3Partitioner (tokens),
+locator/TokenMetadata (ring state; superseded by tcm/ClusterMetadata's
+tokenMap in 5.1 — our Ring plays that tokenMap role), dht/Splitter
+(even range splitting).
+"""
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass, field
+
+from ..utils import murmur3
+
+
+@dataclass(frozen=True)
+class Endpoint:
+    """A node address (host-port stands in for InetAddressAndPort)."""
+    name: str
+    dc: str = "dc1"
+    rack: str = "rack1"
+
+    def __repr__(self):
+        return self.name
+
+
+class Ring:
+    """token -> owning endpoint, sorted; replica walks go clockwise
+    (locator/AbstractReplicationStrategy.calculateNaturalReplicas walk)."""
+
+    def __init__(self):
+        self._tokens: list[int] = []
+        self._owners: dict[int, Endpoint] = {}
+        self.endpoints: dict[Endpoint, list[int]] = {}
+
+    def add_node(self, ep: Endpoint, tokens: list[int]) -> None:
+        for t in tokens:
+            if t in self._owners:
+                raise ValueError(f"token {t} already owned")
+            bisect.insort(self._tokens, t)
+            self._owners[t] = ep
+        self.endpoints.setdefault(ep, []).extend(tokens)
+
+    def remove_node(self, ep: Endpoint) -> None:
+        for t in self.endpoints.pop(ep, []):
+            self._tokens.remove(t)
+            del self._owners[t]
+
+    def successors(self, token: int):
+        """Endpoints in ring order starting at the first token >= token."""
+        if not self._tokens:
+            return
+        start = bisect.bisect_left(self._tokens, token)
+        n = len(self._tokens)
+        for i in range(n):
+            t = self._tokens[(start + i) % n]
+            yield self._owners[t]
+
+    def primary(self, token: int) -> Endpoint:
+        return next(self.successors(token))
+
+    def token_of(self, key: bytes) -> int:
+        return murmur3.token_of(key)
+
+    def ranges_of(self, ep: Endpoint) -> list[tuple[int, int]]:
+        """(start, end] ranges owned primarily by ep."""
+        out = []
+        n = len(self._tokens)
+        for i, t in enumerate(self._tokens):
+            if self._owners[t] is ep or self._owners[t] == ep:
+                prev = self._tokens[(i - 1) % n]
+                out.append((prev, t))
+        return out
+
+
+def even_tokens(n_nodes: int, vnodes: int = 1) -> list[list[int]]:
+    """Evenly spread initial tokens (dht/tokenallocator role, simplified
+    to the uniform case)."""
+    total = n_nodes * vnodes
+    span = 1 << 64
+    step = span // total
+    out: list[list[int]] = [[] for _ in range(n_nodes)]
+    for i in range(total):
+        tok = -(1 << 63) + 1 + i * step
+        out[i % n_nodes].append(tok)
+    return out

@@ -96,10 +96,8 @@ class _Cursor:
             self.bufs = []
             self._fetch()
             return buf
-        head = buf.apply_permutation(np.arange(idx))
-        head.pk_map = buf.pk_map
-        tail = buf.apply_permutation(np.arange(idx, len(buf)))
-        tail.pk_map = buf.pk_map
+        head = buf.slice_range(0, idx)
+        tail = buf.slice_range(idx, len(buf))
         self.bufs = [tail]
         return head
 

@@ -2,33 +2,39 @@
 
 The reference merges k sorted SSTable scanners through a binary heap one row
 at a time (utils/MergeIterator.java:23, CompactionIterator.java:90). The
-TPU formulation: concatenate the runs' identity lanes, run ONE stable
-variadic sort (jax.lax.sort), then compute winners / deletion shadowing /
-purge as masks with segmented scans (lax.associative_scan). Everything is
-uint32 lanes — 64-bit quantities travel as (hi, lo) pairs and compare
-pairwise — so the kernel maps directly onto TPU vector units with no 64-bit
-emulation.
+TPU formulation: concatenate the runs' identity lanes, sort, then compute
+winners / deletion shadowing / purge as masks with segmented scans
+(lax.associative_scan). Everything is uint32 lanes — 64-bit quantities
+travel as (hi, lo) pairs and compare pairwise — so the kernel maps directly
+onto TPU vector units with no 64-bit emulation.
+
+Sorting strategy (the load-bearing TPU decision): XLA's TPU sort compile
+time explodes with the number of operands (a 2-operand sort compiles in
+seconds; an 18-operand variadic sort takes tens of minutes), while warm
+runs are fast. So the lexicographic sort is an LSD radix composition:
+16 passes of ONE reused jitted (key, perm) stable sort, least-significant
+lane first. One small program compiles once; the passes chain on-device
+with no host synchronisation.
+
+Tie-breaks beyond (identity, timestamp) — tombstone-beats-data and
+larger-value-wins at equal timestamps (db/rows/Cells.java:68) — are
+resolved on the host for the rare flagged runs, exactly, with full value
+bytes.
 
 Outputs are a permutation + keep mask; the host applies them to the
-variable-length payload with numpy gathers (storage/cellbatch.py). Value
-tie-breaks beyond the 4-byte prefix lane are flagged in an `ambiguous` mask
-for the host to resolve exactly (rare; Cells.reconcile full-value compare).
-
-Shapes are padded to buckets so jit traces once per bucket size, not per
-batch (XLA static-shape discipline).
+variable-length payload with numpy gathers (storage/cellbatch.py).
+Shapes are padded to buckets so programs are traced once per bucket size.
 """
 from __future__ import annotations
-
-from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..schema import COL_PARTITION_DEL, COL_ROW_DEL
 from ..storage.cellbatch import (DEATH_FLAGS, FLAG_COMPLEX_DEL,
                                  FLAG_EXPIRING, FLAG_PARTITION_DEL,
                                  FLAG_ROW_DEL, FLAG_TOMBSTONE, CellBatch)
-from ..schema import COL_PARTITION_DEL, COL_ROW_DEL
 
 _U32_MAX = jnp.uint32(0xFFFFFFFF)
 
@@ -58,47 +64,70 @@ def _seg_carry_pair(vh, vl, is_start):
     return h, l
 
 
-@jax.jit
-def merge_reconcile_kernel(operands):
-    """Core kernel. `operands` is a dict of arrays, all length N (padded):
-      lanes:   uint32 [N, K]  identity lanes (column lane at K-3)
-      valid:   uint32 [N]     0 for real cells, 1 for padding
-      ts_h/ts_l: uint32       biased write timestamp (desc tie-break + shadow)
-      death:   uint32         1 if record is any kind of deletion
-      vp:      uint32         4-byte value prefix (tie-break)
-      ldt:     int32          local deletion / expiry seconds
-      expiring: uint32        1 if cell has TTL
-      purge_h/purge_l: uint32 biased per-cell max-purgeable timestamp
-      gc_before, now: int32 scalars
-    Returns (perm, keep, ambiguous) — all length N.
-    """
-    lanes = operands["lanes"]
-    N, K = lanes.shape
-    ts_h, ts_l = operands["ts_h"], operands["ts_l"]
-    death = operands["death"]
-    vp = operands["vp"]
+# ------------------------------------------------------------------- sort --
 
-    # ---- 1. one big stable sort ------------------------------------------
+@jax.jit
+def _lsd_pass(key: jnp.ndarray, perm: jnp.ndarray) -> jnp.ndarray:
+    """One stable radix pass: reorder perm by key[perm]. Chained from the
+    least-significant sort lane to the most significant, this composes a
+    full lexicographic sort (stability carries the lower lanes' order)."""
+    k = key[perm]
+    _, new_perm = jax.lax.sort((k, perm), num_keys=1, is_stable=True)
+    return new_perm
+
+
+def _sort_keys(operands) -> list:
+    """Most-significant first: validity, identity lanes, ~ts."""
+    lanes = operands["lanes"]
+    K = lanes.shape[1]
     keys = [operands["valid"]]
     keys += [lanes[:, k] for k in range(K)]
-    keys += [_U32_MAX - ts_h, _U32_MAX - ts_l,        # ts desc
-             jnp.uint32(1) - death,                   # tombstone first
-             _U32_MAX - vp]                           # larger value first
-    idx = jnp.arange(N, dtype=jnp.uint32)
-    out = jax.lax.sort(tuple(keys) + (idx,), num_keys=len(keys),
-                       is_stable=True)
-    perm = out[-1].astype(jnp.int32)
+    keys += [_U32_MAX - operands["ts_h"], _U32_MAX - operands["ts_l"]]
+    return keys
 
+
+def device_sort_perm(operands) -> jnp.ndarray:
+    """Host-driven LSD loop using the single cached-compile pass. All
+    intermediates stay on device; dispatches pipeline without sync."""
+    keys = [jnp.asarray(k) for k in _sort_keys(operands)]
+    N = keys[0].shape[0]
+    perm = jnp.arange(N, dtype=jnp.int32)
+    for key in reversed(keys):
+        perm = _lsd_pass(key, perm)
+    return perm
+
+
+def _traced_sort_perm(operands) -> jnp.ndarray:
+    """Same composition under an enclosing trace (nested jit inlines)."""
+    keys = _sort_keys(operands)
+    N = keys[0].shape[0]
+    perm = jnp.arange(N, dtype=jnp.int32)
+    for key in reversed(keys):
+        perm = _lsd_pass(key, perm)
+    return perm
+
+
+# -------------------------------------------------------------- reconcile --
+
+@jax.jit
+def reconcile_kernel(operands, perm):
+    """Reconcile over a sort permutation. `operands` as in build_operands;
+    returns (keep, ambiguous, expired, shadowed) aligned to SORTED order.
+
+    ambiguous marks records whose (identity, ts) equal the previous sorted
+    record — the host picks the winner there with death/value tie-break
+    rules (the device sort does not order by them)."""
+    lanes = operands["lanes"][perm]
+    N, K = lanes.shape
     g = lambda a: a[perm]
-    lanes = lanes[perm]
-    ts_h, ts_l = g(ts_h), g(ts_l)
-    death, vp = g(death), g(vp)
+    ts_h, ts_l = g(operands["ts_h"]), g(operands["ts_l"])
     valid = g(operands["valid"]) == 0
     ldt = g(operands["ldt"])
     expiring = g(operands["expiring"]) == 1
+    is_cd = g(operands["cdel"]) == 1
     purge_h, purge_l = g(operands["purge_h"]), g(operands["purge_l"])
 
-    # ---- 2. boundaries ----------------------------------------------------
+    # ---- boundaries
     prev = jnp.concatenate([jnp.full((1, K), 0xFFFFFFFF, dtype=jnp.uint32),
                             lanes[:-1]], axis=0)
     diff = lanes != prev
@@ -111,25 +140,19 @@ def merge_reconcile_kernel(operands):
     col = lanes[:, K - 3]
     winner = cell_new & valid
 
-    # ---- 3. deletion shadowing -------------------------------------------
+    # ---- deletion shadowing
     is_pd = col == COL_PARTITION_DEL
     is_rd = col == COL_ROW_DEL
-    is_cd = g(operands["cdel"]) == 1
     zero = jnp.uint32(0)
-    # partition deletions sort first in their partition; the partition-start
-    # record is the pd winner when one exists
     pd_h = jnp.where(part_new & is_pd, ts_h, zero)
     pd_l = jnp.where(part_new & is_pd, ts_l, zero)
     pd_h, pd_l = _seg_carry_pair(pd_h, pd_l, part_new)
-    # row deletions sort first in their row
     rd_h = jnp.where(row_new & is_rd, ts_h, zero)
     rd_l = jnp.where(row_new & is_rd, ts_l, zero)
     rd_h, rd_l = _seg_carry_pair(rd_h, rd_l, row_new)
-    # effective row-scope deletion = max(pd, rd)
     use_pd = _lt_pair(rd_h, rd_l, pd_h, pd_l)
     del_h = jnp.where(use_pd, pd_h, rd_h)
     del_l = jnp.where(use_pd, pd_l, rd_l)
-    # complex (collection) deletions sort first in their (row, column)
     cd_h = jnp.where(col_new & is_cd, ts_h, zero)
     cd_l = jnp.where(col_new & is_cd, ts_l, zero)
     cd_h, cd_l = _seg_carry_pair(cd_h, cd_l, col_new)
@@ -144,20 +167,29 @@ def merge_reconcile_kernel(operands):
                   jnp.where(is_cd, _le_pair(ts_h, ts_l, del_h, del_l),
                             False)))
 
-    # ---- 4. TTL expiry + purge -------------------------------------------
+    # ---- TTL expiry + purge
     now = operands["now"]
     gc_before = operands["gc_before"]
+    death = g(operands["death"]) == 1
     expired = expiring & (ldt <= now)
-    death_eff = (death == 1) | expired
+    death_eff = death | expired
     purgeable = _lt_pair(ts_h, ts_l, purge_h, purge_l)
     purged = death_eff & (ldt < gc_before) & purgeable
 
     keep = winner & ~shadowed & ~purged
 
-    # ---- 5. ambiguous value ties (host resolves with full bytes) ---------
-    same_meta = (~cell_new) & (ts_h == prev_eq(ts_h)) & (ts_l == prev_eq(ts_l)) \
-        & (death == prev_eq(death)) & (vp == prev_eq(vp))
-    ambiguous = same_meta & valid
+    # ---- ties the device didn't order: same identity AND same ts
+    same_ts = (ts_h == prev_eq(ts_h)) & (ts_l == prev_eq(ts_l))
+    ambiguous = (~cell_new) & same_ts & valid
+    return keep, ambiguous, expired, shadowed
+
+
+def merge_reconcile_kernel(operands):
+    """Jittable single-call form (driver entry / shard_map body): traced
+    sort composition + reconcile. Returns (perm, keep, ambiguous, expired,
+    shadowed)."""
+    perm = _traced_sort_perm(operands)
+    keep, ambiguous, expired, shadowed = reconcile_kernel(operands, perm)
     return perm, keep, ambiguous, expired, shadowed
 
 
@@ -176,15 +208,11 @@ def _bucket(n: int) -> int:
     return b
 
 
-def merge_sorted_device(batches: list[CellBatch], gc_before: int = 0,
-                        now: int = 0, purgeable_ts_fn=None) -> CellBatch:
-    """Drop-in equivalent of storage.cellbatch.merge_sorted running the
-    sort/reconcile on the default JAX device."""
-    cat = CellBatch.concat(batches)
+def build_operands(cat: CellBatch, gc_before: int = 0, now: int = 0,
+                   purgeable_ts_fn=None, bucket: int | None = None) -> dict:
+    """Pack a CellBatch into the kernel's padded uint32 operand arrays."""
     n = len(cat)
-    if n == 0:
-        return cat
-    N = _bucket(n)
+    N = bucket or _bucket(n)
     K = cat.n_lanes
 
     lanes = np.full((N, K), 0xFFFFFFFF, dtype=np.uint32)
@@ -201,8 +229,6 @@ def merge_sorted_device(batches: list[CellBatch], gc_before: int = 0,
     death[:n] = (cat.flags & DEATH_FLAGS) != 0
     cdel = np.zeros(N, dtype=np.uint32)
     cdel[:n] = (cat.flags & FLAG_COMPLEX_DEL) != 0
-    vp = np.zeros(N, dtype=np.uint32)
-    vp[:n] = cat._value_prefix_lane()
     ldt = np.zeros(N, dtype=np.int32)
     ldt[:n] = cat.ldt
     expiring = np.zeros(N, dtype=np.uint32)
@@ -220,17 +246,29 @@ def merge_sorted_device(batches: list[CellBatch], gc_before: int = 0,
         purge_h = np.full(N, 0xFFFFFFFF, dtype=np.uint32)
         purge_l = np.full(N, 0xFFFFFFFF, dtype=np.uint32)
 
-    operands = {
+    return {
         "lanes": jnp.asarray(lanes), "valid": jnp.asarray(valid),
         "ts_h": jnp.asarray(ts_h), "ts_l": jnp.asarray(ts_l),
-        "death": jnp.asarray(death), "vp": jnp.asarray(vp),
+        "death": jnp.asarray(death),
         "cdel": jnp.asarray(cdel),
         "ldt": jnp.asarray(ldt), "expiring": jnp.asarray(expiring),
         "purge_h": jnp.asarray(purge_h), "purge_l": jnp.asarray(purge_l),
         "gc_before": jnp.int32(gc_before), "now": jnp.int32(now),
     }
-    perm, keep, ambiguous, expired, shadowed = merge_reconcile_kernel(operands)
-    perm = np.asarray(perm)
+
+
+def merge_sorted_device(batches: list[CellBatch], gc_before: int = 0,
+                        now: int = 0, purgeable_ts_fn=None) -> CellBatch:
+    """Drop-in equivalent of storage.cellbatch.merge_sorted running the
+    sort/reconcile on the default JAX device."""
+    cat = CellBatch.concat(batches)
+    n = len(cat)
+    if n == 0:
+        return cat
+    operands = build_operands(cat, gc_before, now, purgeable_ts_fn)
+    perm_d = device_sort_perm(operands)
+    keep, ambiguous, expired, shadowed = reconcile_kernel(operands, perm_d)
+    perm = np.asarray(perm_d)
     keep = np.array(keep)          # writable copy: host fix-up mutates it
     ambiguous = np.asarray(ambiguous)
     expired = np.asarray(expired)
@@ -238,47 +276,67 @@ def merge_sorted_device(batches: list[CellBatch], gc_before: int = 0,
 
     # strip padding; padded entries sort last (valid is the primary key)
     perm_real = perm[:n]
-    s = cat.apply_permutation(perm_real)
     keep = keep[:n]
     expired = expired[:n]
-    # expired-TTL conversion (mirrors numpy reconcile step 2)
-    s.flags[expired] |= FLAG_TOMBSTONE
 
-    # host-exact value tie-break (device flagged the candidate runs);
-    # mirrors the numpy path: winner moves to the largest full value, then
-    # shadow/purge apply at the new winner (ts/death equal across the run,
-    # so only the ldt-dependent purge needs re-evaluation)
-    amb = ambiguous[:n]
-    if amb.any():
-        if purgeable_ts_fn is not None:
-            pts_sorted = purgeable_ts_fn(cat).astype(np.int64)[perm_real]
-        else:
-            pts_sorted = None
-        death_s = ((s.flags & DEATH_FLAGS) != 0)
-        shadow_n = shadowed[:n]
-        idxs = np.flatnonzero(amb)
-        prev_i = -2
-        runs = []
-        for i in idxs:
-            if i != prev_i + 1:
-                runs.append([i - 1, i])
-            else:
-                runs[-1][1] = i
-            prev_i = i
-        _, _, cell_new = s.boundaries()
-        for lo, hi in runs:
-            if not cell_new[lo]:
-                continue  # run of older duplicates below the winner
-            best = max(range(lo, hi + 1), key=s.cell_value)
-            keep[lo:hi + 1] = False
-            purgeable = pts_sorted is None or s.ts[best] < pts_sorted[best]
-            purged = bool(death_s[best]) and s.ldt[best] < gc_before \
-                and purgeable
-            keep[best] = not (shadow_n[best] or purged)
-    out = s.apply_permutation(np.flatnonzero(keep))
+    # host tie-break for equal-(identity, ts) runs (host_tiebreak below)
+    pts_sorted = purgeable_ts_fn(cat).astype(np.int64)[perm_real] \
+        if purgeable_ts_fn is not None else None
+    host_tiebreak(cat, perm_real, keep, ambiguous[:n], shadowed[:n],
+                  expired, gc_before, pts_sorted)
+
+    kept_sorted_pos = np.flatnonzero(keep)
+    out = cat.apply_permutation(perm_real[kept_sorted_pos])
     out.sorted = True
-    # expired-TTL -> tombstone conversion drops the dead value (mirrors
-    # the numpy path exactly)
-    converted = ((out.flags & FLAG_EXPIRING) != 0) & \
-        ((out.flags & FLAG_TOMBSTONE) != 0)
-    return out.drop_values(converted)
+    converted = expired[kept_sorted_pos]
+    if converted.any():
+        out.flags[converted] |= FLAG_TOMBSTONE
+        out = out.drop_values(converted)
+    return out
+
+
+def host_tiebreak(cat: CellBatch, perm_real: np.ndarray, keep: np.ndarray,
+                  amb: np.ndarray, shadowed: np.ndarray,
+                  expired: np.ndarray, gc_before: int,
+                  pts_sorted: np.ndarray | None) -> None:
+    """Resolve equal-(identity, ts) runs with exact Cells.reconcile rules
+    (tombstone first, then largest full value, then first-seen). Mutates
+    `keep` in place. Arrays are in SORTED order; perm_real maps sorted
+    position -> index into `cat`. Shared by the single-device and the
+    mesh-sharded paths."""
+    if not amb.any():
+        return
+    n = len(perm_real)
+    flags_sorted = cat.flags[perm_real]
+    death_orig = (flags_sorted & DEATH_FLAGS) != 0
+    death_eff = death_orig | expired
+    ldt_sorted = cat.ldt[perm_real]
+    ts_sorted = cat.ts[perm_real]
+    lanes_sorted = cat.lanes[perm_real]
+    cell_new = np.ones(n, dtype=bool)
+    if n > 1:
+        cell_new[1:] = (lanes_sorted[1:] != lanes_sorted[:-1]).any(axis=1)
+
+    def orig_value(i):
+        j = perm_real[i]
+        return cat.payload[cat.val_start[j]:cat.off[j + 1]].tobytes()
+
+    idxs = np.flatnonzero(amb)
+    prev_i = -2
+    runs = []
+    for i in idxs:
+        if i != prev_i + 1:
+            runs.append([i - 1, i])
+        else:
+            runs[-1][1] = i
+        prev_i = i
+    for lo, hi in runs:
+        if lo < 0 or not cell_new[lo]:
+            continue  # run of older duplicates below the winner
+        best = max(range(lo, hi + 1),
+                   key=lambda i: (bool(death_orig[i]), orig_value(i)))
+        keep[lo:hi + 1] = False
+        purgeable = pts_sorted is None or ts_sorted[best] < pts_sorted[best]
+        purged = bool(death_eff[best]) and ldt_sorted[best] < gc_before \
+            and purgeable
+        keep[best] = not (shadowed[best] or purged)

@@ -1,0 +1,1 @@
+from .mesh import make_mesh, sharded_merge_step, shard_batch  # noqa: F401

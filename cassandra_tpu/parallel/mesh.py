@@ -1,0 +1,173 @@
+"""Multi-chip compaction: token-range sharding over a jax.sharding.Mesh.
+
+Design (SURVEY.md section 5.7): the reference parallelises compaction
+within a node via UCS's ShardManager (db/compaction/ShardManager.java:33 —
+token-range shards compacted independently) and across the cluster by
+ownership. The TPU formulation is the same idea on a device mesh: the
+token ring is split into one contiguous range per device, each device
+runs the merge/reconcile kernel on its shard (shard_map; no cross-device
+traffic for the merge itself — shards are disjoint), and per-shard stats
+are combined with psum over ICI.
+
+The same step doubles as the driver's multichip dry run: it is the full
+"training step" of this framework — one round of the LSM data plane.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.merge import merge_reconcile_kernel
+from ..storage.cellbatch import (DEATH_FLAGS, FLAG_COMPLEX_DEL,
+                                 FLAG_EXPIRING, CellBatch)
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise RuntimeError(
+                f"mesh needs {n_devices} devices, backend "
+                f"{jax.default_backend()!r} has {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), ("shard",))
+
+
+# ------------------------------------------------------------- host split --
+
+def shard_batch(cat: CellBatch, n_shards: int, gc_before: int = 0,
+                now: int = 0) -> tuple[dict, np.ndarray, np.ndarray]:
+    """Split a concatenated (unsorted) batch into n token-range shards of
+    equal padded size and build the [S, N] operand arrays for
+    sharded_merge_step. Returns (operands, shard_of_cell, position_in_shard)
+    so the host can map kernel outputs back to cells.
+
+    Shard boundaries are count-balanced quantiles of the token distribution
+    (ShardManager.computeBoundaries role)."""
+    n = len(cat)
+    with np.errstate(over="ignore"):
+        tok = (cat.lanes[:, 0].astype(np.uint64) << np.uint64(32)) \
+            | cat.lanes[:, 1].astype(np.uint64)
+    order = np.argsort(tok, kind="stable")
+    # count-balanced boundaries, snapped so a partition never splits:
+    # use the token value at each quantile; cells with token < boundary
+    # go left (equal tokens stay together on the right side)
+    bounds = []
+    for s in range(1, n_shards):
+        q = tok[order[min(int(round(s * n / n_shards)), n - 1)]]
+        bounds.append(q)
+    bounds = np.array(bounds, dtype=np.uint64)
+    shard_of = np.searchsorted(bounds, tok, side="right").astype(np.int32)
+
+    counts = np.bincount(shard_of, minlength=n_shards)
+    N = max(1024, int(1 << int(np.ceil(np.log2(max(counts.max(), 1))))))
+
+    K = cat.n_lanes
+    S = n_shards
+    lanes = np.full((S, N, K), 0xFFFFFFFF, dtype=np.uint32)
+    valid = np.ones((S, N), dtype=np.uint32)
+    ts_h = np.zeros((S, N), dtype=np.uint32)
+    ts_l = np.zeros((S, N), dtype=np.uint32)
+    death = np.zeros((S, N), dtype=np.uint32)
+    cdel = np.zeros((S, N), dtype=np.uint32)
+    ldt = np.zeros((S, N), dtype=np.int32)
+    expiring = np.zeros((S, N), dtype=np.uint32)
+    purge = np.full((S, N), 0xFFFFFFFF, dtype=np.uint32)
+
+    with np.errstate(over="ignore"):
+        uts = cat.ts.astype(np.uint64) ^ np.uint64(1 << 63)
+    pos_in_shard = np.zeros(n, dtype=np.int64)
+    shard_members: list[np.ndarray] = []
+    for s in range(S):
+        idx = np.flatnonzero(shard_of == s)
+        shard_members.append(idx)
+        c = len(idx)
+        pos_in_shard[idx] = np.arange(c)
+        lanes[s, :c] = cat.lanes[idx]
+        valid[s, :c] = 0
+        ts_h[s, :c] = (uts[idx] >> np.uint64(32)).astype(np.uint32)
+        ts_l[s, :c] = (uts[idx] & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        death[s, :c] = (cat.flags[idx] & DEATH_FLAGS) != 0
+        cdel[s, :c] = (cat.flags[idx] & FLAG_COMPLEX_DEL) != 0
+        ldt[s, :c] = cat.ldt[idx]
+        expiring[s, :c] = (cat.flags[idx] & FLAG_EXPIRING) != 0
+
+    operands = {
+        "lanes": lanes, "valid": valid, "ts_h": ts_h, "ts_l": ts_l,
+        "death": death, "cdel": cdel, "ldt": ldt,
+        "expiring": expiring, "purge_h": purge, "purge_l": purge.copy(),
+        "gc_before": np.int32(gc_before), "now": np.int32(now),
+    }
+    return operands, shard_of, pos_in_shard, shard_members
+
+
+# ----------------------------------------------------------- device step --
+
+def sharded_merge_step(mesh: Mesh):
+    """Build the jitted sharded compaction step for a mesh. Input operands
+    carry a leading shard axis partitioned over the mesh; each device sorts
+    and reconciles its token range locally, then global stats (cells kept,
+    tombstones purged) are psum'd across the mesh."""
+
+    def per_shard(operands):
+        # operands arrive with a leading axis of local size 1
+        local = {k: (v[0] if getattr(v, "ndim", 0) > 0 else v)
+                 for k, v in operands.items()}
+        perm, keep, amb, expired, shadowed = merge_reconcile_kernel(local)
+        kept = jnp.sum(keep.astype(jnp.int32))
+        dropped = jnp.sum((local["valid"] == 0).astype(jnp.int32)) - kept
+        stats = jnp.stack([kept, dropped])
+        stats = jax.lax.psum(stats, axis_name="shard")
+        return (perm[None], keep[None], amb[None], expired[None],
+                shadowed[None], stats)
+
+    arr_spec = P("shard")
+    scalar_spec = P()
+    in_specs = ({k: (arr_spec if k not in ("gc_before", "now")
+                     else scalar_spec)
+                 for k in ("lanes", "valid", "ts_h", "ts_l", "death",
+                           "cdel", "ldt", "expiring", "purge_h", "purge_l",
+                           "gc_before", "now")},)
+    out_specs = (arr_spec, arr_spec, arr_spec, arr_spec, arr_spec, P())
+
+    return jax.jit(jax.shard_map(per_shard, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False))
+
+
+def run_sharded_merge(cat: CellBatch, mesh: Mesh, gc_before: int = 0,
+                      now: int = 0):
+    """Host orchestration: split -> device step -> host tie-break ->
+    per-shard outputs. Returns (keep [S,N] numpy, perm [S,N],
+    stats (kept, dropped), shard_of, pos_in_shard)."""
+    from ..ops.merge import host_tiebreak
+
+    n_shards = mesh.devices.size
+    operands, shard_of, pos, members = shard_batch(cat, n_shards,
+                                                   gc_before, now)
+    step = sharded_merge_step(mesh)
+    jop = {k: jnp.asarray(v) for k, v in operands.items()}
+    perm, keep, amb, expired, shadowed, stats = step(jop)
+    keep = np.array(keep)
+    perm = np.asarray(perm)
+    amb = np.asarray(amb)
+    expired = np.asarray(expired)
+    shadowed = np.asarray(shadowed)
+    # equal-(identity, ts) winners need the exact death/value rules — per
+    # shard, map sorted positions back into cat and resolve on host
+    for s in range(n_shards):
+        c = len(members[s])
+        if c == 0 or not amb[s, :c].any():
+            continue
+        perm_real = members[s][perm[s, :c]]
+        host_tiebreak(cat, perm_real, keep[s, :c], amb[s, :c],
+                      shadowed[s, :c], expired[s, :c], gc_before, None)
+    stats = np.array([int(keep[s, :len(members[s])].sum())
+                      for s in range(n_shards)]).sum(), \
+        len(cat) - sum(int(keep[s, :len(members[s])].sum())
+                       for s in range(n_shards))
+    stats = np.array(stats)
+    return keep, perm, stats, shard_of, pos

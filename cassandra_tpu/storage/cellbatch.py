@@ -190,6 +190,18 @@ class CellBatch:
 
     # ------------------------------------------------------------ concat --
 
+    def slice_range(self, lo: int, hi: int) -> "CellBatch":
+        """Zero-copy contiguous slice [lo, hi) — arrays are VIEWS of this
+        batch (callers must not mutate either). The payload offsets are
+        rebased (the only small copy)."""
+        base = int(self.off[lo])
+        return CellBatch(self.lanes[lo:hi], self.ts[lo:hi], self.ldt[lo:hi],
+                         self.ttl[lo:hi], self.flags[lo:hi],
+                         self.off[lo:hi + 1] - base,
+                         self.val_start[lo:hi] - base,
+                         self.payload[base:int(self.off[hi])],
+                         self.pk_map, sorted=self.sorted)
+
     def drop_values(self, mask: np.ndarray) -> "CellBatch":
         """Rewrite the payload with value bytes removed for masked cells
         (expired-TTL -> tombstone conversion drops the dead value)."""

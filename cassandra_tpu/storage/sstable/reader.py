@@ -264,9 +264,7 @@ class SSTableReader:
             lo = max(c0 - int(self._seg_cell0[s]), 0)
             hi = min(c1 - int(self._seg_cell0[s]), len(seg))
             if lo > 0 or hi < len(seg):
-                sub = seg.apply_permutation(np.arange(lo, hi))
-                sub.pk_map = seg.pk_map
-                parts.append(sub)
+                parts.append(seg.slice_range(lo, hi))
             else:
                 parts.append(seg)
         out = CellBatch.concat(parts) if len(parts) > 1 else parts[0]

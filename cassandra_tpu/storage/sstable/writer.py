@@ -123,12 +123,8 @@ class SSTableWriter:
                 self._pending.pop(0)
                 got += len(b)
             else:
-                idx = np.arange(need)
-                head = b.apply_permutation(idx)
-                tail = b.apply_permutation(np.arange(need, len(b)))
-                tail.sorted = b.sorted
-                taken.append(head)
-                self._pending[0] = tail
+                taken.append(b.slice_range(0, need))
+                self._pending[0] = b.slice_range(need, len(b))
                 got = n
         self._pending_cells -= n
         return CellBatch.concat(taken) if len(taken) > 1 else taken[0]

@@ -74,6 +74,19 @@ class ColumnFamilyStore:
             [d.generation for d in Descriptor.list_in(self.directory)],
             default=0)
 
+    def reload_sstables(self) -> None:
+        """Pick up sstables written into the directory out-of-band
+        (bulk load / sstableloader role). NOT safe concurrently with
+        in-process flush/compaction — those register their outputs with
+        the tracker themselves; calling this mid-write can double-add a
+        generation. Quiesce writes first."""
+        with self._gen_lock:
+            known = {s.desc.generation for s in self.tracker.view()}
+            for desc in Descriptor.list_in(self.directory):
+                if desc.generation not in known:
+                    self.tracker.add(SSTableReader(desc))
+                    self._last_gen = max(self._last_gen, desc.generation)
+
     def next_generation(self) -> int:
         """Race-free generation allocation shared by flush + compaction
         (a directory re-scan alone is a TOCTOU between writers)."""

@@ -128,15 +128,26 @@ def _pad_keys(keys: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
 
 
 def hash128_batch(keys: list[bytes], seed: int = 0) -> tuple[np.ndarray, np.ndarray]:
-    """Vectorised murmur3 x64/128 over many keys. Returns (h1, h2) uint64 arrays.
+    """Vectorised murmur3 x64/128 over many keys. Returns (h1, h2) uint64
+    arrays."""
+    if not keys:
+        return np.zeros(0, np.uint64), np.zeros(0, np.uint64)
+    mat, lens = _pad_keys(keys)
+    return hash128_mat(mat, lens, seed)
+
+
+def hash128_mat(mat: np.ndarray, lens: np.ndarray,
+                seed: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorised murmur3 over a pre-padded (n, width) uint8 matrix with
+    per-row lengths; width must be a multiple of 16 with >= 16 bytes of
+    padding beyond the longest row. Zero python loops over rows — the bulk
+    generator and bloom builds feed millions of keys through here.
 
     All keys are processed in lock-step over the padded width; per-key block
     counts are honoured by masking (a block is only mixed into rows whose key
     is long enough). This is the same data-parallel shape a Pallas kernel
     would use."""
-    if not keys:
-        return np.zeros(0, np.uint64), np.zeros(0, np.uint64)
-    mat, lens = _pad_keys(keys)
+    lens = np.asarray(lens, dtype=np.int64)
     n, width = mat.shape
     blocks = mat.reshape(n, width // 16, 16)
     # little-endian u64 pairs per block (explicit dtype: host may be BE)

@@ -1,12 +1,23 @@
 """Test configuration: force an 8-device virtual CPU mesh so sharding tests
 run without TPU hardware (mirrors the reference's in-JVM dtest approach of
-simulating a cluster in one process; see SURVEY.md section 4)."""
+simulating a cluster in one process; see SURVEY.md section 4).
+
+The image pins JAX_PLATFORMS=axon (the TPU plugin), so this must OVERRIDE,
+not setdefault. Set CASSANDRA_TPU_TEST_BACKEND=axon to run the suite on
+the real chip instead."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+backend = os.environ.get("CASSANDRA_TPU_TEST_BACKEND", "cpu")
+os.environ["JAX_PLATFORMS"] = backend
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+# the axon sitecustomize registers the TPU plugin before this file runs;
+# the env var alone is ignored once that happened — force via config
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", backend)
