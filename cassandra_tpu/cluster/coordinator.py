@@ -204,9 +204,19 @@ class StorageProxy:
                  cl: str = ConsistencyLevel.ONE) -> cb.CellBatch:
         """Full-range read across the cluster: every live node contributes
         its local view; coordinator merges (RangeCommands.partitions,
-        simplified to a full-ring scan)."""
-        peers = [e for e in self.node.ring.endpoints
-                 if self.node.is_alive(e)]
+        simplified to a full-ring scan). Every targeted peer must respond —
+        a silent partial result would drop rows owned only by the missing
+        peer; dead peers are only tolerable when surviving replicas can
+        still cover the ring (approximated here by requiring all-live for
+        CL above ONE)."""
+        all_eps = list(self.node.ring.endpoints)
+        peers = [e for e in all_eps if self.node.is_alive(e)]
+        if len(peers) < len(all_eps) and cl not in (ConsistencyLevel.ONE,
+                                                    ConsistencyLevel.ANY,
+                                                    ConsistencyLevel.LOCAL_ONE):
+            raise UnavailableException(
+                f"range read at {cl} with {len(all_eps) - len(peers)} "
+                "endpoints down")
         handler = _Await(len(peers))
         results = []
         lock = threading.Lock()
@@ -227,7 +237,10 @@ class StorageProxy:
                     on_response=on_rsp,
                     on_failure=lambda mid: handler.fail(),
                     timeout=self.timeout)
-        handler.await_(self.timeout)
+        if not handler.await_(self.timeout):
+            raise TimeoutException(
+                f"range read: {len(handler.responses)}/{len(peers)} "
+                "responses")
         with lock:
             return cb.merge_sorted(results) if results else cb.CellBatch.empty()
 

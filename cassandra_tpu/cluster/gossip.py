@@ -30,12 +30,15 @@ class EndpointState:
 
 class FailureDetector:
     """Phi accrual: phi = -log10(P(no heartbeat for `elapsed`)) under an
-    exponential model of observed inter-arrival times."""
+    exponential model of observed inter-arrival times. Until enough
+    intervals are observed, `default_mean` stands in so a peer that dies
+    right after startup is still convicted."""
 
     WINDOW = 100
 
-    def __init__(self):
+    def __init__(self, default_mean: float = 1.0):
         self._states: dict[Endpoint, EndpointState] = {}
+        self.default_mean = default_mean
 
     def report(self, ep: Endpoint, state: EndpointState,
                now: float) -> None:
@@ -46,10 +49,14 @@ class FailureDetector:
         state.last_heartbeat = now
 
     def phi(self, state: EndpointState, now: float) -> float:
-        if not state.arrival_intervals or state.last_heartbeat == 0:
+        if state.last_heartbeat == 0:
             return 0.0
-        mean = sum(state.arrival_intervals) / len(state.arrival_intervals)
-        mean = max(mean, 1e-3)
+        if state.arrival_intervals:
+            mean = sum(state.arrival_intervals) / \
+                len(state.arrival_intervals)
+            mean = max(mean, 1e-3)
+        else:
+            mean = self.default_mean
         elapsed = now - state.last_heartbeat
         return (elapsed / mean) / math.log(10)
 
@@ -68,7 +75,7 @@ class Gossiper:
         self.seeds = [s for s in seeds if s != self.ep]
         self.interval = interval
         self.clock = clock
-        self.detector = FailureDetector()
+        self.detector = FailureDetector(default_mean=max(interval * 3, 0.1))
         self.states: dict[Endpoint, EndpointState] = {
             self.ep: EndpointState(generation=int(time.time()))}
         self._lock = threading.Lock()

@@ -183,11 +183,16 @@ class MessagingService:
                 with self._cb_lock:
                     cb = self._callbacks.pop(msg.reply_to, None)
                 if cb is not None:
-                    on_response = cb[0]
-                    try:
-                        on_response(msg)
-                    except Exception:
-                        pass
+                    on_response, on_failure, _ = cb
+                    # a FAILURE_RSP (remote handler raised) is a failure,
+                    # never an ack (write/hint acks must mean applied)
+                    fn = on_failure if msg.verb == Verb.FAILURE_RSP \
+                        else on_response
+                    if fn is not None:
+                        try:
+                            fn(msg if fn is on_response else msg.reply_to)
+                        except Exception:
+                            pass
                 continue
             handler = self.handlers.get(msg.verb)
             if handler is None:

@@ -38,6 +38,13 @@ class Node:
         self.proxy = StorageProxy(self)
         self._register_verbs()
         self.default_cl = ConsistencyLevel.ONE
+        # periodic hint dispatch (HintsDispatchExecutor role): hints must
+        # flow even when the target was never convicted dead
+        self._stop_hints = threading.Event()
+        self._hint_thread = threading.Thread(
+            target=self._hint_loop, daemon=True,
+            name=f"hints-{endpoint.name}")
+        self._hint_thread.start()
 
     # ------------------------------------------------------------- verbs --
 
@@ -75,10 +82,32 @@ class Node:
         return ep == self.endpoint or self.gossiper.is_alive(ep)
 
     def _on_peer_alive(self, ep: Endpoint):
-        if self.hints.has_hints(ep):
-            self.hints.dispatch(
-                ep, lambda m: self.messaging.send_one_way(
-                    Verb.HINT_REQ, m.serialize(), ep))
+        self._dispatch_hints(ep)
+
+    def _hint_loop(self):
+        while not self._stop_hints.wait(0.5):
+            for ep in list(self.ring.endpoints):
+                if ep != self.endpoint and self.hints.has_hints(ep) \
+                        and self.is_alive(ep):
+                    try:
+                        self._dispatch_hints(ep)
+                    except Exception:
+                        pass
+
+    def _dispatch_hints(self, ep: Endpoint):
+        """Replay hints with acks: un-acked mutations are re-stored so a
+        still-unreachable target keeps its hints."""
+        if not self.hints.has_hints(ep):
+            return
+
+        def send(m):
+            self.messaging.send_with_callback(
+                Verb.HINT_REQ, m.serialize(), ep,
+                on_response=lambda rsp: None,
+                on_failure=lambda mid, mm=m: self.hints.store(ep, mm),
+                timeout=self.proxy.timeout)
+
+        self.hints.dispatch(ep, send)
 
     # -------------------------------------------------- CQL backend role --
 
@@ -87,15 +116,10 @@ class Node:
         return getattr(self.engine, "indexes", None)
 
     def apply(self, mutation: Mutation, durable: bool = True) -> None:
-        ks = None
-        for k in self.schema.keyspaces.values():
-            for t in k.tables.values():
-                if t.id == mutation.table_id:
-                    ks = k.name
-                    break
-        if ks is None:
+        t = self.schema.table_by_id(mutation.table_id)
+        if t is None:
             raise KeyError(f"unknown table id {mutation.table_id}")
-        self.proxy.mutate(ks, mutation, self.default_cl)
+        self.proxy.mutate(t.keyspace, mutation, self.default_cl)
 
     def store(self, keyspace: str, name: str):
         return _DistributedStore(self, keyspace, name)
@@ -121,6 +145,7 @@ class Node:
         return Session(self)
 
     def shutdown(self):
+        self._stop_hints.set()
         self.gossiper.stop()
         self.messaging.close()
         self.engine.close()
@@ -174,15 +199,14 @@ class LocalCluster:
                         self.ring, self.transport, seeds=endpoints[:1],
                         gossip_interval=gossip_interval)
             self.nodes.append(node)
+        from .gossip import EndpointState
         for node in self.nodes:
             node.cluster_nodes = self.nodes
             # seed full liveness so tests don't wait for convergence
             for other in self.nodes:
                 if other.endpoint != node.endpoint:
                     st = node.gossiper.states.setdefault(
-                        other.endpoint,
-                        type(node.gossiper.states[node.endpoint])(
-                            generation=1))
+                        other.endpoint, EndpointState(generation=1))
                     node.gossiper.detector.report(
                         other.endpoint, st, node.gossiper.clock())
         for node in self.nodes:
@@ -199,12 +223,15 @@ class LocalCluster:
         return self.nodes[i - 1].session()
 
     def stop_node(self, i: int) -> None:
-        """Simulate a crash: stop gossip + messaging (data stays on disk)."""
+        """Simulate a crash: stop gossip + messaging + hint dispatch
+        (a crashed process sends nothing; data stays on disk)."""
         n = self.nodes[i - 1]
+        n._stop_hints.set()
         n.gossiper.stop()
         n.messaging.close()
 
     def restart_node(self, i: int) -> None:
+        import threading
         n = self.nodes[i - 1]
         n.messaging = MessagingService(n.endpoint, self.transport)
         n.gossiper = Gossiper(n.messaging, [self.nodes[0].endpoint],
@@ -213,6 +240,9 @@ class LocalCluster:
         n._register_verbs()
         n.proxy = StorageProxy(n)
         n.gossiper.start()
+        n._stop_hints = threading.Event()
+        n._hint_thread = threading.Thread(target=n._hint_loop, daemon=True)
+        n._hint_thread.start()
 
     def shutdown(self):
         for n in self.nodes:

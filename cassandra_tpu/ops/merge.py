@@ -86,25 +86,19 @@ def _sort_keys(operands) -> list:
     return keys
 
 
-def device_sort_perm(operands) -> jnp.ndarray:
-    """Host-driven LSD loop using the single cached-compile pass. All
-    intermediates stay on device; dispatches pipeline without sync."""
-    keys = [jnp.asarray(k) for k in _sort_keys(operands)]
-    N = keys[0].shape[0]
-    perm = jnp.arange(N, dtype=jnp.int32)
-    for key in reversed(keys):
-        perm = _lsd_pass(key, perm)
-    return perm
-
-
 def _traced_sort_perm(operands) -> jnp.ndarray:
-    """Same composition under an enclosing trace (nested jit inlines)."""
+    """LSD composition. Works eagerly (each _lsd_pass hits the one cached
+    jit program; dispatches pipeline without host sync) and under an
+    enclosing jit/shard_map (nested jit inlines)."""
     keys = _sort_keys(operands)
     N = keys[0].shape[0]
     perm = jnp.arange(N, dtype=jnp.int32)
     for key in reversed(keys):
-        perm = _lsd_pass(key, perm)
+        perm = _lsd_pass(jnp.asarray(key), perm)
     return perm
+
+
+device_sort_perm = _traced_sort_perm
 
 
 # -------------------------------------------------------------- reconcile --

@@ -157,17 +157,18 @@ def run_sharded_merge(cat: CellBatch, mesh: Mesh, gc_before: int = 0,
     expired = np.asarray(expired)
     shadowed = np.asarray(shadowed)
     # equal-(identity, ts) winners need the exact death/value rules — per
-    # shard, map sorted positions back into cat and resolve on host
+    # shard, map sorted positions back into cat and resolve on host.
+    # The device stats (psum over the mesh) are adjusted by the (rare)
+    # tie-break keep-count delta instead of being recomputed.
+    delta = 0
     for s in range(n_shards):
         c = len(members[s])
         if c == 0 or not amb[s, :c].any():
             continue
+        before = int(keep[s, :c].sum())
         perm_real = members[s][perm[s, :c]]
         host_tiebreak(cat, perm_real, keep[s, :c], amb[s, :c],
                       shadowed[s, :c], expired[s, :c], gc_before, None)
-    stats = np.array([int(keep[s, :len(members[s])].sum())
-                      for s in range(n_shards)]).sum(), \
-        len(cat) - sum(int(keep[s, :len(members[s])].sum())
-                       for s in range(n_shards))
-    stats = np.array(stats)
+        delta += int(keep[s, :c].sum()) - before
+    stats = np.asarray(stats) + np.array([delta, -delta])
     return keep, perm, stats, shard_of, pos

@@ -214,8 +214,12 @@ class Schema:
 
     def __init__(self):
         self.keyspaces: dict[str, KeyspaceMetadata] = {}
+        self._by_id: dict = {}
         self._lock = threading.RLock()
         self.version = 0
+
+    def table_by_id(self, table_id) -> "TableMetadata | None":
+        return self._by_id.get(table_id)
 
     def create_keyspace(self, name: str, params: KeyspaceParams | None = None,
                         if_not_exists: bool = False) -> KeyspaceMetadata:
@@ -231,17 +235,21 @@ class Schema:
 
     def drop_keyspace(self, name: str):
         with self._lock:
-            del self.keyspaces[name]
+            ks = self.keyspaces.pop(name)
+            for t in ks.tables.values():
+                self._by_id.pop(t.id, None)
             self.version += 1
 
     def add_table(self, t: TableMetadata):
         with self._lock:
             self.keyspaces[t.keyspace].add_table(t)
+            self._by_id[t.id] = t
             self.version += 1
 
     def drop_table(self, keyspace: str, name: str):
         with self._lock:
-            del self.keyspaces[keyspace].tables[name]
+            t = self.keyspaces[keyspace].tables.pop(name)
+            self._by_id.pop(t.id, None)
             self.version += 1
 
     def get_table(self, keyspace: str, name: str) -> TableMetadata:
