@@ -39,6 +39,7 @@ class Verb:
     REPAIR_VALIDATION_REQ = "REPAIR_VALIDATION_REQ"
     REPAIR_VALIDATION_RSP = "REPAIR_VALIDATION_RSP"
     REPAIR_SYNC_REQ = "REPAIR_SYNC_REQ"
+    BOOTSTRAP_PULL_REQ = "BOOTSTRAP_PULL_REQ"
     FAILURE_RSP = "FAILURE_RSP"
     TRUNCATE_REQ = "TRUNCATE_REQ"
     TRUNCATE_RSP = "TRUNCATE_RSP"

@@ -37,6 +37,8 @@ class Node:
         self.gossiper.on_alive = self._on_peer_alive
         self.proxy = StorageProxy(self)
         self._register_verbs()
+        from .repair import RepairService
+        self.repair = RepairService(self)
         self.default_cl = ConsistencyLevel.ONE
         # periodic hint dispatch (HintsDispatchExecutor role): hints must
         # flow even when the target was never convicted dead

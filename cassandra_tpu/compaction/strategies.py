@@ -199,10 +199,64 @@ class TimeWindowCompactionStrategy(AbstractCompactionStrategy):
         return None
 
 
+class UnifiedCompactionStrategy(AbstractCompactionStrategy):
+    """Unified strategy (reference UnifiedCompactionStrategy.java:66 and
+    UnifiedCompactionStrategy.md, simplified): sstables bucket into
+    density levels with fanout F = 2 + |w|; a positive scaling parameter w
+    behaves tiered (merge when F sstables share a level), negative behaves
+    leveled (merge eagerly at 2), and outputs are sharded into
+    `base_shard_count` token ranges — the knob that parallelises one
+    logical compaction across cores/chips (ShardManager.java:33; the mesh
+    path in parallel/mesh.py consumes exactly these shards)."""
+
+    def __init__(self, cfs, options=None):
+        super().__init__(cfs, options)
+        # e.g. scaling_parameters: "T4" (w=2), "L4" (w=-2), "N" (w=0)
+        spec = str(self.options.get("scaling_parameters", "T4"))
+        self.w = self._parse_w(spec)
+        self.fanout = 2 + abs(self.w)
+        self.base_shard_count = int(self.options.get("base_shard_count", 4))
+        self.min_sstable_size = int(self.options.get(
+            "min_sstable_size", 2 * 1024 * 1024))
+
+    @staticmethod
+    def _parse_w(spec: str) -> int:
+        spec = spec.strip().upper()
+        if spec.startswith("T"):
+            return max(int(spec[1:] or 4) - 2, 0)
+        if spec.startswith("L"):
+            return -max(int(spec[1:] or 4) - 2, 0)
+        return 0
+
+    def _level_of(self, sst: SSTableReader) -> int:
+        import math
+        density = max(sst.data_size / self.min_sstable_size, 1.0)
+        return int(math.log(density, self.fanout)) if density > 1 else 0
+
+    def next_background_task(self):
+        from .task import CompactionTask
+        levels: dict[int, list[SSTableReader]] = {}
+        for s in self.cfs.live_sstables():
+            levels.setdefault(self._level_of(s), []).append(s)
+        threshold = self.fanout if self.w >= 0 else 2
+        for lvl in sorted(levels):
+            group = levels[lvl]
+            if len(group) >= threshold:
+                inputs = group[: self.max_threshold]
+                total = sum(s.data_size for s in inputs)
+                shard_bytes = max(total // self.base_shard_count,
+                                  self.min_sstable_size)
+                return CompactionTask(self.cfs, inputs,
+                                      max_output_bytes=shard_bytes,
+                                      level=lvl + 1)
+        return None
+
+
 STRATEGIES = {
     "SizeTieredCompactionStrategy": SizeTieredCompactionStrategy,
     "LeveledCompactionStrategy": LeveledCompactionStrategy,
     "TimeWindowCompactionStrategy": TimeWindowCompactionStrategy,
+    "UnifiedCompactionStrategy": UnifiedCompactionStrategy,
 }
 
 

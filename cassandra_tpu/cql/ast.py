@@ -55,6 +55,7 @@ class SelectStatement:
     selectors: list      # list of (expr, alias|None); expr: '*'|name|FunctionCall
     where: list[Relation] = field(default_factory=list)
     order_by: list[tuple[str, bool]] = field(default_factory=list)  # (col, desc)
+    ann: tuple | None = None          # (column, query-vector term)
     limit: Term | None = None
     per_partition_limit: Term | None = None
     allow_filtering: bool = False

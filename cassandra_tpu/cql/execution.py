@@ -490,12 +490,13 @@ class Executor:
         target_ck = b"" if col.kind == schema_mod.ColumnKind.STATIC else ck
         typ = col.cql_type
         if typ.is_counter:
+            if op.op not in ("add", "sub"):
+                raise InvalidRequest("counters only support +/- updates")
             delta = bind_term(op.value, typ, params)
             if op.op == "sub":
                 delta = -delta
             m.add(target_ck, col.column_id, b"",
-                  typ.serialize(delta), ts, 0x7FFFFFFF, 0,
-                  cb.FLAG_COUNTER if hasattr(cb, "FLAG_COUNTER") else 0)
+                  typ.serialize(delta), ts, 0x7FFFFFFF, 0, cb.FLAG_COUNTER)
             return
         if op.op == "set":
             v = bind_term(op.value, typ, params)
@@ -648,15 +649,28 @@ class Executor:
         t = self._table(s, keyspace)
         cfs = self.backend.store(t.keyspace, t.name)
         pk_vals, ck_rel, filters = self._split_where(t, s.where, params)
-        if (filters or (ck_rel and not pk_vals)) and not s.allow_filtering:
-            indexed = self._indexed_lookup(t, filters)
-            if indexed is None and filters:
+
+        if s.ann is not None:
+            return self._ann_select(t, cfs, s, params)
+
+        index_rows = None
+        if filters and not s.allow_filtering:
+            index_rows = self._indexed_lookup(t, cfs, filters, params)
+            if index_rows is None:
                 raise InvalidRequest(
-                    "filtering on non-key columns requires ALLOW FILTERING")
+                    "filtering on non-key columns requires ALLOW FILTERING"
+                    " (or an index on the column)")
 
         rows: list[dict] = []
         statics_by_pk: dict[bytes, dict] = {}
-        if pk_vals:
+        if index_rows is not None:
+            rows = index_rows
+            # an accompanying pk restriction still applies
+            for cname, vals in pk_vals.items():
+                rows = [r for r in rows if r.get(cname) in vals]
+            statics_by_pk = {}
+            batches = []
+        elif pk_vals:
             batches = [(pk, cfs.read_partition(pk))
                        for pk in self._pk_bytes_list(t, pk_vals)]
         else:
@@ -671,7 +685,7 @@ class Executor:
                 rows.append(d)
         # join static values onto their partition's rows
         for d in rows:
-            st = statics_by_pk.get(d.pop("__pk"), None)
+            st = statics_by_pk.get(d.pop("__pk", None), None)
             if st:
                 for c in t.static_columns:
                     if d.get(c.name) is None:
@@ -702,9 +716,62 @@ class Executor:
 
         return self._project(t, s, rows)
 
-    def _indexed_lookup(self, t, filters):
+    def _indexed_lookup(self, t, cfs, filters, params):
+        """Serve a single-equality filter from a secondary index: locators
+        from the index, base rows re-read and re-checked (stale-entry
+        filtering — index/internal 2i semantics)."""
         registry = getattr(self.backend, "indexes", None)
-        return None if registry is None else None  # placeholder round 1
+        if registry is None or len(filters) != 1:
+            return None
+        col, op, v = filters[0]
+        if op != "=":
+            return None
+        idx = registry.get(t.keyspace, t.name, col.name)
+        if idx is None or not hasattr(idx, "lookup"):
+            return None
+        out = []
+        value_b = col.cql_type.serialize(v)
+        for pk, ck in idx.lookup(value_b):
+            batch = cfs.read_partition(pk)
+            static_row = None
+            hit = None
+            for r in rows_from_batch(t, batch):
+                if r.is_static:
+                    static_row = row_to_dict(t, r)
+                elif r.ck_frame == ck:
+                    hit = row_to_dict(t, r)
+            if hit is not None and hit.get(col.name) == v:  # drop stale
+                if static_row:
+                    for c in t.static_columns:
+                        if hit.get(c.name) is None:
+                            hit[c.name] = static_row.get(c.name)
+                out.append(hit)
+        return out
+
+    def _ann_select(self, t, cfs, s, params):
+        """ORDER BY col ANN OF <vector> LIMIT k (SAI vector search)."""
+        registry = getattr(self.backend, "indexes", None)
+        col_name, term = s.ann
+        col = t.columns.get(col_name)
+        if col is None:
+            raise InvalidRequest(f"unknown column {col_name}")
+        idx = registry.get(t.keyspace, t.name, col_name) \
+            if registry is not None else None
+        if idx is None or not hasattr(idx, "ann"):
+            raise InvalidRequest(
+                f"ANN requires a vector index on {col_name}")
+        import numpy as np
+        q = np.asarray(bind_term(term, col.cql_type, params),
+                       dtype=np.float32)
+        k = int(bind_term(s.limit, None, params)) if s.limit is not None \
+            else 10
+        rows = []
+        for pk, ck, score in idx.ann(q, k):
+            batch = cfs.read_partition(pk)
+            for r in rows_from_batch(t, batch):
+                if r.ck_frame == ck and not r.is_static:
+                    rows.append(row_to_dict(t, r))
+        return self._project(t, s, rows)
 
     def _apply_ck_restrictions(self, t, rows, ck_rel):
         for cname, rels in ck_rel.items():

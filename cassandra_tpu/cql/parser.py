@@ -205,18 +205,25 @@ class Parser:
         if self.accept_kw("where"):
             where = self._relations()
         order = []
+        ann = None
         if self.accept_kw("order"):
             self.expect_kw("by")
-            while True:
-                col = self.ident()
-                desc = False
-                if self.accept_kw("desc"):
-                    desc = True
-                else:
-                    self.accept_kw("asc")
-                order.append((col, desc))
-                if not self.accept_op(","):
-                    break
+            col = self.ident()
+            if self.accept_ident("ann"):
+                # SAI vector search: ORDER BY v ANN OF [..] (CEP-30 syntax)
+                self.expect_kw("of")
+                ann = (col, self.term())
+            else:
+                while True:
+                    desc = False
+                    if self.accept_kw("desc"):
+                        desc = True
+                    else:
+                        self.accept_kw("asc")
+                    order.append((col, desc))
+                    if not self.accept_op(","):
+                        break
+                    col = self.ident()
         per_partition = None
         limit = None
         if self.accept_kw("per"):
@@ -229,7 +236,7 @@ class Parser:
         if self.accept_kw("allow"):
             self.expect_kw("filtering")
             allow = True
-        return ast.SelectStatement(ks, table, selectors, where, order,
+        return ast.SelectStatement(ks, table, selectors, where, order, ann,
                                    limit, per_partition, allow, distinct,
                                    json)
 

@@ -32,9 +32,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..schema import COL_PARTITION_DEL, COL_ROW_DEL
-from ..storage.cellbatch import (DEATH_FLAGS, FLAG_COMPLEX_DEL,
+from ..storage.cellbatch import (DEATH_FLAGS, FLAG_COMPLEX_DEL, FLAG_COUNTER,
                                  FLAG_EXPIRING, FLAG_PARTITION_DEL,
-                                 FLAG_ROW_DEL, FLAG_TOMBSTONE, CellBatch)
+                                 FLAG_ROW_DEL, FLAG_TOMBSTONE, CellBatch,
+                                 apply_counter_sums, sum_counter_runs)
 
 _U32_MAX = jnp.uint32(0xFFFFFFFF)
 
@@ -282,6 +283,12 @@ def merge_sorted_device(batches: list[CellBatch], gc_before: int = 0,
     kept_sorted_pos = np.flatnonzero(keep)
     out = cat.apply_permutation(perm_real[kept_sorted_pos])
     out.sorted = True
+    if ((cat.flags & FLAG_COUNTER) != 0).any():
+        # counter columns reconcile by summation (host pass, as in the
+        # numpy path; counter tables are the uncommon case)
+        s = cat.apply_permutation(perm_real)
+        sums = sum_counter_runs(s, keep, shadowed[:n])
+        out = apply_counter_sums(out, kept_sorted_pos, sums)
     converted = expired[kept_sorted_pos]
     if converted.any():
         out.flags[converted] |= FLAG_TOMBSTONE

@@ -58,8 +58,10 @@ FLAG_ROW_LIVENESS = 16
 FLAG_COMPLEX_DEL = 32    # whole-collection deletion (column-scoped,
                          # path-less; shadows older path cells — reference
                          # ComplexColumnData complex deletion semantics)
-FLAG_RANGE_START = 64    # reserved: range tombstone bound
-FLAG_RANGE_END = 128
+FLAG_RANGE_BOUND = 64    # reserved: range tombstone bound
+FLAG_COUNTER = 128       # counter delta cell: reconcile SUMS live versions
+                         # instead of newest-wins (db/context/CounterContext
+                         # commutative merge, simplified to delta shards)
 
 DEATH_FLAGS = (FLAG_TOMBSTONE | FLAG_PARTITION_DEL | FLAG_ROW_DEL
                | FLAG_COMPLEX_DEL)
@@ -107,6 +109,8 @@ class CellBatch:
     pk_map: dict[bytes, bytes] = field(default_factory=dict)
     # maps the 16-byte (token,pkh) lane prefix -> full partition key bytes
     sorted: bool = False
+
+    last_shadowed = None  # set by reconcile(); consumed by counter summing
 
     def __len__(self) -> int:
         return len(self.ts)
@@ -396,6 +400,8 @@ class CellBatch:
             purgeable = self.ts < purgeable_ts
         purged = death & (self.ldt < gc_before) & purgeable
 
+        # stash for counter summation (merge_sorted consumes it)
+        self.last_shadowed = shadowed
         return winner & ~shadowed & ~purged
 
 
@@ -531,6 +537,69 @@ class CellBatchBuilder:
             dict(self.pk_map))
 
 
+def sum_counter_runs(sorted_batch: "CellBatch", keep: np.ndarray,
+                     shadowed: np.ndarray | None = None) -> dict:
+    """Counter reconciliation (db/context/CounterContext.java:78 semantics,
+    simplified to commutative deltas): for each cell run whose winner is a
+    live counter cell, the result value is the SUM of the DISTINCT live,
+    unshadowed versions. Distinctness is by timestamp: replicas of the
+    same delta share the coordinator's timestamp and must count once
+    (the reference's shard (clock, count) pairs serve the same purpose);
+    deltas older than an enclosing deletion are excluded (a deleted
+    counter restarts from zero). Returns {sorted_position: int64 sum}."""
+    flags = sorted_batch.flags
+    counters = (flags & FLAG_COUNTER) != 0
+    if not counters.any():
+        return {}
+    _, _, cell_new = sorted_batch.boundaries()
+    out: dict[int, int] = {}
+    n = len(sorted_batch)
+    idxs = np.flatnonzero(cell_new)
+    ends = np.append(idxs[1:], n)
+    ts = sorted_batch.ts
+    for start, end in zip(idxs, ends):
+        if not (counters[start] and keep[start]):
+            continue
+        total = 0
+        prev_ts = None
+        for j in range(start, end):
+            if flags[j] & DEATH_FLAGS:
+                break   # ts-descending run: everything older is deleted
+            if not counters[j]:
+                continue
+            if shadowed is not None and shadowed[j]:
+                continue
+            if prev_ts is not None and ts[j] == prev_ts:
+                continue  # replica duplicate of the same delta
+            prev_ts = ts[j]
+            v = sorted_batch.cell_value(j)
+            if len(v) == 8:
+                total += int.from_bytes(v, "big", signed=True)
+        out[int(start)] = total
+    return out
+
+
+def apply_counter_sums(out_batch: "CellBatch", kept_sorted_pos: np.ndarray,
+                       sums: dict) -> "CellBatch":
+    """Rewrite summed counter values into the compacted output batch."""
+    if not sums:
+        return out_batch
+    pos_to_out = {int(p): i for i, p in enumerate(kept_sorted_pos)}
+    payload = out_batch.payload.copy()
+    for p, total in sums.items():
+        i = pos_to_out.get(p)
+        if i is None:
+            continue
+        vs = int(out_batch.val_start[i])
+        ve = int(out_batch.off[i + 1])
+        if ve - vs == 8:
+            payload[vs:ve] = np.frombuffer(
+                (total & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "big"),
+                dtype=np.uint8)
+    out_batch.payload = payload
+    return out_batch
+
+
 def merge_sorted(batches: list[CellBatch], gc_before: int = 0, now: int = 0,
                  purgeable_ts_fn=None) -> CellBatch:
     """Host (numpy) reference merge: concat -> sort -> reconcile -> compact.
@@ -545,8 +614,11 @@ def merge_sorted(batches: list[CellBatch], gc_before: int = 0, now: int = 0,
     else:
         purgeable_ts = None
     keep = s.reconcile(gc_before=gc_before, now=now, purgeable_ts=purgeable_ts)
-    out = s.apply_permutation(np.flatnonzero(keep))
+    sums = sum_counter_runs(s, keep, s.last_shadowed)
+    kept = np.flatnonzero(keep)
+    out = s.apply_permutation(kept)
     out.sorted = True
+    out = apply_counter_sums(out, kept, sums)
     # expired-TTL cells were converted to tombstones: drop their values
     converted = ((out.flags & FLAG_EXPIRING) != 0) & \
         ((out.flags & FLAG_TOMBSTONE) != 0)

@@ -34,6 +34,8 @@ class StorageEngine:
         self._register_existing()
         if self.commitlog:
             self._replay()
+        from ..index import IndexManager
+        self.indexes = IndexManager(self)
 
     def _register_existing(self) -> None:
         for ks in self.schema.keyspaces.values():
@@ -79,6 +81,9 @@ class StorageEngine:
         if cfs is None:
             raise KeyError(f"unknown table id {mutation.table_id}")
         cfs.apply(mutation, self.commitlog, durable)
+        t = self.schema.table_by_id(mutation.table_id)
+        if t is not None and getattr(self, "indexes", None) is not None:
+            self.indexes.on_mutation(t, mutation)
         if cfs.should_flush():
             cfs.flush()
 

@@ -266,3 +266,72 @@ def test_survives_flush_and_restart(tmp_path):
         s.execute(f"INSERT INTO kv (k, v) VALUES ({i}, 'v{i}')")
     assert len(s.execute("SELECT * FROM kv").rows) == 30
     eng.close()
+
+
+def test_counters(session):
+    session.execute("CREATE TABLE cnt (k int PRIMARY KEY, hits counter)")
+    for _ in range(5):
+        session.execute("UPDATE cnt SET hits = hits + 3 WHERE k = 1")
+    session.execute("UPDATE cnt SET hits = hits - 5 WHERE k = 1")
+    assert session.execute("SELECT hits FROM cnt WHERE k = 1").rows == [(10,)]
+
+
+def test_secondary_index(session):
+    session.execute("CREATE TABLE users2 (id int PRIMARY KEY, email text, "
+                    "age int)")
+    session.execute("CREATE INDEX ON users2 (email)")
+    for i in range(20):
+        session.execute(
+            f"INSERT INTO users2 (id, email, age) VALUES ({i}, 'u{i % 5}@x', {i})")
+    rs = session.execute("SELECT id FROM users2 WHERE email = 'u2@x'")
+    assert sorted(r[0] for r in rs.rows) == [2, 7, 12, 17]
+    # stale entries filtered after overwrite
+    session.execute("UPDATE users2 SET email = 'moved@x' WHERE id = 2")
+    rs = session.execute("SELECT id FROM users2 WHERE email = 'u2@x'")
+    assert sorted(r[0] for r in rs.rows) == [7, 12, 17]
+    rs = session.execute("SELECT id FROM users2 WHERE email = 'moved@x'")
+    assert [r[0] for r in rs.rows] == [2]
+
+
+def test_vector_ann(session):
+    session.execute("CREATE TABLE docs (id int PRIMARY KEY, "
+                    "embedding vector<float, 4>)")
+    session.execute("CREATE CUSTOM INDEX ON docs (embedding) "
+                    "USING 'StorageAttachedIndex'")
+    import math
+    for i in range(50):
+        a = i / 50.0 * math.pi
+        session.execute("INSERT INTO docs (id, embedding) VALUES (?, ?)",
+                        (i, [math.cos(a), math.sin(a), 0.0, 0.0]))
+    # query near angle of i=10
+    a = 10 / 50.0 * math.pi
+    rs = session.execute(
+        "SELECT id FROM docs ORDER BY embedding ANN OF ? LIMIT 3",
+        ([math.cos(a), math.sin(a), 0.0, 0.0],))
+    ids = [r[0] for r in rs.rows]
+    assert ids[0] == 10 and set(ids) <= {8, 9, 10, 11, 12}
+
+
+def test_ucs_strategy(tmp_path):
+    from cassandra_tpu.compaction import CompactionManager, get_strategy
+    from cassandra_tpu.schema import Schema
+    eng = StorageEngine(str(tmp_path / "du"), Schema(), commitlog_sync="batch")
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE u WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE u")
+    s.execute("CREATE TABLE t (k int PRIMARY KEY, v text) WITH compaction = "
+              "{'class': 'UnifiedCompactionStrategy', "
+              "'scaling_parameters': 'T4', 'base_shard_count': 2}")
+    cfs = eng.store("u", "t")
+    for gen in range(4):
+        for i in range(50):
+            s.execute(f"INSERT INTO t (k, v) VALUES ({i}, 'g{gen}')")
+        cfs.flush()
+    strat = get_strategy(cfs)
+    task = strat.next_background_task()
+    assert task is not None and len(task.inputs) == 4
+    task.execute()
+    assert len(s.execute("SELECT * FROM t").rows) == 50
+    assert all(r[0] == "g3" for r in s.execute("SELECT v FROM t").rows)
+    eng.close()

@@ -116,3 +116,33 @@ def test_empty_and_single():
     ref = cb.merge_sorted([b.seal()])
     dev = dmerge.merge_sorted_device([b.seal()])
     assert_equal_batches(ref, dev)
+
+
+def test_counter_sum_both_paths():
+    Tc = make_table("ks", "cnt", pk=["id"], cols={"id": "int",
+                                                  "hits": "counter"})
+    cid = Tc.columns["hits"].column_id
+    idt = Tc.columns["id"].cql_type
+    batches = []
+    for gen, deltas in enumerate([(3, 4), (5,), (-2,)]):
+        b = cb.CellBatchBuilder(Tc)
+        for j, d in enumerate(deltas):
+            b.append_raw(idt.serialize(1), b"", cid, b"",
+                         (d & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "big"),
+                         ts=100 * gen + j, flags=cb.FLAG_COUNTER)
+        batches.append(b.seal())
+    ref = cb.merge_sorted(batches)
+    dev = dmerge.merge_sorted_device(batches)
+    assert len(ref) == 1 and len(dev) == 1
+    for m in (ref, dev):
+        v = int.from_bytes(m.cell_value(0), "big", signed=True)
+        assert v == 10, v
+    # replica duplicates (same deltas, same timestamps) must count once
+    dup = cb.merge_sorted([batches[0], batches[0]])
+    assert int.from_bytes(dup.cell_value(0), "big", signed=True) == 7
+    # merging the compacted result with NEW deltas must add up
+    b = cb.CellBatchBuilder(Tc)
+    b.append_raw(idt.serialize(1), b"", cid, b"",
+                 (7).to_bytes(8, "big"), ts=1000, flags=cb.FLAG_COUNTER)
+    m3 = cb.merge_sorted([ref, b.seal()])
+    assert int.from_bytes(m3.cell_value(0), "big", signed=True) == 17
