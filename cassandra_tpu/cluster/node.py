@@ -39,6 +39,8 @@ class Node:
         self._register_verbs()
         from .repair import RepairService
         self.repair = RepairService(self)
+        from ..storage.virtual import build_node_virtuals
+        self.virtual_tables = build_node_virtuals(self)
         self.default_cl = ConsistencyLevel.ONE
         # periodic hint dispatch (HintsDispatchExecutor role): hints must
         # flow even when the target was never convicted dead

@@ -646,6 +646,25 @@ class Executor:
         return ResultSet(cols, [(False, *existing.values())])
 
     def _exec_SelectStatement(self, s, params, keyspace, now):
+        # virtual tables (db/virtual role) intercept before real schema
+        vts = getattr(self.backend, "virtual_tables", None)
+        vks = s.keyspace or keyspace
+        if vts is not None and vks in ("system", "system_views"):
+            vt = vts.get(vks, s.table)
+            if vt is not None:
+                rows = vt.rows()
+                for rel in s.where:
+                    col = vt.table.columns.get(rel.column)
+                    typ = col.cql_type if col else None
+                    v = bind_term(rel.value, typ, params) \
+                        if rel.op != "IN" else \
+                        [bind_term(x, typ, params) for x in rel.value]
+                    rows = [r for r in rows
+                            if self._match(r.get(rel.column), rel.op, v)]
+                if s.limit is not None:
+                    rows = rows[: int(bind_term(s.limit, None, params))]
+                return self._project(vt.table, s, rows)
+
         t = self._table(s, keyspace)
         cfs = self.backend.store(t.keyspace, t.name)
         pk_vals, ck_rel, filters = self._split_where(t, s.where, params)

@@ -58,8 +58,18 @@ class Session:
         self.processor = QueryProcessor(backend)
         self.keyspace = keyspace
 
-    def execute(self, query: str, params=()) -> ResultSet:
-        rs = self.processor.process(query, params, self.keyspace)
+    def execute(self, query: str, params=(), trace: bool = False) -> ResultSet:
+        if trace:
+            from ..service import tracing
+            st = tracing.begin()
+            tracing.trace(f"Parsing {query[:60]}")
+            try:
+                rs = self.processor.process(query, params, self.keyspace)
+            finally:
+                tracing.end()
+            rs.trace = st
+        else:
+            rs = self.processor.process(query, params, self.keyspace)
         if hasattr(rs, "keyspace"):
             self.keyspace = rs.keyspace
         return rs

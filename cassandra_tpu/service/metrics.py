@@ -1,0 +1,99 @@
+"""Metrics registry: counters + latency histograms.
+
+Reference counterpart: metrics/CassandraMetricsRegistry.java (Dropwizard)
+with TableMetrics / ClientRequestMetrics / CompactionMetrics groups and
+DecayingEstimatedHistogramReservoir latency tracking. Here: plain counters
+and a fixed-bucket log-scale histogram (the reference's estimated histogram
+is also log-bucketed).
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+
+class LatencyHistogram:
+    """Log-scale bucket histogram of microsecond latencies."""
+
+    N_BUCKETS = 64
+
+    def __init__(self):
+        self.buckets = [0] * self.N_BUCKETS
+        self.count = 0
+        self.total_us = 0
+        self._lock = threading.Lock()
+
+    def update_us(self, us: float) -> None:
+        b = min(int(math.log2(max(us, 1))), self.N_BUCKETS - 1)
+        with self._lock:
+            self.buckets[b] += 1
+            self.count += 1
+            self.total_us += us
+
+    def percentile(self, p: float) -> float:
+        with self._lock:
+            if not self.count:
+                return 0.0
+            target = self.count * p
+            acc = 0
+            for b, c in enumerate(self.buckets):
+                acc += c
+                if acc >= target:
+                    return float(2 ** b)
+            return float(2 ** (self.N_BUCKETS - 1))
+
+    @property
+    def mean_us(self) -> float:
+        with self._lock:
+            return self.total_us / self.count if self.count else 0.0
+
+
+class Timer:
+    def __init__(self, hist: LatencyHistogram):
+        self.hist = hist
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.update_us((time.perf_counter() - self._t0) * 1e6)
+
+
+class MetricsRegistry:
+    """Grouped counters + histograms: metrics.group('table.ks.t').incr(..)"""
+
+    def __init__(self):
+        self._counters: dict[str, int] = {}
+        self._hists: dict[str, LatencyHistogram] = {}
+        self._lock = threading.Lock()
+
+    def incr(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def counter(self, name: str) -> int:
+        return self._counters.get(name, 0)
+
+    def hist(self, name: str) -> LatencyHistogram:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = LatencyHistogram()
+            return h
+
+    def timer(self, name: str) -> Timer:
+        return Timer(self.hist(name))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self._counters)
+            for name, h in self._hists.items():
+                out[f"{name}.count"] = h.count
+                out[f"{name}.mean_us"] = round(h.mean_us, 1)
+                out[f"{name}.p99_us"] = h.percentile(0.99)
+            return out
+
+
+GLOBAL = MetricsRegistry()

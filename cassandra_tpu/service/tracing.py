@@ -1,0 +1,49 @@
+"""Request tracing: per-query event timelines.
+
+Reference counterpart: tracing/Tracing.java:52 — a session id propagated
+through stages; events land in system_traces and cqlsh's TRACING ON
+renders them. Here a contextvar carries the active trace; subsystems call
+trace("..."); Session.execute(..., trace=True) returns the events on the
+result set.
+"""
+from __future__ import annotations
+
+import contextvars
+import time
+import uuid as uuid_mod
+from dataclasses import dataclass, field
+
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "trace_state", default=None)
+
+
+@dataclass
+class TraceState:
+    session_id: uuid_mod.UUID = field(default_factory=uuid_mod.uuid4)
+    started: float = field(default_factory=time.perf_counter)
+    events: list = field(default_factory=list)
+
+    def add(self, activity: str, source: str = "local") -> None:
+        self.events.append(
+            (round((time.perf_counter() - self.started) * 1e6), source,
+             activity))
+
+
+def begin() -> TraceState:
+    st = TraceState()
+    _current.set(st)
+    return st
+
+
+def end() -> None:
+    _current.set(None)
+
+
+def trace(activity: str, source: str = "local") -> None:
+    st = _current.get()
+    if st is not None:
+        st.add(activity, source)
+
+
+def active() -> TraceState | None:
+    return _current.get()

