@@ -264,6 +264,7 @@ class Executor:
         ftypes = [parse_type(t, ksm.user_types) for _, t in s.fields]
         ksm.user_types[s.name] = UserType(ks, s.name,
                                           [n for n, _ in s.fields], ftypes)
+        self.schema._changed()
         return ResultSet([], [])
 
     def _exec_CreateIndexStatement(self, s, params, keyspace, now):
@@ -273,6 +274,7 @@ class Executor:
         registry = getattr(self.backend, "indexes", None)
         if registry is not None:
             registry.create(t, s.column, s.name, s.custom_class)
+            self.schema._changed()   # index defs persist with the schema
         return ResultSet([], [])
 
     def _exec_DropStatement(self, s, params, keyspace, now):
@@ -289,10 +291,12 @@ class Executor:
                 self.backend.drop_table(ks, s.name)
             elif s.what == "type":
                 del self.schema.keyspaces[ks].user_types[s.name]
+                self.schema._changed()
             elif s.what == "index":
                 registry = getattr(self.backend, "indexes", None)
                 if registry is not None:
                     registry.drop(ks, s.name)
+                    self.schema._changed()
         except KeyError:
             if not s.if_exists:
                 raise InvalidRequest(f"unknown {s.what} {s.name}")
@@ -330,7 +334,7 @@ class Executor:
                 t.params.gc_grace_seconds = p.gc_grace_seconds
             if "default_time_to_live" in s.options:
                 t.params.default_ttl = p.default_ttl
-        self.schema.version += 1
+        self.schema._changed()
         return ResultSet([], [])
 
     def _exec_TruncateStatement(self, s, params, keyspace, now):

@@ -47,7 +47,12 @@ class QueryProcessor:
 
     def process(self, query: str, params=(),
                 keyspace: str | None = None) -> ResultSet:
-        return self.executor.execute(parse(query), params, keyspace)
+        from ..service.metrics import GLOBAL
+        stmt = parse(query)
+        kind = type(stmt).__name__.removesuffix("Statement").lower()
+        GLOBAL.incr(f"cql.{kind}")
+        with GLOBAL.timer("cql.request"):
+            return self.executor.execute(stmt, params, keyspace)
 
 
 class Session:

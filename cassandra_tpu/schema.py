@@ -217,9 +217,21 @@ class Schema:
         self._by_id: dict = {}
         self._lock = threading.RLock()
         self.version = 0
+        self.listeners: list = []  # persistence hooks (one per engine)
 
     def table_by_id(self, table_id) -> "TableMetadata | None":
         return self._by_id.get(table_id)
+
+    def _changed(self):
+        self.version += 1
+        for fn in self.listeners:
+            try:
+                fn(self)
+            except Exception as e:
+                # a failed persistence write must not be silent: DDL
+                # durability is at stake
+                import sys
+                print(f"schema listener failed: {e!r}", file=sys.stderr)
 
     def create_keyspace(self, name: str, params: KeyspaceParams | None = None,
                         if_not_exists: bool = False) -> KeyspaceMetadata:
@@ -230,7 +242,7 @@ class Schema:
                 raise ValueError(f"keyspace {name} already exists")
             ks = KeyspaceMetadata(name, params)
             self.keyspaces[name] = ks
-            self.version += 1
+            self._changed()
             return ks
 
     def drop_keyspace(self, name: str):
@@ -238,25 +250,112 @@ class Schema:
             ks = self.keyspaces.pop(name)
             for t in ks.tables.values():
                 self._by_id.pop(t.id, None)
-            self.version += 1
+            self._changed()
 
     def add_table(self, t: TableMetadata):
         with self._lock:
             self.keyspaces[t.keyspace].add_table(t)
             self._by_id[t.id] = t
-            self.version += 1
+            self._changed()
 
     def drop_table(self, keyspace: str, name: str):
         with self._lock:
             t = self.keyspaces[keyspace].tables.pop(name)
             self._by_id.pop(t.id, None)
-            self.version += 1
+            self._changed()
 
     def get_table(self, keyspace: str, name: str) -> TableMetadata:
         ks = self.keyspaces.get(keyspace)
         if ks is None or name not in ks.tables:
             raise KeyError(f"unknown table {keyspace}.{name}")
         return ks.tables[name]
+
+
+# ------------------------------------------------------------- persistence --
+
+def table_to_dict(t: TableMetadata) -> dict:
+    return {
+        "keyspace": t.keyspace, "name": t.name, "id": str(t.id),
+        "partition_key": [(c.name, repr(c.cql_type))
+                          for c in t.partition_key_columns],
+        "clustering": [(c.name, repr(c.cql_type), c.reversed)
+                       for c in t.clustering_columns],
+        "regular": [(c.name, repr(c.cql_type)) for c in t.regular_columns],
+        "static": [(c.name, repr(c.cql_type)) for c in t.static_columns],
+        # explicit ids: ALTERed tables must not re-derive ids from sorted
+        # name order on reload (cells on disk reference these ids)
+        "column_ids": {c.name: c.column_id
+                       for c in t.static_columns + t.regular_columns},
+        "params": {
+            "compression": t.params.compression.to_dict(),
+            "compaction": t.params.compaction,
+            "gc_grace_seconds": t.params.gc_grace_seconds,
+            "default_ttl": t.params.default_ttl,
+            "comment": t.params.comment,
+            "clustering_prefix_bytes": t.params.clustering_prefix_bytes,
+        },
+    }
+
+
+def table_from_dict(d: dict, udts: dict | None = None) -> TableMetadata:
+    p = d["params"]
+    params = TableParams(
+        compression=CompressionParams.from_dict(p["compression"]),
+        compaction=dict(p["compaction"]),
+        gc_grace_seconds=int(p["gc_grace_seconds"]),
+        default_ttl=int(p["default_ttl"]),
+        comment=p.get("comment", ""),
+        clustering_prefix_bytes=int(p.get("clustering_prefix_bytes", 16)))
+    t = TableMetadata(
+        d["keyspace"], d["name"],
+        [(n, parse_type(ts, udts)) for n, ts in d["partition_key"]],
+        [(n, parse_type(ts, udts), bool(rev))
+         for n, ts, rev in d["clustering"]],
+        [(n, parse_type(ts, udts)) for n, ts in d["regular"]],
+        [(n, parse_type(ts, udts)) for n, ts in d["static"]],
+        params, uuid_mod.UUID(d["id"]))
+    ids = d.get("column_ids")
+    if ids:
+        for c in t.static_columns + t.regular_columns:
+            if c.name in ids:
+                c.column_id = int(ids[c.name])
+        t.columns_by_id = {c.column_id: c
+                           for c in t.static_columns + t.regular_columns}
+    return t
+
+
+def schema_to_dict(schema: Schema) -> dict:
+    out = {"keyspaces": {}}
+    for name, ks in schema.keyspaces.items():
+        out["keyspaces"][name] = {
+            "replication": ks.params.replication,
+            "durable_writes": ks.params.durable_writes,
+            "user_types": {tn: [(f, repr(ft)) for f, ft in
+                                zip(t.field_names, t.elems)]
+                           for tn, t in ks.user_types.items()},
+            "tables": {tn: table_to_dict(t) for tn, t in ks.tables.items()},
+        }
+    return out
+
+
+def load_schema_dict(schema: Schema, data: dict) -> None:
+    """Merge a persisted schema dump into `schema` (existing entries win —
+    a process-supplied schema takes priority over the disk copy)."""
+    from .types.marshal import UserType
+    for name, ksd in data.get("keyspaces", {}).items():
+        if name not in schema.keyspaces:
+            schema.create_keyspace(name, KeyspaceParams(
+                replication=ksd["replication"],
+                durable_writes=ksd.get("durable_writes", True)))
+        ks = schema.keyspaces[name]
+        for tn, fields in ksd.get("user_types", {}).items():
+            if tn not in ks.user_types:
+                ks.user_types[tn] = UserType(
+                    name, tn, [f for f, _ in fields],
+                    [parse_type(ft, ks.user_types) for _, ft in fields])
+        for tn, td in ksd.get("tables", {}).items():
+            if tn not in ks.tables:
+                schema.add_table(table_from_dict(td, ks.user_types))
 
 
 def make_table(keyspace: str, name: str, *, pk: list[str], ck: list[str] = (),

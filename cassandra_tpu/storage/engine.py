@@ -31,13 +31,58 @@ class StorageEngine:
             if durable_writes else None
         self.stores: dict = {}  # table_id -> ColumnFamilyStore
         self._lock = threading.RLock()
+        self._load_schema()
+        self._schema_listener = lambda s: self._save_schema()
+        self.schema.listeners.append(self._schema_listener)
         self._register_existing()
         if self.commitlog:
             self._replay()
         from ..index import IndexManager
         self.indexes = IndexManager(self)
+        self._restore_indexes()
         from .virtual import build_engine_virtuals
         self.virtual_tables = build_engine_virtuals(self)
+
+    @property
+    def _schema_path(self) -> str:
+        return os.path.join(self.data_dir, "schema.json")
+
+    def _load_schema(self) -> None:
+        """Restore persisted DDL (role of the reference's system_schema
+        tables: schema survives restarts without the client re-issuing
+        CREATEs)."""
+        import json
+        from ..schema import load_schema_dict
+        if os.path.exists(self._schema_path):
+            with open(self._schema_path) as f:
+                load_schema_dict(self.schema, json.load(f))
+
+    def _save_schema(self) -> None:
+        import json
+        from ..schema import schema_to_dict
+        dump = schema_to_dict(self.schema)
+        idx = getattr(self, "indexes", None)
+        if idx is not None:
+            dump["indexes"] = [
+                {"keyspace": ks, "table": tb, "column": col, "name": nm}
+                for (ksn, nm), (ks, tb, col) in idx.by_name.items()]
+        tmp = self._schema_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(dump, f)
+        os.replace(tmp, self._schema_path)
+
+    def _restore_indexes(self) -> None:
+        import json
+        if not os.path.exists(self._schema_path):
+            return
+        with open(self._schema_path) as f:
+            dump = json.load(f)
+        for d in dump.get("indexes", []):
+            try:
+                t = self.schema.get_table(d["keyspace"], d["table"])
+                self.indexes.create(t, d["column"], d["name"])
+            except KeyError:
+                pass  # table dropped since
 
     def _register_existing(self) -> None:
         for ks in self.schema.keyspaces.values():
@@ -82,8 +127,12 @@ class StorageEngine:
         cfs = self.stores.get(mutation.table_id)
         if cfs is None:
             raise KeyError(f"unknown table id {mutation.table_id}")
-        from ..service.tracing import trace
-        trace(f"Appending to commitlog and memtable ({len(mutation.ops)} ops)")
+        from ..service.metrics import GLOBAL
+        from ..service.tracing import active, trace
+        GLOBAL.incr("storage.writes")
+        if active() is not None:
+            trace(f"Appending to commitlog and memtable "
+                  f"({len(mutation.ops)} ops)")
         cfs.apply(mutation, self.commitlog, durable)
         t = self.schema.table_by_id(mutation.table_id)
         if t is not None and getattr(self, "indexes", None) is not None:
@@ -118,6 +167,10 @@ class StorageEngine:
             cfs.flush()
 
     def close(self) -> None:
+        try:
+            self.schema.listeners.remove(self._schema_listener)
+        except ValueError:
+            pass
         if self.commitlog:
             self.commitlog.close()
         for cfs in self.stores.values():

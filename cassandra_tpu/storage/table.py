@@ -156,7 +156,7 @@ class ColumnFamilyStore:
         """Merged view of one partition across memtable + sstables
         (SinglePartitionReadCommand.queryMemtableAndDisk role)."""
         self.metrics["reads"] += 1
-        from ..service.tracing import trace
+        from ..service.tracing import active, trace
         now = now if now is not None else timeutil.now_seconds()
         sources = []
         with self._switch_lock:
@@ -168,8 +168,8 @@ class ColumnFamilyStore:
             part = sst.read_partition(pk)
             if part is not None:
                 sources.append(part)
-        trace(f"Merging {len(sources)} source(s) for partition read "
-              f"({len(self.tracker.view())} live sstables)")
+        if active() is not None:   # tracing off: zero-cost path
+            trace(f"Merging {len(sources)} source(s) for partition read")
         if not sources:
             from .cellbatch import lanes_for_table
             return CellBatch.empty(lanes_for_table(self.table))

@@ -64,7 +64,8 @@ def mixed(session, n: int, write_ratio: float = 0.5, seed: int = 3) -> dict:
     for i in range(n):
         if rng.random() < write_ratio:
             session.execute_prepared(
-                wq, (rng.randrange(n), *[rng.randbytes(34)] * 4))
+                wq, (rng.randrange(n),
+                     *[rng.randbytes(34) for _ in range(4)]))
         else:
             session.execute_prepared(rq, (rng.randrange(n),))
     dt = time.time() - t0

@@ -204,3 +204,55 @@ def test_scan_all(tmp_path):
     assert len(rows) == 40
     assert {r["name"] for r in rows} == {f"u{i}" for i in range(40)}
     eng.close()
+
+
+def test_schema_persisted_across_restart(tmp_path):
+    from cassandra_tpu.cql import Session
+    d = str(tmp_path / "sp")
+    eng = StorageEngine(d, Schema(), commitlog_sync="batch")
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TYPE addr (street text, zip int)")
+    s.execute("CREATE TABLE t (k int, c text, v frozen<addr>, w list<int>, "
+              "PRIMARY KEY (k, c)) WITH CLUSTERING ORDER BY (c DESC) "
+              "AND gc_grace_seconds = 1234")
+    s.execute("INSERT INTO t (k, c, w) VALUES (1, 'x', [1, 2])")
+    eng.close()
+
+    # brand-new engine with EMPTY schema: DDL must come back from disk
+    eng2 = StorageEngine(d, Schema(), commitlog_sync="batch")
+    t = eng2.schema.get_table("ks", "t")
+    assert t.params.gc_grace_seconds == 1234
+    assert t.clustering_columns[0].reversed is True
+    s2 = Session(eng2)
+    s2.keyspace = "ks"
+    rows = s2.execute("SELECT k, c, w FROM t WHERE k = 1").dicts()
+    assert rows == [{"k": 1, "c": "x", "w": [1, 2]}]
+    eng2.close()
+
+
+def test_alter_and_index_persist_across_restart(tmp_path):
+    from cassandra_tpu.cql import Session
+    d = str(tmp_path / "ap")
+    eng = StorageEngine(d, Schema(), commitlog_sync="batch")
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE t (k int PRIMARY KEY, zz text)")
+    s.execute("ALTER TABLE t ADD aa text")   # 'aa' sorts before 'zz'!
+    s.execute("CREATE INDEX ON t (zz)")
+    s.execute("INSERT INTO t (k, zz, aa) VALUES (1, 'zval', 'aval')")
+    eng.close()
+
+    eng2 = StorageEngine(d, Schema(), commitlog_sync="batch")
+    s2 = Session(eng2)
+    s2.keyspace = "ks"
+    row = s2.execute("SELECT k, zz, aa FROM t WHERE k = 1").dicts()[0]
+    assert row == {"k": 1, "zz": "zval", "aa": "aval"}   # ids stable
+    # index restored and functional
+    rs = s2.execute("SELECT k FROM t WHERE zz = 'zval'")
+    assert rs.rows == [(1,)]
+    eng2.close()
