@@ -41,6 +41,8 @@ class Node:
         self.repair = RepairService(self)
         from ..storage.virtual import build_node_virtuals
         self.virtual_tables = build_node_virtuals(self)
+        from .paxos import PaxosService
+        self.paxos = PaxosService(self)
         self.default_cl = ConsistencyLevel.ONE
         # periodic hint dispatch (HintsDispatchExecutor role): hints must
         # flow even when the target was never convicted dead
@@ -49,6 +51,17 @@ class Node:
             target=self._hint_loop, daemon=True,
             name=f"hints-{endpoint.name}")
         self._hint_thread.start()
+
+    def cas(self, keyspace, table, pk, ck, check_fn, mutation_fn):
+        """Linearizable conditional write (StorageProxy.cas role)."""
+        return self.paxos.cas(keyspace, table, pk, ck, check_fn,
+                              mutation_fn, timeout=self.proxy.timeout)
+
+    @property
+    def batchlog(self):
+        """Logged batches persist in the coordinator's batchlog before the
+        replicated applies (BatchlogManager role)."""
+        return self.engine.batchlog
 
     # ------------------------------------------------------------- verbs --
 
@@ -243,6 +256,12 @@ class LocalCluster:
         n.gossiper.on_alive = n._on_peer_alive
         n._register_verbs()
         n.proxy = StorageProxy(n)
+        # re-register sidecar verb handlers on the fresh MessagingService
+        # (paxos state resets too — crash semantics; promises are volatile)
+        from .paxos import PaxosService
+        from .repair import RepairService
+        n.paxos = PaxosService(n)
+        n.repair = RepairService(n)
         n.gossiper.start()
         n._stop_hints = threading.Event()
         n._hint_thread = threading.Thread(target=n._hint_loop, daemon=True)

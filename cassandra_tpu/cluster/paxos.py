@@ -1,0 +1,244 @@
+"""Paxos-backed lightweight transactions (compare-and-set).
+
+Reference counterpart: service/paxos/ (Paxos.java / Paxos.md — v2 rounds:
+begin(prepare) -> read -> condition -> propose(accept) -> commit;
+PaxosState per partition; in-flight proposals from a previous coordinator
+are finished by the next prepare). Entry: StorageProxy.cas:305.
+
+Single-decree per (table, partition, ballot): ballots are monotonic
+(timestamp, endpoint) pairs; a quorum of promises is required to read the
+linearization point, a quorum of accepts to decide, and commit applies the
+mutation through the normal write path on all replicas.
+
+PaxosState here is in-memory per process (the reference persists it in the
+system.paxos table; crash-restart of a replica forgets promises, which can
+only cause a retried round, not a lost committed write — commits go
+through the durable write path).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..storage.mutation import Mutation
+from .messaging import Verb
+from .replication import ConsistencyLevel, ReplicationStrategy
+
+
+class CasTimeout(Exception):
+    pass
+
+
+class CasContention(Exception):
+    pass
+
+
+@dataclass(order=True, frozen=True)
+class Ballot:
+    ts: int
+    endpoint: str
+
+    def pack(self):
+        return (self.ts, self.endpoint)
+
+    @staticmethod
+    def unpack(t):
+        return Ballot(t[0], t[1]) if t else None
+
+
+ZERO = Ballot(0, "")
+
+
+@dataclass
+class PaxosState:
+    promised: Ballot = ZERO
+    accepted_ballot: Ballot | None = None
+    accepted_value: bytes | None = None
+    committed: Ballot = ZERO
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+
+class PaxosService:
+    def __init__(self, node):
+        self.node = node
+        self._states: dict[tuple, PaxosState] = {}
+        self._lock = threading.Lock()
+        ms = node.messaging
+        ms.register_handler("PAXOS_PREPARE", self._handle_prepare)
+        ms.register_handler("PAXOS_PROPOSE", self._handle_propose)
+        ms.register_handler("PAXOS_COMMIT", self._handle_commit)
+
+    def _state(self, table_id, pk: bytes) -> PaxosState:
+        key = (table_id, pk)
+        with self._lock:
+            st = self._states.get(key)
+            if st is None:
+                st = self._states[key] = PaxosState()
+            return st
+
+    # ------------------------------------------------------------ replicas
+
+    def _handle_prepare(self, msg):
+        table_id, pk, ballot_t = msg.payload
+        ballot = Ballot.unpack(ballot_t)
+        st = self._state(table_id, pk)
+        with st.lock:
+            if ballot > st.promised:
+                st.promised = ballot
+                return "PAXOS_PROMISE", {
+                    "promised": True,
+                    "accepted_ballot": st.accepted_ballot.pack()
+                    if st.accepted_ballot else None,
+                    "accepted_value": st.accepted_value,
+                    "committed": st.committed.pack(),
+                }
+            return "PAXOS_PROMISE", {"promised": False,
+                                     "promised_ballot": st.promised.pack()}
+
+    def _handle_propose(self, msg):
+        table_id, pk, ballot_t, value = msg.payload
+        ballot = Ballot.unpack(ballot_t)
+        st = self._state(table_id, pk)
+        with st.lock:
+            if ballot >= st.promised:
+                st.promised = ballot
+                st.accepted_ballot = ballot
+                st.accepted_value = value
+                return "PAXOS_ACCEPTED", {"accepted": True}
+            return "PAXOS_ACCEPTED", {"accepted": False}
+
+    def _handle_commit(self, msg):
+        table_id, pk, ballot_t, value = msg.payload
+        ballot = Ballot.unpack(ballot_t)
+        st = self._state(table_id, pk)
+        with st.lock:
+            if ballot > st.committed:
+                st.committed = ballot
+                if st.accepted_ballot == ballot:
+                    st.accepted_ballot = None
+                    st.accepted_value = None
+        if value:
+            self.node.engine.apply(Mutation.deserialize(value))
+        return "PAXOS_COMMITTED", {}
+
+    # ---------------------------------------------------------- coordinator
+
+    def _quorum_round(self, verb, payload, replicas, timeout, need):
+        """Send a round to all live replicas (self included), wait for
+        `need` responses (majority of the FULL replica set — partitions
+        must not let both sides decide)."""
+        node = self.node
+        results = []
+        lock = threading.Lock()
+        ev = threading.Event()
+
+        def collect(res):
+            with lock:
+                results.append(res)
+                if len(results) >= need:
+                    ev.set()
+
+        handler = {"PAXOS_PREPARE": self._handle_prepare,
+                   "PAXOS_PROPOSE": self._handle_propose,
+                   "PAXOS_COMMIT": self._handle_commit}[verb]
+        for ep in replicas:
+            if ep == node.endpoint:
+                from .messaging import Message
+                m = Message(verb, payload, ep, ep)
+                collect(handler(m)[1])
+            else:
+                node.messaging.send_with_callback(
+                    verb, payload, ep,
+                    on_response=lambda m: collect(m.payload),
+                    timeout=timeout)
+        if not ev.wait(timeout):
+            raise CasTimeout(f"{verb}: {len(results)}/{need} responses")
+        with lock:
+            return list(results)
+
+    def cas(self, keyspace: str, table, pk: bytes, ck: bytes, check_fn,
+            mutation_fn, timeout: float = 5.0, attempts: int = 10):
+        """Linearizable compare-and-set: check_fn(current_row_dict|None) ->
+        bool; mutation_fn() -> Mutation applied iff the check passed.
+        Returns (applied, current_row)."""
+        node = self.node
+        ks = node.schema.keyspaces[keyspace]
+        strat = ReplicationStrategy.create(ks.params.replication)
+        token = node.ring.token_of(pk)
+        all_replicas = strat.replicas(node.ring, token) or [node.endpoint]
+        need = len(all_replicas) // 2 + 1
+        live = [r for r in all_replicas if node.is_alive(r)]
+        if len(live) < need:
+            from .coordinator import UnavailableException
+            raise UnavailableException(
+                f"SERIAL requires {need}/{len(all_replicas)} replicas, "
+                f"{len(live)} alive")
+
+        last_contention = None
+        for attempt in range(attempts):
+            ballot = self._next_ballot()
+            promises = self._quorum_round(
+                "PAXOS_PREPARE", (table.id, pk, ballot.pack()),
+                live, timeout, need)
+            if not all(p.get("promised") for p in promises):
+                last_contention = CasContention("prepare rejected")
+                time.sleep(0.01 * (attempt + 1))
+                continue
+            # finish an in-flight accepted-but-uncommitted proposal first
+            inflight = [(Ballot.unpack(p["accepted_ballot"]),
+                         p["accepted_value"]) for p in promises
+                        if p.get("accepted_ballot") is not None]
+            if inflight:
+                ib, iv = max(inflight, key=lambda x: x[0])
+                acc = self._quorum_round(
+                    "PAXOS_PROPOSE", (table.id, pk, ballot.pack(), iv),
+                    live, timeout, need)
+                if all(a.get("accepted") for a in acc):
+                    self._quorum_round(
+                        "PAXOS_COMMIT", (table.id, pk, ballot.pack(), iv),
+                        live, timeout, need)
+                # either way: retry our own round on fresh state
+                continue
+
+            # linearization-point read (QUORUM)
+            current = self._read_row(keyspace, table, pk, ck)
+            if not check_fn(current):
+                return False, current
+
+            mutation = mutation_fn()
+            value = mutation.serialize()
+            accepts = self._quorum_round(
+                "PAXOS_PROPOSE", (table.id, pk, ballot.pack(), value),
+                live, timeout, need)
+            if not all(a.get("accepted") for a in accepts):
+                last_contention = CasContention("propose rejected")
+                time.sleep(0.01 * (attempt + 1))
+                continue
+            self._quorum_round("PAXOS_COMMIT",
+                               (table.id, pk, ballot.pack(), value),
+                               live, timeout, need)
+            return True, current
+        raise last_contention or CasContention("cas retries exhausted")
+
+    _last_ballot_ts = 0
+    _ballot_lock = threading.Lock()
+
+    def _next_ballot(self) -> Ballot:
+        """Wall-clock-derived monotonic ballots: comparable ACROSS
+        processes (the reference uses UUID-v1 ballots for the same
+        reason; monotonic_ns has a per-process epoch and must not be
+        used)."""
+        with self._ballot_lock:
+            ts = max(time.time_ns(), PaxosService._last_ballot_ts + 1)
+            PaxosService._last_ballot_ts = ts
+        return Ballot(ts, self.node.endpoint.name)
+
+    def _read_row(self, keyspace, table, pk, ck):
+        from ..storage.rows import row_to_dict, rows_from_batch
+        batch = self.node.proxy.read_partition(
+            keyspace, table.name, pk, ConsistencyLevel.QUORUM)
+        for r in rows_from_batch(table, batch):
+            if not r.is_static and r.ck_frame == ck:
+                return row_to_dict(table, r)
+        return None

@@ -108,6 +108,21 @@ def _call_function(fn: ast.FunctionCall, params):
 
 # ---------------------------------------------------------------- executor --
 
+class _MutationCollector:
+    """Backend proxy that records mutations instead of applying them
+    (logged-batch collection)."""
+
+    def __init__(self, backend):
+        self._backend = backend
+        self.mutations: list[Mutation] = []
+
+    def apply(self, mutation, durable: bool = True) -> None:
+        self.mutations.append(mutation)
+
+    def __getattr__(self, name):
+        return getattr(self._backend, name)
+
+
 class Executor:
     """Executes parsed statements. `backend` must provide: schema,
     apply(mutation), store(ks, table) with read_partition/scan_all, and
@@ -383,10 +398,6 @@ class Executor:
             [values[c.name] for c in t.partition_key_columns])
         ck = b"" if static_only else t.serialize_clustering(
             [values[c.name] for c in t.clustering_columns])
-        if s.if_not_exists:
-            existing = self._read_row(t, pk, ck, now)
-            if existing is not None:
-                return self._not_applied(t, existing)
         m = Mutation(t.id, pk)
         now_s = timeutil.now_seconds()
         if not static_only:
@@ -399,6 +410,15 @@ class Executor:
             target_ck = b"" if col.kind == schema_mod.ColumnKind.STATIC else ck
             self._add_cell_ops(m, t, col, target_ck, v, ts, ttl, now_s,
                                overwrite_collection=True)
+        if s.if_not_exists:
+            casfn = getattr(self.backend, "cas", None)
+            if casfn is not None:   # distributed: Paxos round
+                applied, cur = casfn(t.keyspace, t, pk, ck,
+                                     lambda c: c is None, lambda: m)
+                return APPLIED if applied else self._not_applied(t, cur)
+            existing = self._read_row(t, pk, ck, now)
+            if existing is not None:
+                return self._not_applied(t, existing)
         self.backend.apply(m)
         return APPLIED if s.if_not_exists else ResultSet([], [])
 
@@ -461,25 +481,29 @@ class Executor:
         pks = self._pk_bytes_list(t, pk_vals)
         ck = self._full_ck(t, ck_rel) if t.clustering_columns else b""
         now_s = timeutil.now_seconds()
-        results = []
+        conditional = s.if_exists or s.conditions
+        if conditional and len(pks) > 1:
+            raise InvalidRequest("IN with conditions is not supported")
         for pk in pks:
-            if s.if_exists or s.conditions:
-                existing = self._read_row(t, pk, ck, now)
-                if s.if_exists and existing is None:
-                    return ResultSet(["[applied]"], [(False,)])
-                if s.conditions and not self._check_conditions(
-                        t, existing, s.conditions, params):
-                    return self._not_applied(t, existing)
             m = Mutation(t.id, pk)
-            is_counter = t.is_counter_table
-            if not is_counter:
-                # UPDATE does NOT create liveness (reference semantics:
-                # update of a non-existent row leaves no row marker)
-                pass
             for op in s.ops:
                 self._apply_update_op(m, t, op, ck, ts, ttl, now_s, params)
+            if conditional:
+                def check(cur):
+                    if s.if_exists:
+                        return cur is not None
+                    return self._check_conditions(t, cur, s.conditions,
+                                                  params)
+                casfn = getattr(self.backend, "cas", None)
+                if casfn is not None:
+                    applied, cur = casfn(t.keyspace, t, pk, ck, check,
+                                         lambda: m)
+                    return APPLIED if applied else self._not_applied(t, cur)
+                existing = self._read_row(t, pk, ck, now)
+                if not check(existing):
+                    return self._not_applied(t, existing)
             self.backend.apply(m)
-        if s.if_exists or s.conditions:
+        if conditional:
             return APPLIED
         return ResultSet([], [])
 
@@ -613,6 +637,29 @@ class Executor:
 
     def _exec_BatchStatement(self, s, params, keyspace, now):
         now = now or timeutil.now_micros()
+        for sub in s.statements:
+            if getattr(sub, "if_not_exists", False) \
+                    or getattr(sub, "if_exists", False) \
+                    or getattr(sub, "conditions", None):
+                raise InvalidRequest(
+                    "conditional statements are not supported in batches "
+                    "(round 1; the reference restricts them to a single "
+                    "partition)")
+        batchlog = getattr(self.backend, "batchlog", None)
+        if s.kind == "logged" and batchlog is not None \
+                and len(s.statements) > 1:
+            # collect all mutations first, persist the batch, then apply —
+            # a crash mid-apply replays the remainder at boot
+            # (BatchStatement.executeWithConditions logged path)
+            collector = _MutationCollector(self.backend)
+            sub_exec = Executor(collector)
+            for sub in s.statements:
+                sub_exec.execute(sub, params, keyspace, now_micros=now)
+            bid = batchlog.store(collector.mutations)
+            for m in collector.mutations:
+                self.backend.apply(m)
+            batchlog.remove(bid)
+            return ResultSet([], [])
         for sub in s.statements:
             self.execute(sub, params, keyspace, now_micros=now)
         return ResultSet([], [])

@@ -37,6 +37,9 @@ class StorageEngine:
         self._register_existing()
         if self.commitlog:
             self._replay()
+        from .batchlog import Batchlog
+        self.batchlog = Batchlog(os.path.join(data_dir, "batchlog"))
+        self._replay_batchlog()
         from ..index import IndexManager
         self.indexes = IndexManager(self)
         self._restore_indexes()
@@ -159,6 +162,14 @@ class StorageEngine:
         # with; reclaim all pre-existing segments
         self.commitlog.delete_segments_before(
             self.commitlog.current_position().segment_id)
+
+    def _replay_batchlog(self) -> None:
+        """Finish batches interrupted by a crash (BatchlogManager.replay)."""
+        for bid, muts in self.batchlog.pending():
+            for m in muts:
+                if self.schema.table_by_id(m.table_id) is not None:
+                    self.apply(m)
+            self.batchlog.remove(bid)
 
     # --------------------------------------------------------------- misc --
 

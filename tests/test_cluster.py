@@ -225,3 +225,98 @@ def test_merkle_tree_difference():
     lo, hi = diffs[0]
     assert lo <= 42 * (1 << 55) <= hi
     assert a.difference(a) == []
+
+
+def test_lwt_paxos_basic(cluster):
+    s1 = cluster.session(1)
+    s1.keyspace = "ks"
+    rs = s1.execute("INSERT INTO kv (k, v) VALUES (50, 'first') "
+                    "IF NOT EXISTS")
+    assert rs.rows[0][0] is True
+    # from ANOTHER node: must see the committed value and refuse
+    s2 = cluster.session(2)
+    s2.keyspace = "ks"
+    rs = s2.execute("INSERT INTO kv (k, v) VALUES (50, 'second') "
+                    "IF NOT EXISTS")
+    assert rs.rows[0][0] is False
+    assert "first" in rs.rows[0]  # prior row returned
+    rs = s2.execute("UPDATE kv SET v = 'updated' WHERE k = 50 "
+                    "IF v = 'first'")
+    assert rs.rows[0][0] is True
+    assert s1.execute("SELECT v FROM kv WHERE k = 50").rows == [("updated",)]
+    rs = s1.execute("UPDATE kv SET v = 'nope' WHERE k = 50 IF v = 'wrong'")
+    assert rs.rows[0][0] is False
+
+
+def test_lwt_paxos_contention(cluster):
+    import threading
+    results = []
+    lock = threading.Lock()
+
+    def contend(i):
+        s = cluster.session((i % 3) + 1)
+        s.keyspace = "ks"
+        try:
+            rs = s.execute(
+                f"INSERT INTO kv (k, v) VALUES (60, 'w{i}') IF NOT EXISTS")
+            with lock:
+                results.append(bool(rs.rows[0][0]))
+        except Exception:
+            with lock:
+                results.append(None)   # contention timeout acceptable
+
+    threads = [threading.Thread(target=contend, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    wins = sum(1 for r in results if r is True)
+    # at most one winner (a proposer whose in-flight round was finished by
+    # a helper may report not-applied even though its value committed —
+    # the reference has the same false-negative anomaly, CASSANDRA-12126)
+    assert wins <= 1, results
+    s = cluster.session(1)
+    s.keyspace = "ks"
+    rows = s.execute("SELECT v FROM kv WHERE k = 60").rows
+    assert len(rows) == 1 and rows[0][0].startswith("w")
+
+
+def test_logged_batch_atomic_replay(tmp_path):
+    # batchlog: a crash after store but before apply replays at boot
+    from cassandra_tpu.cql import Session
+    from cassandra_tpu.schema import Schema
+    from cassandra_tpu.storage.engine import StorageEngine
+    from cassandra_tpu.storage.mutation import Mutation
+    d = str(tmp_path / "bl")
+    eng = StorageEngine(d, Schema(), commitlog_sync="batch")
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    t = eng.schema.get_table("ks", "kv")
+    # simulate: batch persisted, crash before apply
+    m1 = Mutation(t.id, t.columns["k"].cql_type.serialize(1))
+    m1.add(b"", t.columns["v"].column_id, b"",
+           t.columns["v"].cql_type.serialize("a"), 100)
+    m2 = Mutation(t.id, t.columns["k"].cql_type.serialize(2))
+    m2.add(b"", t.columns["v"].column_id, b"",
+           t.columns["v"].cql_type.serialize("b"), 100)
+    eng.batchlog.store([m1, m2])
+    eng.close()
+    eng2 = StorageEngine(d, Schema(), commitlog_sync="batch")
+    s2 = Session(eng2)
+    s2.keyspace = "ks"
+    assert len(s2.execute("SELECT * FROM kv").rows) == 2
+    assert list(eng2.batchlog.pending()) == []
+    eng2.close()
+
+
+def test_logged_batch_through_cql(cluster):
+    s = cluster.session(1)
+    s.keyspace = "ks"
+    s.execute("""BEGIN BATCH
+        INSERT INTO kv (k, v) VALUES (70, 'a');
+        INSERT INTO kv (k, v) VALUES (71, 'b');
+        APPLY BATCH""")
+    assert len(s.execute("SELECT v FROM kv WHERE k IN (70, 71)").rows) == 2
