@@ -104,10 +104,19 @@ device_sort_perm = _traced_sort_perm
 
 # -------------------------------------------------------------- reconcile --
 
+def unpack_masks(packed: np.ndarray):
+    """(keep, ambiguous, expired, shadowed) from the kernel's packed uint8
+    lane — the single definition of the bit layout."""
+    return ((packed & 1).astype(bool), (packed & 2).astype(bool),
+            (packed & 4).astype(bool), (packed & 8).astype(bool))
+
+
 @jax.jit
 def reconcile_kernel(operands, perm):
     """Reconcile over a sort permutation. `operands` as in build_operands;
-    returns (keep, ambiguous, expired, shadowed) aligned to SORTED order.
+    returns ONE packed uint8 mask array aligned to SORTED order
+    (bit0=keep, bit1=ambiguous, bit2=expired, bit3=shadowed — decode with
+    unpack_masks). One small transfer instead of four bool arrays.
 
     ambiguous marks records whose (identity, ts) equal the previous sorted
     record — the host picks the winner there with death/value tie-break
@@ -176,16 +185,26 @@ def reconcile_kernel(operands, perm):
     # ---- ties the device didn't order: same identity AND same ts
     same_ts = (ts_h == prev_eq(ts_h)) & (ts_l == prev_eq(ts_l))
     ambiguous = (~cell_new) & same_ts & valid
-    return keep, ambiguous, expired, shadowed
+
+    # pack the four masks into ONE uint8 lane: a single (and much smaller)
+    # device->host transfer instead of four bool arrays — transfers through
+    # the chip link are the warm-path cost
+    packed = (keep.astype(jnp.uint8)
+              | (ambiguous.astype(jnp.uint8) << 1)
+              | (expired.astype(jnp.uint8) << 2)
+              | (shadowed.astype(jnp.uint8) << 3))
+    return packed
 
 
 def merge_reconcile_kernel(operands):
     """Jittable single-call form (driver entry / shard_map body): traced
-    sort composition + reconcile. Returns (perm, keep, ambiguous, expired,
-    shadowed)."""
+    sort composition + reconcile. Returns (perm, packed_masks) where
+    packed bit0=keep, bit1=ambiguous, bit2=expired, bit3=shadowed."""
     perm = _traced_sort_perm(operands)
-    keep, ambiguous, expired, shadowed = reconcile_kernel(operands, perm)
-    return perm, keep, ambiguous, expired, shadowed
+    packed = reconcile_kernel(operands, perm)
+    return perm, packed
+
+
 
 
 def prev_eq(a):
@@ -262,22 +281,17 @@ def merge_sorted_device(batches: list[CellBatch], gc_before: int = 0,
         return cat
     operands = build_operands(cat, gc_before, now, purgeable_ts_fn)
     perm_d = device_sort_perm(operands)
-    keep, ambiguous, expired, shadowed = reconcile_kernel(operands, perm_d)
+    packed_d = reconcile_kernel(operands, perm_d)
+    # two pulls total (perm + packed uint8 masks); padded entries sort last
     perm = np.asarray(perm_d)
-    keep = np.array(keep)          # writable copy: host fix-up mutates it
-    ambiguous = np.asarray(ambiguous)
-    expired = np.asarray(expired)
-    shadowed = np.asarray(shadowed)
-
-    # strip padding; padded entries sort last (valid is the primary key)
+    packed = np.asarray(packed_d)
     perm_real = perm[:n]
-    keep = keep[:n]
-    expired = expired[:n]
+    keep, ambiguous, expired, shadowed = unpack_masks(packed[:n])
 
     # host tie-break for equal-(identity, ts) runs (host_tiebreak below)
     pts_sorted = purgeable_ts_fn(cat).astype(np.int64)[perm_real] \
         if purgeable_ts_fn is not None else None
-    host_tiebreak(cat, perm_real, keep, ambiguous[:n], shadowed[:n],
+    host_tiebreak(cat, perm_real, keep, ambiguous, shadowed,
                   expired, gc_before, pts_sorted)
 
     kept_sorted_pos = np.flatnonzero(keep)
@@ -287,7 +301,7 @@ def merge_sorted_device(batches: list[CellBatch], gc_before: int = 0,
         # counter columns reconcile by summation (host pass, as in the
         # numpy path; counter tables are the uncommon case)
         s = cat.apply_permutation(perm_real)
-        sums = sum_counter_runs(s, keep, shadowed[:n])
+        sums = sum_counter_runs(s, keep, shadowed)
         out = apply_counter_sums(out, kept_sorted_pos, sums)
     converted = expired[kept_sorted_pos]
     if converted.any():

@@ -18,6 +18,7 @@ _SO = os.path.join(_DIR, "libcodec.so")
 _STAMP = _SO + ".srchash"
 _lock = threading.Lock()
 _lib = None
+_load_error = None  # negative cache: don't re-run g++ per call on failure
 
 
 def _src_hash() -> str:
@@ -50,15 +51,23 @@ def _stale(h: str) -> bool:
 
 
 def load() -> ctypes.CDLL:
-    global _lib
+    global _lib, _load_error
     if _lib is not None:
         return _lib
+    if _load_error is not None:
+        raise _load_error
     with _lock:
         if _lib is not None:
             return _lib
-        h = _src_hash()
-        if _stale(h):
-            _build(h)
+        if _load_error is not None:
+            raise _load_error
+        try:
+            h = _src_hash()
+            if _stale(h):
+                _build(h)
+        except Exception as e:
+            _load_error = RuntimeError(f"native codec build failed: {e}")
+            raise _load_error
         lib = ctypes.CDLL(_SO)
         i64 = ctypes.c_int64
         u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -77,5 +86,7 @@ def load() -> ctypes.CDLL:
             f = getattr(lib, fn)
             f.restype = i64
             f.argtypes = [u8p, i64p, u8p, i64p, i64p, i64]
+        lib.gather_frames.restype = i64
+        lib.gather_frames.argtypes = [u8p, i64p, i64p, i64, i64p, u8p]
         _lib = lib
         return _lib

@@ -117,13 +117,12 @@ def sharded_merge_step(mesh: Mesh):
         # operands arrive with a leading axis of local size 1
         local = {k: (v[0] if getattr(v, "ndim", 0) > 0 else v)
                  for k, v in operands.items()}
-        perm, keep, amb, expired, shadowed = merge_reconcile_kernel(local)
-        kept = jnp.sum(keep.astype(jnp.int32))
+        perm, packed = merge_reconcile_kernel(local)
+        kept = jnp.sum((packed & 1).astype(jnp.int32))
         dropped = jnp.sum((local["valid"] == 0).astype(jnp.int32)) - kept
         stats = jnp.stack([kept, dropped])
         stats = jax.lax.psum(stats, axis_name="shard")
-        return (perm[None], keep[None], amb[None], expired[None],
-                shadowed[None], stats)
+        return perm[None], packed[None], stats
 
     arr_spec = P("shard")
     scalar_spec = P()
@@ -132,7 +131,7 @@ def sharded_merge_step(mesh: Mesh):
                  for k in ("lanes", "valid", "ts_h", "ts_l", "death",
                            "cdel", "ldt", "expiring", "purge_h", "purge_l",
                            "gc_before", "now")},)
-    out_specs = (arr_spec, arr_spec, arr_spec, arr_spec, arr_spec, P())
+    out_specs = (arr_spec, arr_spec, P())
 
     return jax.jit(jax.shard_map(per_shard, mesh=mesh, in_specs=in_specs,
                                  out_specs=out_specs, check_vma=False))
@@ -150,12 +149,10 @@ def run_sharded_merge(cat: CellBatch, mesh: Mesh, gc_before: int = 0,
                                                    gc_before, now)
     step = sharded_merge_step(mesh)
     jop = {k: jnp.asarray(v) for k, v in operands.items()}
-    perm, keep, amb, expired, shadowed, stats = step(jop)
-    keep = np.array(keep)
+    perm, packed, stats = step(jop)
     perm = np.asarray(perm)
-    amb = np.asarray(amb)
-    expired = np.asarray(expired)
-    shadowed = np.asarray(shadowed)
+    from ..ops.merge import unpack_masks
+    keep, amb, expired, shadowed = unpack_masks(np.asarray(packed))
     # equal-(identity, ts) winners need the exact death/value rules — per
     # shard, map sorted positions back into cat and resolve on host.
     # The device stats (psum over the mesh) are adjusted by the (rare)

@@ -70,6 +70,33 @@ _BIAS = 1 << 63
 _U32 = 0xFFFFFFFF
 
 
+def _native_gather(payload: np.ndarray, off: np.ndarray, perm: np.ndarray,
+                   new_off: np.ndarray) -> np.ndarray | None:
+    """C++ ragged gather (ops/native/codec.cpp gather_frames); None if the
+    native lib is unavailable (caller falls back to numpy)."""
+    try:
+        import ctypes
+
+        from ..ops.native import build as native_build
+        lib = native_build.load()
+    except Exception:
+        return None
+    out = np.empty(int(new_off[-1]), dtype=np.uint8)
+    payload = np.ascontiguousarray(payload)
+    off = np.ascontiguousarray(off, dtype=np.int64)
+    perm = np.ascontiguousarray(perm, dtype=np.int64)
+    new_off = np.ascontiguousarray(new_off, dtype=np.int64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    r = lib.gather_frames(
+        payload.ctypes.data_as(u8p), off.ctypes.data_as(i64p),
+        perm.ctypes.data_as(i64p), len(perm),
+        new_off.ctypes.data_as(i64p), out.ctypes.data_as(u8p))
+    if r != 0:
+        return None
+    return out
+
+
 def lanes_for_table(table: TableMetadata) -> int:
     return 9 + table.clustering_lanes
 
@@ -178,12 +205,17 @@ class CellBatch:
         new_off = np.zeros(n + 1, dtype=np.int64)
         np.cumsum(lens, out=new_off[1:])
         total = int(new_off[-1])
-        # vectorised ragged gather of payload frames
+        # ragged gather of payload frames: C++ memcpy loop (the numpy
+        # fancy-index fallback builds a per-byte index array — measurably
+        # the compaction host hot spot)
         if total:
-            pos_in_cell = np.arange(total, dtype=np.int64) - \
-                np.repeat(new_off[:-1], lens)
-            flat_idx = np.repeat(starts, lens) + pos_in_cell
-            new_payload = self.payload[flat_idx]
+            new_payload = _native_gather(self.payload, self.off, perm,
+                                         new_off)
+            if new_payload is None:
+                pos_in_cell = np.arange(total, dtype=np.int64) - \
+                    np.repeat(new_off[:-1], lens)
+                flat_idx = np.repeat(starts, lens) + pos_in_cell
+                new_payload = self.payload[flat_idx]
         else:
             new_payload = np.zeros(0, dtype=np.uint8)
         new_val_start = new_off[:-1] + (self.val_start - self.off[:-1])[perm]
