@@ -161,6 +161,79 @@ class Node:
     def session(self) -> Session:
         return Session(self)
 
+    # ------------------------------------------------- topology changes --
+
+    def bootstrap(self) -> int:
+        """Pull this node's replica ranges from existing owners and write
+        them as local sstables (reference: tcm/sequences/BootstrapAndJoin
+        -> RangeStreamer -> entire-sstable streaming; writes that land
+        during the stream are healed by hints/repair — pending-range
+        tracking is a listed gap). Call AFTER ring registration. Returns
+        cells streamed."""
+        from ..storage import cellbatch as cbmod
+        from .repair import filter_token_range
+        from .replication import ReplicationStrategy
+
+        total = 0
+        # stream sources come from PRE-join ownership: at RF=1 the new
+        # node is the only post-join replica of its ranges — the data
+        # lives with the former owner
+        old_ring = self.ring.clone_without(self.endpoint)
+        for ks in list(self.schema.keyspaces.values()):
+            strat = ReplicationStrategy.create(ks.params.replication)
+            for lo, hi in self.ring.all_ranges():
+                replicas = strat.replicas(self.ring, hi)
+                if self.endpoint not in replicas:
+                    continue   # we don't replicate this range
+                owners = [e for e in strat.replicas(old_ring, hi)
+                          if e != self.endpoint and self.is_alive(e)]
+                if not owners:
+                    continue
+                for tname, table in ks.tables.items():
+                    batch = self.repair._fetch_range(
+                        owners[0], ks.name, tname,
+                        lo + 1 if lo < hi else lo, hi,
+                        self.proxy.timeout)
+                    if lo > hi:  # wrap-around range: fetch both arcs
+                        batch2 = self.repair._fetch_range(
+                            owners[0], ks.name, tname,
+                            -(1 << 63), hi, self.proxy.timeout)
+                        batch3 = self.repair._fetch_range(
+                            owners[0], ks.name, tname,
+                            lo + 1, (1 << 63) - 1, self.proxy.timeout)
+                        batch = cbmod.merge_sorted([batch2, batch3])
+                    if len(batch) == 0:
+                        continue
+                    # stream lands as a local sstable, not mutations
+                    # (entire-sstable streaming role)
+                    cfs = self.engine.store(ks.name, tname)
+                    from ..storage.sstable import Descriptor, SSTableWriter
+                    gen = cfs.next_generation()
+                    w = SSTableWriter(Descriptor(cfs.directory, gen), table)
+                    w.append(cbmod.merge_sorted([batch]))
+                    w.finish()
+                    cfs.reload_sstables()
+                    total += len(batch)
+        return total
+
+    def decommission(self) -> int:
+        """Push all local data to its post-removal owners, then leave the
+        ring (tcm/sequences/Leave + unbootstrap streaming role)."""
+        snapshots = {}
+        for ks in list(self.schema.keyspaces.values()):
+            for tname in ks.tables:
+                batch = self.engine.store(ks.name, tname).scan_all()
+                if len(batch):
+                    snapshots[(ks.name, tname)] = batch
+        self.ring.remove_node(self.endpoint)   # new ownership takes effect
+        total = 0
+        for (ksn, tname), batch in snapshots.items():
+            table = self.schema.get_table(ksn, tname)
+            self.repair.apply_batch_to_owners(ksn, table, batch)
+            total += len(batch)
+        self.shutdown()
+        return total
+
     def shutdown(self):
         self._stop_hints.set()
         self.gossiper.stop()
@@ -200,6 +273,7 @@ class LocalCluster:
     def __init__(self, n: int, base_dir: str, rf: int = 3,
                  gossip_interval: float = 0.05,
                  dcs: list[str] | None = None):
+        self.base_dir = base_dir
         self.transport = LocalTransport()
         self.schema = Schema()
         self.ring = Ring()
@@ -238,6 +312,46 @@ class LocalCluster:
 
     def session(self, i: int = 1) -> Session:
         return self.nodes[i - 1].session()
+
+    def add_node(self, dc: str = "dc1", vnodes: int = 4) -> Node:
+        """Grow the cluster: register in the ring, bootstrap-stream the new
+        node's ranges from existing owners, start serving (the jvm-dtest
+        addInstance + bootstrap flow)."""
+        import random as _random
+
+        from .ring import Endpoint
+        i = len(self.nodes) + 1
+        ep = Endpoint(f"node{i}", dc=dc)
+        taken = {t for toks in self.ring.endpoints.values() for t in toks}
+        rng = _random.Random(i * 7919)
+        tokens = []
+        while len(tokens) < vnodes:
+            t = rng.randrange(-(1 << 63) + 1, (1 << 63) - 1)
+            if t not in taken:
+                tokens.append(t)
+                taken.add(t)
+        node = Node(ep, os.path.join(self.base_dir, ep.name), self.schema,
+                    self.ring, self.transport,
+                    seeds=[self.nodes[0].endpoint],
+                    gossip_interval=self.nodes[0].gossiper.interval)
+        node.cluster_nodes = self.nodes
+        from .gossip import EndpointState
+        # seed liveness both ways
+        for other in self.nodes:
+            node.gossiper.states.setdefault(other.endpoint,
+                                            EndpointState(generation=1))
+            node.gossiper.detector.report(
+                other.endpoint,
+                node.gossiper.states[other.endpoint],
+                node.gossiper.clock())
+            other.gossiper.states.setdefault(ep, EndpointState(generation=1))
+            other.gossiper.detector.report(
+                ep, other.gossiper.states[ep], other.gossiper.clock())
+        self.ring.add_node(ep, tokens)
+        node.bootstrap()
+        self.nodes.append(node)
+        node.gossiper.start()
+        return node
 
     def stop_node(self, i: int) -> None:
         """Simulate a crash: stop gossip + messaging + hint dispatch

@@ -34,6 +34,22 @@ def batch_tokens(batch: cb.CellBatch) -> np.ndarray:
         return (u ^ np.uint64(_BIAS)).astype(np.int64)
 
 
+def iter_partitions(batch: cb.CellBatch):
+    """Yield (start, end, token) for every partition run of a sorted
+    batch — the shared partition-boundary idiom."""
+    n = len(batch)
+    if n == 0:
+        return
+    toks = batch_tokens(batch)
+    lane4 = batch.lanes[:, :4]
+    part_new = np.ones(n, dtype=bool)
+    part_new[1:] = (lane4[1:] != lane4[:-1]).any(axis=1)
+    starts = np.flatnonzero(part_new)
+    ends = np.append(starts[1:], n)
+    for s, e in zip(starts, ends):
+        yield int(s), int(e), int(toks[s])
+
+
 def filter_token_range(batch: cb.CellBatch, lo: int, hi: int) -> cb.CellBatch:
     """Cells whose partition token falls in [lo, hi] (sorted input -> the
     result is a contiguous slice)."""
@@ -53,19 +69,13 @@ def build_validation_tree(table, batch: cb.CellBatch,
     if n == 0:
         tree.seal()
         return tree
-    toks = batch_tokens(batch)
-    lane4 = batch.lanes[:, :4]
-    part_new = np.ones(n, dtype=bool)
-    part_new[1:] = (lane4[1:] != lane4[:-1]).any(axis=1)
-    starts = np.flatnonzero(part_new)
-    ends = np.append(starts[1:], n)
-    for s, e in zip(starts, ends):
+    for s, e, tok in iter_partitions(batch):
         h = hashlib.md5()
         h.update(batch.lanes[s:e].astype("<u4").tobytes())
         h.update(batch.ts[s:e].astype("<i8").tobytes())
         h.update(batch.flags[s:e].tobytes())
         h.update(batch.payload[batch.off[s]:batch.off[e]].tobytes())
-        tree.add(int(toks[s]), h.digest())
+        tree.add(tok, h.digest())
     tree.seal()
     return tree
 
@@ -201,16 +211,10 @@ class RepairService:
         """Push the merged truth for a range to a replica, one partition
         per mutation (SyncTask -> streaming role)."""
         node = self.node
-        n = len(merged)
-        if n == 0:
+        if len(merged) == 0:
             return
-        lane4 = merged.lanes[:, :4]
-        part_new = np.ones(n, dtype=bool)
-        part_new[1:] = (lane4[1:] != lane4[:-1]).any(axis=1)
-        starts = np.flatnonzero(part_new)
-        ends = np.append(starts[1:], n)
-        for s, e in zip(starts, ends):
-            part = merged.slice_range(int(s), int(e))
+        for s, e, _tok in iter_partitions(merged):
+            part = merged.slice_range(s, e)
             m = batch_to_mutation(table, part)
             if m is None:
                 continue
@@ -219,6 +223,35 @@ class RepairService:
             else:
                 node.messaging.send_one_way(Verb.MUTATION_REQ,
                                             m.serialize(), ep)
+
+    def apply_batch_to_owners(self, keyspace: str, table,
+                              batch: cb.CellBatch,
+                              timeout: float = 10.0) -> None:
+        """Push every partition of a batch to that partition's current
+        replica set, acked (decommission / rebalance streaming must be
+        durable before the sender departs)."""
+        node = self.node
+        ks = node.schema.keyspaces[keyspace]
+        strat = ReplicationStrategy.create(ks.params.replication)
+        pending = threading.Semaphore(0)
+        sent = 0
+        for s, e, tok in iter_partitions(batch):
+            part = batch.slice_range(s, e)
+            m = batch_to_mutation(table, part)
+            if m is None:
+                continue
+            for ep in strat.replicas(node.ring, tok):
+                if ep == node.endpoint:
+                    node.engine.apply(m)
+                else:
+                    sent += 1
+                    node.messaging.send_with_callback(
+                        Verb.MUTATION_REQ, m.serialize(), ep,
+                        on_response=lambda _m: pending.release(),
+                        on_failure=lambda _i: pending.release(),
+                        timeout=timeout)
+        for _ in range(sent):
+            pending.acquire(timeout=timeout)
 
     def _sync_range(self, keyspace, table_name, a, b, lo, hi,
                     timeout) -> int:

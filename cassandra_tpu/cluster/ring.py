@@ -72,6 +72,22 @@ class Ring:
                 out.append((prev, t))
         return out
 
+    def clone_without(self, ep: Endpoint) -> "Ring":
+        """A copy of the ring as it was before `ep` joined (bootstrap
+        stream sources must be computed against PRE-join ownership)."""
+        r = Ring()
+        for e, toks in self.endpoints.items():
+            if e != ep:
+                r.add_node(e, list(toks))
+        return r
+
+    def all_ranges(self) -> list[tuple[int, int]]:
+        """Every (start, end] vnode range of the ring (start > end for the
+        wrap-around range)."""
+        n = len(self._tokens)
+        return [(self._tokens[(i - 1) % n], t)
+                for i, t in enumerate(self._tokens)]
+
 
 def even_tokens(n_nodes: int, vnodes: int = 1) -> list[list[int]]:
     """Evenly spread initial tokens (dht/tokenallocator role, simplified

@@ -177,3 +177,25 @@ class TruncateStatement:
 @dataclass
 class UseStatement:
     keyspace: str
+
+
+@dataclass
+class RoleStatement:
+    action: str          # create | drop | alter
+    name: str
+    password: str | None = None
+    superuser: bool = False
+    if_not_exists: bool = False
+
+
+@dataclass
+class GrantStatement:
+    permission: str      # SELECT | MODIFY | CREATE | DROP | ALL | ...
+    resource: str        # keyspace name or 'all keyspaces'
+    role: str
+    revoke: bool = False
+
+
+@dataclass
+class ListRolesStatement:
+    pass

