@@ -190,10 +190,6 @@ class Node:
                 if not owners:
                     continue
                 for tname, table in ks.tables.items():
-                    batch = self.repair._fetch_range(
-                        owners[0], ks.name, tname,
-                        lo + 1 if lo < hi else lo, hi,
-                        self.proxy.timeout)
                     if lo > hi:  # wrap-around range: fetch both arcs
                         batch2 = self.repair._fetch_range(
                             owners[0], ks.name, tname,
@@ -202,6 +198,10 @@ class Node:
                             owners[0], ks.name, tname,
                             lo + 1, (1 << 63) - 1, self.proxy.timeout)
                         batch = cbmod.merge_sorted([batch2, batch3])
+                    else:
+                        batch = self.repair._fetch_range(
+                            owners[0], ks.name, tname, lo + 1, hi,
+                            self.proxy.timeout)
                     if len(batch) == 0:
                         continue
                     # stream lands as a local sstable, not mutations

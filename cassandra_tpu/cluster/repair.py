@@ -234,6 +234,7 @@ class RepairService:
         ks = node.schema.keyspaces[keyspace]
         strat = ReplicationStrategy.create(ks.params.replication)
         pending = threading.Semaphore(0)
+        failures = []
         sent = 0
         for s, e, tok in iter_partitions(batch):
             part = batch.slice_range(s, e)
@@ -245,13 +246,22 @@ class RepairService:
                     node.engine.apply(m)
                 else:
                     sent += 1
+
+                    def fail(_i, e=ep):
+                        failures.append(e)
+                        pending.release()
+
                     node.messaging.send_with_callback(
                         Verb.MUTATION_REQ, m.serialize(), ep,
                         on_response=lambda _m: pending.release(),
-                        on_failure=lambda _i: pending.release(),
-                        timeout=timeout)
+                        on_failure=fail, timeout=timeout)
         for _ in range(sent):
-            pending.acquire(timeout=timeout)
+            if not pending.acquire(timeout=timeout):
+                raise TimeoutError("stream push not acknowledged")
+        if failures:
+            raise RuntimeError(
+                f"stream push failed to {len(failures)} replica(s): "
+                f"{set(failures)} — aborting handoff")
 
     def _sync_range(self, keyspace, table_name, a, b, lo, hi,
                     timeout) -> int:

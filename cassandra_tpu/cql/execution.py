@@ -181,7 +181,10 @@ class Executor:
                 if not s.if_not_exists:
                     raise InvalidRequest(f"role {s.name} exists")
         elif s.action == "drop":
-            auth.drop_role(s.name)
+            try:
+                auth.drop_role(s.name, if_exists=s.if_not_exists)
+            except ValueError as e:
+                raise InvalidRequest(str(e))
         return ResultSet([], [])
 
     def _exec_GrantStatement(self, s, params, keyspace, now, user=None):
@@ -262,6 +265,9 @@ class Executor:
         for c in cols:
             vals = pk_vals[c.name]
             combos = [prev + [v] for prev in combos for v in vals]
+        gr = getattr(self.backend, "guardrails", None)
+        if gr is not None:
+            gr.check_in_cartesian(len(combos))
         return [table.serialize_partition_key(c) for c in combos]
 
     def _full_ck(self, table, ck_rel, params=()):
@@ -296,6 +302,10 @@ class Executor:
             raise InvalidRequest(f"table {ks}.{s.name} exists")
         if not s.partition_key:
             raise InvalidRequest("missing PRIMARY KEY")
+        gr = getattr(self.backend, "guardrails", None)
+        if gr is not None:
+            gr.check_table_count(1 + sum(len(k.tables) for k in
+                                         self.schema.keyspaces.values()))
         udts = self.schema.keyspaces[ks].user_types
         cols = {n: t for n, t, _ in s.columns}
         statics = {n for n, _, st in s.columns if st}
@@ -698,6 +708,9 @@ class Executor:
 
     def _exec_BatchStatement(self, s, params, keyspace, now, user=None):
         now = now or timeutil.now_micros()
+        gr = getattr(self.backend, "guardrails", None)
+        if gr is not None:
+            gr.check_batch_size(len(s.statements))
         for sub in s.statements:
             if getattr(sub, "if_not_exists", False) \
                     or getattr(sub, "if_exists", False) \
@@ -824,6 +837,16 @@ class Executor:
                         d[c.name] = st.get(c.name)
         # static-only partitions still produce one row in CQL
         # (skipped for round 1 simplicity when regular rows exist)
+
+        gr = getattr(self.backend, "guardrails", None)
+        if gr is not None and batches:
+            # tombstone pressure: count death-flagged cells merged for
+            # this read (TombstoneOverwhelmingException role)
+            from ..storage.cellbatch import DEATH_FLAGS
+            dead = int(sum(int(((b.flags & DEATH_FLAGS) != 0).sum())
+                           for _, b in batches))
+            if dead:
+                gr.check_tombstones(dead, t.full_name())
 
         rows = self._apply_ck_restrictions(t, rows, ck_rel)
         for col, op, v in filters:

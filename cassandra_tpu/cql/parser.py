@@ -485,8 +485,12 @@ class Parser:
                 self.next()   # 'keyspaces'
                 resource = "all keyspaces"
             elif str(w.value) == "table":
-                ks, _ = self.qualified_name()
-                resource = ks or "all keyspaces"
+                ks, tb = self.qualified_name()
+                if ks is None:
+                    raise ParseError(
+                        "GRANT/REVOKE ON TABLE requires a qualified "
+                        "ks.table name")
+                resource = ks
             else:
                 resource = str(w.value)
         self.expect_kw("from" if revoke else "to")

@@ -71,8 +71,10 @@ class AuthService:
             }
             self._save()
 
-    def drop_role(self, name: str):
+    def drop_role(self, name: str, if_exists: bool = False):
         with self._lock:
+            if name not in self.roles and not if_exists:
+                raise ValueError(f"unknown role {name}")
             self.roles.pop(name, None)
             self._save()
 

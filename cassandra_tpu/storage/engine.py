@@ -48,6 +48,8 @@ class StorageEngine:
         self.virtual_tables = build_engine_virtuals(self)
         from ..service.auth import AuthService
         self.auth = AuthService(data_dir, enabled=auth_enabled)
+        from .guardrails import Guardrails
+        self.guardrails = Guardrails()
 
     @property
     def _schema_path(self) -> str:

@@ -1,0 +1,59 @@
+"""Guardrails: operator-configured limits and warnings.
+
+Reference counterpart: db/guardrails/Guardrails.java — thresholds that
+warn or fail operations before they hurt the node (tables per keyspace,
+batch size, tombstones per read, partition size ...).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+class GuardrailViolation(Exception):
+    pass
+
+
+@dataclass
+class Guardrails:
+    tables_warn_threshold: int = 150
+    tables_fail_threshold: int = 500
+    batch_statements_warn: int = 50
+    batch_statements_fail: int = 500
+    tombstones_warn_per_read: int = 1000
+    tombstones_fail_per_read: int = 100_000
+    collection_size_warn_bytes: int = 5 * 1024 * 1024
+    in_select_cartesian_fail: int = 100
+    warnings: list = field(default_factory=list)
+
+    def _warn(self, msg: str) -> None:
+        self.warnings.append(msg)
+        if len(self.warnings) > 100:
+            self.warnings.pop(0)
+
+    def check_table_count(self, n: int) -> None:
+        if n >= self.tables_fail_threshold:
+            raise GuardrailViolation(
+                f"too many tables ({n} >= {self.tables_fail_threshold})")
+        if n >= self.tables_warn_threshold:
+            self._warn(f"table count {n} above warn threshold")
+
+    def check_batch_size(self, n: int) -> None:
+        if n > self.batch_statements_fail:
+            raise GuardrailViolation(
+                f"batch with {n} statements (fail threshold "
+                f"{self.batch_statements_fail})")
+        if n > self.batch_statements_warn:
+            self._warn(f"batch with {n} statements above warn threshold")
+
+    def check_tombstones(self, n: int, where: str) -> None:
+        if n > self.tombstones_fail_per_read:
+            raise GuardrailViolation(
+                f"read scanned {n} tombstones in {where} "
+                "(TombstoneOverwhelmingException role)")
+        if n > self.tombstones_warn_per_read:
+            self._warn(f"read scanned {n} tombstones in {where}")
+
+    def check_in_cartesian(self, n: int) -> None:
+        if n > self.in_select_cartesian_fail:
+            raise GuardrailViolation(
+                f"IN restriction expands to {n} partitions")

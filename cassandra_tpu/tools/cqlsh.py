@@ -48,7 +48,9 @@ def describe(session, what: str) -> str:
             for t in ks.tables:
                 out.append(f"{ks.name}.{t}")
         return "\n".join(out) or "(none)"
-    parts = what.replace("table", "").strip().split(".")
+    if what.startswith("table "):
+        what = what[len("table "):]
+    parts = what.strip().split(".")
     if len(parts) == 2:
         ksn, tn = parts
     else:
@@ -117,14 +119,12 @@ def repl(session, stdin=None, stdout=None):
             if not stripped:
                 continue
         buf += line
-        if ";" not in buf and not buf.strip().lower().startswith(
-                ("begin",)):
-            if not buf.strip().endswith(";"):
-                # statements end with ';' (BEGIN BATCH blocks span lines)
-                if ";" not in buf:
-                    continue
-        if buf.strip().lower().startswith("begin") \
-                and "apply batch" not in buf.lower():
+        is_batch = buf.strip().lower().startswith("begin")
+        # statements end with ';'; BEGIN BATCH blocks span lines until
+        # APPLY BATCH
+        if not is_batch and ";" not in buf:
+            continue
+        if is_batch and "apply batch" not in buf.lower():
             continue
         stmt = buf
         buf = ""
@@ -147,13 +147,17 @@ def main(argv=None):
     p.add_argument("--data", required=True)
     p.add_argument("-e", "--execute", help="run one statement and exit")
     p.add_argument("-f", "--file", help="run statements from a file")
+    p.add_argument("-u", "--user", help="role name (auth-enabled dirs)")
+    p.add_argument("-p", "--password", default="")
     args = p.parse_args(argv)
 
     from ..cql import Session
     from ..schema import Schema
     from ..storage.engine import StorageEngine
-    engine = StorageEngine(args.data, Schema())
-    session = Session(engine)
+    import os as _os
+    auth_on = _os.path.exists(_os.path.join(args.data, "system_auth.json"))
+    engine = StorageEngine(args.data, Schema(), auth_enabled=auth_on)
+    session = Session(engine, user=args.user, password=args.password)
     try:
         if args.execute:
             rs = session.execute(args.execute)

@@ -114,6 +114,35 @@ def ring(node) -> list[dict]:
     return out
 
 
+def snapshot(engine, keyspace: str | None = None,
+             table: str | None = None, tag: str | None = None) -> list[str]:
+    """nodetool snapshot."""
+    from ..storage import snapshot as snap
+    out = []
+    for cfs in engine.stores.values():
+        if keyspace and cfs.table.keyspace != keyspace:
+            continue
+        if table and cfs.table.name != table:
+            continue
+        cfs.flush()   # snapshots must include memtable contents
+        out.append(f"{cfs.table.full_name()}:{snap.snapshot(cfs, tag)}")
+    return out
+
+
+def listsnapshots(engine) -> list[dict]:
+    from ..storage import snapshot as snap
+    out = []
+    for cfs in engine.stores.values():
+        out.extend(snap.list_snapshots(cfs))
+    return out
+
+
+def clearsnapshot(engine, tag: str | None = None) -> int:
+    from ..storage import snapshot as snap
+    return sum(snap.clear_snapshot(cfs, tag)
+               for cfs in engine.stores.values())
+
+
 def garbagecollect(engine, keyspace: str | None = None,
                    table: str | None = None) -> list[dict]:
     """Single-sstable rewrite dropping gc-able tombstones

@@ -94,3 +94,57 @@ def test_nodetool_status_on_cluster(tmp_path):
         assert len(rs.rows) == 2
     finally:
         c.shutdown()
+
+
+def test_snapshots(tmp_path):
+    from cassandra_tpu.storage import snapshot as snap
+    eng = StorageEngine(str(tmp_path / "sn"), Schema(),
+                        commitlog_sync="batch")
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    for i in range(10):
+        s.execute(f"INSERT INTO kv (k, v) VALUES ({i}, 'v{i}')")
+    cfs = eng.store("ks", "kv")
+    cfs.flush()
+    tag = snap.snapshot(cfs, "backup1")
+    assert tag == "backup1"
+    assert snap.list_snapshots(cfs)[0]["files"]
+    # destroy the live table, restore from snapshot
+    cfs.truncate()
+    assert s.execute("SELECT * FROM kv").rows == []
+    snap.restore_snapshot(cfs, "backup1")
+    assert len(s.execute("SELECT * FROM kv").rows) == 10
+    assert snap.clear_snapshot(cfs) == 1
+    eng.close()
+
+
+def test_guardrails(tmp_path):
+    from cassandra_tpu.storage.guardrails import GuardrailViolation
+    eng = StorageEngine(str(tmp_path / "gr"), Schema(),
+                        commitlog_sync="batch")
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE kv (k int, c int, v text, PRIMARY KEY (k, c))")
+    # tombstone-overwhelming read fails
+    eng.guardrails.tombstones_fail_per_read = 50
+    for c in range(100):
+        s.execute(f"INSERT INTO kv (k, c, v) VALUES (1, {c}, 'x')")
+        s.execute(f"DELETE FROM kv WHERE k = 1 AND c = {c}")
+    with pytest.raises(GuardrailViolation):
+        s.execute("SELECT * FROM kv WHERE k = 1")
+    # huge batches fail
+    eng.guardrails.batch_statements_fail = 3
+    with pytest.raises(GuardrailViolation):
+        s.execute("BEGIN BATCH " + " ".join(
+            f"INSERT INTO kv (k, c, v) VALUES (2, {i}, 'y');"
+            for i in range(5)) + " APPLY BATCH")
+    # table-count cap
+    eng.guardrails.tables_fail_threshold = 2
+    with pytest.raises(GuardrailViolation):
+        s.execute("CREATE TABLE another (k int PRIMARY KEY)")
+    eng.close()
