@@ -820,21 +820,27 @@ class Executor:
                        for pk in self._pk_bytes_list(t, pk_vals)]
         else:
             batches = [(None, cfs.scan_all())]
+        want_meta = any(isinstance(expr, ast.FunctionCall)
+                        and expr.name.lower() in ("writetime", "ttl")
+                        for expr, _ in s.selectors)
         for _, batch in batches:
             for r in rows_from_batch(t, batch):
-                d = row_to_dict(t, r)
+                d = row_to_dict(t, r, with_meta=want_meta)
                 if r.is_static:
                     statics_by_pk[r.pk] = d
                     continue
                 d["__pk"] = r.pk
                 rows.append(d)
-        # join static values onto their partition's rows
+        # join static values (and their cell metadata) onto the rows
         for d in rows:
             st = statics_by_pk.get(d.pop("__pk", None), None)
             if st:
                 for c in t.static_columns:
                     if d.get(c.name) is None:
                         d[c.name] = st.get(c.name)
+                        if want_meta and c.name in st.get("__meta__", {}):
+                            d.setdefault("__meta__", {})[c.name] = \
+                                st["__meta__"][c.name]
         # static-only partitions still produce one row in CQL
         # (skipped for round 1 simplicity when regular rows exist)
 
@@ -894,7 +900,7 @@ class Executor:
                 if r.is_static:
                     static_row = row_to_dict(t, r)
                 elif r.ck_frame == ck:
-                    hit = row_to_dict(t, r)
+                    hit = row_to_dict(t, r, with_meta=True)
             if hit is not None and hit.get(col.name) == v:  # drop stale
                 if static_row:
                     for c in t.static_columns:
@@ -925,7 +931,7 @@ class Executor:
             batch = cfs.read_partition(pk)
             for r in rows_from_batch(t, batch):
                 if r.ck_frame == ck and not r.is_static:
-                    rows.append(row_to_dict(t, r))
+                    rows.append(row_to_dict(t, r, with_meta=True))
         return self._project(t, s, rows)
 
     def _apply_ck_restrictions(self, t, rows, ck_rel):
@@ -985,6 +991,7 @@ class Executor:
                     raise InvalidRequest(f"unknown column {expr}")
                 names.append(alias or expr)
                 exprs.append((None, expr))
+        _now_s = timeutil.now_seconds()   # one 'now' for the whole result
         agg_fns = {"count", "min", "max", "sum", "avg"}
         if any(f in agg_fns for f, _ in exprs if f):
             out = []
@@ -1015,7 +1022,18 @@ class Executor:
                         [r[c.name] for c in t.partition_key_columns])
                     row.append(murmur3.token_of(pkb))
                 elif f in ("writetime", "ttl"):
-                    row.append(None)  # needs cell metadata: round 2
+                    meta = r.get("__meta__", {}).get(cname)
+                    # a deleted column has null writetime/ttl (the meta of
+                    # its tombstone must not leak)
+                    if meta is None or r.get(cname) is None:
+                        row.append(None)
+                    elif f == "writetime":
+                        row.append(meta[0])
+                    else:
+                        _, ttl_s, ldt = meta
+                        remaining = ldt - _now_s
+                        row.append(remaining if ttl_s and remaining > 0
+                                   else None)
                 else:
                     row.append(r.get(cname))
             result_rows.append(tuple(row))

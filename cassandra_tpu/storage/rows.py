@@ -27,6 +27,7 @@ class RowData:
     ck_frame: bytes               # serialized clustering frame
     cells: dict = field(default_factory=dict)   # column_id -> value bytes|None
     multicell: dict = field(default_factory=dict)  # column_id -> {path: bytes}
+    cell_meta: dict = field(default_factory=dict)  # column_id -> (ts, ttl, ldt)
     liveness_ts: int | None = None
     max_ts: int = 0
     is_static: bool = False
@@ -77,13 +78,23 @@ def rows_from_batch(table: TableMetadata, batch: CellBatch):
                 current.multicell.setdefault(col, {})[path] = value
         else:
             current.cells[col] = None if dead else value
+            current.cell_meta[col] = (int(batch.ts[i]), int(batch.ttl[i]),
+                                      int(batch.ldt[i]))
     if current is not None and current.has_live_data():
         yield current
 
 
-def row_to_dict(table: TableMetadata, row: RowData) -> dict:
-    """Decode a RowData into {column_name: python value}."""
+def row_to_dict(table: TableMetadata, row: RowData,
+                with_meta: bool = False) -> dict:
+    """Decode a RowData into {column_name: python value}. with_meta adds
+    '__meta__': {name: (writetime_us, ttl, ldt)} for writetime()/ttl()
+    selectors."""
     out: dict = {}
+    if with_meta:
+        out["__meta__"] = {
+            table.columns_by_id[cid].name: m
+            for cid, m in row.cell_meta.items()
+            if cid in table.columns_by_id}
     for c, v in zip(table.partition_key_columns,
                     table.split_partition_key(row.pk)):
         out[c.name] = v

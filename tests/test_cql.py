@@ -335,3 +335,32 @@ def test_ucs_strategy(tmp_path):
     assert len(s.execute("SELECT * FROM t").rows) == 50
     assert all(r[0] == "g3" for r in s.execute("SELECT v FROM t").rows)
     eng.close()
+
+
+def test_writetime_and_ttl_selectors(session):
+    session.execute("CREATE TABLE wt (k int PRIMARY KEY, v text, w text)")
+    session.execute("INSERT INTO wt (k, v) VALUES (1, 'a') "
+                    "USING TIMESTAMP 123456789")
+    session.execute("UPDATE wt USING TTL 1000 SET w = 'b' WHERE k = 1")
+    rs = session.execute("SELECT writetime(v), ttl(v), ttl(w) FROM wt "
+                         "WHERE k = 1")
+    wt_v, ttl_v, ttl_w = rs.rows[0]
+    assert wt_v == 123456789
+    assert ttl_v is None            # no TTL on v
+    assert 990 <= ttl_w <= 1000     # remaining TTL on w
+
+
+def test_writetime_null_for_deleted_and_static(session):
+    session.execute("CREATE TABLE wt2 (k int, c int, s text static, v text, "
+                    "w text, PRIMARY KEY (k, c))")
+    session.execute("INSERT INTO wt2 (k, c, v, w) VALUES (1, 1, 'a', 'b') "
+                    "USING TIMESTAMP 777")
+    session.execute("INSERT INTO wt2 (k, s) VALUES (1, 'st') "
+                    "USING TIMESTAMP 888")
+    session.execute("DELETE v FROM wt2 WHERE k = 1 AND c = 1")
+    rs = session.execute("SELECT writetime(v), writetime(w), writetime(s) "
+                         "FROM wt2 WHERE k = 1")
+    wt_v, wt_w, wt_s = rs.rows[0]
+    assert wt_v is None           # deleted column: null, not tombstone ts
+    assert wt_w == 777
+    assert wt_s == 888            # static meta joined
