@@ -56,6 +56,7 @@ class SelectStatement:
     where: list[Relation] = field(default_factory=list)
     order_by: list[tuple[str, bool]] = field(default_factory=list)  # (col, desc)
     ann: tuple | None = None          # (column, query-vector term)
+    group_by: list[str] = field(default_factory=list)
     limit: Term | None = None
     per_partition_limit: Term | None = None
     allow_filtering: bool = False

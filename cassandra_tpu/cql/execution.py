@@ -959,13 +959,23 @@ class Executor:
 
     def _project(self, t, s, rows) -> ResultSet:
         sel = s.selectors
+        group_by = getattr(s, "group_by", [])
         if len(sel) == 1 and isinstance(sel[0][0], ast.FunctionCall) \
-                and sel[0][0].name.lower() == "count":
+                and sel[0][0].name.lower() == "count" and not group_by:
             return ResultSet(["count"], [(len(rows),)])
         if sel and sel[0][0] == "*":
             names = [c.name for c in t.partition_key_columns
                      + t.clustering_columns + t.static_columns
                      + t.regular_columns]
+            if group_by:
+                # first row of each group (reference GroupMaker behavior)
+                seen = {}
+                for r in rows:
+                    key = tuple(r.get(g) for g in group_by)
+                    seen.setdefault(key, r)
+                return ResultSet(names,
+                                 [tuple(r.get(n) for n in names)
+                                  for r in seen.values()])
             if s.distinct:
                 names = [c.name for c in t.partition_key_columns]
                 seen = []
@@ -993,6 +1003,57 @@ class Executor:
                 exprs.append((None, expr))
         _now_s = timeutil.now_seconds()   # one 'now' for the whole result
         agg_fns = {"count", "min", "max", "sum", "avg"}
+
+        if s.group_by:
+            # GROUP BY over primary-key prefix columns (reference
+            # cql3 SelectStatement/GroupMaker semantics): aggregates per
+            # group; plain selectors must be grouped columns (their value
+            # is constant within a group)
+            pk_prefix = [c.name for c in t.partition_key_columns] + \
+                [c.name for c in t.clustering_columns]
+            for g in s.group_by:
+                if g not in pk_prefix:
+                    raise InvalidRequest(
+                        f"GROUP BY only supports primary key columns "
+                        f"({g} is not one)")
+            if pk_prefix[:len(s.group_by)] != s.group_by:
+                raise InvalidRequest(
+                    "GROUP BY columns must form a primary-key prefix")
+            for f, cname in exprs:
+                if f is None and cname not in s.group_by:
+                    raise InvalidRequest(
+                        f"selecting {cname} without an aggregate requires "
+                        "it in GROUP BY")
+            groups: dict = {}
+            for r in rows:
+                key = tuple(r.get(g) for g in s.group_by)
+                groups.setdefault(key, []).append(r)
+            out_rows = []
+            for key, grp in groups.items():
+                row = []
+                for f, cname in exprs:
+                    if f is None:
+                        row.append(grp[0].get(cname))
+                        continue
+                    vals = [r.get(cname) for r in grp
+                            if r.get(cname) is not None]
+                    if f == "count":
+                        row.append(len(grp) if cname in ("*", None)
+                                   else len(vals))
+                    elif f == "min":
+                        row.append(min(vals) if vals else None)
+                    elif f == "max":
+                        row.append(max(vals) if vals else None)
+                    elif f == "sum":
+                        row.append(sum(vals) if vals else 0)
+                    elif f == "avg":
+                        row.append(sum(vals) / len(vals) if vals else 0)
+                    else:
+                        raise InvalidRequest(
+                            f"{f}() not allowed with GROUP BY")
+                out_rows.append(tuple(row))
+            return ResultSet(names, out_rows)
+
         if any(f in agg_fns for f, _ in exprs if f):
             out = []
             for f, cname in exprs:

@@ -206,6 +206,13 @@ class Parser:
         where = []
         if self.accept_kw("where"):
             where = self._relations()
+        group_by = []
+        if self.accept_ident("group"):
+            self.expect_kw("by")
+            while True:
+                group_by.append(self.ident())
+                if not self.accept_op(","):
+                    break
         order = []
         ann = None
         if self.accept_kw("order"):
@@ -239,8 +246,8 @@ class Parser:
             self.expect_kw("filtering")
             allow = True
         return ast.SelectStatement(ks, table, selectors, where, order, ann,
-                                   limit, per_partition, allow, distinct,
-                                   json)
+                                   group_by, limit, per_partition, allow,
+                                   distinct, json)
 
     def _selector(self):
         t = self.peek()

@@ -364,3 +364,23 @@ def test_writetime_null_for_deleted_and_static(session):
     assert wt_v is None           # deleted column: null, not tombstone ts
     assert wt_w == 777
     assert wt_s == 888            # static meta joined
+
+
+def test_group_by(session):
+    session.execute("CREATE TABLE g (k int, c int, v int, "
+                    "PRIMARY KEY (k, c))")
+    for k in (1, 2):
+        for c in range(4):
+            session.execute(
+                f"INSERT INTO g (k, c, v) VALUES ({k}, {c}, {k * 10 + c})")
+    rs = session.execute("SELECT k, count(*), sum(v) FROM g GROUP BY k")
+    got = {r[0]: (r[1], r[2]) for r in rs.rows}
+    assert got == {1: (4, 10 + 11 + 12 + 13), 2: (4, 20 + 21 + 22 + 23)}
+    rs = session.execute("SELECT k, max(v) FROM g WHERE k = 1 GROUP BY k")
+    assert rs.rows == [(1, 13)]
+    with pytest.raises(Exception):
+        session.execute("SELECT v, count(*) FROM g GROUP BY k")  # ungrouped v
+    with pytest.raises(Exception):
+        session.execute("SELECT count(*) FROM g GROUP BY v")     # non-pk
+    rs = session.execute("SELECT * FROM g GROUP BY k")
+    assert len(rs.rows) == 2                                     # first/group
