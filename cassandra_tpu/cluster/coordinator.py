@@ -9,7 +9,6 @@ resolution (service/reads/DigestResolver) and blocking read repair
 """
 from __future__ import annotations
 
-import hashlib
 import threading
 
 from ..storage import cellbatch as cb
@@ -62,47 +61,70 @@ class StorageProxy:
 
     # --------------------------------------------------------------- plan
 
-    def _plan(self, keyspace: str, pk: bytes) -> list[Endpoint]:
+    def _plan(self, keyspace: str, pk: bytes):
+        """(replicas, strategy) — blockFor math needs the configured RF
+        from the strategy, not the materialized endpoint count."""
         ks = self.node.schema.keyspaces[keyspace]
         strat = ReplicationStrategy.create(ks.params.replication)
         token = self.node.ring.token_of(pk)
         replicas = strat.replicas(self.node.ring, token)
-        return replicas or [self.node.endpoint]
+        return (replicas or [self.node.endpoint]), strat
 
     def _split_live(self, replicas):
         live = [r for r in replicas if self.node.is_alive(r)]
         dead = [r for r in replicas if r not in live]
         return live, dead
 
+    @staticmethod
+    def _counts_toward(cl: str, replica: Endpoint, local_dc: str) -> bool:
+        """LOCAL_* consistency only counts local-DC replicas toward
+        blockFor — a remote-DC ack must not satisfy a local quorum
+        (db/ConsistencyLevel.java isDatacenterLocal + countLocalEndpoints)."""
+        if cl in (ConsistencyLevel.LOCAL_QUORUM, ConsistencyLevel.LOCAL_ONE):
+            return replica.dc == local_dc
+        return True
+
     # -------------------------------------------------------------- write
 
     def mutate(self, keyspace: str, mutation: Mutation,
                cl: str = ConsistencyLevel.ONE) -> None:
-        replicas = self._plan(keyspace, mutation.pk)
-        block_for = ConsistencyLevel.required(cl, replicas,
-                                              self.node.endpoint.dc)
+        replicas, strat = self._plan(keyspace, mutation.pk)
+        block_for = ConsistencyLevel.block_for(cl, strat,
+                                               self.node.endpoint.dc)
         live, dead = self._split_live(replicas)
+        local_dc = self.node.endpoint.dc
+        countable = [r for r in live
+                     if self._counts_toward(cl, r, local_dc)]
         if cl == ConsistencyLevel.ANY:
             pass  # a hint alone satisfies ANY
-        elif len(live) < block_for:
+        elif len(countable) < block_for:
             raise UnavailableException(
-                f"{cl} requires {block_for} replicas, {len(live)} alive")
+                f"{cl} requires {block_for} replicas, "
+                f"{len(countable)} countable alive")
+        elif cl == ConsistencyLevel.EACH_QUORUM:
+            bad = ConsistencyLevel.each_quorum_unavailable_dcs(strat, live)
+            if bad:
+                raise UnavailableException(
+                    f"EACH_QUORUM: quorum unreachable in {bad}")
         handler = _Await(block_for)
         for target in dead:
             self.node.hints.store(target, mutation)
             if cl == ConsistencyLevel.ANY:
                 handler.ack()
         for target in live:
+            counts = self._counts_toward(cl, target, local_dc)
             if target == self.node.endpoint:
                 try:
                     self.node.engine.apply(mutation)
-                    handler.ack()
+                    if counts:
+                        handler.ack()
                 except Exception:
                     handler.fail()
             else:
                 self.messaging.send_with_callback(
                     Verb.MUTATION_REQ, mutation.serialize(), target,
-                    on_response=lambda m: handler.ack(),
+                    on_response=(lambda m: handler.ack()) if counts
+                    else (lambda m: None),
                     on_failure=lambda mid, t=target: self._write_timeout(
                         handler, t, mutation),
                     timeout=self.timeout)
@@ -116,68 +138,89 @@ class StorageProxy:
 
     # --------------------------------------------------------------- read
 
-    @staticmethod
-    def _digest(batch: cb.CellBatch) -> bytes:
-        h = hashlib.md5()
-        h.update(batch.lanes.astype("<u4").tobytes())
-        h.update(batch.ts.astype("<i8").tobytes())
-        h.update(batch.flags.tobytes())
-        h.update(batch.payload.tobytes())
-        return h.digest()
+    _digest = staticmethod(cb.content_digest)
 
     def read_partition(self, keyspace: str, table_name: str, pk: bytes,
                        cl: str = ConsistencyLevel.ONE) -> cb.CellBatch:
-        """Single-partition read: full data from one replica, digests from
-        the rest of the blockFor set; mismatch -> full-data round + repair
+        """Single-partition read: full data from ONE replica, digest-only
+        responses from the rest of the blockFor set — the digest round
+        ships 16 bytes per replica, not the partition. A mismatch triggers
+        a full-data round to every target plus blocking read repair
         (AbstractReadExecutor + DigestResolver + DataResolver)."""
-        replicas = self._plan(keyspace, pk)
-        block_for = ConsistencyLevel.required(cl, replicas,
-                                              self.node.endpoint.dc)
+        replicas, strat = self._plan(keyspace, pk)
+        block_for = ConsistencyLevel.block_for(cl, strat,
+                                               self.node.endpoint.dc)
         live, _ = self._split_live(replicas)
-        if len(live) < block_for:
+        local_dc = self.node.endpoint.dc
+        countable = [r for r in live
+                     if self._counts_toward(cl, r, local_dc)]
+        if len(countable) < block_for:
             raise UnavailableException(
-                f"{cl} requires {block_for} replicas, {len(live)} alive")
-        # prefer self as the data replica
-        live.sort(key=lambda r: r != self.node.endpoint)
-        targets = live[:block_for]
-        results = self._fetch(keyspace, table_name, pk, targets)
-        if len(results) < block_for:
+                f"{cl} requires {block_for} replicas, "
+                f"{len(countable)} countable alive")
+        if cl == ConsistencyLevel.EACH_QUORUM:
+            bad = ConsistencyLevel.each_quorum_unavailable_dcs(strat, live)
+            if bad:
+                raise UnavailableException(
+                    f"EACH_QUORUM: quorum unreachable in {bad}")
+        # prefer self as the data replica; only countable replicas serve
+        # the blockFor set (LOCAL_* never reads across DCs for the quorum)
+        countable.sort(key=lambda r: r != self.node.endpoint)
+        targets = countable[:block_for]
+        results, digests = self._fetch(keyspace, table_name, pk,
+                                       targets[:1], targets[1:])
+        if len(results) + len(digests) < block_for:
             raise TimeoutException(
-                f"{len(results)}/{block_for} read responses")
-        digests = {self._digest(b) for _, b in results}
-        if len(digests) > 1:
+                f"{len(results) + len(digests)}/{block_for} read responses")
+        want = {self._digest(b) for _, b in results} | \
+            {d for _, d in digests}
+        if len(want) > 1:
+            # digest mismatch: full-data second round from every target
+            results, _ = self._fetch(keyspace, table_name, pk, targets, [])
+            if len(results) < block_for:
+                raise TimeoutException(
+                    f"{len(results)}/{block_for} data responses")
             self._read_repair(keyspace, table_name, results)
         merged = cb.merge_sorted([b for _, b in results])
         return merged
 
-    def _fetch(self, keyspace, table_name, pk, targets):
-        handler = _Await(len(targets))
+    def _fetch(self, keyspace, table_name, pk, data_targets,
+               digest_targets):
+        """One round: full READ_REQ to data_targets, digest-only READ_REQ
+        to digest_targets. Returns ([(ep, batch)], [(ep, digest)])."""
+        handler = _Await(len(data_targets) + len(digest_targets))
         results: list = []
+        digests: list = []
         lock = threading.Lock()
 
-        def local():
-            batch = self.node.engine.store(
-                keyspace, table_name).read_partition(pk)
-            with lock:
-                results.append((self.node.endpoint, batch))
-            handler.ack()
-
-        for target in targets:
+        for target in data_targets + digest_targets:
+            digest_only = target in digest_targets
             if target == self.node.endpoint:
-                local()
+                batch = self.node.engine.store(
+                    keyspace, table_name).read_partition(pk)
+                with lock:
+                    if digest_only:
+                        digests.append((target, cb.content_digest(batch)))
+                    else:
+                        results.append((target, batch))
+                handler.ack()
             else:
-                def on_rsp(m, t=target):
+                def on_rsp(m, t=target, dg=digest_only):
                     with lock:
-                        results.append((t, cb_deserialize(m.payload)))
+                        if dg:
+                            digests.append((t, m.payload))
+                        else:
+                            results.append((t, cb_deserialize(m.payload)))
                     handler.ack()
                 self.messaging.send_with_callback(
-                    Verb.READ_REQ, (keyspace, table_name, pk), target,
+                    Verb.READ_REQ,
+                    (keyspace, table_name, pk, digest_only), target,
                     on_response=on_rsp,
                     on_failure=lambda mid: handler.fail(),
                     timeout=self.timeout)
         handler.await_(self.timeout)
         with lock:
-            return list(results)
+            return list(results), list(digests)
 
     def _read_repair(self, keyspace, table_name, results) -> None:
         """Blocking read repair: compute the merged truth and push it as a
@@ -246,6 +289,8 @@ class StorageProxy:
 
 
 # -------------------------------------------------------------- serde -----
+
+
 
 def cb_serialize(batch: cb.CellBatch) -> dict:
     """CellBatch as a plain dict (LocalTransport passes objects; a socket

@@ -79,8 +79,12 @@ class Node:
         return Verb.MUTATION_RSP, b""
 
     def _handle_read(self, msg):
-        keyspace, table_name, pk = msg.payload
+        keyspace, table_name, pk, *rest = msg.payload
+        digest_only = bool(rest[0]) if rest else False
         batch = self.engine.store(keyspace, table_name).read_partition(pk)
+        if digest_only:
+            # digest read: 16 bytes back instead of the partition
+            return Verb.READ_RSP, cbmod.content_digest(batch)
         return Verb.READ_RSP, cb_serialize(batch)
 
     def _handle_range(self, msg):

@@ -167,7 +167,10 @@ class PaxosService:
         strat = ReplicationStrategy.create(ks.params.replication)
         token = node.ring.token_of(pk)
         all_replicas = strat.replicas(node.ring, token) or [node.endpoint]
-        need = len(all_replicas) // 2 + 1
+        # quorum from the CONFIGURED RF: SERIAL on an undersized ring must
+        # refuse like QUORUM does, not decide with fewer promises than a
+        # real majority of the replication factor (Paxos.java blockFor)
+        need = strat.replication_factor() // 2 + 1
         live = [r for r in all_replicas if node.is_alive(r)]
         if len(live) < need:
             from .coordinator import UnavailableException

@@ -279,10 +279,4 @@ class RepairService:
         return len(merged)
 
 
-def _digest(batch: cb.CellBatch) -> bytes:
-    h = hashlib.md5()
-    h.update(batch.lanes.astype("<u4").tobytes())
-    h.update(batch.ts.astype("<i8").tobytes())
-    h.update(batch.flags.tobytes())
-    h.update(batch.payload.tobytes())
-    return h.digest()
+_digest = cb.content_digest

@@ -787,9 +787,7 @@ class Executor:
                         [bind_term(x, typ, params) for x in rel.value]
                     rows = [r for r in rows
                             if self._match(r.get(rel.column), rel.op, v)]
-                if s.limit is not None:
-                    rows = rows[: int(bind_term(s.limit, None, params))]
-                return self._project(vt.table, s, rows)
+                return self._project_with_limit(vt.table, s, rows, params)
 
         t = self._table(s, keyspace)
         cfs = self.backend.store(t.keyspace, t.name)
@@ -872,10 +870,31 @@ class Executor:
                 if seen[key] <= limit:
                     out.append(r)
             rows = out
-        if s.limit is not None:
-            rows = rows[: int(bind_term(s.limit, None, params))]
+        return self._project_with_limit(t, s, rows, params)
 
-        return self._project(t, s, rows)
+    def _project_with_limit(self, t, s, rows, params) -> ResultSet:
+        """LIMIT applies to *result* rows: for aggregates / GROUP BY /
+        DISTINCT the reference truncates after aggregation and dedup (cql3
+        SelectStatement userLimit on the grouped result), never the source
+        rows feeding them."""
+        limit = int(bind_term(s.limit, None, params)) \
+            if s.limit is not None else None
+        post = self._limit_after_projection(s)
+        if limit is not None and not post:
+            rows = rows[:limit]
+        rs = self._project(t, s, rows)
+        if limit is not None and post:
+            rs = ResultSet(rs.column_names, rs.rows[:limit])
+        return rs
+
+    @staticmethod
+    def _limit_after_projection(s) -> bool:
+        if getattr(s, "group_by", None) or getattr(s, "distinct", False):
+            return True
+        agg_fns = {"count", "min", "max", "sum", "avg"}
+        return any(isinstance(expr, ast.FunctionCall)
+                   and expr.name.lower() in agg_fns
+                   for expr, _ in s.selectors)
 
     def _indexed_lookup(self, t, cfs, filters, params):
         """Serve a single-equality filter from a secondary index: locators

@@ -318,16 +318,18 @@ def host_tiebreak(cat: CellBatch, perm_real: np.ndarray, keep: np.ndarray,
                   amb: np.ndarray, shadowed: np.ndarray,
                   expired: np.ndarray, gc_before: int,
                   pts_sorted: np.ndarray | None) -> None:
-    """Resolve equal-(identity, ts) runs with exact Cells.reconcile rules
-    (tombstone first, then largest full value, then first-seen). Mutates
-    `keep` in place. Arrays are in SORTED order; perm_real maps sorted
-    position -> index into `cat`. Shared by the single-device and the
-    mesh-sharded paths."""
+    """Resolve equal-(identity, ts) runs with exact Cells.resolveRegular
+    rules (db/rows/Cells.java:79, CASSANDRA-14592): expiring-or-tombstone
+    beats live, pure tombstone beats expiring, larger localDeletionTime,
+    larger value bytes, then first-seen. Mutates `keep` in place. Arrays
+    are in SORTED order; perm_real maps sorted position -> index into
+    `cat`. Shared by the single-device and the mesh-sharded paths."""
     if not amb.any():
         return
     n = len(perm_real)
     flags_sorted = cat.flags[perm_real]
     death_orig = (flags_sorted & DEATH_FLAGS) != 0
+    eot = death_orig | ((flags_sorted & FLAG_EXPIRING) != 0)
     death_eff = death_orig | expired
     ldt_sorted = cat.ldt[perm_real]
     ts_sorted = cat.ts[perm_real]
@@ -353,7 +355,8 @@ def host_tiebreak(cat: CellBatch, perm_real: np.ndarray, keep: np.ndarray,
         if lo < 0 or not cell_new[lo]:
             continue  # run of older duplicates below the winner
         best = max(range(lo, hi + 1),
-                   key=lambda i: (bool(death_orig[i]), orig_value(i)))
+                   key=lambda i: (bool(eot[i]), bool(death_orig[i]),
+                                  int(ldt_sorted[i]), orig_value(i)))
         keep[lo:hi + 1] = False
         purgeable = pts_sorted is None or ts_sorted[best] < pts_sorted[best]
         purged = bool(death_eff[best]) and ldt_sorted[best] < gc_before \

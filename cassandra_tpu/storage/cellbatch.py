@@ -24,9 +24,10 @@ Identity lanes (uint32, big-endian packing), K = 9 + C:
   8+C  path_hash   murmur3 h1 low 32 of the path
 
 Merge tie-break lanes (computed at sort time, not identity):
-  ~ts (descending), ~death-rank (tombstone beats live at equal ts),
-  ~value-prefix (larger value wins at equal ts; Cells.reconcile semantics,
-  reference db/rows/Cells.java:68).
+  ~ts (descending), then the Cells.resolveRegular equal-ts ranking
+  (reference db/rows/Cells.java:79, CASSANDRA-14592): expiring-or-tombstone
+  beats live, pure tombstone beats expiring, larger localDeletionTime,
+  larger value bytes (~value-prefix lane + exact host fix-up).
 
 Reconcile semantics mirrored from the reference:
   - newest timestamp wins per cell (Cells.reconcile)
@@ -95,6 +96,23 @@ def _native_gather(payload: np.ndarray, off: np.ndarray, perm: np.ndarray,
     if r != 0:
         return None
     return out
+
+
+def content_digest(batch: "CellBatch") -> bytes:
+    """Content digest over every reconcile-significant lane — the ONE
+    definition shared by digest reads (DigestResolver role) and merkle
+    repair. ldt/ttl are included: replicas can diverge in expiry alone
+    (CASSANDRA-14592 makes ldt a reconcile dimension), and a digest blind
+    to them would never trigger the repair that fixes it."""
+    import hashlib
+    h = hashlib.md5()
+    h.update(batch.lanes.astype("<u4").tobytes())
+    h.update(batch.ts.astype("<i8").tobytes())
+    h.update(batch.ldt.astype("<i4").tobytes())
+    h.update(batch.ttl.astype("<i4").tobytes())
+    h.update(batch.flags.tobytes())
+    h.update(batch.payload.tobytes())
+    return h.digest()
 
 
 def lanes_for_table(table: TableMetadata) -> int:
@@ -168,11 +186,16 @@ class CellBatch:
     # ------------------------------------------------------------- sort ---
 
     def sort_permutation(self) -> np.ndarray:
-        """Stable sort order: identity lanes asc, then ts desc, death desc,
-        value-prefix desc (newest-wins reconcile order)."""
+        """Stable sort order: identity lanes asc, then ts desc, then the
+        Cells.resolveRegular equal-ts ranking (CASSANDRA-14592): expiring-
+        or-tombstone beats live, pure tombstone beats expiring, larger
+        localDeletionTime, larger value — clock-independent so replicas
+        reconcile identically before and after expiry."""
         # np.lexsort: LAST key is the primary -> least-significant first
         keys = [_U32 - self._value_prefix_lane(),            # value desc
-                np.uint8(1) - self._death_lane()]            # death desc
+                np.int64(NO_DELETION_TIME) - self.ldt,       # ldt desc
+                np.uint8(1) - self._death_lane(),            # tombstone 1st
+                np.uint8(1) - self._eot_lane()]              # eot first
         with np.errstate(over="ignore"):
             # two's-complement reinterpret + sign-bit flip = biased unsigned
             uts = self.ts.astype(np.uint64) ^ np.uint64(_BIAS)
@@ -183,6 +206,12 @@ class CellBatch:
 
     def _death_lane(self) -> np.ndarray:
         return ((self.flags & DEATH_FLAGS) != 0).astype(np.uint8)
+
+    def _eot_lane(self) -> np.ndarray:
+        """Expiring-or-tombstone: has a localDeletionTime (static property,
+        independent of the reconciling clock — CASSANDRA-14592)."""
+        return ((self.flags & (DEATH_FLAGS | FLAG_EXPIRING)) != 0) \
+            .astype(np.uint8)
 
     def _value_prefix_lane(self) -> np.ndarray:
         """First 4 bytes of each value, big-endian, zero-padded
@@ -355,15 +384,18 @@ class CellBatch:
         winner = cell_new.copy()
 
         # 1b. exact value tie-break: the sort separates equal-(identity, ts,
-        # death) records only by a 4-byte value prefix; when full values
-        # differ beyond it, pick the lexicographically largest value
-        # (Cells.reconcile compares whole values). Host fix-up, rare.
+        # eot, death, ldt) records only by a 4-byte value prefix; when full
+        # values differ beyond it, pick the lexicographically largest value
+        # (Cells.resolveRegular compares whole values last). Host fix-up,
+        # rare.
         vp = self._value_prefix_lane()
         death = self._death_lane()
+        eot = self._eot_lane()
         tie = np.zeros(n, dtype=bool)
         if n > 1:
             tie[1:] = (~cell_new[1:]) & (self.ts[1:] == self.ts[:-1]) & \
-                (death[1:] == death[:-1]) & (vp[1:] == vp[:-1])
+                (eot[1:] == eot[:-1]) & (death[1:] == death[:-1]) & \
+                (self.ldt[1:] == self.ldt[:-1]) & (vp[1:] == vp[:-1])
         if tie.any():
             idxs = np.flatnonzero(tie)
             run_start = None

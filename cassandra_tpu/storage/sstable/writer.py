@@ -90,16 +90,36 @@ class SSTableWriter:
         self._write_filter()
         stats = self._write_stats()
         self._write_digest()
-        # TOC last, then atomic renames (TOC rename LAST = commit point)
+        # TOC last, then atomic renames (TOC rename LAST = commit point).
+        # Every component is fsynced before its rename and the directory
+        # is fsynced after the TOC rename — otherwise a crash can persist
+        # the commit point over truncated/unrenamed components.
         with open(self.desc.tmp_path(Component.TOC), "w") as f:
             f.write("\n".join(Component.ALL) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
         for comp in Component.ALL:
             if comp != Component.TOC:
+                self._fsync_path(self.desc.tmp_path(comp))
                 os.replace(self.desc.tmp_path(comp), self.desc.path(comp))
+        # component renames must be durable BEFORE the TOC commit point
+        # lands, and the TOC rename itself needs a second dir sync
+        self._fsync_path(self.desc.directory)
         os.replace(self.desc.tmp_path(Component.TOC),
                    self.desc.path(Component.TOC))
+        self._fsync_path(self.desc.directory)
         self._finished = True
         return stats
+
+    @staticmethod
+    def _fsync_path(path: str) -> None:
+        """fsync a file or directory by path (directories need an fd too —
+        the rename itself is only durable once the dir entry is synced)."""
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
 
     def abort(self) -> None:
         if not self._data.closed:

@@ -242,3 +242,35 @@ def test_idempotent_remerge():
     m2 = cb.merge_sorted([m1])
     assert summarize(m1) == summarize(m2)
     np.testing.assert_array_equal(m1.lanes, m2.lanes)
+
+
+def test_expiring_beats_live_at_equal_ts():
+    """CASSANDRA-14592 (Cells.resolveRegular): at equal ts, an
+    expiring-or-tombstone cell beats a live cell regardless of value
+    order — otherwise reconciliation flips when the TTL later expires."""
+    live = build([("add_cell", pk(1), ck(1), V, b"zzz", 100)])
+    ttl = build([("add_cell", pk(1), ck(1), V, b"aaa", 100, 1000, 0)])
+    for order in ([live, ttl], [ttl, live]):
+        m = cb.merge_sorted(order, now=0)
+        (val, _, _), = summarize(m).values()
+        assert val == b"aaa"
+
+
+def test_pure_tombstone_beats_expiring_at_equal_ts():
+    ttl = build([("add_cell", pk(1), ck(1), V, b"zzz", 100, 1000, 0)])
+    tomb = build([("add_tombstone", pk(1), ck(1), V, 100, 50)])
+    for order in ([ttl, tomb], [tomb, ttl]):
+        m = cb.merge_sorted(order, now=0)
+        (val, _, dead), = summarize(m).values()
+        assert dead and val == b""
+
+
+def test_larger_ldt_wins_between_expiring_at_equal_ts():
+    # both expiring, same ts: larger localDeletionTime wins even when the
+    # value bytes would order the other way
+    a = build([("add_cell", pk(1), ck(1), V, b"zzz", 100, 500, 0)])
+    b = build([("add_cell", pk(1), ck(1), V, b"aaa", 100, 900, 0)])
+    for order in ([a, b], [b, a]):
+        m = cb.merge_sorted(order, now=0)
+        (val, _, _), = summarize(m).values()
+        assert val == b"aaa"

@@ -374,3 +374,26 @@ def test_decommission_preserves_data(tmp_path):
         assert len(s1.execute("SELECT k FROM kv").rows) == 40
     finally:
         c.shutdown()
+
+
+def test_quorum_unavailable_on_undersized_ring(tmp_path):
+    """blockFor comes from the CONFIGURED RF: QUORUM at RF=3 on a 1-node
+    ring must refuse (blockFor=2), not silently accept with 1 replica
+    (db/ConsistencyLevel.java blockFor)."""
+    c = LocalCluster(1, str(tmp_path), rf=3)
+    try:
+        s = c.session(1)
+        s.execute("CREATE KEYSPACE uks WITH replication = "
+                  "{'class': 'SimpleStrategy', 'replication_factor': 3}")
+        s.execute("USE uks")
+        s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+        c.node(1).default_cl = ConsistencyLevel.QUORUM
+        with pytest.raises(UnavailableException):
+            s.execute("INSERT INTO kv (k, v) VALUES (1, 'x')")
+        c.node(1).default_cl = ConsistencyLevel.ONE
+        s.execute("INSERT INTO kv (k, v) VALUES (1, 'x')")
+        c.node(1).default_cl = ConsistencyLevel.QUORUM
+        with pytest.raises(UnavailableException):
+            s.execute("SELECT v FROM kv WHERE k = 1")
+    finally:
+        c.shutdown()

@@ -384,3 +384,34 @@ def test_group_by(session):
         session.execute("SELECT count(*) FROM g GROUP BY v")     # non-pk
     rs = session.execute("SELECT * FROM g GROUP BY k")
     assert len(rs.rows) == 2                                     # first/group
+
+
+def test_limit_applies_after_aggregation(session):
+    """LIMIT bounds result groups, not the rows feeding the aggregate
+    (cql3 SelectStatement: userLimit applies to the grouped result)."""
+    session.execute("CREATE TABLE la (k int, c int, v int, "
+                    "PRIMARY KEY (k, c))")
+    for k in (1, 2, 3):
+        for c in range(5):
+            session.execute(
+                f"INSERT INTO la (k, c, v) VALUES ({k}, {c}, 1)")
+    assert session.execute(
+        "SELECT count(*) FROM la LIMIT 1").rows == [(15,)]
+    assert session.execute(
+        "SELECT sum(v) FROM la LIMIT 3").rows == [(15,)]
+    rs = session.execute(
+        "SELECT k, count(*) FROM la GROUP BY k LIMIT 2")
+    assert len(rs.rows) == 2 and all(n == 5 for _, n in rs.rows)
+    # non-aggregate LIMIT still truncates plain rows
+    assert len(session.execute("SELECT * FROM la LIMIT 4").rows) == 4
+
+
+def test_distinct_limit_after_dedup(session):
+    session.execute("CREATE TABLE dl (k int, c int, v int, "
+                    "PRIMARY KEY (k, c))")
+    for k in (1, 2, 3):
+        for c in range(5):
+            session.execute(
+                f"INSERT INTO dl (k, c, v) VALUES ({k}, {c}, 1)")
+    rs = session.execute("SELECT DISTINCT k FROM dl LIMIT 2")
+    assert len(rs.rows) == 2 and len({r[0] for r in rs.rows}) == 2
