@@ -147,6 +147,9 @@ class StorageProxy:
         ships 16 bytes per replica, not the partition. A mismatch triggers
         a full-data round to every target plus blocking read repair
         (AbstractReadExecutor + DigestResolver + DataResolver)."""
+        if cl == ConsistencyLevel.EACH_QUORUM:
+            raise ValueError(
+                "EACH_QUORUM ConsistencyLevel is only supported for writes")
         replicas, strat = self._plan(keyspace, pk)
         block_for = ConsistencyLevel.block_for(cl, strat,
                                                self.node.endpoint.dc)
@@ -158,11 +161,6 @@ class StorageProxy:
             raise UnavailableException(
                 f"{cl} requires {block_for} replicas, "
                 f"{len(countable)} countable alive")
-        if cl == ConsistencyLevel.EACH_QUORUM:
-            bad = ConsistencyLevel.each_quorum_unavailable_dcs(strat, live)
-            if bad:
-                raise UnavailableException(
-                    f"EACH_QUORUM: quorum unreachable in {bad}")
         # prefer self as the data replica; only countable replicas serve
         # the blockFor set (LOCAL_* never reads across DCs for the quorum)
         countable.sort(key=lambda r: r != self.node.endpoint)

@@ -298,6 +298,15 @@ def merge_sorted_device(batches: list[CellBatch], gc_before: int = 0,
     host_tiebreak(cat, perm_real, keep, ambiguous, shadowed,
                   expired, gc_before, pts_sorted)
 
+    return finalize_merged(cat, perm_real, keep, expired, shadowed)
+
+
+def finalize_merged(cat: CellBatch, perm_real: np.ndarray,
+                    keep: np.ndarray, expired: np.ndarray,
+                    shadowed: np.ndarray) -> CellBatch:
+    """Materialize the merged output from kernel masks: gather kept cells
+    in sorted order, sum counter runs, convert expired-TTL winners to
+    tombstones. Shared by the single-device and mesh-sharded paths."""
     kept_sorted_pos = np.flatnonzero(keep)
     out = cat.apply_permutation(perm_real[kept_sorted_pos])
     out.sorted = True

@@ -107,11 +107,20 @@ def shard_batch(cat: CellBatch, n_shards: int, gc_before: int = 0,
 
 # ----------------------------------------------------------- device step --
 
+_step_cache: dict = {}
+
+
 def sharded_merge_step(mesh: Mesh):
-    """Build the jitted sharded compaction step for a mesh. Input operands
-    carry a leading shard axis partitioned over the mesh; each device sorts
-    and reconciles its token range locally, then global stats (cells kept,
-    tombstones purged) are psum'd across the mesh."""
+    """Build (or fetch the cached) jitted sharded compaction step for a
+    mesh. Input operands carry a leading shard axis partitioned over the
+    mesh; each device sorts and reconciles its token range locally, then
+    global stats (cells kept, tombstones purged) are psum'd across the
+    mesh. Cached per device tuple so repeated rounds reuse one jit
+    program (compiles are expensive on this box)."""
+    key = tuple(id(d) for d in mesh.devices.flat)
+    cached = _step_cache.get(key)
+    if cached is not None:
+        return cached
 
     def per_shard(operands):
         # operands arrive with a leading axis of local size 1
@@ -133,16 +142,17 @@ def sharded_merge_step(mesh: Mesh):
                            "gc_before", "now")},)
     out_specs = (arr_spec, arr_spec, P())
 
-    return jax.jit(jax.shard_map(per_shard, mesh=mesh, in_specs=in_specs,
+    step = jax.jit(jax.shard_map(per_shard, mesh=mesh, in_specs=in_specs,
                                  out_specs=out_specs, check_vma=False))
+    _step_cache[key] = step
+    return step
 
 
-def run_sharded_merge(cat: CellBatch, mesh: Mesh, gc_before: int = 0,
-                      now: int = 0):
-    """Host orchestration: split -> device step -> host tie-break ->
-    per-shard outputs. Returns (keep [S,N] numpy, perm [S,N],
-    stats (kept, dropped), shard_of, pos_in_shard)."""
-    from ..ops.merge import host_tiebreak
+def _run_sharded(cat: CellBatch, mesh: Mesh, gc_before: int, now: int):
+    """split -> device step -> host tie-break. Returns the full per-shard
+    state (keep/perm/masks in shard-padded [S, N] layout, member index
+    lists, psum'd stats)."""
+    from ..ops.merge import host_tiebreak, unpack_masks
 
     n_shards = mesh.devices.size
     operands, shard_of, pos, members = shard_batch(cat, n_shards,
@@ -151,7 +161,6 @@ def run_sharded_merge(cat: CellBatch, mesh: Mesh, gc_before: int = 0,
     jop = {k: jnp.asarray(v) for k, v in operands.items()}
     perm, packed, stats = step(jop)
     perm = np.asarray(perm)
-    from ..ops.merge import unpack_masks
     keep, amb, expired, shadowed = unpack_masks(np.asarray(packed))
     # equal-(identity, ts) winners need the exact death/value rules — per
     # shard, map sorted positions back into cat and resolve on host.
@@ -168,4 +177,70 @@ def run_sharded_merge(cat: CellBatch, mesh: Mesh, gc_before: int = 0,
                       shadowed[s, :c], expired[s, :c], gc_before, None)
         delta += int(keep[s, :c].sum()) - before
     stats = np.asarray(stats) + np.array([delta, -delta])
+    return (keep, perm, expired, shadowed, stats, shard_of, pos, members)
+
+
+def run_sharded_merge(cat: CellBatch, mesh: Mesh, gc_before: int = 0,
+                      now: int = 0):
+    """Host orchestration: split -> device step -> host tie-break ->
+    per-shard outputs. Returns (keep [S,N] numpy, perm [S,N],
+    stats (kept, dropped), shard_of, pos_in_shard)."""
+    keep, perm, _, _, stats, shard_of, pos, _ = _run_sharded(
+        cat, mesh, gc_before, now)
     return keep, perm, stats, shard_of, pos
+
+
+def materialize_sharded_merge(cat: CellBatch, mesh: Mesh,
+                              gc_before: int = 0,
+                              now: int = 0) -> list[CellBatch]:
+    """Per-shard merged CellBatches, token-ordered: shard s holds exactly
+    the cells whose token falls in its range, reconciled, sorted. The
+    concatenation equals the single-device merge output bit-for-bit, and
+    each element can feed its own SSTableWriter — the ShardManager model
+    (db/compaction/ShardManager.java:33: disjoint token shards feed
+    independent writers)."""
+    from ..ops.merge import finalize_merged
+
+    keep, perm, expired, shadowed, _, _, _, members = _run_sharded(
+        cat, mesh, gc_before, now)
+    out: list[CellBatch] = []
+    for s in range(len(members)):
+        c = len(members[s])
+        if c == 0:
+            out.append(CellBatch.empty(cat.n_lanes))
+            continue
+        perm_real = members[s][perm[s, :c]]
+        out.append(finalize_merged(cat, perm_real, keep[s, :c],
+                                   expired[s, :c], shadowed[s, :c]))
+    return out
+
+
+def sharded_compact_to_sstables(batches: list[CellBatch], table, mesh,
+                                directory: str, generation_base: int = 0,
+                                gc_before: int = 0, now: int = 0,
+                                shards: list[CellBatch] | None = None):
+    """One compaction round over the mesh, landing one sstable per shard:
+    merge the input CellBatches sharded across devices, then write each
+    shard's reconciled output through a real SSTableWriter. Pass
+    precomputed `shards` (from materialize_sharded_merge) to skip the
+    merge. Returns the list of (Descriptor, stats) for non-empty shards."""
+    from ..storage.sstable.format import Descriptor
+    from ..storage.sstable.writer import SSTableWriter
+
+    if shards is None:
+        cat = CellBatch.concat(batches)
+        shards = materialize_sharded_merge(cat, mesh, gc_before, now)
+    results = []
+    for s, shard in enumerate(shards):
+        if len(shard) == 0:
+            continue
+        desc = Descriptor(directory, generation_base + s)
+        w = SSTableWriter(desc, table)
+        try:
+            w.append(shard)
+            stats = w.finish()
+        except BaseException:
+            w.abort()
+            raise
+        results.append((desc, stats))
+    return results

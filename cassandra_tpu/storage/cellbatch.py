@@ -111,6 +111,10 @@ def content_digest(batch: "CellBatch") -> bytes:
     h.update(batch.ldt.astype("<i4").tobytes())
     h.update(batch.ttl.astype("<i4").tobytes())
     h.update(batch.flags.tobytes())
+    # cell boundaries too: identical concatenated bytes split into
+    # different cells must not collide
+    h.update(batch.off.astype("<i8").tobytes())
+    h.update(batch.val_start.astype("<i8").tobytes())
     h.update(batch.payload.tobytes())
     return h.digest()
 
