@@ -5,15 +5,23 @@ the hot loop :207-225 `while (ci.hasNext()) writer.append(ci.next())`),
 CompactionIterator.java:90 (merge + purge pipeline) and
 CompactionController.java:55 (purgeability from overlapping sources).
 
-TPU formulation: instead of a row-at-a-time heap, each round buffers one
+Formulation: instead of a row-at-a-time heap, each round buffers one
 batch per input run, finds the safe merge boundary (min of the runs'
-buffered maxima), merges everything below it in ONE device kernel call
-(ops/merge.py), and appends the result to the output writer. Disk I/O
-(segment decode) and device merge alternate per round; batches are large
-(64K cells) so the device amortises.
+buffered maxima), merges everything below it in ONE engine call, and
+hands the result to a pipelined writer thread (compression + file I/O
+overlap the next round's decode + merge). Three interchangeable,
+bit-identical merge engines:
+
+  device  ops/merge.py — the TPU kernel (LSD radix sort + segmented-scan
+          reconcile); big rounds amortise link latency.
+  native  ops/native/merge.cpp — C++ k-way streaming merge with inline
+          reconcile (the CompactionIterator formulation in native code);
+          wins when the accelerator link is bandwidth-bound.
+  numpy   storage/cellbatch.py — the executable spec.
 """
 from __future__ import annotations
 
+import threading
 import time
 
 import numpy as np
@@ -50,23 +58,41 @@ class _Cursor:
     buffered whole — acceptable for round 1; the reference streams within
     partitions via its row index."""
 
-    def __init__(self, reader: SSTableReader):
+    def __init__(self, reader: SSTableReader, prof: dict | None = None):
         self._it = reader.scanner()
+        self.prof = prof
         self.bufs: list[cb.CellBatch] = []
         self.exhausted = False
         self._fetch()
 
     def _fetch(self) -> bool:
+        t0 = time.perf_counter()
         try:
             self.bufs.append(next(self._it))
             return True
         except StopIteration:
             self.exhausted = True
             return False
+        finally:
+            if self.prof is not None:
+                self.prof["io_decode"] = self.prof.get("io_decode", 0.0) \
+                    + (time.perf_counter() - t0)
 
     @property
     def has_data(self) -> bool:
         return bool(self.bufs)
+
+    @property
+    def buffered_cells(self) -> int:
+        return sum(len(b) for b in self.bufs)
+
+    def fill_to(self, n_cells: int) -> None:
+        """Buffer segments until ~n_cells are held (or input exhausted).
+        Large rounds amortise the per-round device round-trip latency —
+        the dominant warm-path cost through the tunneled chip."""
+        while not self.exhausted and self.buffered_cells < n_cells:
+            if not self._fetch():
+                return
 
     def last_key(self) -> bytes:
         return _full_key(self.bufs[-1], -1)
@@ -151,14 +177,48 @@ class CompactionController:
 
 
 class CompactionTask:
+    # cells merged per round. The device engine wants BIG rounds: the
+    # fixed per-round transfer latency dominates (each push ~50-100ms,
+    # pull ~25 MiB/s on a tunneled chip); the cap bounds host buffering
+    # (~100 bytes/cell) and keeps N < 2^24 for the packed perm layout.
+    ROUND_CELLS_DEVICE = 1 << 21
+    # the host engines want SMALL rounds: per-round cost is near zero and
+    # many rounds let the pipelined writer thread overlap compression +
+    # file I/O with the next round's decode + merge.
+    ROUND_CELLS_HOST = 1 << 17
+
     def __init__(self, cfs, inputs: list[SSTableReader],
                  max_output_bytes: int | None = None,
-                 level: int = 0, use_device: bool = True):
+                 level: int = 0, use_device: bool | None = None,
+                 round_cells: int | None = None,
+                 engine: str | None = None):
+        """engine: 'device' (TPU kernel), 'native' (C++ streaming merge),
+        'numpy' (reference path). All three are tested bit-identical.
+        Default (engine=None, use_device unset): the native engine when
+        the library is available, else numpy — the measured winner when
+        the accelerator link is bandwidth-bound (BASELINE.md); pass
+        engine='device' (or use_device=True) on deployments with a
+        locally attached chip."""
         self.cfs = cfs
         self.inputs = inputs
         self.max_output_bytes = max_output_bytes
         self.level = level
-        self.use_device = use_device
+        self.use_device = bool(use_device)
+        if engine is None:
+            if use_device:
+                engine = "device"
+            elif use_device is False:
+                engine = "numpy"
+            else:
+                from ..ops import host_merge
+                engine = "native" if host_merge.available() else "numpy"
+        self.engine = engine
+        self.round_cells = round_cells or (
+            self.ROUND_CELLS_DEVICE if self.engine == "device"
+            else self.ROUND_CELLS_HOST)
+        # per-phase wall seconds, accumulated across rounds (published by
+        # bench.py -- the breakdown the perf work navigates by)
+        self.profile: dict = {}
 
     def execute(self) -> dict:
         """Run the compaction; returns stats (reference logs these at
@@ -169,8 +229,17 @@ class CompactionTask:
         gc_before = timeutil.now_seconds() - table.params.gc_grace_seconds
         now = timeutil.now_seconds()
         controller = CompactionController(cfs, self.inputs)
-        merge_fn = dmerge.merge_sorted_device if self.use_device \
-            else cb.merge_sorted
+        prof = self.profile
+        if self.engine == "device":
+            def merge_fn(slices, **kw):
+                return dmerge.merge_sorted_device(slices, prof=prof, **kw)
+        elif self.engine == "native":
+            from ..ops.host_merge import merge_sorted_native
+
+            def merge_fn(slices, **kw):
+                return merge_sorted_native(slices, prof=prof, **kw)
+        else:
+            merge_fn = cb.merge_sorted
 
         txn = LifecycleTransaction(cfs.directory)
         writers: list[SSTableWriter] = []
@@ -190,17 +259,60 @@ class CompactionTask:
             writers.append(w)
             return w
 
+        # pipelined write stage: compression + file I/O run on a worker
+        # thread (ctypes FFI and FileIO release the GIL) while the main
+        # thread decodes and merges the next round — the reference gets
+        # the same overlap from the kernel's writeback cache; here it is
+        # explicit. Queue depth 2 bounds buffered memory.
+        import queue
+
+        wq: queue.Queue = queue.Queue(maxsize=2)
+        werr: list[BaseException] = []
+        wstate = {"writer": None, "cells": 0}
+
+        def write_loop():
+            try:
+                while True:
+                    merged = wq.get()
+                    if merged is None:
+                        return
+                    tw = time.perf_counter()
+                    wstate["writer"].append(merged)
+                    prof["write"] = prof.get("write", 0.0) + \
+                        (time.perf_counter() - tw)
+                    wstate["cells"] += len(merged)
+                    if self.max_output_bytes and \
+                            wstate["writer"]._data_off >= \
+                            self.max_output_bytes:
+                        # roll the output (MaxSSTableSizeWriter role)
+                        wstate["writer"].finish()
+                        new_readers.append(
+                            SSTableReader(wstate["writer"].desc))
+                        wstate["writer"] = new_writer()
+            except BaseException as e:   # surfaced after join
+                werr.append(e)
+                while True:              # drain so the producer never blocks
+                    if wq.get() is None:
+                        return
+
+        wthread = None
         try:
-            writer = new_writer()
-            cursors = [_Cursor(r) for r in self.inputs]
+            wstate["writer"] = new_writer()
+            wthread = threading.Thread(target=write_loop, name="compact-w")
+            wthread.start()
+            cursors = [_Cursor(r, prof) for r in self.inputs]
             while True:
                 active = [c for c in cursors if c.has_data]
                 if not active:
                     break
-                # partition-aligned round: find the minimal buffered-through
-                # key, then make sure no cursor's buffer ends INSIDE that
-                # key's partition, and merge everything up to the partition
-                # end (full key width padded with 0xFF)
+                # buffer a full round's worth per cursor first, THEN find
+                # the partition-aligned boundary: the minimal buffered-
+                # through key, extended so no cursor's buffer ends INSIDE
+                # that key's partition; merge everything up to the
+                # partition end (full key width padded with 0xFF)
+                per_cursor = max(self.round_cells // len(active), 1)
+                for c in active:
+                    c.fill_to(per_cursor)
                 prefix16 = min(c.last_key() for c in active)[:16]
                 for c in cursors:
                     c.extend_past_partition(prefix16)
@@ -216,15 +328,17 @@ class CompactionTask:
                 merged = merge_fn(slices, gc_before=gc_before, now=now,
                                   purgeable_ts_fn=controller.purgeable_ts_fn)
                 if len(merged):
-                    writer.append(merged)
-                    cells_written += len(merged)
-                if self.max_output_bytes and \
-                        writer._data_off >= self.max_output_bytes:
-                    # roll the output (MaxSSTableSizeWriter role)
-                    writer.finish()
-                    new_readers.append(SSTableReader(writer.desc))
-                    writer = new_writer()
+                    wq.put(merged)
+            wq.put(None)
+            wthread.join()
+            if werr:
+                raise werr[0]
+            cells_written = wstate["cells"]
+            writer = wstate["writer"]
+            tw = time.perf_counter()
             writer.finish()
+            prof["write"] = prof.get("write", 0.0) + \
+                (time.perf_counter() - tw)
             new_readers.append(SSTableReader(writer.desc))
             for r in self.inputs:
                 txn.track_obsolete(r.desc.generation)
@@ -246,6 +360,12 @@ class CompactionTask:
             for r in self.inputs:
                 r.release()
         except BaseException:
+            if wthread is not None and wthread.is_alive():
+                # blocking put is safe: the consumer is either processing
+                # or draining toward the sentinel — put_nowait could drop
+                # the sentinel on a full queue and leave the thread stuck
+                wq.put(None)
+                wthread.join(timeout=30.0)
             for w in writers:
                 try:
                     w.abort()

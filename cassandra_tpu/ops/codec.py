@@ -45,6 +45,29 @@ class Compressor:
                          lengths: list[int]) -> list[bytes]:
         return [self.uncompress(c, n) for c, n in zip(chunks, lengths)]
 
+    def compress_iov(self, frames: list) -> tuple:
+        """Compress buffer-protocol frames (numpy arrays / memoryviews)
+        without staging copies. Returns (dst_uint8_array, offsets, sizes):
+        frame i's compressed bytes are dst[offsets[i]:offsets[i]+sizes[i]].
+        Generic fallback; the native codecs override with a zero-copy
+        FFI path."""
+        outs = [self.compress(bytes(f)) for f in frames]
+        offs = np.zeros(len(outs) + 1, dtype=np.int64)
+        np.cumsum([len(o) for o in outs], out=offs[1:])
+        dst = np.frombuffer(b"".join(outs), dtype=np.uint8)
+        return dst, offs[:-1], np.diff(offs)
+
+    def decompress_iov(self, src: np.ndarray, src_offs, src_lens,
+                       dsts: list) -> None:
+        """Decompress chunk i (src[src_offs[i] : +src_lens[i]]) directly
+        into the writable buffer dsts[i] (numpy uint8 views — the arrays
+        the decoded CellBatch will own). Generic fallback."""
+        for i, d in enumerate(dsts):
+            o, l = int(src_offs[i]), int(src_lens[i])
+            raw = self.uncompress(src[o:o + l].tobytes(), d.nbytes)
+            d.reshape(-1).view(np.uint8)[:] = np.frombuffer(raw,
+                                                            dtype=np.uint8)
+
 
 class _NativeCompressor(Compressor):
     """ctypes front-end over the C++ batch codecs."""
@@ -56,6 +79,9 @@ class _NativeCompressor(Compressor):
         self._decompress = getattr(self._lib, f"{self._prefix}_decompress")
         self._compress_b = getattr(self._lib, f"{self._prefix}_compress_batch")
         self._decompress_b = getattr(self._lib, f"{self._prefix}_decompress_batch")
+        self._compress_iov = getattr(self._lib, f"{self._prefix}_compress_iov")
+        self._decompress_iov = getattr(self._lib,
+                                       f"{self._prefix}_decompress_iov")
         self._max = getattr(self._lib, f"{self._prefix}_max_compressed")
 
     def compress(self, data: bytes) -> bytes:
@@ -122,6 +148,65 @@ class _NativeCompressor(Compressor):
         raw = dst.raw
         return [raw[int(dst_offs[i]):int(dst_offs[i + 1])]
                 for i in range(len(chunks))]
+
+
+    @staticmethod
+    def _as_u8(buf) -> np.ndarray:
+        a = np.frombuffer(buf, dtype=np.uint8) if not isinstance(
+            buf, np.ndarray) else buf
+        if a.dtype != np.uint8:
+            a = a.view(np.uint8)
+        return np.ascontiguousarray(a).reshape(-1)
+
+    def compress_iov(self, frames: list) -> tuple:
+        """Zero-copy scatter-gather compression: frames go over the FFI as
+        (pointer, length) pairs; results land in one preallocated numpy
+        buffer. No b''.join, no from_buffer_copy, no .raw re-copy — the
+        write path's staging copies were a measured compaction hot spot."""
+        n = len(frames)
+        if n == 0:
+            return np.zeros(0, np.uint8), np.zeros(0, np.int64), \
+                np.zeros(0, np.int64)
+        arrs = [self._as_u8(f) for f in frames]
+        lens = np.array([a.nbytes for a in arrs], dtype=np.int64)
+        dst_offs = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum([self._max(int(l)) for l in lens], out=dst_offs[1:])
+        dst = np.empty(int(dst_offs[-1]), dtype=np.uint8)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        ptrs = (u8p * n)(*[a.ctypes.data_as(u8p) for a in arrs])
+        sizes = np.zeros(n, dtype=np.int64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        r = self._compress_iov(
+            ptrs, lens.ctypes.data_as(i64p), dst.ctypes.data_as(u8p),
+            dst_offs.ctypes.data_as(i64p), sizes.ctypes.data_as(i64p), n)
+        if r < 0:
+            raise RuntimeError(f"{self.name}: iov compression failed")
+        return dst, dst_offs[:-1], sizes
+
+    def decompress_iov(self, src: np.ndarray, src_offs, src_lens,
+                       dsts: list) -> None:
+        n = len(dsts)
+        if n == 0:
+            return
+        src = np.ascontiguousarray(src.view(np.uint8).reshape(-1))
+        src_offs = np.ascontiguousarray(src_offs, dtype=np.int64)
+        src_lens = np.ascontiguousarray(src_lens, dtype=np.int64)
+        arrs = []
+        for d in dsts:
+            a = d.reshape(-1).view(np.uint8)
+            if not a.flags.c_contiguous:
+                raise ValueError("decompress_iov needs contiguous dsts")
+            arrs.append(a)
+        lens = np.array([a.nbytes for a in arrs], dtype=np.int64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        ptrs = (u8p * n)(*[a.ctypes.data_as(u8p) for a in arrs])
+        r = self._decompress_iov(
+            src.ctypes.data_as(u8p), src_offs.ctypes.data_as(i64p),
+            src_lens.ctypes.data_as(i64p), ptrs,
+            lens.ctypes.data_as(i64p), n)
+        if r < 0:
+            raise ValueError(f"{self.name}: corrupt chunk in iov batch")
 
 
 class LZ4Compressor(_NativeCompressor):

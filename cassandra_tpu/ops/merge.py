@@ -111,25 +111,26 @@ def unpack_masks(packed: np.ndarray):
             (packed & 4).astype(bool), (packed & 8).astype(bool))
 
 
-@jax.jit
-def reconcile_kernel(operands, perm):
-    """Reconcile over a sort permutation. `operands` as in build_operands;
-    returns ONE packed uint8 mask array aligned to SORTED order
-    (bit0=keep, bit1=ambiguous, bit2=expired, bit3=shadowed — decode with
-    unpack_masks). One small transfer instead of four bool arrays.
+def _reconcile_core(lanes, ts_h, ts_l, valid, ldt, expiring, is_cd,
+                    death, purge_h, purge_l, now, gc_before, perm):
+    """Reconcile over a sort permutation; all arrays UNSORTED (gathered
+    through perm here). Returns ONE packed uint8 mask array aligned to
+    SORTED order (bit0=keep, bit1=ambiguous, bit2=expired, bit3=shadowed —
+    decode with unpack_masks). One small transfer instead of four bools.
 
     ambiguous marks records whose (identity, ts) equal the previous sorted
     record — the host picks the winner there with death/value tie-break
     rules (the device sort does not order by them)."""
-    lanes = operands["lanes"][perm]
+    lanes = lanes[perm]
     N, K = lanes.shape
     g = lambda a: a[perm]
-    ts_h, ts_l = g(operands["ts_h"]), g(operands["ts_l"])
-    valid = g(operands["valid"]) == 0
-    ldt = g(operands["ldt"])
-    expiring = g(operands["expiring"]) == 1
-    is_cd = g(operands["cdel"]) == 1
-    purge_h, purge_l = g(operands["purge_h"]), g(operands["purge_l"])
+    ts_h, ts_l = g(ts_h), g(ts_l)
+    valid = g(valid) == 0
+    ldt = g(ldt)
+    expiring = g(expiring) == 1
+    is_cd = g(is_cd) == 1
+    purge_h, purge_l = g(purge_h), g(purge_l)
+    death = g(death) == 1
 
     # ---- boundaries
     prev = jnp.concatenate([jnp.full((1, K), 0xFFFFFFFF, dtype=jnp.uint32),
@@ -172,9 +173,6 @@ def reconcile_kernel(operands, perm):
                             False)))
 
     # ---- TTL expiry + purge
-    now = operands["now"]
-    gc_before = operands["gc_before"]
-    death = g(operands["death"]) == 1
     expired = expiring & (ldt <= now)
     death_eff = death | expired
     purgeable = _lt_pair(ts_h, ts_l, purge_h, purge_l)
@@ -196,6 +194,16 @@ def reconcile_kernel(operands, perm):
     return packed
 
 
+@jax.jit
+def reconcile_kernel(operands, perm):
+    """Dict-operand form (driver entry / shard_map body)."""
+    return _reconcile_core(
+        operands["lanes"], operands["ts_h"], operands["ts_l"],
+        operands["valid"], operands["ldt"], operands["expiring"],
+        operands["cdel"], operands["death"], operands["purge_h"],
+        operands["purge_l"], operands["now"], operands["gc_before"], perm)
+
+
 def merge_reconcile_kernel(operands):
     """Jittable single-call form (driver entry / shard_map body): traced
     sort composition + reconcile. Returns (perm, packed_masks) where
@@ -203,6 +211,103 @@ def merge_reconcile_kernel(operands):
     perm = _traced_sort_perm(operands)
     packed = reconcile_kernel(operands, perm)
     return perm, packed
+
+
+# --------------------------------------------- packed two-push/one-pull path
+
+# meta column layout for the packed transfer path (one [N, 7] uint32 push
+# instead of nine separate arrays — each push through the tunneled chip
+# costs ~50-100ms of latency regardless of size)
+_M_TSH, _M_TSL, _M_LDT, _M_PRGH, _M_PRGL, _M_FLAGS, _M_VALID = range(7)
+_MF_DEATH, _MF_CDEL, _MF_EXPIRING = 1, 2, 4
+
+
+def pack_host(cat: CellBatch, pts: np.ndarray | None,
+              bucket: int | None = None):
+    """Host-side packing of a CellBatch into (lanes [N,K] u32,
+    meta [N,7] u32) padded arrays for the packed device path."""
+    n = len(cat)
+    N = bucket or _bucket(n)
+    K = cat.n_lanes
+    lanes = np.full((N, K), 0xFFFFFFFF, dtype=np.uint32)
+    lanes[:n] = cat.lanes
+    meta = np.zeros((N, 7), dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        uts = cat.ts.astype(np.uint64) ^ np.uint64(1 << 63)
+        meta[:n, _M_TSH] = (uts >> np.uint64(32)).astype(np.uint32)
+        meta[:n, _M_TSL] = (uts & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        meta[:n, _M_LDT] = cat.ldt.astype(np.int32).view(np.uint32)
+        if pts is not None:
+            upts = pts.astype(np.uint64) ^ np.uint64(1 << 63)
+            meta[:n, _M_PRGH] = (upts >> np.uint64(32)).astype(np.uint32)
+            meta[:n, _M_PRGL] = (upts & np.uint64(0xFFFFFFFF)) \
+                .astype(np.uint32)
+        else:
+            meta[:n, _M_PRGH] = 0xFFFFFFFF
+            meta[:n, _M_PRGL] = 0xFFFFFFFF
+    flags = np.zeros(n, dtype=np.uint32)
+    flags |= ((cat.flags & DEATH_FLAGS) != 0).astype(np.uint32) * _MF_DEATH
+    flags |= ((cat.flags & FLAG_COMPLEX_DEL) != 0).astype(np.uint32) \
+        * _MF_CDEL
+    flags |= ((cat.flags & FLAG_EXPIRING) != 0).astype(np.uint32) \
+        * _MF_EXPIRING
+    meta[:n, _M_FLAGS] = flags
+    meta[n:, _M_VALID] = 1
+    return lanes, meta
+
+
+@jax.jit
+def _lsd_pass_desc(key: jnp.ndarray, perm: jnp.ndarray) -> jnp.ndarray:
+    """Descending stable radix pass (for the ~ts keys) — complements the
+    ascending _lsd_pass with the bit-flip fused into the same dispatch."""
+    k = _U32_MAX - key[perm]
+    _, new_perm = jax.lax.sort((k, perm), num_keys=1, is_stable=True)
+    return new_perm
+
+
+@jax.jit
+def _reconcile_packed(lanes, meta, perm, gc_before, now):
+    """Reconcile from the packed (lanes, meta) layout; returns ONE uint32
+    array combining masks and permutation: (packed_masks << 24) | perm.
+    One pull instead of two (pulls through the tunnel run at ~25 MB/s,
+    so bytes AND round-trips both matter). Requires N < 2^24."""
+    fl = meta[:, _M_FLAGS]
+    packed = _reconcile_core(
+        lanes, meta[:, _M_TSH], meta[:, _M_TSL], meta[:, _M_VALID],
+        meta[:, _M_LDT].astype(jnp.int32), (fl >> 2) & 1, (fl >> 1) & 1,
+        fl & 1, meta[:, _M_PRGH], meta[:, _M_PRGL], now, gc_before, perm)
+    return (packed.astype(jnp.uint32) << 24) | perm.astype(jnp.uint32)
+
+
+def packed_sort_reconcile(lanes_np: np.ndarray, meta_np: np.ndarray,
+                          gc_before: int, now: int):
+    """Two pushes, ~K+4 cached-jit sort dispatches, one pull. Sort passes
+    for lanes that are constant across the real cells are skipped (the
+    host sees the numpy arrays; a constant key cannot reorder anything —
+    common tables never touch the collection-path lanes, and single-column
+    workloads skip the column lane too). Returns (perm, packed_masks)
+    numpy arrays of length N (padded)."""
+    n_real = int((meta_np[:, _M_VALID] == 0).sum())
+    varying = [k for k in range(lanes_np.shape[1])
+               if n_real and lanes_np[:n_real, k].min()
+               != lanes_np[:n_real, k].max()]
+    lanes_d = jax.device_put(lanes_np)
+    meta_d = jax.device_put(meta_np)
+    N = lanes_np.shape[0]
+    if N >= (1 << 24):   # output integrity guard, must survive python -O
+        raise ValueError("round too large for the packed perm layout")
+    perm = jnp.arange(N, dtype=jnp.int32)
+    # LSD: least-significant first — ~ts_l, ~ts_h, lanes K-1..0, valid
+    perm = _lsd_pass_desc(meta_d[:, _M_TSL], perm)
+    perm = _lsd_pass_desc(meta_d[:, _M_TSH], perm)
+    for k in reversed(varying):
+        perm = _lsd_pass(lanes_d[:, k], perm)
+    perm = _lsd_pass(meta_d[:, _M_VALID], perm)
+    combined = np.asarray(_reconcile_packed(lanes_d, meta_d, perm,
+                                            jnp.int32(gc_before),
+                                            jnp.int32(now)))
+    return (combined & 0x00FFFFFF).astype(np.int64), \
+        (combined >> 24).astype(np.uint8)
 
 
 
@@ -276,29 +381,51 @@ def build_operands(cat: CellBatch, gc_before: int = 0, now: int = 0,
 
 
 def merge_sorted_device(batches: list[CellBatch], gc_before: int = 0,
-                        now: int = 0, purgeable_ts_fn=None) -> CellBatch:
+                        now: int = 0, purgeable_ts_fn=None,
+                        prof: dict | None = None) -> CellBatch:
     """Drop-in equivalent of storage.cellbatch.merge_sorted running the
-    sort/reconcile on the default JAX device."""
+    sort/reconcile on the default JAX device. `prof` (optional) accumulates
+    per-phase wall seconds: pack / purge_fn / device / gather."""
+    import time as _time
+
+    def _t():
+        return _time.perf_counter()
+
+    from ..storage.cellbatch import merge_sorted as cb_merge_fallback
+
     cat = CellBatch.concat(batches)
     n = len(cat)
     if n == 0:
         return cat
-    operands = build_operands(cat, gc_before, now, purgeable_ts_fn)
-    perm_d = device_sort_perm(operands)
-    packed_d = reconcile_kernel(operands, perm_d)
-    # two pulls total (perm + packed uint8 masks); padded entries sort last
-    perm = np.asarray(perm_d)
-    packed = np.asarray(packed_d)
+    t0 = _t()
+    pts = purgeable_ts_fn(cat).astype(np.int64) \
+        if purgeable_ts_fn is not None else None
+    t1 = _t()
+    if _bucket(n) >= (1 << 24):
+        # the packed perm layout holds 24 bits; a larger round (a single
+        # >16M-cell partition) falls back to the numpy spec path rather
+        # than corrupt indices
+        return cb_merge_fallback(batches, gc_before, now, purgeable_ts_fn)
+    lanes_np, meta_np = pack_host(cat, pts)
+    t2 = _t()
+    perm, packed = packed_sort_reconcile(lanes_np, meta_np, gc_before, now)
+    t3 = _t()
     perm_real = perm[:n]
     keep, ambiguous, expired, shadowed = unpack_masks(packed[:n])
 
     # host tie-break for equal-(identity, ts) runs (host_tiebreak below)
-    pts_sorted = purgeable_ts_fn(cat).astype(np.int64)[perm_real] \
-        if purgeable_ts_fn is not None else None
+    pts_sorted = pts[perm_real] if pts is not None else None
     host_tiebreak(cat, perm_real, keep, ambiguous, shadowed,
                   expired, gc_before, pts_sorted)
 
-    return finalize_merged(cat, perm_real, keep, expired, shadowed)
+    out = finalize_merged(cat, perm_real, keep, expired, shadowed)
+    t4 = _t()
+    if prof is not None:
+        prof["purge_fn"] = prof.get("purge_fn", 0.0) + (t1 - t0)
+        prof["pack"] = prof.get("pack", 0.0) + (t2 - t1)
+        prof["device"] = prof.get("device", 0.0) + (t3 - t2)
+        prof["gather"] = prof.get("gather", 0.0) + (t4 - t3)
+    return out
 
 
 def finalize_merged(cat: CellBatch, perm_real: np.ndarray,

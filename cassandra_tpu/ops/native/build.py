@@ -14,6 +14,7 @@ import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "codec.cpp")
+_SRCS = [os.path.join(_DIR, f) for f in ("codec.cpp", "merge.cpp")]
 _SO = os.path.join(_DIR, "libcodec.so")
 _STAMP = _SO + ".srchash"
 _lock = threading.Lock()
@@ -22,10 +23,12 @@ _load_error = None  # negative cache: don't re-run g++ per call on failure
 
 
 def _src_hash() -> str:
-    with open(_SRC, "rb") as f:
-        src = f.read()
-    # stamp covers source AND host (a -march=native binary from a different
-    # CPU must never be loaded: SIGILL)
+    src = b""
+    for p in _SRCS:
+        with open(p, "rb") as f:
+            src += f.read()
+    # stamp covers sources AND host (a -march=native binary from a
+    # different CPU must never be loaded: SIGILL)
     host = f"{platform.machine()}|{platform.processor()}|{platform.node()}"
     return hashlib.sha256(src + host.encode()).hexdigest()
 
@@ -33,7 +36,7 @@ def _src_hash() -> str:
 def _build(h: str) -> None:
     tmp = f"{_SO}.tmp.{os.getpid()}"  # unique per process: no build races
     cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-           "-o", tmp, _SRC]
+           "-o", tmp] + _SRCS
     subprocess.run(cmd, check=True, capture_output=True)
     os.replace(tmp, _SO)
     with open(_STAMP + f".{os.getpid()}", "w") as f:
@@ -86,7 +89,24 @@ def load() -> ctypes.CDLL:
             f = getattr(lib, fn)
             f.restype = i64
             f.argtypes = [u8p, i64p, u8p, i64p, i64p, i64]
+        u8pp = ctypes.POINTER(u8p)
+        for fn in ("lz4_compress_iov", "snappy_compress_iov"):
+            f = getattr(lib, fn)
+            f.restype = i64
+            f.argtypes = [u8pp, i64p, u8p, i64p, i64p, i64]
+        for fn in ("lz4_decompress_iov", "snappy_decompress_iov"):
+            f = getattr(lib, fn)
+            f.restype = i64
+            f.argtypes = [u8p, i64p, i64p, u8pp, i64p, i64]
         lib.gather_frames.restype = i64
         lib.gather_frames.argtypes = [u8p, i64p, i64p, i64, i64p, u8p]
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.merge_reconcile.restype = i64
+        lib.merge_reconcile.argtypes = [
+            u32p, i64p, i32p, u8p, i64p, i64p, u8p, i64,  # batch arrays, K
+            i64p, i64,                                    # run_starts, n
+            i64p, i64, i64,                               # pts, gc, now
+            i64p, u8p]                                    # out_idx, out_exp
         _lib = lib
         return _lib

@@ -163,49 +163,58 @@ class SSTableReader:
     def _read_segment(self, i: int) -> CellBatch:
         n = int(self._seg_n[i])
         pos = int(self._seg_off[i])
-        blocks = []
-        lens = []
+        cls = [int(self._blk[i, b, 0]) for b in range(3)]
+        uls = [int(self._blk[i, b, 1]) for b in range(3)]
+        crcs = [int(self._blk[i, b, 2]) for b in range(3)]
+        # ONE pread for all three blocks (they are adjacent on disk), then
+        # decompress straight into the arrays the CellBatch will own —
+        # no per-block bytes objects, no post-decode astype copies.
+        # pread: stateless positional read — readers share this handle
+        # across threads (reference: FileHandle/RandomAccessReader are
+        # per-thread; pread avoids the seek/read race entirely)
+        raw = os.pread(self._data.fileno(), sum(cls), pos)
+        src = np.frombuffer(raw, dtype=np.uint8)
+        offs = [0, cls[0], cls[0] + cls[1]]
         for b in range(3):
-            cl, ul, crc = (int(x) for x in self._blk[i, b])
-            # pread: stateless positional read — readers share this handle
-            # across threads (reference: FileHandle/RandomAccessReader are
-            # per-thread; pread avoids the seek/read race entirely)
-            raw = os.pread(self._data.fileno(), cl, pos)
-            pos += cl
-            if zlib.crc32(raw) != crc:
+            if zlib.crc32(memoryview(raw)[offs[b]:offs[b] + cls[b]]) \
+                    != crcs[b]:
                 raise CorruptSSTableError(
                     f"{self.desc}: segment {i} block {b} CRC mismatch")
-            blocks.append(raw)
-            lens.append(ul)
-        if self.params.enabled:
-            out = []
-            for raw, ul in zip(blocks, lens):
-                if len(raw) == ul:  # stored uncompressed (ratio fallback)
-                    out.append(raw)
-                else:
-                    out.append(self.compressor.uncompress(raw, ul))
-            blocks = out
-        meta, lanes_b, payload_b = blocks
 
-        ts = np.frombuffer(meta, dtype="<i8", count=n, offset=0)
+        meta = np.empty(uls[0], dtype=np.uint8)
+        lanes = np.empty((n, self.K), dtype=np.uint32)
+        payload = np.empty(uls[2], dtype=np.uint8)
+        dsts = [meta, lanes, payload]
+        iov_offs, iov_lens, iov_dsts = [], [], []
+        for b in range(3):
+            if not self.params.enabled or cls[b] == uls[b]:
+                # stored uncompressed (ratio fallback): straight memcpy
+                dsts[b].reshape(-1).view(np.uint8)[:] = \
+                    src[offs[b]:offs[b] + cls[b]]
+            else:
+                iov_offs.append(offs[b])
+                iov_lens.append(cls[b])
+                iov_dsts.append(dsts[b])
+        if iov_dsts:
+            self.compressor.decompress_iov(src, iov_offs, iov_lens,
+                                           iov_dsts)
+
+        ts = meta[:8 * n].view("<i8")
         o = 8 * n
-        ldt = np.frombuffer(meta, dtype="<i4", count=n, offset=o)
+        ldt = meta[o:o + 4 * n].view("<i4")
         o += 4 * n
-        ttl = np.frombuffer(meta, dtype="<i4", count=n, offset=o)
+        ttl = meta[o:o + 4 * n].view("<i4")
         o += 4 * n
-        flags = np.frombuffer(meta, dtype="u1", count=n, offset=o)
+        flags = meta[o:o + n]
         o += n
-        off = np.frombuffer(meta, dtype="<i8", count=n + 1, offset=o)
+        off = meta[o:o + 8 * (n + 1)].view("<i8")
         o += 8 * (n + 1)
-        val_start = np.frombuffer(meta, dtype="<i8", count=n, offset=o)
-        lanes = np.frombuffer(lanes_b, dtype="<u4").reshape(n, self.K)
-        payload = np.frombuffer(payload_b, dtype=np.uint8)
+        val_start = meta[o:o + 8 * n].view("<i8")
 
-        batch = CellBatch(
-            lanes.astype(np.uint32), ts.astype(np.int64),
-            ldt.astype(np.int32), ttl.astype(np.int32),
-            flags.astype(np.uint8), off.astype(np.int64),
-            val_start.astype(np.int64), payload.copy(), {}, sorted=True)
+        batch = CellBatch(lanes, ts.view(np.int64), ldt.view(np.int32),
+                          ttl.view(np.int32), flags, off.view(np.int64),
+                          val_start.view(np.int64), payload, {},
+                          sorted=True)
         self._fill_pk_map(batch, i)
         return batch
 

@@ -37,7 +37,10 @@ class SSTableWriter:
         self.K = None  # lanes, learned from first batch
 
         os.makedirs(descriptor.directory, exist_ok=True)
-        self._data = open(descriptor.tmp_path(Component.DATA), "wb")
+        # unbuffered: segment blocks are MB-sized memoryviews already —
+        # BufferedWriter would only add a copy per write
+        self._data = open(descriptor.tmp_path(Component.DATA), "wb",
+                          buffering=0)
         self._data_crc = 0
         self._data_off = 0
         self._index_entries: list[bytes] = []
@@ -110,6 +113,15 @@ class SSTableWriter:
         self._fsync_path(self.desc.directory)
         self._finished = True
         return stats
+
+    def _write_all(self, mv: memoryview) -> None:
+        """Raw FileIO.write may write short (and caps single writes around
+        2 GiB on Linux) — loop until every byte lands."""
+        while mv.nbytes:
+            n = self._data.write(mv)
+            if n is None or n <= 0:
+                raise OSError("short write to Data.db")
+            mv = mv[n:]
 
     @staticmethod
     def _fsync_path(path: str) -> None:
@@ -203,33 +215,43 @@ class SSTableWriter:
         self._stats["tombstones"] += int(
             ((seg.flags & DEATH_FLAGS) != 0).sum())
 
-        # --- blocks
-        off_rel = (seg.off - seg.off[0]).astype(np.int64)
-        vs_rel = (seg.val_start - seg.off[0]).astype(np.int64)
-        meta = b"".join([
-            seg.ts.astype("<i8").tobytes(),
-            seg.ldt.astype("<i4").tobytes(),
-            seg.ttl.astype("<i4").tobytes(),
-            seg.flags.astype("u1").tobytes(),
-            off_rel.astype("<i8").tobytes(),
-            vs_rel.astype("<i8").tobytes(),
-        ])
-        lanes_b = seg.lanes.astype("<u4").tobytes()
-        payload_b = seg.payload.tobytes()
+        # --- blocks: vectorized serialization into one scratch buffer,
+        # then zero-copy scatter-gather compression (the previous
+        # tobytes/join/ctypes staging copied every byte ~4x — measured as
+        # the dominant write-path cost)
+        off_rel = (seg.off - seg.off[0]).astype("<i8")
+        vs_rel = (seg.val_start - seg.off[0]).astype("<i8")
+        # ts 8 + ldt 4 + ttl 4 + flags 1 + off 8 + val_start 8 = 33 B/cell,
+        # plus the off array's extra (n+1)th entry
+        meta = np.empty(n * 33 + 8, dtype=np.uint8)
+        pos = 0
+        for arr, width in ((seg.ts.astype("<i8", copy=False), 8),
+                           (seg.ldt.astype("<i4", copy=False), 4),
+                           (seg.ttl.astype("<i4", copy=False), 4),
+                           (seg.flags.astype("u1", copy=False), 1),
+                           (off_rel, 8), (vs_rel, 8)):
+            end = pos + (n + 1 if arr is off_rel else n) * width
+            meta[pos:end] = np.ascontiguousarray(arr).view(np.uint8)
+            pos = end
+        meta = meta[:pos]
+        lanes_b = np.ascontiguousarray(seg.lanes.astype("<u4", copy=False))
+        payload_b = np.ascontiguousarray(seg.payload)
         blocks = [meta, lanes_b, payload_b]
-        comp = self.compressor.compress_batch(blocks)
+        dst, dst_offs, sizes = self.compressor.compress_iov(blocks)
         # min_compress_ratio fallback: store uncompressed when too poor
         # (CompressedSequentialWriter.java:160-175 semantics)
         maxlen = self.params.max_compressed_length
         entry = struct.pack("<QI", self._data_off, n)
-        for raw, c in zip(blocks, comp):
-            if len(c) >= min(len(raw), maxlen):
+        for i, raw in enumerate(blocks):
+            c = dst[int(dst_offs[i]):int(dst_offs[i]) + int(sizes[i])]
+            if c.nbytes >= min(raw.nbytes, maxlen):
                 c = raw
-            crc = zlib.crc32(c)
-            entry += struct.pack("<QQI", len(c), len(raw), crc)
-            self._data.write(c)
-            self._data_crc = zlib.crc32(c, self._data_crc)
-            self._data_off += len(c)
+            mv = memoryview(c).cast("B")
+            crc = zlib.crc32(mv)
+            entry += struct.pack("<QQI", c.nbytes, raw.nbytes, crc)
+            self._write_all(mv)
+            self._data_crc = zlib.crc32(mv, self._data_crc)
+            self._data_off += c.nbytes
         entry += seg.lanes[0].astype("<u4").tobytes()
         entry += seg.lanes[-1].astype("<u4").tobytes()
         self._index_entries.append(entry)
