@@ -186,6 +186,8 @@ class StorageProxy:
                digest_targets):
         """One round: full READ_REQ to data_targets, digest-only READ_REQ
         to digest_targets. Returns ([(ep, batch)], [(ep, digest)])."""
+        ck_comp = self.node.schema.get_table(
+            keyspace, table_name).clustering_comp
         handler = _Await(len(data_targets) + len(digest_targets))
         results: list = []
         digests: list = []
@@ -208,7 +210,9 @@ class StorageProxy:
                         if dg:
                             digests.append((t, m.payload))
                         else:
-                            results.append((t, cb_deserialize(m.payload)))
+                            b = cb_deserialize(m.payload)
+                            b.ck_comp = ck_comp
+                            results.append((t, b))
                     handler.ack()
                 self.messaging.send_with_callback(
                     Verb.READ_REQ,
@@ -250,6 +254,8 @@ class StorageProxy:
         peer; dead peers are only tolerable when surviving replicas can
         still cover the ring (approximated here by requiring all-live for
         CL above ONE)."""
+        ck_comp = self.node.schema.get_table(
+            keyspace, table_name).clustering_comp
         all_eps = list(self.node.ring.endpoints)
         peers = [e for e in all_eps if self.node.is_alive(e)]
         if len(peers) < len(all_eps) and cl not in (ConsistencyLevel.ONE,
@@ -271,7 +277,9 @@ class StorageProxy:
             else:
                 def on_rsp(m):
                     with lock:
-                        results.append(cb_deserialize(m.payload))
+                        b = cb_deserialize(m.payload)
+                        b.ck_comp = ck_comp
+                        results.append(b)
                     handler.ack()
                 self.messaging.send_with_callback(
                     Verb.RANGE_REQ, (keyspace, table_name), target,

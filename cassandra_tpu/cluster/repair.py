@@ -205,7 +205,12 @@ class RepairService:
             on_response=on_rsp, timeout=timeout)
         if not ev.wait(timeout):
             raise TimeoutError(f"sync fetch from {ep} timed out")
-        return holder["batch"]
+        batch = holder["batch"]
+        # deserialized batches lose the ck composite translator; range
+        # tombstone reconciliation needs it back
+        t = node.schema.get_table(keyspace, table_name)
+        batch.ck_comp = t.clustering_comp
+        return batch
 
     def _apply_batch(self, ep, table, merged: cb.CellBatch):
         """Push the merged truth for a range to a replica, one partition

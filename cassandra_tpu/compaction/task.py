@@ -287,7 +287,7 @@ class CompactionTask:
                         # roll the output (MaxSSTableSizeWriter role)
                         wstate["writer"].finish()
                         new_readers.append(
-                            SSTableReader(wstate["writer"].desc))
+                            SSTableReader(wstate["writer"].desc, table))
                         wstate["writer"] = new_writer()
             except BaseException as e:   # surfaced after join
                 werr.append(e)
@@ -302,6 +302,8 @@ class CompactionTask:
             wthread.start()
             cursors = [_Cursor(r, prof) for r in self.inputs]
             while True:
+                if werr:       # writer died: fail fast, don't keep merging
+                    break
                 active = [c for c in cursors if c.has_data]
                 if not active:
                     break
@@ -339,7 +341,7 @@ class CompactionTask:
             writer.finish()
             prof["write"] = prof.get("write", 0.0) + \
                 (time.perf_counter() - tw)
-            new_readers.append(SSTableReader(writer.desc))
+            new_readers.append(SSTableReader(writer.desc, table))
             for r in self.inputs:
                 txn.track_obsolete(r.desc.generation)
             # empty outputs (everything purged) die in the same txn

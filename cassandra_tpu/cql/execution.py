@@ -270,6 +270,52 @@ class Executor:
             gr.check_in_cartesian(len(combos))
         return [table.serialize_partition_key(c) for c in combos]
 
+    def _range_delete_slice(self, table, ck_rel, ts, now_s):
+        """DELETE with clustering restrictions: None for full-equality
+        (exact row delete), else the range-tombstone Slice — an equality
+        prefix plus optional inequalities on the next column (reference
+        ClusteringBound semantics: prefix deletes and slice deletes)."""
+        from ..storage.rangetomb import Slice
+
+        eq_vals: list = []
+        ineqs: list[tuple[str, object]] = []
+        seen_end = False
+        for c in table.clustering_columns:
+            rels = ck_rel.get(c.name)
+            if rels is None:
+                seen_end = True
+                continue
+            if seen_end:
+                raise InvalidRequest(
+                    f"DELETE restriction on {c.name} skips a clustering "
+                    "column")
+            ops = [op for op, _ in rels]
+            if ops == ["="] and not ineqs:
+                eq_vals.append(rels[0][1])
+                continue
+            for op, v in rels:
+                if op not in (">", ">=", "<", "<="):
+                    raise InvalidRequest(
+                        f"unsupported DELETE restriction {op} on {c.name}")
+                ineqs.append((op, v))
+            seen_end = True
+        if len(eq_vals) == len(table.clustering_columns):
+            return None
+        prefix = table.clustering_bytecomp(eq_vals) if eq_vals else b""
+        start, start_incl = prefix, True
+        end, end_incl = prefix, True
+        for op, v in ineqs:
+            bcomp = table.clustering_bytecomp(eq_vals + [v])
+            if op == ">":
+                start, start_incl = bcomp, False
+            elif op == ">=":
+                start, start_incl = bcomp, True
+            elif op == "<":
+                end, end_incl = bcomp, False
+            else:
+                end, end_incl = bcomp, True
+        return Slice(start, start_incl, end, end_incl, ts, now_s)
+
     def _full_ck(self, table, ck_rel, params=()):
         """Full-equality clustering frame (for writes)."""
         vals = []
@@ -698,9 +744,18 @@ class Executor:
                 m.add(b"", schema_mod.COL_PARTITION_DEL, b"", b"", ts, now_s,
                       0, cb.FLAG_PARTITION_DEL)
             else:
-                ck = self._full_ck(t, ck_rel)
-                m.add(ck, schema_mod.COL_ROW_DEL, b"", b"", ts, now_s, 0,
-                      cb.FLAG_ROW_DEL)
+                slc = self._range_delete_slice(t, ck_rel, ts, now_s)
+                if slc is None:
+                    # full clustering equality: exact row deletion
+                    ck = self._full_ck(t, ck_rel)
+                    m.add(ck, schema_mod.COL_ROW_DEL, b"", b"", ts, now_s,
+                          0, cb.FLAG_ROW_DEL)
+                else:
+                    # clustering range / prefix: range tombstone slice
+                    # (db/RangeTombstone.java; storage/rangetomb.py)
+                    m.add(slc.start, schema_mod.COL_RANGE_TOMB,
+                          slc.encode_path(), b"", ts, now_s, 0,
+                          cb.FLAG_RANGE_BOUND | cb.FLAG_TOMBSTONE)
             self.backend.apply(m)
         if s.if_exists or s.conditions:
             return APPLIED

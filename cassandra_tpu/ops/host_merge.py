@@ -19,7 +19,8 @@ import ctypes
 import numpy as np
 
 from ..storage import cellbatch as cb
-from ..storage.cellbatch import FLAG_COUNTER, FLAG_TOMBSTONE, CellBatch
+from ..storage.cellbatch import (FLAG_COUNTER, FLAG_RANGE_BOUND,
+                                 FLAG_TOMBSTONE, CellBatch)
 
 
 _lib = None
@@ -51,7 +52,8 @@ def merge_sorted_native(batches: list[CellBatch], gc_before: int = 0,
         return CellBatch.empty()
     if not available() or len(batches) > 64 \
             or not all(b.sorted for b in batches) \
-            or any((b.flags & FLAG_COUNTER).any() for b in batches):
+            or any((b.flags & (FLAG_COUNTER | FLAG_RANGE_BOUND)).any()
+                   for b in batches):
         return cb.merge_sorted(batches, gc_before=gc_before, now=now,
                                purgeable_ts_fn=purgeable_ts_fn)
 

@@ -34,7 +34,8 @@ import numpy as np
 from ..schema import COL_PARTITION_DEL, COL_ROW_DEL
 from ..storage.cellbatch import (DEATH_FLAGS, FLAG_COMPLEX_DEL, FLAG_COUNTER,
                                  FLAG_EXPIRING, FLAG_PARTITION_DEL,
-                                 FLAG_ROW_DEL, FLAG_TOMBSTONE, CellBatch,
+                                 FLAG_RANGE_BOUND, FLAG_ROW_DEL,
+                                 FLAG_TOMBSTONE, CellBatch,
                                  apply_counter_sums, sum_counter_runs)
 
 _U32_MAX = jnp.uint32(0xFFFFFFFF)
@@ -401,10 +402,11 @@ def merge_sorted_device(batches: list[CellBatch], gc_before: int = 0,
     pts = purgeable_ts_fn(cat).astype(np.int64) \
         if purgeable_ts_fn is not None else None
     t1 = _t()
-    if _bucket(n) >= (1 << 24):
-        # the packed perm layout holds 24 bits; a larger round (a single
-        # >16M-cell partition) falls back to the numpy spec path rather
-        # than corrupt indices
+    if _bucket(n) >= (1 << 24) or \
+            ((cat.flags & FLAG_RANGE_BOUND) != 0).any():
+        # fall back to the numpy spec path: the packed perm layout holds
+        # 24 bits (a single >16M-cell partition overflows it), and range
+        # tombstone coverage is evaluated host-side on full composites
         return cb_merge_fallback(batches, gc_before, now, purgeable_ts_fn)
     lanes_np, meta_np = pack_host(cat, pts)
     t2 = _t()

@@ -17,6 +17,7 @@ from .types import CQLType, parse_type
 COL_PARTITION_DEL = 0   # partition-level deletion record
 COL_ROW_DEL = 1         # row-level deletion record
 COL_ROW_LIVENESS = 2    # primary-key liveness (row exists even if all null)
+COL_RANGE_TOMB = 3      # range tombstone slice (storage/rangetomb.py)
 COL_REGULAR_BASE = 8    # first real column id
 
 

@@ -160,6 +160,10 @@ class CellBatch:
     sorted: bool = False
 
     last_shadowed = None  # set by reconcile(); consumed by counter summing
+    # serialized-ck-frame -> byte-comparable composite translator
+    # (table.clustering_comp). Set by builders/readers that know the
+    # table; needed only when range tombstones are reconciled.
+    ck_comp = None
 
     def __len__(self) -> int:
         return len(self.ts)
@@ -252,10 +256,12 @@ class CellBatch:
         else:
             new_payload = np.zeros(0, dtype=np.uint8)
         new_val_start = new_off[:-1] + (self.val_start - self.off[:-1])[perm]
-        return CellBatch(self.lanes[perm], self.ts[perm], self.ldt[perm],
-                         self.ttl[perm], self.flags[perm], new_off,
-                         new_val_start, new_payload, dict(self.pk_map),
-                         sorted=True)
+        out = CellBatch(self.lanes[perm], self.ts[perm], self.ldt[perm],
+                        self.ttl[perm], self.flags[perm], new_off,
+                        new_val_start, new_payload, dict(self.pk_map),
+                        sorted=True)
+        out.ck_comp = self.ck_comp
+        return out
 
     # ------------------------------------------------------------ concat --
 
@@ -264,12 +270,14 @@ class CellBatch:
         batch (callers must not mutate either). The payload offsets are
         rebased (the only small copy)."""
         base = int(self.off[lo])
-        return CellBatch(self.lanes[lo:hi], self.ts[lo:hi], self.ldt[lo:hi],
-                         self.ttl[lo:hi], self.flags[lo:hi],
-                         self.off[lo:hi + 1] - base,
-                         self.val_start[lo:hi] - base,
-                         self.payload[base:int(self.off[hi])],
-                         self.pk_map, sorted=self.sorted)
+        out = CellBatch(self.lanes[lo:hi], self.ts[lo:hi], self.ldt[lo:hi],
+                        self.ttl[lo:hi], self.flags[lo:hi],
+                        self.off[lo:hi + 1] - base,
+                        self.val_start[lo:hi] - base,
+                        self.payload[base:int(self.off[hi])],
+                        self.pk_map, sorted=self.sorted)
+        out.ck_comp = self.ck_comp
+        return out
 
     def drop_values(self, mask: np.ndarray) -> "CellBatch":
         """Rewrite the payload with value bytes removed for masked cells
@@ -288,9 +296,11 @@ class CellBatch:
         flat_idx = np.repeat(self.off[:-1], new_lens) + pos_in_cell
         new_payload = self.payload[flat_idx]
         header_lens = self.val_start - self.off[:-1]
-        return CellBatch(self.lanes, self.ts, self.ldt, self.ttl, self.flags,
-                         new_off, new_off[:-1] + header_lens,
-                         new_payload, dict(self.pk_map), sorted=self.sorted)
+        out = CellBatch(self.lanes, self.ts, self.ldt, self.ttl, self.flags,
+                        new_off, new_off[:-1] + header_lens,
+                        new_payload, dict(self.pk_map), sorted=self.sorted)
+        out.ck_comp = self.ck_comp
+        return out
 
     @staticmethod
     def concat(batches: list["CellBatch"]) -> "CellBatch":
@@ -321,8 +331,13 @@ class CellBatch:
                 if prev is not None and prev != v:
                     raise RuntimeError("128-bit partition-key hash collision")
                 pk_map[k] = v
-        return CellBatch(lanes, ts, ldt, ttl, flags, off, val_start, payload,
-                         pk_map, sorted=False)
+        out = CellBatch(lanes, ts, ldt, ttl, flags, off, val_start, payload,
+                        pk_map, sorted=False)
+        for b in batches:
+            if b.ck_comp is not None:
+                out.ck_comp = b.ck_comp
+                break
+        return out
 
     @staticmethod
     def empty(n_lanes: int = 13) -> "CellBatch":
@@ -451,14 +466,74 @@ class CellBatch:
 
         is_pd = col == COL_PARTITION_DEL
         is_rd = col == COL_ROW_DEL
+        is_range = (self.flags & FLAG_RANGE_BOUND) != 0
         shadowed = np.zeros(n, dtype=bool)
         # cells and liveness: deleted if ts <= enclosing deletion ts
-        plain = ~is_pd & ~is_rd & ~is_cd
+        plain = ~is_pd & ~is_rd & ~is_cd & ~is_range
         shadowed[plain] = self.ts[plain] <= cd_of[plain]
         # row deletions superseded by the partition deletion; complex
         # deletions superseded by row/partition deletions
         shadowed[is_rd] = self.ts[is_rd] <= pd_of[is_rd]
         shadowed[is_cd] = self.ts[is_cd] <= rd_of[is_cd]
+
+        # 3b. range tombstones (storage/rangetomb.py): per affected
+        # partition, winner slices cover rows by full byte-comparable
+        # composite — the marker's stream position is not load-bearing.
+        # Zero cost when no FLAG_RANGE_BOUND cell is present.
+        if is_range.any():
+            from .rangetomb import Slice, covering_ts
+            if self.ck_comp is None:
+                raise RuntimeError(
+                    "range tombstones require batch.ck_comp (open the "
+                    "sstable/builder with its table)")
+            cover = np.full(n, NO_TIMESTAMP, dtype=np.int64)
+            comp_cache: dict[bytes, bytes] = {}
+            # part_id is sorted ascending: locate each affected
+            # partition's run with searchsorted, not a full rescan —
+            # per-partition prefix deletes are a common pattern and a
+            # linear scan per marker partition would be O(n * partitions)
+            rt_parts = np.unique(part_id[is_range])
+            run_bounds = np.searchsorted(part_id, [rt_parts, rt_parts + 1])
+            for p, lo_i, hi_i in zip(rt_parts, run_bounds[0],
+                                     run_bounds[1]):
+                members = np.arange(int(lo_i), int(hi_i))
+                slices: list = []
+                slice_idx: list[int] = []
+                for i in members[is_range[members] & winner[members]]:
+                    ck, path, _ = self.cell_payload(int(i))
+                    slices.append(Slice.from_cell(
+                        ck, path, int(self.ts[i]), int(self.ldt[i])))
+                    slice_idx.append(int(i))
+                if not slices:
+                    continue
+                for i in members:
+                    if is_range[i]:
+                        continue
+                    ck = self.cell_payload(int(i))[0]
+                    if not ck:
+                        continue   # static row is never range-covered
+                    compv = comp_cache.get(ck)
+                    if compv is None:
+                        compv = self.ck_comp(ck)
+                        comp_cache[ck] = compv
+                    cover[i] = covering_ts(slices, compv)
+                # a slice fully contained in a newer (or equal-ts,
+                # earlier-seen) slice is redundant — dropped like the
+                # reference's RangeTombstoneList normalization
+                for j, (sl, i) in enumerate(zip(slices, slice_idx)):
+                    for k2, other in enumerate(slices):
+                        if k2 == j or not other.contains(sl):
+                            continue
+                        if other.ts > sl.ts or \
+                                (other.ts == sl.ts and k2 < j):
+                            shadowed[i] = True
+                            break
+            shadowed[plain] |= self.ts[plain] <= cover[plain]
+            shadowed[is_rd] |= self.ts[is_rd] <= cover[is_rd]
+            shadowed[is_cd] |= self.ts[is_cd] <= cover[is_cd]
+            # range markers themselves: only the partition deletion (or a
+            # containing slice, handled above) supersedes them
+            shadowed[is_range] |= self.ts[is_range] <= pd_of[is_range]
 
         # 4. purge gc-able tombstones (incl. expired-TTL converted ones)
         death = ((self.flags & DEATH_FLAGS) != 0)
@@ -507,16 +582,20 @@ class CellBatchBuilder:
             raise RuntimeError("128-bit partition-key hash collision")
         return lanes
 
-    def _ck_lanes(self, ck_frame: bytes) -> tuple:
+    def _ck_lanes(self, ck_frame: bytes, is_comp: bool = False) -> tuple:
         """ck_frame is the SERIALIZED clustering tuple (payload form);
-        lanes come from its byte-comparable composite."""
+        lanes come from its byte-comparable composite. is_comp=True means
+        the bytes ARE already a composite (range-tombstone bounds)."""
         if not ck_frame:
             return (0,) * (self.C + 2)
-        comp = self._comp_cache.get(ck_frame)
-        if comp is None:
-            comp = self.table.clustering_comp(ck_frame)
-            if len(self._comp_cache) < 65536:
-                self._comp_cache[ck_frame] = comp
+        if is_comp:
+            comp = ck_frame
+        else:
+            comp = self._comp_cache.get(ck_frame)
+            if comp is None:
+                comp = self.table.clustering_comp(ck_frame)
+                if len(self._comp_cache) < 65536:
+                    self._comp_cache[ck_frame] = comp
         pref = _pack_prefix(comp, self.C)
         h1, _ = murmur3.hash128(comp)
         return (*pref, h1 >> 32, h1 & _U32)
@@ -531,8 +610,9 @@ class CellBatchBuilder:
     def append_raw(self, pk: bytes, ck: bytes, column: int, path: bytes,
                    value: bytes, ts: int, ldt: int = NO_DELETION_TIME,
                    ttl: int = 0, flags: int = 0) -> None:
-        lanes = (*self._pk_lanes(pk), *self._ck_lanes(ck), column,
-                 *self._path_lanes(path))
+        lanes = (*self._pk_lanes(pk),
+                 *self._ck_lanes(ck, is_comp=bool(flags & FLAG_RANGE_BOUND)),
+                 column, *self._path_lanes(path))
         assert len(lanes) == self.K
         self._lanes.append(lanes)
         self._ts.append(ts)
@@ -588,12 +668,22 @@ class CellBatchBuilder:
         self.append_raw(pk, ck, column_id, b"", b"", ts, ldt=ldt,
                         flags=FLAG_COMPLEX_DEL)
 
+    def add_range_tombstone(self, pk: bytes, slc) -> None:
+        """Range tombstone slice (storage/rangetomb.py Slice): one cell at
+        COL_RANGE_TOMB whose ck frame is the start bound and whose path
+        encodes the kinds + end bound — identical re-writes share an
+        identity and reconcile newest-wins like any cell."""
+        from ..schema import COL_RANGE_TOMB
+        self.append_raw(pk, slc.start, COL_RANGE_TOMB, slc.encode_path(),
+                        b"", slc.ts, ldt=slc.ldt,
+                        flags=FLAG_RANGE_BOUND | FLAG_TOMBSTONE)
+
     # --------------------------------------------------------------- seal --
 
     def seal(self) -> CellBatch:
         n = len(self._ts)
         lanes = np.array(self._lanes, dtype=np.uint32).reshape(n, self.K)
-        return CellBatch(
+        out = CellBatch(
             lanes,
             np.array(self._ts, dtype=np.int64),
             np.array(self._ldt, dtype=np.int32),
@@ -603,6 +693,8 @@ class CellBatchBuilder:
             np.array(self._val_start, dtype=np.int64),
             np.frombuffer(bytes(self._payload), dtype=np.uint8).copy(),
             dict(self.pk_map))
+        out.ck_comp = self.table.clustering_comp
+        return out
 
 
 def sum_counter_runs(sorted_batch: "CellBatch", keep: np.ndarray,

@@ -15,8 +15,9 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from ..schema import (COL_PARTITION_DEL, COL_REGULAR_BASE, COL_ROW_DEL,
-                      COL_ROW_LIVENESS, TableMetadata)
+from ..schema import (COL_PARTITION_DEL, COL_RANGE_TOMB,
+                      COL_REGULAR_BASE, COL_ROW_DEL, COL_ROW_LIVENESS,
+                      TableMetadata)
 from ..types.marshal import ListType, MapType, SetType
 from .cellbatch import FLAG_COMPLEX_DEL, FLAG_TOMBSTONE, CellBatch
 
@@ -51,7 +52,7 @@ def rows_from_batch(table: TableMetadata, batch: CellBatch):
     current: RowData | None = None
     for i in range(n):
         col = int(col_lane[i])
-        if col == COL_PARTITION_DEL or col == COL_ROW_DEL:
+        if col in (COL_PARTITION_DEL, COL_ROW_DEL, COL_RANGE_TOMB):
             continue  # markers only matter to merges; reads skip them
         flags = int(batch.flags[i])
         ck, path, value = batch.cell_payload(i)

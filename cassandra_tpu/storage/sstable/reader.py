@@ -30,7 +30,11 @@ class CorruptSSTableError(Exception):
 
 
 class SSTableReader:
-    def __init__(self, descriptor: Descriptor):
+    def __init__(self, descriptor: Descriptor, table=None):
+        # table is optional: offline tools read without schema, but range
+        # tombstone reconciliation needs table.clustering_comp — batches
+        # decoded here carry it as ck_comp when the table is known
+        self._table = table
         self.desc = descriptor
         with open(descriptor.path(Component.STATS)) as f:
             self.stats = json.load(f)
@@ -215,6 +219,8 @@ class SSTableReader:
                           ttl.view(np.int32), flags, off.view(np.int64),
                           val_start.view(np.int64), payload, {},
                           sorted=True)
+        if self._table is not None:
+            batch.ck_comp = self._table.clustering_comp
         self._fill_pk_map(batch, i)
         return batch
 

@@ -66,7 +66,7 @@ class ColumnFamilyStore:
         from .lifecycle import replay_directory
         replay_directory(self.directory)
         for desc in Descriptor.list_in(self.directory):
-            self.tracker.add(SSTableReader(desc))
+            self.tracker.add(SSTableReader(desc, self.table))
         self.compaction_listener = None  # set by CompactionManager
         self.compaction_history: list[dict] = []
         self._gen_lock = threading.Lock()
@@ -84,7 +84,7 @@ class ColumnFamilyStore:
             known = {s.desc.generation for s in self.tracker.view()}
             for desc in Descriptor.list_in(self.directory):
                 if desc.generation not in known:
-                    self.tracker.add(SSTableReader(desc))
+                    self.tracker.add(SSTableReader(desc, self.table))
                     self._last_gen = max(self._last_gen, desc.generation)
 
     def next_generation(self) -> int:
@@ -140,7 +140,7 @@ class ColumnFamilyStore:
             except BaseException:
                 writer.abort()
                 raise
-            reader = SSTableReader(desc)
+            reader = SSTableReader(desc, self.table)
             self.tracker.add(reader)
             self.metrics["flushes"] += 1
             self.metrics["bytes_flushed"] += reader.data_size

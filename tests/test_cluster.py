@@ -397,3 +397,18 @@ def test_quorum_unavailable_on_undersized_ring(tmp_path):
             s.execute("SELECT v FROM kv WHERE k = 1")
     finally:
         c.shutdown()
+
+
+def test_range_delete_replicates(cluster):
+    s = cluster.session(1)
+    s.keyspace = "ks"
+    s.execute("CREATE TABLE rd (k int, c int, v text, PRIMARY KEY (k, c))")
+    cluster.node(1).default_cl = ConsistencyLevel.ALL
+    for c in range(6):
+        s.execute(f"INSERT INTO rd (k, c, v) VALUES (1, {c}, 'x')")
+    s.execute("DELETE FROM rd WHERE k = 1 AND c >= 3")
+    # every replica applied the range; read from another coordinator
+    s2 = cluster.session(2)
+    s2.keyspace = "ks"
+    got = sorted(r[0] for r in s2.execute("SELECT c FROM rd WHERE k = 1"))
+    assert got == [0, 1, 2]

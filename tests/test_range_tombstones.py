@@ -1,0 +1,156 @@
+"""Range tombstone semantics — the CompactionsPurgeTest-style corner cases
+for clustering-range deletes (reference db/RangeTombstone.java,
+db/RangeTombstoneList.java, test/unit/.../CompactionsPurgeTest.java)."""
+import pytest
+
+from cassandra_tpu.cql import Session
+from cassandra_tpu.schema import Schema, make_table
+from cassandra_tpu.storage import cellbatch as cb
+from cassandra_tpu.storage.engine import StorageEngine
+from cassandra_tpu.storage.rangetomb import Slice, covering_ts
+
+
+@pytest.fixture
+def engine(tmp_path):
+    eng = StorageEngine(str(tmp_path / "data"), Schema(),
+                        commitlog_sync="batch")
+    yield eng
+    eng.close()
+
+
+@pytest.fixture
+def session(engine):
+    s = Session(engine)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    return s
+
+
+def rows(s, q):
+    return s.execute(q).rows
+
+
+def test_range_delete_basic(session):
+    session.execute("CREATE TABLE t (k int, c int, v text, "
+                    "PRIMARY KEY (k, c))")
+    for c in range(10):
+        session.execute(f"INSERT INTO t (k, c, v) VALUES (1, {c}, 'x{c}')")
+    session.execute("DELETE FROM t WHERE k = 1 AND c > 2 AND c <= 6")
+    got = sorted(r[0] for r in rows(session, "SELECT c FROM t WHERE k = 1"))
+    assert got == [0, 1, 2, 7, 8, 9]
+
+
+def test_range_delete_bound_kinds(session):
+    session.execute("CREATE TABLE b (k int, c int, PRIMARY KEY (k, c))")
+    for c in range(6):
+        session.execute(f"INSERT INTO b (k, c) VALUES (1, {c})")
+    session.execute("DELETE FROM b WHERE k = 1 AND c >= 4")
+    assert sorted(r[0] for r in rows(session, "SELECT c FROM b WHERE k=1"))\
+        == [0, 1, 2, 3]
+    session.execute("DELETE FROM b WHERE k = 1 AND c < 2")
+    assert sorted(r[0] for r in rows(session, "SELECT c FROM b WHERE k=1"))\
+        == [2, 3]
+
+
+def test_prefix_delete_two_clusterings(session):
+    session.execute("CREATE TABLE p (k int, a int, b int, v int, "
+                    "PRIMARY KEY (k, a, b))")
+    for a in (1, 2):
+        for b in (1, 2, 3):
+            session.execute(
+                f"INSERT INTO p (k, a, b, v) VALUES (1, {a}, {b}, 0)")
+    session.execute("DELETE FROM p WHERE k = 1 AND a = 1")  # prefix delete
+    got = rows(session, "SELECT a, b FROM p WHERE k = 1")
+    assert sorted(got) == [(2, 1), (2, 2), (2, 3)]
+    # inequality under an equality prefix
+    session.execute("DELETE FROM p WHERE k = 1 AND a = 2 AND b >= 3")
+    got = rows(session, "SELECT a, b FROM p WHERE k = 1")
+    assert sorted(got) == [(2, 1), (2, 2)]
+
+
+def test_newer_write_survives_range_delete(session):
+    session.execute("CREATE TABLE n (k int, c int, v text, "
+                    "PRIMARY KEY (k, c))")
+    session.execute("INSERT INTO n (k, c, v) VALUES (1, 5, 'old') "
+                    "USING TIMESTAMP 100")
+    session.execute("DELETE FROM n USING TIMESTAMP 200 WHERE k = 1 AND c > 0")
+    session.execute("INSERT INTO n (k, c, v) VALUES (1, 5, 'new') "
+                    "USING TIMESTAMP 300")
+    assert rows(session, "SELECT v FROM n WHERE k = 1") == [("new",)]
+
+
+def test_range_delete_across_flush_and_compaction(session, engine):
+    session.execute("CREATE TABLE f (k int, c int, v text, "
+                    "PRIMARY KEY (k, c))")
+    for c in range(8):
+        session.execute(f"INSERT INTO f (k, c, v) VALUES (1, {c}, 'x')")
+    cfs = engine.store("ks", "f")
+    cfs.flush()                      # data lives in an sstable
+    session.execute("DELETE FROM f WHERE k = 1 AND c >= 4")
+    cfs.flush()                      # tombstone in a second sstable
+    got = sorted(r[0] for r in rows(session, "SELECT c FROM f WHERE k=1"))
+    assert got == [0, 1, 2, 3]
+    # major compaction applies the range across sstables
+    from cassandra_tpu.compaction.task import CompactionTask
+    CompactionTask(cfs, cfs.tracker.view()).execute()
+    got = sorted(r[0] for r in rows(session, "SELECT c FROM f WHERE k=1"))
+    assert got == [0, 1, 2, 3]
+
+
+def test_range_tombstone_purged_after_gc_grace(session, engine):
+    session.execute("CREATE TABLE g (k int, c int, v text, "
+                    "PRIMARY KEY (k, c)) WITH gc_grace_seconds = 0")
+    cfs = engine.store("ks", "g")
+    for c in range(6):
+        session.execute(f"INSERT INTO g (k, c, v) VALUES (1, {c}, 'x')")
+    cfs.flush()
+    session.execute("DELETE FROM g WHERE k = 1 AND c >= 3")
+    cfs.flush()
+    import time
+    time.sleep(1.2)   # purge needs ldt strictly below gcBefore (= now)
+    from cassandra_tpu.compaction.task import CompactionTask
+    CompactionTask(cfs, cfs.tracker.view()).execute()
+    # covered rows gone AND the marker itself purged (gc_grace=0, no
+    # overlapping sources)
+    live = cfs.tracker.view()
+    total = sum(r.n_cells for r in live)
+    batch = cb.CellBatch.concat(
+        [seg for r in live for seg in r.scanner()]) if total else None
+    if batch is not None:
+        assert not ((batch.flags & cb.FLAG_RANGE_BOUND) != 0).any()
+    got = sorted(r[0] for r in rows(session, "SELECT c FROM g WHERE k=1"))
+    assert got == [0, 1, 2]
+
+
+def test_contained_older_slice_dropped(session, engine):
+    session.execute("CREATE TABLE o (k int, c int, PRIMARY KEY (k, c))")
+    session.execute("DELETE FROM o USING TIMESTAMP 100 "
+                    "WHERE k = 1 AND c >= 3 AND c <= 4")
+    session.execute("DELETE FROM o USING TIMESTAMP 200 "
+                    "WHERE k = 1 AND c >= 1 AND c <= 8")
+    cfs = engine.store("ks", "o")
+    batch = cfs.read_partition(
+        engine.schema.get_table("ks", "o").columns["k"]
+        .cql_type.serialize(1))
+    ranges = (batch.flags & cb.FLAG_RANGE_BOUND) != 0
+    assert int(ranges.sum()) == 1          # contained slice reconciled away
+    assert int(batch.ts[ranges][0]) == 200
+
+
+def test_slice_primitives():
+    T = make_table("ks", "s", pk=["k"], ck=["a", "b"],
+                   cols={"k": "int", "a": "int", "b": "int", "v": "int"})
+    enc = T.clustering_bytecomp
+    full = lambda a, b: enc([a, b])
+    sl = Slice(enc([1]), True, enc([1]), True, 50, 0)   # prefix a=1
+    assert sl.covers_row(full(1, 1)) and sl.covers_row(full(1, 99))
+    assert not sl.covers_row(full(2, 0)) and not sl.covers_row(full(0, 9))
+    assert not sl.covers_row(b"")                        # static exempt
+    sl2 = Slice(enc([1, 3]), False, enc([2]), True, 60, 0)
+    assert not sl2.covers_row(full(1, 3))                # exclusive start
+    assert sl2.covers_row(full(1, 4)) and sl2.covers_row(full(2, 7))
+    assert covering_ts([sl, sl2], full(1, 4)) == 60
+    big = Slice(enc([0]), True, enc([9]), True, 70, 0)
+    assert big.contains(sl) and big.contains(sl2)
+    assert not sl.contains(big)
