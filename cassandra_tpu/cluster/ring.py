@@ -15,10 +15,13 @@ from ..utils import murmur3
 
 @dataclass(frozen=True)
 class Endpoint:
-    """A node address (host-port stands in for InetAddressAndPort)."""
+    """A node address (InetAddressAndPort role). host/port address real
+    socket transports; the in-process transport routes by identity."""
     name: str
     dc: str = "dc1"
     rack: str = "rack1"
+    host: str = "127.0.0.1"
+    port: int = 0
 
     def __repr__(self):
         return self.name

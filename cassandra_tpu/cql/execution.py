@@ -304,16 +304,21 @@ class Executor:
         prefix = table.clustering_bytecomp(eq_vals) if eq_vals else b""
         start, start_incl = prefix, True
         end, end_incl = prefix, True
+        seen_start = seen_end = False
         for op, v in ineqs:
             bcomp = table.clustering_bytecomp(eq_vals + [v])
-            if op == ">":
-                start, start_incl = bcomp, False
-            elif op == ">=":
-                start, start_incl = bcomp, True
-            elif op == "<":
-                end, end_incl = bcomp, False
+            if op in (">", ">="):
+                if seen_start:
+                    raise InvalidRequest(
+                        "more than one lower bound in DELETE range")
+                seen_start = True
+                start, start_incl = bcomp, op == ">="
             else:
-                end, end_incl = bcomp, True
+                if seen_end:
+                    raise InvalidRequest(
+                        "more than one upper bound in DELETE range")
+                seen_end = True
+                end, end_incl = bcomp, op == "<="
         return Slice(start, start_incl, end, end_incl, ts, now_s)
 
     def _full_ck(self, table, ck_rel, params=()):
@@ -364,8 +369,19 @@ class Executor:
                  if n not in s.partition_key and n not in s.clustering
                  and not st]
         stat = [(n, parse_type(cols[n], udts)) for n in statics]
+        tid = None
+        if "id" in s.options:
+            # CREATE TABLE ... WITH id = <uuid>: explicit table id —
+            # the reference supports this so independently-started nodes
+            # (or restores) can agree on the id without schema exchange
+            import uuid as uuid_mod
+            try:
+                tid = uuid_mod.UUID(str(s.options["id"]))
+            except ValueError:
+                raise InvalidRequest(
+                    f"invalid table id {s.options['id']!r}")
         t = schema_mod.TableMetadata(ks, s.name, pkc, ckc, other, stat,
-                                     params_obj)
+                                     params_obj, table_id=tid)
         self.backend.add_table(t)
         return ResultSet([], [])
 

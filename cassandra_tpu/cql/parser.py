@@ -562,6 +562,9 @@ class Parser:
             return t.value == "true"
         if t.kind in ("IDENT",):
             return t.value
+        if t.kind == "UUID":
+            # CREATE TABLE ... WITH id = <uuid> (explicit table id)
+            return str(t.value)
         raise ParseError(f"bad option value {t}")
 
     def _expect_colon_or_marker(self):

@@ -1,0 +1,91 @@
+"""noded — the standalone node daemon (CassandraDaemon role).
+
+Reference counterpart: service/CassandraDaemon.java (process entrypoint:
+load config, init storage, join the ring, serve) driven by a JSON config
+standing in for cassandra.yaml.
+
+Config:
+{
+  "name": "node2", "host": "127.0.0.1", "port": 9502,
+  "dc": "dc1", "rack": "rack1",
+  "data_dir": "/var/lib/ctpu/node2",
+  "tokens": [ ... this node's tokens ... ],
+  "peers": [{"name": "node1", "host": "...", "port": 9501,
+             "dc": "dc1", "rack": "rack1", "tokens": [...]}, ...],
+  "seeds": ["node1"],
+  "gossip_interval": 0.2,
+  "ddl": ["CREATE KEYSPACE ks WITH ...",
+          "CREATE TABLE ks.t (...) WITH id = <uuid>"]
+}
+
+Every node executes the same `ddl` locally at startup; explicit
+`WITH id = <uuid>` table ids keep independently-started processes in
+agreement (distributed schema propagation is the TCM work item).
+Prints "READY <port>" on stdout once the transport is listening and the
+node serves requests; exits cleanly on SIGTERM.
+
+Usage: python -m cassandra_tpu.tools.noded <config.json>
+"""
+from __future__ import annotations
+
+import json
+import signal
+import sys
+import threading
+
+
+def build_node(cfg: dict):
+    from ..cluster.node import Node
+    from ..cluster.ring import Endpoint, Ring
+    from ..cluster.tcp import TcpTransport
+    from ..schema import Schema
+
+    me = Endpoint(cfg["name"], cfg.get("dc", "dc1"),
+                  cfg.get("rack", "rack1"), cfg.get("host", "127.0.0.1"),
+                  int(cfg["port"]))
+    ring = Ring()
+    ring.add_node(me, [int(t) for t in cfg["tokens"]])
+    peers = {}
+    for p in cfg.get("peers", []):
+        ep = Endpoint(p["name"], p.get("dc", "dc1"), p.get("rack", "rack1"),
+                      p.get("host", "127.0.0.1"), int(p["port"]))
+        peers[ep.name] = ep
+        ring.add_node(ep, [int(t) for t in p["tokens"]])
+    seeds = [peers[n] for n in cfg.get("seeds", []) if n in peers] or [me]
+
+    transport = TcpTransport()
+    node = Node(me, cfg["data_dir"], Schema(), ring, transport,
+                seeds=seeds,
+                gossip_interval=float(cfg.get("gossip_interval", 0.2)))
+    node.cluster_nodes = [node]   # DDL opens stores on this engine only
+    session = node.session()
+    for stmt in cfg.get("ddl", []):
+        session.execute(stmt)
+    node.gossiper.start()
+    return node, transport
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 1:
+        print("usage: noded <config.json>", file=sys.stderr)
+        return 2
+    with open(argv[0]) as f:
+        cfg = json.load(f)
+    if cfg.get("jax_platform"):
+        # must happen before any backend initializes (this box pins an
+        # accelerator platform via sitecustomize; env vars don't override)
+        import jax
+        jax.config.update("jax_platforms", cfg["jax_platform"])
+    node, transport = build_node(cfg)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    print(f"READY {transport.bound_port}", flush=True)
+    stop.wait()
+    node.engine.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
