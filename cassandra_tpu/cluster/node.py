@@ -88,8 +88,13 @@ class Node:
         return Verb.READ_RSP, cb_serialize(batch)
 
     def _handle_range(self, msg):
-        keyspace, table_name = msg.payload
-        batch = self.engine.store(keyspace, table_name).scan_all()
+        keyspace, table_name, *window = msg.payload
+        store = self.engine.store(keyspace, table_name)
+        if window:
+            lo, hi = window
+            batch = store.scan_window(int(lo), int(hi))
+        else:
+            batch = store.scan_all()
         return Verb.RANGE_RSP, cb_serialize(batch)
 
     def _handle_truncate(self, msg):
@@ -260,6 +265,29 @@ class _DistributedStore:
     def scan_all(self, now=None):
         return self.node.proxy.scan_all(self.keyspace, self.name,
                                         self.node.default_cl)
+
+    def scan_window(self, lo: int, hi: int, now=None):
+        return self.node.proxy.scan_window(self.keyspace, self.name, lo,
+                                           hi, self.node.default_cl)
+
+    def iter_scan(self, now=None, after: int = -(1 << 63),
+                  window_parts: int = 64):
+        """Bounded cluster scan: one vnode arc per window, each fetched
+        from that arc's replicas only (paging substrate; window_parts is
+        a partition-count hint the arc granularity stands in for)."""
+        MIN, MAX = -(1 << 63), (1 << 63) - 1
+        bounds = sorted({hi for _, hi in self.node.ring.all_ranges()})
+        cuts = [b for b in bounds if b > after] + [MAX]
+        pos = after
+        for hi in cuts:
+            if hi <= pos and not (pos == MIN and hi == MIN):
+                continue
+            batch = self.scan_window(pos, hi, now)
+            if len(batch):
+                yield batch
+            pos = hi
+            if pos == MAX:
+                break
 
     def truncate(self):
         for ep in list(self.node.ring.endpoints):

@@ -27,11 +27,7 @@ from .replication import ReplicationStrategy
 _BIAS = 1 << 63
 
 
-def batch_tokens(batch: cb.CellBatch) -> np.ndarray:
-    with np.errstate(over="ignore"):
-        u = (batch.lanes[:, 0].astype(np.uint64) << np.uint64(32)) \
-            | batch.lanes[:, 1].astype(np.uint64)
-        return (u ^ np.uint64(_BIAS)).astype(np.int64)
+batch_tokens = cb.batch_tokens
 
 
 def iter_partitions(batch: cb.CellBatch):
@@ -50,13 +46,7 @@ def iter_partitions(batch: cb.CellBatch):
         yield int(s), int(e), int(toks[s])
 
 
-def filter_token_range(batch: cb.CellBatch, lo: int, hi: int) -> cb.CellBatch:
-    """Cells whose partition token falls in [lo, hi] (sorted input -> the
-    result is a contiguous slice)."""
-    toks = batch_tokens(batch)
-    i0 = int(np.searchsorted(toks, lo, side="left"))
-    i1 = int(np.searchsorted(toks, hi, side="right"))
-    return batch.slice_range(i0, i1)
+filter_token_range = cb.filter_token_range
 
 
 def build_validation_tree(table, batch: cb.CellBatch,

@@ -29,6 +29,8 @@ class InvalidRequest(ValueError):
 
 
 class ResultSet:
+    paging_state: bytes | None = None   # set when a page cut a scan short
+
     def __init__(self, columns: list[str], rows: list[tuple]):
         self.column_names = columns
         self.rows = rows
@@ -151,7 +153,8 @@ class Executor:
 
     def execute(self, stmt, params=(), keyspace: str | None = None,
                 now_micros: int | None = None,
-                user: str | None = None) -> ResultSet:
+                user: str | None = None, page_size: int | None = None,
+                paging_state: bytes | None = None) -> ResultSet:
         name = type(stmt).__name__
         auth = getattr(self.backend, "auth", None)
         if auth is not None and auth.enabled:
@@ -165,6 +168,9 @@ class Executor:
         if name in ("RoleStatement", "GrantStatement",
                     "ListRolesStatement", "BatchStatement"):
             return m(stmt, params, keyspace, now_micros, user)
+        if name == "SelectStatement":
+            return m(stmt, params, keyspace, now_micros,
+                     page_size=page_size, paging_state=paging_state)
         return m(stmt, params, keyspace, now_micros)
 
     # ------------------------------------------------------------- auth --
@@ -842,7 +848,8 @@ class Executor:
         cols = ["[applied]"] + list(existing.keys())
         return ResultSet(cols, [(False, *existing.values())])
 
-    def _exec_SelectStatement(self, s, params, keyspace, now):
+    def _exec_SelectStatement(self, s, params, keyspace, now,
+                              page_size=None, paging_state=None):
         # virtual tables (db/virtual role) intercept before real schema
         vts = getattr(self.backend, "virtual_tables", None)
         vks = s.keyspace or keyspace
@@ -877,6 +884,10 @@ class Executor:
 
         rows: list[dict] = []
         statics_by_pk: dict[bytes, dict] = {}
+        want_meta = any(isinstance(expr, ast.FunctionCall)
+                        and expr.name.lower() in ("writetime", "ttl")
+                        for expr, _ in s.selectors)
+        new_paging_state = None
         if index_rows is not None:
             rows = index_rows
             # an accompanying pk restriction still applies
@@ -888,10 +899,12 @@ class Executor:
             batches = [(pk, cfs.read_partition(pk))
                        for pk in self._pk_bytes_list(t, pk_vals)]
         else:
-            batches = [(None, cfs.scan_all())]
-        want_meta = any(isinstance(expr, ast.FunctionCall)
-                        and expr.name.lower() in ("writetime", "ttl")
-                        for expr, _ in s.selectors)
+            # full scan: paged, windowed, bounded memory (QueryPagers)
+            rows, statics_by_pk, new_paging_state = self._paged_scan(
+                t, cfs, s, params, ck_rel, filters, want_meta,
+                page_size, paging_state)
+            batches = []
+            ck_rel, filters = {}, []   # applied inline by the pager
         for _, batch in batches:
             for r in rows_from_batch(t, batch):
                 d = row_to_dict(t, r, with_meta=want_meta)
@@ -931,6 +944,7 @@ class Executor:
             col, desc = s.order_by[0]
             rows.sort(key=lambda r: r[col], reverse=desc)
 
+
         if s.per_partition_limit is not None:
             limit = int(bind_term(s.per_partition_limit, None, params))
             seen: dict[tuple, int] = {}
@@ -941,7 +955,9 @@ class Executor:
                 if seen[key] <= limit:
                     out.append(r)
             rows = out
-        return self._project_with_limit(t, s, rows, params)
+        rs = self._project_with_limit(t, s, rows, params)
+        rs.paging_state = new_paging_state
+        return rs
 
     def _project_with_limit(self, t, s, rows, params) -> ResultSet:
         """LIMIT applies to *result* rows: for aggregates / GROUP BY /
@@ -966,6 +982,108 @@ class Executor:
         return any(isinstance(expr, ast.FunctionCall)
                    and expr.name.lower() in agg_fns
                    for expr, _ in s.selectors)
+
+    def _paged_scan(self, t, cfs, s, params, ck_rel, filters, want_meta,
+                    page_size, paging_state):
+        """Full-table SELECT through the pager: rows stream window by
+        window (bounded memory), restrictions apply inline so page counts
+        reflect returned rows, and the result carries a resumable paging
+        state when page_size cut the scan short (service/pager/
+        PartitionRangeQueryPager role)."""
+        from ..storage import paging as paging_mod
+
+        state = paging_mod.PagingState.deserialize(paging_state) \
+            if paging_state else None
+        post_agg = self._limit_after_projection(s) or bool(s.order_by)
+        if post_agg:
+            # aggregates / GROUP BY / DISTINCT / sorted scans consume all
+            # windows internally (AggregationQueryPager role) — memory
+            # stays window-bounded, the result is small or must be whole
+            page_size = None
+        limit = int(bind_term(s.limit, None, params)) \
+            if s.limit is not None else None
+        # the user LIMIT is decremented ACROSS pages via the state (the
+        # reference pagers do the same) — a paged LIMIT 10 returns 10
+        # rows total, not 10 per page
+        if state is not None and state.remaining >= 0:
+            limit = state.remaining
+        ppl = int(bind_term(s.per_partition_limit, None, params)) \
+            if s.per_partition_limit is not None else None
+
+        rows: list[dict] = []
+        statics: dict[bytes, dict] = {}
+        if state is not None and state.ck:
+            # resuming mid-partition: the static row was emitted with an
+            # earlier page — rebuild it so static columns still join
+            for r in rows_from_batch(t, cfs.read_partition(state.pk)):
+                if r.is_static:
+                    statics[r.pk] = row_to_dict(t, r, with_meta=want_meta)
+                break
+        seen_per_pk: dict[bytes, int] = {}
+        if state is not None and ppl is not None:
+            seen_per_pk[state.pk] = state.ppl_seen
+        gr = getattr(self.backend, "guardrails", None)
+
+        def on_batch(batch):
+            if gr is not None:
+                from ..storage.cellbatch import DEATH_FLAGS
+                dead = int(((batch.flags & DEATH_FLAGS) != 0).sum())
+                if dead:
+                    gr.check_tombstones(dead, t.full_name())
+
+        last_row = None
+        more = False
+        for row in paging_mod.paged_rows(cfs, t, state=state,
+                                         on_batch=on_batch):
+            if row.is_static:
+                statics[row.pk] = row_to_dict(t, row, with_meta=want_meta)
+                continue
+            d = row_to_dict(t, row, with_meta=want_meta)
+            # join static values BEFORE filtering — a filter on a static
+            # column must see the partition's value
+            st = statics.get(row.pk)
+            if st:
+                for c in t.static_columns:
+                    if d.get(c.name) is None:
+                        d[c.name] = st.get(c.name)
+                        if want_meta and c.name in st.get("__meta__", {}):
+                            d.setdefault("__meta__", {})[c.name] = \
+                                st["__meta__"][c.name]
+            ok = True
+            for cname, rels in ck_rel.items():
+                for op, v in rels:
+                    if not self._match(d.get(cname), op, v):
+                        ok = False
+                        break
+                if not ok:
+                    break
+            if ok:
+                for col, op, v in filters:
+                    if not self._match(d.get(col.name), op, v):
+                        ok = False
+                        break
+            if not ok:
+                continue
+            if ppl is not None:
+                c = seen_per_pk.get(row.pk, 0) + 1
+                seen_per_pk[row.pk] = c
+                if c > ppl:
+                    continue
+            d["__pk"] = row.pk
+            rows.append(d)
+            last_row = row
+            if not post_agg and limit is not None and len(rows) >= limit:
+                break                         # limit satisfied: no more
+            if page_size is not None and len(rows) >= page_size:
+                more = True
+                break
+        new_state = None
+        if more and last_row is not None:
+            rem = (limit - len(rows)) if limit is not None else -1
+            new_state = paging_mod.position_of(
+                t, last_row, remaining=rem,
+                ppl_seen=seen_per_pk.get(last_row.pk, 0)).serialize()
+        return rows, statics, new_state
 
     def _indexed_lookup(self, t, cfs, filters, params):
         """Serve a single-equality filter from a secondary index: locators

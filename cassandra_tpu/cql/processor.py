@@ -49,13 +49,16 @@ class QueryProcessor:
 
     def process(self, query: str, params=(),
                 keyspace: str | None = None,
-                user: str | None = None) -> ResultSet:
+                user: str | None = None, page_size: int | None = None,
+                paging_state: bytes | None = None) -> ResultSet:
         from ..service.metrics import GLOBAL
         stmt = parse(query)
         kind = type(stmt).__name__.removesuffix("Statement").lower()
         GLOBAL.incr(f"cql.{kind}")
         with GLOBAL.timer("cql.request"):
-            return self.executor.execute(stmt, params, keyspace, user=user)
+            return self.executor.execute(stmt, params, keyspace, user=user,
+                                         page_size=page_size,
+                                         paging_state=paging_state)
 
 
 class Session:
@@ -73,20 +76,29 @@ class Session:
                 raise ValueError("this backend requires authentication")
             self.user = auth.authenticate(user, password or "")
 
-    def execute(self, query: str, params=(), trace: bool = False) -> ResultSet:
+    def execute(self, query: str, params=(), trace: bool = False,
+                fetch_size: int | None = None,
+                paging_state: bytes | None = None) -> ResultSet:
+        """fetch_size pages large scans: the ResultSet carries at most
+        fetch_size rows plus .paging_state to pass back for the next page
+        (driver-style paging)."""
         if trace:
             from ..service import tracing
             st = tracing.begin()
             tracing.trace(f"Parsing {query[:60]}")
             try:
                 rs = self.processor.process(query, params, self.keyspace,
-                                            user=self.user)
+                                            user=self.user,
+                                            page_size=fetch_size,
+                                            paging_state=paging_state)
             finally:
                 tracing.end()
             rs.trace = st
         else:
             rs = self.processor.process(query, params, self.keyspace,
-                                        user=self.user)
+                                        user=self.user,
+                                        page_size=fetch_size,
+                                        paging_state=paging_state)
         if hasattr(rs, "keyspace"):
             self.keyspace = rs.keyspace
         return rs

@@ -98,6 +98,23 @@ def _native_gather(payload: np.ndarray, off: np.ndarray, perm: np.ndarray,
     return out
 
 
+def batch_tokens(batch: "CellBatch") -> np.ndarray:
+    """int64 partition tokens per cell (shared token idiom)."""
+    with np.errstate(over="ignore"):
+        u = (batch.lanes[:, 0].astype(np.uint64) << np.uint64(32)) \
+            | batch.lanes[:, 1].astype(np.uint64)
+        return (u ^ np.uint64(_BIAS)).astype(np.int64)
+
+
+def filter_token_range(batch: "CellBatch", lo: int, hi: int) -> "CellBatch":
+    """Cells whose partition token falls in [lo, hi] (sorted input -> the
+    result is a contiguous slice)."""
+    toks = batch_tokens(batch)
+    i0 = int(np.searchsorted(toks, lo, side="left"))
+    i1 = int(np.searchsorted(toks, hi, side="right"))
+    return batch.slice_range(i0, i1)
+
+
 def content_digest(batch: "CellBatch") -> bytes:
     """Content digest over every reconcile-significant lane — the ONE
     definition shared by digest reads (DigestResolver role) and merkle

@@ -98,6 +98,12 @@ class Memtable:
                 self._sorted_cache = merge_sorted([self._builder.seal()])
             return self._sorted_cache
 
+    def scan_window(self, lo: int, hi: int) -> CellBatch:
+        """Cells of partitions with token in (lo, hi] (paging windows)."""
+        from .cellbatch import filter_token_range
+        return filter_token_range(self.scan(), lo + 1 if lo > -(1 << 63)
+                                  else lo, hi)
+
     # ------------------------------------------------------------- flush --
 
     def flush_batch(self) -> CellBatch:

@@ -292,6 +292,33 @@ class SSTableReader:
         for i in range(self.n_segments):
             yield self._read_segment(i)
 
+    @property
+    def partition_tokens(self) -> np.ndarray:
+        """int64 tokens of the partition directory, ascending (cached)."""
+        if not hasattr(self, "_part_tok"):
+            l4 = self._part_lane4.astype(np.uint64)
+            with np.errstate(over="ignore"):
+                self._part_tok = (((l4[:, 0] << np.uint64(32)) | l4[:, 1])
+                                  ^ np.uint64(_BIAS)).astype(np.int64)
+        return self._part_tok
+
+    def scan_tokens(self, lo: int, hi: int) -> CellBatch | None:
+        """Cells of partitions with token in (lo, hi] — the bounded range
+        read primitive (paging windows / vnode-range scans). Decodes only
+        the covering segments."""
+        toks = self.partition_tokens
+        # lo == int64 min means "from the absolute start, inclusive" —
+        # there is no token below it to exclude
+        side0 = "left" if lo == -(1 << 63) else "right"
+        i0 = int(np.searchsorted(toks, lo, side=side0))
+        i1 = int(np.searchsorted(toks, hi, side="right"))
+        if i0 >= i1:
+            return None
+        c0 = int(self._part_cell0[i0])
+        c1 = int(self._part_cell0[i1]) if i1 < self.n_partitions \
+            else self.n_cells
+        return self._cell_range(c0, c1)
+
     def verify_digest(self) -> bool:
         with open(self.desc.path(Component.DIGEST)) as f:
             expected = int(f.read().strip())

@@ -188,6 +188,58 @@ class ColumnFamilyStore:
         return merge_sorted([s for s in sources if len(s)] or sources[:1],
                             now=now)
 
+    def scan_window(self, lo: int, hi: int,
+                    now: int | None = None) -> CellBatch:
+        """Merged view of partitions with token in (lo, hi] — the bounded
+        range-read primitive behind paging (service/pager/QueryPagers
+        role: read a window, not the table)."""
+        now = now if now is not None else timeutil.now_seconds()
+        sources = [self.memtable.scan_window(lo, hi)]
+        for sst in self.tracker.view():
+            w = sst.scan_tokens(lo, hi)
+            if w is not None and len(w):
+                sources.append(w)
+        sources = [s for s in sources if len(s)]
+        if not sources:
+            from .cellbatch import lanes_for_table
+            return CellBatch.empty(lanes_for_table(self.table))
+        return merge_sorted(sources, now=now)
+
+    def next_partition_tokens(self, after: int, k: int) -> list[int]:
+        """The first k distinct partition tokens > after, across the
+        memtable and every sstable's partition directory — how the pager
+        sizes its next window without scanning data."""
+        cands: set[int] = set()
+        side = "left" if after == -(1 << 63) else "right"
+        from .cellbatch import batch_tokens
+        mem = self.memtable.scan()
+        if len(mem):
+            toks = batch_tokens(mem)
+            i = int(np.searchsorted(toks, after, side=side))
+            uniq = np.unique(toks[i:])
+            cands.update(int(t) for t in uniq[:k])
+        for sst in self.tracker.view():
+            toks = sst.partition_tokens
+            i = int(np.searchsorted(toks, after, side=side))
+            cands.update(int(t) for t in toks[i:i + k])
+        return sorted(cands)[:k]
+
+    def iter_scan(self, now: int | None = None, after: int = -(1 << 63),
+                  window_parts: int = 64):
+        """Yield merged CellBatches window by window, each window covering
+        up to window_parts partitions — full scans in bounded memory."""
+        now = now if now is not None else timeutil.now_seconds()
+        pos = after
+        while True:
+            toks = self.next_partition_tokens(pos, window_parts)
+            if not toks:
+                return
+            hi = toks[-1]
+            batch = self.scan_window(pos, hi, now=now)
+            if len(batch):
+                yield batch
+            pos = hi
+
     # --------------------------------------------------------------- misc --
 
     def live_sstables(self) -> list[SSTableReader]:

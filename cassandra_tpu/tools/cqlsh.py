@@ -129,10 +129,26 @@ def repl(session, stdin=None, stdout=None):
         stmt = buf
         buf = ""
         try:
-            rs = session.execute(stmt, trace=tracing)
-            out = format_rows(rs)
-            if out:
-                emit(out)
+            # SELECTs page like the reference cqlsh (default 5000 rows a
+            # page) — a huge table never materializes client-side at once
+            if stmt.strip().lower().startswith("select"):
+                rs = session.execute(stmt, trace=tracing, fetch_size=5000)
+                out = format_rows(rs)
+                if out:
+                    emit(out)
+                page = rs
+                while page.paging_state is not None:
+                    page = session.execute(stmt, fetch_size=5000,
+                                           paging_state=page.paging_state)
+                    out = format_rows(page)
+                    if out:
+                        emit(out)
+                # rs stays the FIRST page: its trace block prints below
+            else:
+                rs = session.execute(stmt, trace=tracing)
+                out = format_rows(rs)
+                if out:
+                    emit(out)
             if tracing and hasattr(rs, "trace"):
                 emit("\nTracing session: " + str(rs.trace.session_id))
                 for us, src, activity in rs.trace.events:

@@ -166,12 +166,15 @@ def test_nts_placement():
 
 
 def test_scan_all_across_cluster(cluster):
-    # RF=3 on 3 nodes: use RF=1-style spread by writing at ONE to
-    # different coordinators, then scan from one node
+    # write at ALL so every replica holds the rows before scanning: the
+    # windowed range read serves each arc from blockFor replicas only
+    # (real CL=ONE semantics), so ONE-written rows may lag replicas
     s1 = cluster.session(1)
     s1.keyspace = "ks"
+    cluster.node(1).default_cl = ConsistencyLevel.ALL
     for i in range(30):
         s1.execute(f"INSERT INTO kv (k, v) VALUES ({i}, 'v{i}')")
+    cluster.node(1).default_cl = ConsistencyLevel.ONE
     rows = cluster.session(2)
     rows.keyspace = "ks"
     got = rows.execute("SELECT count(*) FROM kv")

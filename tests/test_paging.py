@@ -1,0 +1,135 @@
+"""Query paging: bounded windows, resumable page state, mid-partition
+splits — reference service/pager/QueryPagers.java semantics."""
+import pytest
+
+from cassandra_tpu.cql import Session
+from cassandra_tpu.schema import Schema
+from cassandra_tpu.storage.engine import StorageEngine
+
+
+@pytest.fixture
+def engine(tmp_path):
+    eng = StorageEngine(str(tmp_path / "data"), Schema(),
+                        commitlog_sync="batch")
+    yield eng
+    eng.close()
+
+
+@pytest.fixture
+def session(engine):
+    s = Session(engine)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    return s
+
+
+def page_all(session, query, fetch_size):
+    """Drain a query page by page; returns (all_rows, page_sizes)."""
+    rows, sizes, state = [], [], None
+    while True:
+        rs = session.execute(query, fetch_size=fetch_size,
+                             paging_state=state)
+        rows.extend(rs.rows)
+        sizes.append(len(rs.rows))
+        state = rs.paging_state
+        if state is None:
+            return rows, sizes
+
+
+def test_pages_cover_everything_once(session, engine):
+    session.execute("CREATE TABLE t (k int, c int, v int, "
+                    "PRIMARY KEY (k, c))")
+    cfs = engine.store("ks", "t")
+    expect = set()
+    for k in range(40):
+        for c in range(5):
+            session.execute(
+                f"INSERT INTO t (k, c, v) VALUES ({k}, {c}, {k * 100 + c})")
+            expect.add((k, c, k * 100 + c))
+        if k == 19:
+            cfs.flush()    # half the data from sstables, half memtable
+    rows, sizes = page_all(session, "SELECT k, c, v FROM t", 17)
+    assert len(rows) == len(expect) and set(rows) == expect
+    assert all(sz <= 17 for sz in sizes)
+    assert sum(1 for sz in sizes if sz == 17) >= len(expect) // 17
+
+
+def test_page_split_inside_partition(session):
+    session.execute("CREATE TABLE big (k int, c int, PRIMARY KEY (k, c))")
+    for c in range(100):
+        session.execute(f"INSERT INTO big (k, c) VALUES (1, {c})")
+    rows, sizes = page_all(session, "SELECT c FROM big", 9)
+    assert [r[0] for r in rows] == list(range(100))
+    assert max(sizes) <= 9
+
+
+def test_paging_with_static_columns(session):
+    session.execute("CREATE TABLE st (k int, c int, s text static, v int, "
+                    "PRIMARY KEY (k, c))")
+    for c in range(30):
+        session.execute(f"INSERT INTO st (k, c, v) VALUES (5, {c}, {c})")
+    session.execute("INSERT INTO st (k, s) VALUES (5, 'shared')")
+    rows, _ = page_all(session, "SELECT c, s FROM st", 7)
+    assert len(rows) == 30
+    assert all(r[1] == "shared" for r in rows), \
+        "static column must join on every page, including resumed ones"
+
+
+def test_paging_respects_filters(session):
+    session.execute("CREATE TABLE f (k int, c int, v int, "
+                    "PRIMARY KEY (k, c))")
+    for k in range(20):
+        for c in range(4):
+            session.execute(
+                f"INSERT INTO f (k, c, v) VALUES ({k}, {c}, {c % 2})")
+    rows, sizes = page_all(
+        session, "SELECT k, c FROM f WHERE v = 1 ALLOW FILTERING", 6)
+    assert len(rows) == 20 * 2
+    assert all(sz <= 6 for sz in sizes)
+
+
+def test_limit_without_paging_stops_early(session):
+    session.execute("CREATE TABLE l (k int PRIMARY KEY, v int)")
+    for k in range(50):
+        session.execute(f"INSERT INTO l (k, v) VALUES ({k}, {k})")
+    rs = session.execute("SELECT k FROM l LIMIT 5")
+    assert len(rs.rows) == 5
+    assert rs.paging_state is None
+
+
+def test_aggregation_consumes_all_pages_internally(session):
+    session.execute("CREATE TABLE a (k int PRIMARY KEY, v int)")
+    for k in range(30):
+        session.execute(f"INSERT INTO a (k, v) VALUES ({k}, 1)")
+    rs = session.execute("SELECT count(*) FROM a", fetch_size=7)
+    assert rs.rows == [(30,)]
+
+
+def test_limit_carries_across_pages(session):
+    session.execute("CREATE TABLE lc (k int PRIMARY KEY, v int)")
+    for k in range(50):
+        session.execute(f"INSERT INTO lc (k, v) VALUES ({k}, {k})")
+    rows, _ = page_all(session, "SELECT k FROM lc LIMIT 10", 4)
+    assert len(rows) == 10          # 10 total, not 10 per page
+
+
+def test_per_partition_limit_across_pages(session):
+    session.execute("CREATE TABLE pp (k int, c int, PRIMARY KEY (k, c))")
+    for c in range(20):
+        session.execute(f"INSERT INTO pp (k, c) VALUES (1, {c})")
+    rows, _ = page_all(session, "SELECT c FROM pp PER PARTITION LIMIT 5", 2)
+    assert len(rows) == 5
+
+
+def test_static_filter_on_full_scan(session):
+    session.execute("CREATE TABLE sf (k int, c int, s text static, v int, "
+                    "PRIMARY KEY (k, c))")
+    for k in (1, 2):
+        for c in range(3):
+            session.execute(
+                f"INSERT INTO sf (k, c, v) VALUES ({k}, {c}, 0)")
+    session.execute("INSERT INTO sf (k, s) VALUES (1, 'hit')")
+    rs = session.execute(
+        "SELECT k, c FROM sf WHERE s = 'hit' ALLOW FILTERING")
+    assert sorted(rs.rows) == [(1, 0), (1, 1), (1, 2)]
