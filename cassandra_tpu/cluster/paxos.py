@@ -10,18 +10,24 @@ Single-decree per (table, partition, ballot): ballots are monotonic
 linearization point, a quorum of accepts to decide, and commit applies the
 mutation through the normal write path on all replicas.
 
-PaxosState here is in-memory per process (the reference persists it in the
-system.paxos table; crash-restart of a replica forgets promises, which can
-only cause a retried round, not a lost committed write — commits go
-through the durable write path).
+PaxosState is PERSISTED per node (the system.paxos role): every promise
+and accept is appended to a CRC-framed log and fsynced BEFORE the replica
+responds, and reloaded on restart. Without this, a majority restart could
+forget an in-flight accepted value and let a later prepare decide a
+different value for the same ballot slot — the quorum-intersection
+argument requires promises/accepts to survive crashes.
 """
 from __future__ import annotations
 
+import os
+import struct
 import threading
 import time
+import zlib
 from dataclasses import dataclass, field
 
 from ..storage.mutation import Mutation
+from ..utils import varint as vi
 from .messaging import Verb
 from .replication import ConsistencyLevel, ReplicationStrategy
 
@@ -59,15 +65,161 @@ class PaxosState:
     lock: threading.Lock = field(default_factory=threading.Lock)
 
 
+class PaxosLog:
+    """Durable per-node paxos state (system.paxos role): an append-only
+    CRC-framed record log, fsynced per record BEFORE the replica
+    responds, snapshot-compacted when it grows. Record body:
+    [16B table_id][vint pk_len][pk][kind u8][ballot ts vint]
+    [vint ep_len][ep][vint val_len][value]  (kind: 0=promise 1=accept
+    2=commit; accept carries the value, commit clears it)."""
+
+    K_PROMISE, K_ACCEPT, K_COMMIT = 0, 1, 2
+    COMPACT_EVERY = 4096
+
+    def __init__(self, directory: str):
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, "paxos.log")
+        self._lock = threading.Lock()
+        self._records = 0
+
+    def append(self, table_id, pk: bytes, kind: int, ballot: "Ballot",
+               value: bytes | None) -> None:
+        frame = self._frame(table_id, pk, kind, ballot, value)
+        with self._lock:
+            with open(self.path, "ab") as f:
+                f.write(frame)
+                f.flush()
+                os.fsync(f.fileno())
+            self._records += 1
+
+    def replay(self):
+        """Yield (table_id_bytes, pk, kind, Ballot, value) records; a torn
+        tail (crash mid-append) stops the replay cleanly."""
+        import uuid as uuid_mod
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as f:
+            data = f.read()
+        pos = 0
+        while pos + 8 <= len(data):
+            ln, crc = struct.unpack_from("<II", data, pos)
+            body = data[pos + 8:pos + 8 + ln]
+            if len(body) < ln or zlib.crc32(body) != crc:
+                return                      # torn tail
+            pos += 8 + ln
+            tid = uuid_mod.UUID(bytes=bytes(body[:16]))
+            p = 16
+            n, p = vi.read_unsigned_vint(body, p)
+            pk = bytes(body[p:p + n])
+            p += n
+            kind = body[p]
+            p += 1
+            ts, p = vi.read_signed_vint(body, p)
+            n, p = vi.read_unsigned_vint(body, p)
+            ep = bytes(body[p:p + n]).decode()
+            p += n
+            n, p = vi.read_unsigned_vint(body, p)
+            value = bytes(body[p:p + n]) if n else None
+            self._records += 1
+            yield tid, pk, kind, Ballot(ts, ep), value
+
+    @staticmethod
+    def _frame(table_id, pk: bytes, kind: int, ballot: "Ballot",
+               value: bytes | None) -> bytes:
+        body = bytearray()
+        body += table_id.bytes
+        vi.write_unsigned_vint(len(pk), body)
+        body += pk
+        body.append(kind)
+        vi.write_signed_vint(ballot.ts, body)
+        ep = ballot.endpoint.encode()
+        vi.write_unsigned_vint(len(ep), body)
+        body += ep
+        v = value or b""
+        vi.write_unsigned_vint(len(v), body)
+        body += v
+        return struct.pack("<II", len(body), zlib.crc32(bytes(body))) \
+            + bytes(body)
+
+    def compact(self, states: dict) -> None:
+        """Rewrite the log as a snapshot of live state (old rounds whose
+        commit already landed need no history). Frames are built in
+        memory — each state copied under ITS lock so a concurrent accept
+        cannot be captured torn — then written + fsynced ONCE (never via
+        append(): that would retake self._lock and fsync per record)."""
+        frames: list[bytes] = []
+        n = 0
+        for (tid, pk), st in states.items():
+            with st.lock:
+                promised, committed = st.promised, st.committed
+                ab, av = st.accepted_ballot, st.accepted_value
+            if promised != ZERO:
+                frames.append(self._frame(tid, pk, self.K_PROMISE,
+                                          promised, None))
+                n += 1
+            if ab is not None:
+                frames.append(self._frame(tid, pk, self.K_ACCEPT, ab, av))
+                n += 1
+            if committed != ZERO:
+                frames.append(self._frame(tid, pk, self.K_COMMIT,
+                                          committed, None))
+                n += 1
+        tmp = self.path + ".tmp"
+        with self._lock:
+            with open(tmp, "wb") as f:
+                f.write(b"".join(frames))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            self._records = n
+
+
 class PaxosService:
     def __init__(self, node):
         self.node = node
         self._states: dict[tuple, PaxosState] = {}
         self._lock = threading.Lock()
+        data_dir = getattr(getattr(node, "engine", None), "data_dir", None)
+        self.log = PaxosLog(os.path.join(data_dir, "paxos")) \
+            if data_dir else None
+        if self.log is not None:
+            self._reload()
         ms = node.messaging
         ms.register_handler("PAXOS_PREPARE", self._handle_prepare)
         ms.register_handler("PAXOS_PROPOSE", self._handle_propose)
         ms.register_handler("PAXOS_COMMIT", self._handle_commit)
+
+    def _reload(self) -> None:
+        for tid, pk, kind, ballot, value in self.log.replay():
+            st = self._state(tid, pk)
+            if kind == PaxosLog.K_PROMISE:
+                st.promised = max(st.promised, ballot)
+            elif kind == PaxosLog.K_ACCEPT:
+                st.promised = max(st.promised, ballot)
+                st.accepted_ballot = ballot
+                st.accepted_value = value
+            else:
+                st.committed = max(st.committed, ballot)
+                if st.accepted_ballot is not None \
+                        and st.accepted_ballot <= ballot:
+                    st.accepted_ballot = None
+                    st.accepted_value = None
+
+    def _persist(self, table_id, pk, kind, ballot, value=None) -> None:
+        """Called UNDER the partition's st.lock (durability must precede
+        the response). Append-only here; compaction runs from
+        _maybe_compact AFTER the handler releases st.lock (compact takes
+        every state lock — inline it would self-deadlock)."""
+        if self.log is None:
+            return
+        self.log.append(table_id, pk, kind, ballot, value)
+
+    def _maybe_compact(self) -> None:
+        if self.log is not None \
+                and self.log._records >= PaxosLog.COMPACT_EVERY:
+            with self._lock:
+                states = dict(self._states)
+            self.log.compact(states)
 
     def _state(self, table_id, pk: bytes) -> PaxosState:
         key = (table_id, pk)
@@ -86,15 +238,21 @@ class PaxosService:
         with st.lock:
             if ballot > st.promised:
                 st.promised = ballot
-                return "PAXOS_PROMISE", {
+                # durable BEFORE the response: a promise a crash can
+                # forget breaks quorum intersection
+                self._persist(table_id, pk, PaxosLog.K_PROMISE, ballot)
+                rsp = {
                     "promised": True,
                     "accepted_ballot": st.accepted_ballot.pack()
                     if st.accepted_ballot else None,
                     "accepted_value": st.accepted_value,
                     "committed": st.committed.pack(),
                 }
-            return "PAXOS_PROMISE", {"promised": False,
-                                     "promised_ballot": st.promised.pack()}
+            else:
+                rsp = {"promised": False,
+                       "promised_ballot": st.promised.pack()}
+        self._maybe_compact()
+        return "PAXOS_PROMISE", rsp
 
     def _handle_propose(self, msg):
         table_id, pk, ballot_t, value = msg.payload
@@ -105,8 +263,13 @@ class PaxosService:
                 st.promised = ballot
                 st.accepted_ballot = ballot
                 st.accepted_value = value
-                return "PAXOS_ACCEPTED", {"accepted": True}
-            return "PAXOS_ACCEPTED", {"accepted": False}
+                self._persist(table_id, pk, PaxosLog.K_ACCEPT, ballot,
+                              value)
+                rsp = {"accepted": True}
+            else:
+                rsp = {"accepted": False}
+        self._maybe_compact()
+        return "PAXOS_ACCEPTED", rsp
 
     def _handle_commit(self, msg):
         table_id, pk, ballot_t, value = msg.payload
@@ -115,9 +278,12 @@ class PaxosService:
         with st.lock:
             if ballot > st.committed:
                 st.committed = ballot
-                if st.accepted_ballot == ballot:
+                if st.accepted_ballot is not None \
+                        and st.accepted_ballot <= ballot:
                     st.accepted_ballot = None
                     st.accepted_value = None
+                self._persist(table_id, pk, PaxosLog.K_COMMIT, ballot)
+        self._maybe_compact()
         if value:
             self.node.engine.apply(Mutation.deserialize(value))
         return "PAXOS_COMMITTED", {}

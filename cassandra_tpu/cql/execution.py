@@ -888,6 +888,7 @@ class Executor:
                         and expr.name.lower() in ("writetime", "ttl")
                         for expr, _ in s.selectors)
         new_paging_state = None
+        paged = False
         if index_rows is not None:
             rows = index_rows
             # an accompanying pk restriction still applies
@@ -904,6 +905,7 @@ class Executor:
                 t, cfs, s, params, ck_rel, filters, want_meta,
                 page_size, paging_state)
             batches = []
+            paged = True
             ck_rel, filters = {}, []   # applied inline by the pager
         for _, batch in batches:
             for r in rows_from_batch(t, batch):
@@ -914,7 +916,8 @@ class Executor:
                 d["__pk"] = r.pk
                 rows.append(d)
         # join static values (and their cell metadata) onto the rows
-        for d in rows:
+        # (the pager already joined + filtered + applied ppl inline)
+        for d in [] if paged else rows:
             st = statics_by_pk.get(d.pop("__pk", None), None)
             if st:
                 for c in t.static_columns:
@@ -945,7 +948,7 @@ class Executor:
             rows.sort(key=lambda r: r[col], reverse=desc)
 
 
-        if s.per_partition_limit is not None:
+        if s.per_partition_limit is not None and not paged:
             limit = int(bind_term(s.per_partition_limit, None, params))
             seen: dict[tuple, int] = {}
             out = []
@@ -1023,13 +1026,15 @@ class Executor:
         if state is not None and ppl is not None:
             seen_per_pk[state.pk] = state.ppl_seen
         gr = getattr(self.backend, "guardrails", None)
+        dead_total = [0]   # tombstones accumulate over the WHOLE read
 
         def on_batch(batch):
             if gr is not None:
                 from ..storage.cellbatch import DEATH_FLAGS
-                dead = int(((batch.flags & DEATH_FLAGS) != 0).sum())
-                if dead:
-                    gr.check_tombstones(dead, t.full_name())
+                dead_total[0] += int(((batch.flags & DEATH_FLAGS) != 0)
+                                     .sum())
+                if dead_total[0]:
+                    gr.check_tombstones(dead_total[0], t.full_name())
 
         last_row = None
         more = False
@@ -1069,7 +1074,6 @@ class Executor:
                 seen_per_pk[row.pk] = c
                 if c > ppl:
                     continue
-            d["__pk"] = row.pk
             rows.append(d)
             last_row = row
             if not post_agg and limit is not None and len(rows) >= limit:

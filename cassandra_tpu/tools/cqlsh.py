@@ -10,21 +10,30 @@ import argparse
 import sys
 
 
-def format_rows(rs) -> str:
+def format_rows(rs, header: bool = True,
+                footer_count: int | None = None) -> str:
+    """Render a result table. For paged output: header only on the first
+    page, footer only on the last (with the TRUE total row count)."""
     names = rs.column_names
     if not names:
         return ""
     rows = [[_fmt(v) for v in r] for r in rs.rows]
     widths = [max(len(n), *(len(r[i]) for r in rows)) if rows else len(n)
               for i, n in enumerate(names)]
-    head = " | ".join(n.ljust(w) for n, w in zip(names, widths))
-    sep = "-+-".join("-" * w for w in widths)
+    out = ""
+    if header:
+        head = " | ".join(n.ljust(w) for n, w in zip(names, widths))
+        sep = "-+-".join("-" * w for w in widths)
+        out = f" {head}\n-{sep}-"
     body = "\n".join(" | ".join(c.rjust(w) for c, w in zip(r, widths))
                      for r in rows)
-    out = f" {head}\n-{sep}-"
     if body:
-        out += f"\n {body}"
-    return out + f"\n\n({len(rs.rows)} rows)"
+        out += ("\n " if out else " ") + body
+    if footer_count is None:
+        footer_count = len(rs.rows)
+    if footer_count >= 0:
+        out += f"\n\n({footer_count} rows)"
+    return out
 
 
 def _fmt(v) -> str:
@@ -133,14 +142,22 @@ def repl(session, stdin=None, stdout=None):
             # page) — a huge table never materializes client-side at once
             if stmt.strip().lower().startswith("select"):
                 rs = session.execute(stmt, trace=tracing, fetch_size=5000)
-                out = format_rows(rs)
+                # one table across pages: header once, rows streamed,
+                # one footer with the true total
+                total = len(rs.rows)
+                last = rs.paging_state is None
+                out = format_rows(rs, header=True,
+                                  footer_count=total if last else -1)
                 if out:
                     emit(out)
                 page = rs
                 while page.paging_state is not None:
                     page = session.execute(stmt, fetch_size=5000,
                                            paging_state=page.paging_state)
-                    out = format_rows(page)
+                    total += len(page.rows)
+                    last = page.paging_state is None
+                    out = format_rows(page, header=False,
+                                      footer_count=total if last else -1)
                     if out:
                         emit(out)
                 # rs stays the FIRST page: its trace block prints below

@@ -415,3 +415,60 @@ def test_range_delete_replicates(cluster):
     s2.keyspace = "ks"
     got = sorted(r[0] for r in s2.execute("SELECT c FROM rd WHERE k = 1"))
     assert got == [0, 1, 2]
+
+
+def test_paxos_state_survives_replica_restart(cluster):
+    """A restarted replica must still know its promises and in-flight
+    accepted values (system.paxos persistence): a prepare after the
+    restart sees the accepted proposal and finishes it, and stale
+    ballots stay rejected (service/paxos/PaxosState.java)."""
+    from cassandra_tpu.cluster.paxos import Ballot, PaxosService
+    from cassandra_tpu.cluster.messaging import Message
+    from cassandra_tpu.storage.mutation import Mutation
+
+    n2 = cluster.node(2)
+    t = cluster.schema.get_table("ks", "kv")
+    pk = t.columns["k"].cql_type.serialize(77)
+    m = Mutation(t.id, pk)
+    m.add(b"", 8, b"", t.columns["v"].cql_type.serialize("inflight"),
+          1000, 0x7FFFFFFF, 0, 0)
+    ballot = Ballot(500, "node1")
+
+    def call(verb, payload):
+        handler = {"PAXOS_PREPARE": n2.paxos._handle_prepare,
+                   "PAXOS_PROPOSE": n2.paxos._handle_propose}[verb]
+        return handler(Message(verb, payload, n2.endpoint, n2.endpoint))[1]
+
+    assert call("PAXOS_PREPARE", (t.id, pk, ballot.pack()))["promised"]
+    assert call("PAXOS_PROPOSE",
+                (t.id, pk, ballot.pack(), m.serialize()))["accepted"]
+
+    # crash-restart the replica's paxos service (state only on disk now)
+    n2.paxos = PaxosService(n2)
+
+    # a stale ballot must still be rejected after restart
+    stale = call("PAXOS_PREPARE", (t.id, pk, Ballot(400, "nodeX").pack()))
+    assert not stale["promised"]
+    # a newer prepare must SURFACE the in-flight accepted value
+    rsp = call("PAXOS_PREPARE", (t.id, pk, Ballot(600, "node3").pack()))
+    assert rsp["promised"]
+    assert Ballot.unpack(rsp["accepted_ballot"]) == ballot
+    assert rsp["accepted_value"] == m.serialize()
+
+
+def test_lwt_completes_across_replica_restarts(cluster):
+    """End-to-end: an IF NOT EXISTS decided before a replica restart must
+    keep excluding later contenders afterwards."""
+    from cassandra_tpu.cluster.paxos import PaxosService
+    s = cluster.session(1)
+    s.keyspace = "ks"
+    rs = s.execute("INSERT INTO kv (k, v) VALUES (88, 'first') "
+                   "IF NOT EXISTS")
+    assert rs.rows[0][0] is True
+    for i in (1, 2):
+        n = cluster.node(i + 1)
+        n.paxos = PaxosService(n)     # restart 2 of 3 replicas
+    rs = s.execute("INSERT INTO kv (k, v) VALUES (88, 'second') "
+                   "IF NOT EXISTS")
+    assert rs.rows[0][0] is False
+    assert s.execute("SELECT v FROM kv WHERE k = 88").rows == [("first",)]
