@@ -39,6 +39,8 @@ class Verb:
     REPAIR_VALIDATION_REQ = "REPAIR_VALIDATION_REQ"
     REPAIR_VALIDATION_RSP = "REPAIR_VALIDATION_RSP"
     REPAIR_SYNC_REQ = "REPAIR_SYNC_REQ"
+    REPAIR_ANTICOMPACT_REQ = "REPAIR_ANTICOMPACT_REQ"
+    REPAIR_ANTICOMPACT_RSP = "REPAIR_ANTICOMPACT_RSP"
     BOOTSTRAP_PULL_REQ = "BOOTSTRAP_PULL_REQ"
     FAILURE_RSP = "FAILURE_RSP"
     TRUNCATE_REQ = "TRUNCATE_REQ"

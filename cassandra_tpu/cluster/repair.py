@@ -79,31 +79,141 @@ class RepairService:
                                         self._handle_validation)
         node.messaging.register_handler(Verb.REPAIR_SYNC_REQ,
                                         self._handle_sync)
+        node.messaging.register_handler(Verb.REPAIR_ANTICOMPACT_REQ,
+                                        self._handle_anticompact)
 
     # ------------------------------------------------------------ handlers
 
     def _local_batch(self, keyspace, table_name):
         return self.node.engine.store(keyspace, table_name).scan_all()
 
-    def _handle_validation(self, msg):
-        keyspace, table_name, depth = msg.payload
+    def _validate_local(self, keyspace, table_name, depth, incremental):
+        """Build the local validation tree. For an incremental session,
+        FLUSH first and capture the unrepaired sstable generations that
+        existed at validation time — only exactly those may be stamped
+        repaired later (an sstable flushed mid-repair was never
+        validated). Validation itself always covers the FULL data:
+        comparing unrepaired-only views diverges once repaired status
+        differs across replicas for the same cells; full trees converge
+        and the anticompaction step still delivers the compaction-split
+        benefit (the pre-consistent-repair reference model)."""
         table = self.node.schema.get_table(keyspace, table_name)
-        tree = build_validation_tree(table, self._local_batch(
-            keyspace, table_name), depth)
+        gens = []
+        if incremental:
+            cfs = self.node.engine.store(keyspace, table_name)
+            cfs.flush()
+            gens = [s.desc.generation for s in cfs.live_sstables()
+                    if not s.is_repaired]
+        tree = build_validation_tree(
+            table, self._local_batch(keyspace, table_name), depth)
+        return tree, gens
+
+    def _handle_validation(self, msg):
+        keyspace, table_name, depth, *rest = msg.payload
+        incremental = bool(rest[0]) if rest else False
+        tree, gens = self._validate_local(keyspace, table_name, depth,
+                                          incremental)
+        if incremental:
+            return Verb.REPAIR_VALIDATION_RSP, (tree.serialize(), gens)
         return Verb.REPAIR_VALIDATION_RSP, tree.serialize()
 
     def _handle_sync(self, msg):
-        keyspace, table_name, lo, hi = msg.payload
+        keyspace, table_name, lo, hi, *rest = msg.payload
         batch = filter_token_range(self._local_batch(keyspace, table_name),
                                    lo, hi)
         return Verb.RANGE_RSP, cb_serialize(batch)
 
+    def _handle_anticompact(self, msg):
+        keyspace, table_name, ranges, repaired_at, *rest = msg.payload
+        gens = set(rest[0]) if rest and rest[0] is not None else None
+        n = self.anticompact_local(keyspace, table_name,
+                                   [tuple(r) for r in ranges],
+                                   int(repaired_at), gens)
+        return Verb.REPAIR_ANTICOMPACT_RSP, n
+
+    # ------------------------------------------------------ anticompaction
+
+    def anticompact_local(self, keyspace, table_name, ranges,
+                          repaired_at: int, gens=None) -> int:
+        """Split every UNREPAIRED sstable at the repaired-range boundary:
+        in-range cells land in a new sstable stamped repaired_at,
+        out-of-range cells in a new unrepaired one
+        (db/compaction/CompactionManager.java:838 doAntiCompaction).
+        Returns the number of sstables rewritten."""
+        import numpy as np
+
+        from ..storage.lifecycle import LifecycleTransaction
+        from ..storage.sstable import Descriptor, SSTableReader, \
+            SSTableWriter
+
+        cfs = self.node.engine.store(keyspace, table_name)
+        MIN = -(1 << 63)
+        done = 0
+        for sst in list(cfs.live_sstables()):
+            if sst.is_repaired:
+                continue
+            if gens is not None and sst.desc.generation not in gens:
+                continue   # flushed after validation: never validated
+            segs = list(sst.scanner())
+            if not segs:
+                continue
+            cat = cb.CellBatch.concat(segs)
+            cat.sorted = True
+            toks = batch_tokens(cat)
+            in_mask = np.zeros(len(cat), dtype=bool)
+            for lo, hi in ranges:
+                if lo == MIN:
+                    in_mask |= toks <= hi
+                else:
+                    in_mask |= (toks > lo) & (toks <= hi)
+            txn = LifecycleTransaction(cfs.directory)
+            new_readers = []
+            writers = []
+            try:
+                for mask, rep in ((in_mask, repaired_at), (~in_mask, 0)):
+                    idx = np.flatnonzero(mask)
+                    if len(idx) == 0:
+                        continue
+                    gen = cfs.next_generation()
+                    desc = Descriptor(cfs.directory, gen)
+                    txn.track_new(gen)
+                    w = SSTableWriter(desc, cfs.table,
+                                      estimated_partitions=sst.n_partitions)
+                    writers.append(w)
+                    w.repaired_at = rep
+                    part = cat.apply_permutation(idx)
+                    part.sorted = True
+                    w.append(part)
+                    w.finish()
+                    new_readers.append(SSTableReader(desc, cfs.table))
+                txn.track_obsolete(sst.desc.generation)
+                txn.commit()
+                cfs.tracker.replace([sst], new_readers)
+                sst.release()
+                done += 1
+            except BaseException:
+                for w in writers:
+                    try:
+                        w.abort()
+                    except Exception:
+                        pass
+                for r in new_readers:
+                    r.close()
+                txn.abort()
+                raise
+        return done
+
     # --------------------------------------------------------- coordinator
 
     def repair_table(self, keyspace: str, table_name: str,
-                     depth: int = 10, timeout: float = 10.0) -> dict:
+                     depth: int = 10, timeout: float = 10.0,
+                     incremental: bool = False) -> dict:
         """Full-range repair of one table across its replica set
-        (RepairJob). Returns stats."""
+        (RepairJob). incremental=True validates/syncs only data that was
+        never repaired, then ANTICOMPACTS on every replica: synced
+        ranges split out of unrepaired sstables and are stamped
+        repairedAt, so future repairs skip them and compaction never
+        mixes across the boundary (repair/consistent/). Returns stats."""
         node = self.node
         ks = node.schema.keyspaces[keyspace]
         strat = ReplicationStrategy.create(ks.params.replication)
@@ -114,8 +224,16 @@ class RepairService:
                     replicas.add(r)
         replicas = sorted(replicas, key=lambda e: e.name)
         live = [r for r in replicas if node.is_alive(r)]
+        if incremental and len(live) < len(replicas):
+            # stamping data repaired while a replica is down would hide
+            # its missing writes from future sessions (the reference
+            # refuses incremental repair with dead endpoints)
+            raise RuntimeError(
+                f"incremental repair requires all replicas up "
+                f"({len(live)}/{len(replicas)} live); run full repair")
 
         trees = {}
+        val_gens: dict = {}
         table = node.schema.get_table(keyspace, table_name)
         ev = threading.Event()
         lock = threading.Lock()
@@ -126,20 +244,26 @@ class RepairService:
         for ep in live:
             if ep == node.endpoint:
                 with lock:
-                    trees[ep] = build_validation_tree(
-                        table, self._local_batch(keyspace, table_name),
-                        depth)
+                    tree, gens = self._validate_local(
+                        keyspace, table_name, depth, incremental)
+                    trees[ep] = tree
+                    val_gens[ep] = gens
                     if want_all():
                         ev.set()
             else:
                 def on_rsp(m, e=ep):
                     with lock:
-                        trees[e] = MerkleTree.deserialize(m.payload)
+                        if incremental:
+                            tree_b, gens = m.payload
+                            trees[e] = MerkleTree.deserialize(tree_b)
+                            val_gens[e] = list(gens)
+                        else:
+                            trees[e] = MerkleTree.deserialize(m.payload)
                         if want_all():
                             ev.set()
                 node.messaging.send_with_callback(
                     Verb.REPAIR_VALIDATION_REQ,
-                    (keyspace, table_name, depth), ep,
+                    (keyspace, table_name, depth, incremental), ep,
                     on_response=on_rsp, timeout=timeout)
         ev.wait(timeout)
         if len(trees) < len(live):
@@ -176,6 +300,44 @@ class RepairService:
                                              lo, hi, timeout)
                         stats["ranges_synced"] += 1
                         stats["cells_streamed"] += n
+
+        if incremental:
+            # the whole token space is now consistent across the replica
+            # set: anticompact everywhere so repaired data crosses the
+            # boundary and future incremental repairs skip it
+            import time as _time
+            repaired_at = int(_time.time() * 1000)
+            ranges = [(-(1 << 63), (1 << 63) - 1)]
+            done = {}
+            aev = threading.Event()
+
+            def want_all_ac():
+                return len(done) >= len(live)
+
+            for ep in live:
+                if ep == node.endpoint:
+                    with lock:
+                        done[ep] = self.anticompact_local(
+                            keyspace, table_name, ranges, repaired_at,
+                            set(val_gens.get(ep, [])))
+                        if want_all_ac():
+                            aev.set()
+                else:
+                    def on_ac(m, e=ep):
+                        with lock:
+                            done[e] = m.payload
+                            if want_all_ac():
+                                aev.set()
+                    node.messaging.send_with_callback(
+                        Verb.REPAIR_ANTICOMPACT_REQ,
+                        (keyspace, table_name, ranges, repaired_at,
+                         val_gens.get(ep, [])), ep,
+                        on_response=on_ac, timeout=timeout)
+            if not aev.wait(timeout):
+                raise TimeoutError(
+                    f"anticompaction acks {len(done)}/{len(live)}")
+            stats["anticompacted"] = sum(done.values())
+            stats["repaired_at"] = repaired_at
         return stats
 
     def _fetch_range(self, ep, keyspace, table_name, lo, hi, timeout):
@@ -261,8 +423,10 @@ class RepairService:
     def _sync_range(self, keyspace, table_name, a, b, lo, hi,
                     timeout) -> int:
         table = self.node.schema.get_table(keyspace, table_name)
-        batch_a = self._fetch_range(a, keyspace, table_name, lo, hi, timeout)
-        batch_b = self._fetch_range(b, keyspace, table_name, lo, hi, timeout)
+        batch_a = self._fetch_range(a, keyspace, table_name, lo, hi,
+                                    timeout)
+        batch_b = self._fetch_range(b, keyspace, table_name, lo, hi,
+                                    timeout)
         merged = cb.merge_sorted([batch_a, batch_b])
         digest_a = _digest(batch_a)
         digest_b = _digest(batch_b)

@@ -19,20 +19,33 @@ from ..utils import timeutil
 
 
 class AbstractCompactionStrategy:
-    def __init__(self, cfs, options: dict | None = None):
+    def __init__(self, cfs, options: dict | None = None,
+                 repaired: bool | None = None):
         self.cfs = cfs
         self.options = options or {}
+        # repaired/unrepaired split (CompactionStrategyManager.java:107):
+        # a strategy instance only ever sees ONE side of the boundary —
+        # None (tools/tests constructing a strategy directly) sees all
+        self.repaired = repaired
         self.min_threshold = int(self.options.get("min_threshold", 4))
         self.max_threshold = int(self.options.get("max_threshold", 32))
+
+    def candidates(self) -> list[SSTableReader]:
+        """The live sstables THIS strategy instance may select — never
+        across the repaired/unrepaired boundary."""
+        live = self.cfs.live_sstables()
+        if self.repaired is None:
+            return live
+        return [s for s in live if s.is_repaired == self.repaired]
 
     def next_background_task(self):
         """Return a CompactionTask or None (getNextBackgroundTask)."""
         raise NotImplementedError
 
     def major_task(self):
-        """Compact everything (nodetool compact / major compaction)."""
+        """Compact everything on THIS side of the repaired boundary."""
         from .task import CompactionTask
-        live = self.cfs.live_sstables()
+        live = self.candidates()
         if len(live) < 1:
             return None
         return CompactionTask(self.cfs, live)
@@ -46,13 +59,14 @@ class AbstractCompactionStrategy:
         gc_before = timeutil.now_seconds() - \
             self.cfs.table.params.gc_grace_seconds
         out = []
-        live = self.cfs.live_sstables()
+        live = self.cfs.live_sstables()   # overlap guard: ALL live
+        cands = self.candidates()
         # the purge guard consults the memtable; dropping against a hot
         # memtable could rewrite the sstable unchanged and re-select it
         # forever (livelock) — wait for a flush instead
         if not self.cfs.memtable.is_empty:
             return out
-        for s in live:
+        for s in cands:
             if s.max_ldt is None or s.max_ldt >= gc_before:
                 continue
             if s.n_tombstones < s.n_cells:
@@ -75,15 +89,15 @@ class SizeTieredCompactionStrategy(AbstractCompactionStrategy):
     """Bucket sstables of similar size; compact the biggest eligible
     bucket (hottest-first is a refinement we skip: reference :116)."""
 
-    def __init__(self, cfs, options=None):
-        super().__init__(cfs, options)
+    def __init__(self, cfs, options=None, repaired=None):
+        super().__init__(cfs, options, repaired)
         self.bucket_low = float(self.options.get("bucket_low", 0.5))
         self.bucket_high = float(self.options.get("bucket_high", 1.5))
         self.min_sstable_size = int(self.options.get(
             "min_sstable_size", 50 * 1024 * 1024))
 
     def buckets(self) -> list[list[SSTableReader]]:
-        ssts = sorted(self.cfs.live_sstables(), key=lambda s: s.data_size)
+        ssts = sorted(self.candidates(), key=lambda s: s.data_size)
         buckets: list[tuple[float, list[SSTableReader]]] = []
         for s in ssts:
             size = s.data_size
@@ -113,8 +127,8 @@ class LeveledCompactionStrategy(AbstractCompactionStrategy):
     """Simplified leveled strategy: L0 (flushes) -> L1..: non-overlapping
     runs, each level `fanout` times larger (LeveledManifest semantics)."""
 
-    def __init__(self, cfs, options=None):
-        super().__init__(cfs, options)
+    def __init__(self, cfs, options=None, repaired=None):
+        super().__init__(cfs, options, repaired)
         self.max_sstable_bytes = int(float(self.options.get(
             "sstable_size_in_mb", 160)) * 1024 * 1024)
         self.fanout = int(self.options.get("fanout_size", 10))
@@ -122,7 +136,7 @@ class LeveledCompactionStrategy(AbstractCompactionStrategy):
 
     def _levels(self) -> dict[int, list[SSTableReader]]:
         levels: dict[int, list[SSTableReader]] = {}
-        for s in self.cfs.live_sstables():
+        for s in self.candidates():
             levels.setdefault(s.level, []).append(s)
         return levels
 
@@ -166,8 +180,8 @@ class TimeWindowCompactionStrategy(AbstractCompactionStrategy):
 
     _UNITS = {"MINUTES": 60, "HOURS": 3600, "DAYS": 86400}
 
-    def __init__(self, cfs, options=None):
-        super().__init__(cfs, options)
+    def __init__(self, cfs, options=None, repaired=None):
+        super().__init__(cfs, options, repaired)
         unit = str(self.options.get("compaction_window_unit",
                                     "DAYS")).upper()
         size = int(self.options.get("compaction_window_size", 1))
@@ -184,7 +198,7 @@ class TimeWindowCompactionStrategy(AbstractCompactionStrategy):
             # dropping needs no merge: rewrite-free task over expired only
             return CompactionTask(self.cfs, expired)
         windows: dict[int, list[SSTableReader]] = {}
-        for s in self.cfs.live_sstables():
+        for s in self.candidates():
             windows.setdefault(self._window_of(s), []).append(s)
         if not windows:
             return None
@@ -209,8 +223,8 @@ class UnifiedCompactionStrategy(AbstractCompactionStrategy):
     logical compaction across cores/chips (ShardManager.java:33; the mesh
     path in parallel/mesh.py consumes exactly these shards)."""
 
-    def __init__(self, cfs, options=None):
-        super().__init__(cfs, options)
+    def __init__(self, cfs, options=None, repaired=None):
+        super().__init__(cfs, options, repaired)
         # e.g. scaling_parameters: "T4" (w=2), "L4" (w=-2), "N" (w=0)
         spec = str(self.options.get("scaling_parameters", "T4"))
         self.w = self._parse_w(spec)
@@ -236,7 +250,7 @@ class UnifiedCompactionStrategy(AbstractCompactionStrategy):
     def next_background_task(self):
         from .task import CompactionTask
         levels: dict[int, list[SSTableReader]] = {}
-        for s in self.cfs.live_sstables():
+        for s in self.candidates():
             levels.setdefault(self._level_of(s), []).append(s)
         threshold = self.fanout if self.w >= 0 else 2
         for lvl in sorted(levels):
@@ -260,9 +274,65 @@ STRATEGIES = {
 }
 
 
-def get_strategy(cfs) -> AbstractCompactionStrategy:
+class CompactionStrategyManager:
+    """Holds one strategy instance per side of the repaired boundary and
+    never lets a compaction cross it
+    (db/compaction/CompactionStrategyManager.java:107). Background
+    selection serves whichever side has work; major compaction runs each
+    side as its own task."""
+
+    def __init__(self, cfs, cls, opts):
+        self.cfs = cfs
+        self.unrepaired = cls(cfs, opts, repaired=False)
+        self.repaired = cls(cfs, opts, repaired=True)
+
+    def __getattr__(self, name):
+        # strategy-specific helpers (tests/tools introspection) resolve
+        # against the unrepaired instance
+        return getattr(self.unrepaired, name)
+
+    def next_background_task(self):
+        return self.unrepaired.next_background_task() \
+            or self.repaired.next_background_task()
+
+    def major_task(self):
+        tasks = [t for t in (self.unrepaired.major_task(),
+                             self.repaired.major_task()) if t is not None]
+        if not tasks:
+            return None
+        return _SequentialTasks(tasks)
+
+
+class _SequentialTasks:
+    """Several group-local tasks behind the single-task call surface."""
+
+    def __init__(self, tasks):
+        self.tasks = tasks
+        self.inputs = [s for t in tasks for s in t.inputs]
+
+    def execute(self) -> dict:
+        stats = None
+        for t in self.tasks:
+            st = t.execute()
+            if stats is None:
+                stats = st
+            else:
+                for k in ("bytes_read", "bytes_written", "cells_read",
+                          "cells_written", "seconds"):
+                    stats[k] += st[k]
+                stats["outputs"] += st["outputs"]
+                stats["inputs"] += st["inputs"]
+        if stats and stats.get("seconds"):
+            stats["read_mib_s"] = stats["bytes_read"] / stats["seconds"] \
+                / 2**20
+            stats["write_mib_s"] = stats["bytes_written"] \
+                / stats["seconds"] / 2**20
+        return stats
+
+
+def get_strategy(cfs) -> CompactionStrategyManager:
     opts = dict(cfs.table.params.compaction)
     name = opts.pop("class", "SizeTieredCompactionStrategy").rsplit(".", 1)[-1]
     if name not in STRATEGIES:
         raise ValueError(f"unknown compaction strategy {name}")
-    return STRATEGIES[name](cfs, opts)
+    return CompactionStrategyManager(cfs, STRATEGIES[name], opts)

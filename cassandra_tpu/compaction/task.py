@@ -256,6 +256,10 @@ class CompactionTask:
                               estimated_partitions=max(
                                   sum(r.n_partitions for r in self.inputs), 16))
             w.level = self.level
+            # outputs carry the MINIMUM repairedAt of the inputs
+            # (CompactionTask.getMinRepairedAt): mixing repaired with
+            # unrepaired demotes to unrepaired, never promotes
+            w.repaired_at = min(r.repaired_at for r in self.inputs)
             writers.append(w)
             return w
 

@@ -124,6 +124,15 @@ class SSTableReader:
     def n_tombstones(self) -> int:
         return int(self.stats.get("tombstones", 0))
 
+    @property
+    def repaired_at(self) -> int:
+        """repairedAt millis; 0 = unrepaired (StatsMetadata.repairedAt)."""
+        return int(self.stats.get("repaired_at", 0))
+
+    @property
+    def is_repaired(self) -> bool:
+        return self.repaired_at > 0
+
     def partition_key_at(self, i: int) -> bytes:
         return self._pk_blob[self._pk_off[i]:self._pk_off[i + 1]]
 

@@ -59,6 +59,10 @@ class SSTableWriter:
             "tombstones": 0,
         }
         self.level = 0   # LCS level (recorded in Statistics.db)
+        # repairedAt epoch millis; 0 = unrepaired (reference
+        # StatsMetadata.repairedAt — the repaired/unrepaired compaction
+        # split and incremental repair key off this)
+        self.repaired_at = 0
         self._finished = False
 
     # ---------------------------------------------------------------- api --
@@ -295,6 +299,7 @@ class SSTableWriter:
             "n_partitions": len(self._part_lane4),
             "compression": self.params.to_dict(),
             "level": self.level,
+            "repaired_at": self.repaired_at,
             **self._stats,
         }
         with open(self.desc.tmp_path(Component.STATS), "w") as f:

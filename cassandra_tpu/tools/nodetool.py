@@ -95,13 +95,17 @@ def tablestats(engine, keyspace: str | None = None) -> dict:
     return out
 
 
-def repair(node, keyspace: str, table: str | None = None) -> list[dict]:
-    """nodetool repair."""
+def repair(node, keyspace: str, table: str | None = None,
+           full: bool = False) -> list[dict]:
+    """nodetool repair — incremental by default like the reference
+    (validate/sync only unrepaired data, then anticompact); --full
+    validates everything and leaves repaired status untouched."""
     out = []
     ks = node.schema.keyspaces[keyspace]
     for name in ([table] if table else list(ks.tables)):
         out.append({"table": f"{keyspace}.{name}",
-                    **node.repair.repair_table(keyspace, name)})
+                    **node.repair.repair_table(keyspace, name,
+                                               incremental=not full)})
     return out
 
 
