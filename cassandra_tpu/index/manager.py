@@ -1,8 +1,12 @@
-"""Secondary indexes: equality 2i + TPU vector ANN.
+"""Secondary indexes: equality 2i + TPU vector ANN, storage-attached.
 
 Reference counterpart: index/Index.java SPI + SecondaryIndexManager; the
-classic 2i (index/internal/: index-as-hidden-table keyed by the indexed
-value) and SAI's vector index (index/sai/disk/v1/vector/, jvector ANN).
+storage-attached model is SAI's (index/sai/): every sstable carries its
+own index component (sstable_index.py), built once from that sstable and
+dropped with it — no global rebuild, no unbounded in-memory map, restart
+reopens components from disk. The memtable portion is served by scanning
+the memtable's sorted cache at query time (small, always fresh; the
+reference keeps a trie memtable index for the same role).
 
 The TPU-native twist: the vector index does exact brute-force top-k as a
 single batched matmul on the device — for the dimensions and row counts a
@@ -17,77 +21,154 @@ import threading
 import numpy as np
 
 from ..schema import TableMetadata
-from ..storage.rows import row_to_dict, rows_from_batch
+from . import sstable_index as ssi
 
 
-class EqualityIndex:
-    """Hidden-table-style 2i: indexed value -> set of (pk, ck) locators.
-    Maintained on write through IndexManager.on_mutation and rebuilt from
-    existing data at creation (index build)."""
+class _AttachedIndex:
+    """Shared machinery: per-sstable component cache keyed by generation,
+    lazily built+loaded; memtable served live."""
 
-    def __init__(self, table: TableMetadata, column: str):
+    def __init__(self, backend, table: TableMetadata, column: str):
+        self.backend = backend
         self.table = table
         self.column = column
-        self.col_meta = table.columns[column]
-        self._map: dict[bytes, set] = {}
+        self.col_id = table.columns[column].column_id
+        self._cache: dict = {}          # generation -> loaded component
         self._lock = threading.Lock()
 
-    def put(self, value: bytes, pk: bytes, ck: bytes) -> None:
-        with self._lock:
-            self._map.setdefault(value, set()).add((pk, ck))
+    def _cfs(self):
+        return self.backend.store(self.table.keyspace, self.table.name)
 
-    def remove(self, value: bytes, pk: bytes, ck: bytes) -> None:
+    def _component(self, reader):
+        """Load (or build-once, then load) this sstable's component.
+        Serialized under the index lock: concurrent first-touch queries
+        must not race the build, and a failed load must NEVER cache None
+        (that would silently drop the sstable from every future lookup)."""
+        gen = reader.desc.generation
         with self._lock:
-            s = self._map.get(value)
-            if s:
-                s.discard((pk, ck))
+            if gen in self._cache:
+                return self._cache[gen]
+            path = ssi.component_path(reader.desc, self.col_id)
+            loaded = self._load(path)
+            if loaded is None:
+                self._build(reader)
+                loaded = self._load(path)
+            if loaded is None:   # disk refused twice: serve from memory
+                loaded = self._fresh(reader)
+            self._cache[gen] = loaded
+            # drop cache entries for dead sstables
+            live = {r.desc.generation for r in self._cfs().live_sstables()}
+            for g in [g for g in self._cache if g not in live
+                      and g != gen]:
+                del self._cache[g]
+            return loaded
+
+    def _memtable_entries(self):
+        """(value, pk, ck) for live cells of the column in the memtable."""
+        mem = self._cfs().memtable.scan()
+        if len(mem):
+            yield from ssi.iter_column_cells(mem, self.col_id)
+
+
+class EqualityIndex(_AttachedIndex):
+    """Storage-attached 2i: value -> (pk, ck) locators, one component per
+    sstable (index/internal hidden-table role, SAI storage model)."""
+
+    def _build(self, reader):
+        ssi.build_equality(reader, self.table, self.col_id)
+
+    def _load(self, path):
+        return ssi.load_equality(path)
+
+    def _fresh(self, reader):
+        out: dict = {}
+        for seg in reader.scanner():
+            for v, pk, ck in ssi.iter_column_cells(seg, self.col_id):
+                out.setdefault(v, []).append((pk, ck))
+        return out
 
     def lookup(self, value: bytes) -> list:
-        with self._lock:
-            return sorted(self._map.get(value, ()))
+        out = set()
+        for v, pk, ck in self._memtable_entries():
+            if v == value:
+                out.add((pk, ck))
+        for reader in self._cfs().live_sstables():
+            comp = self._component(reader)
+            if comp:
+                out.update(comp.get(value, ()))
+        return sorted(out)
 
 
-class VectorIndex:
-    """Exact ANN over vector<float, d> columns via device matmul."""
+class VectorIndex(_AttachedIndex):
+    """Exact ANN over vector<float, d> columns via device matmul, matrices
+    persisted per sstable (index/sai/disk/v1/vector role)."""
 
-    def __init__(self, table: TableMetadata, column: str):
-        self.table = table
-        self.column = column
+    def __init__(self, backend, table: TableMetadata, column: str):
+        super().__init__(backend, table, column)
         self.dim = table.columns[column].cql_type.dimension
-        self._keys: list[tuple[bytes, bytes]] = []
-        self._rows: list[np.ndarray] = []
-        self._matrix: np.ndarray | None = None
-        self._lock = threading.Lock()
 
-    def put(self, value: bytes, pk: bytes, ck: bytes) -> None:
-        """Last write wins: an updated vector REPLACES the row's entry (no
-        stale embeddings ranking the row, no duplicate hits)."""
-        vec = np.frombuffer(value, dtype=">f4").astype(np.float32)
-        with self._lock:
-            for i, k in enumerate(self._keys):
-                if k == (pk, ck):
-                    self._rows[i] = vec
-                    self._matrix = None
-                    return
-            self._keys.append((pk, ck))
-            self._rows.append(vec)
-            self._matrix = None
+    def _build(self, reader):
+        ssi.build_vector(reader, self.table, self.col_id, self.dim)
 
-    def remove(self, value: bytes, pk: bytes, ck: bytes) -> None:
-        with self._lock:
-            for i, k in enumerate(self._keys):
-                if k == (pk, ck):
-                    self._keys.pop(i)
-                    self._rows.pop(i)
-                    self._matrix = None
-                    return
+    def _load(self, path):
+        return ssi.load_vector(path)
 
-    def _mat(self) -> np.ndarray:
-        with self._lock:
-            if self._matrix is None and self._rows:
-                self._matrix = np.stack(self._rows)
-            return self._matrix if self._matrix is not None \
-                else np.zeros((0, self.dim), np.float32)
+    def _fresh(self, reader):
+        rows, keys = [], []
+        for seg in reader.scanner():
+            for v, pk, ck in ssi.iter_column_cells(seg, self.col_id):
+                rows.append(np.frombuffer(v, dtype=">f4")
+                            .astype(np.float32))
+                keys.append((pk, ck))
+        mat = np.stack(rows) if rows \
+            else np.zeros((0, self.dim), np.float32)
+        return mat, keys
+
+    def _gather(self):
+        """(matrix, keys): memtable vectors + every live sstable's
+        persisted matrix, newest-first so duplicate locators keep the
+        freshest embedding. Cached until the live set or memtable
+        changes (repeat ANN queries pay one matmul, not re-assembly)."""
+        cfs = self._cfs()
+        mem = cfs.memtable
+        ver = (tuple(sorted(r.desc.generation
+                            for r in cfs.live_sstables())),
+               id(mem), mem.ops)
+        cached = getattr(self, "_gather_cache", None)
+        if cached is not None and cached[0] == ver:
+            return cached[1]
+        mats = []
+        keys: list = []
+        seen: set = set()
+        mem_rows = []
+        for value, pk, ck in self._memtable_entries():
+            k = (pk, ck)
+            if k in seen:
+                continue
+            seen.add(k)
+            mem_rows.append(np.frombuffer(value, dtype=">f4")
+                            .astype(np.float32))
+            keys.append(k)
+        if mem_rows:
+            mats.append(np.stack(mem_rows))
+        # newest sstables first: later-generation data wins dedup
+        for reader in sorted(self._cfs().live_sstables(),
+                             key=lambda r: -r.desc.generation):
+            comp = self._component(reader)
+            if comp is None:
+                continue
+            mat, locs = comp
+            take = [i for i, k in enumerate(locs) if k not in seen]
+            seen.update(locs[i] for i in take)
+            if take:
+                mats.append(mat[take])
+                keys.extend(locs[i] for i in take)
+        if not mats:
+            result = (np.zeros((0, self.dim), np.float32), [])
+        else:
+            result = (np.concatenate(mats, axis=0), keys)
+        self._gather_cache = (ver, result)
+        return result
 
     def ann(self, query: np.ndarray, k: int,
             similarity: str = "cosine") -> list:
@@ -96,7 +177,7 @@ class VectorIndex:
         import jax
         import jax.numpy as jnp
 
-        m = self._mat()
+        m, keys = self._gather()
         if len(m) == 0:
             return []
         q = np.asarray(query, dtype=np.float32)
@@ -113,12 +194,14 @@ class VectorIndex:
             scores = -jnp.sum((mm - qq[None, :]) ** 2, axis=1)
         k = min(k, len(m))
         vals, idx = jax.lax.top_k(scores, k)
-        return [(self._keys[int(i)][0], self._keys[int(i)][1], float(v))
+        return [(keys[int(i)][0], keys[int(i)][1], float(v))
                 for v, i in zip(np.asarray(vals), np.asarray(idx))]
 
 
 class IndexManager:
-    """Registry + write-path hook (SecondaryIndexManager role)."""
+    """Registry (SecondaryIndexManager role). No write-path hook: the
+    memtable is scanned at query time and sstable components attach to
+    the sstables themselves."""
 
     def __init__(self, backend):
         self.backend = backend
@@ -134,13 +217,12 @@ class IndexManager:
             return self.indexes[key]
         col = table.columns[column]
         if isinstance(col.cql_type, VectorType):
-            idx = VectorIndex(table, column)
+            idx = VectorIndex(self.backend, table, column)
         else:
-            idx = EqualityIndex(table, column)
+            idx = EqualityIndex(self.backend, table, column)
         self.indexes[key] = idx
         self.by_name[(table.keyspace,
                       name or f"{table.name}_{column}_idx")] = key
-        self._build(table, idx)
         return idx
 
     def drop(self, keyspace: str, name: str):
@@ -151,29 +233,3 @@ class IndexManager:
 
     def get(self, keyspace: str, table: str, column: str):
         return self.indexes.get((keyspace, table, column))
-
-    def _build(self, table: TableMetadata, idx) -> None:
-        """Index build from existing data (ViewBuilder/index build role)."""
-        store = self.backend.store(table.keyspace, table.name)
-        batch = store.scan_all()
-        col_id = table.columns[idx.column].column_id
-        for r in rows_from_batch(table, batch):
-            v = r.cells.get(col_id)
-            if v is not None:
-                idx.put(v, r.pk, r.ck_frame)
-
-    def on_mutation(self, table: TableMetadata, mutation) -> None:
-        """Write-path maintenance: add new values (stale entries are
-        filtered at read time by re-checking the base row — the
-        read-before-write the reference's 2i also avoids)."""
-        wanted = {c for (ks, tb, c) in self.indexes
-                  if ks == table.keyspace and tb == table.name}
-        if not wanted:
-            return
-        by_id = {table.columns[c].column_id: c for c in wanted}
-        for ck, column, path, value, ts, ldt, ttl, flags in mutation.ops:
-            cname = by_id.get(column)
-            if cname is None or not value:
-                continue
-            self.indexes[(table.keyspace, table.name, cname)].put(
-                value, mutation.pk, ck)

@@ -1,0 +1,163 @@
+"""Per-sstable attached index components — the SAI storage model.
+
+Reference counterpart: index/sai/ (StorageAttachedIndex: every sstable
+carries its own index component, built at flush/compaction time or on
+first use, dropped with the sstable). No global rebuild ever happens: a
+restart reopens components from disk, and an sstable that appears through
+any path (flush, compaction, anticompaction, streaming, bulk load) gets
+its component built once from that sstable alone.
+
+Formats (little-endian, CRC-trailed):
+  equality  [u32 n][records: vint vlen, v, vint pklen, pk, vint cklen, ck]
+  vector    [u32 n][u32 dim][f32 matrix n*dim]
+            [locators: vint pklen, pk, vint cklen, ck]*n
+Both end with [u32 crc32(body)].
+"""
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+import numpy as np
+
+from ..schema import TableMetadata
+from ..utils import varint as vi
+
+
+def component_path(desc, column_id: int) -> str:
+    return os.path.join(desc.directory,
+                        f"{desc.version}-{desc.generation}"
+                        f"-Index_{column_id}.db")
+
+
+def iter_column_cells(batch, column_id: int):
+    """(value, pk, ck) for every LIVE cell of the column in a CellBatch
+    (dead cells carry no value worth indexing; stale entries are filtered
+    at read time by re-checking the base row). Shared by the sstable
+    component builders and the memtable query path."""
+    from ..storage.cellbatch import DEATH_FLAGS
+    C = batch.n_lanes - 9
+    cols = batch.lanes[:, 6 + C]
+    hits = np.flatnonzero((cols == column_id)
+                          & ((batch.flags & DEATH_FLAGS) == 0))
+    for i in hits:
+        ck, _path, value = batch.cell_payload(int(i))
+        if value:
+            yield value, batch.partition_key(int(i)), ck
+
+
+def _scan_column(reader, table: TableMetadata, column_id: int):
+    for seg in reader.scanner():
+        yield from iter_column_cells(seg, column_id)
+
+
+def _write(path: str, body: bytes) -> None:
+    import threading
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "wb") as f:
+        f.write(body)
+        f.write(struct.pack("<I", zlib.crc32(body)))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _read(path: str) -> bytes | None:
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except FileNotFoundError:
+        return None
+    if len(data) < 4:
+        return None
+    body, crc = data[:-4], struct.unpack("<I", data[-4:])[0]
+    if zlib.crc32(body) != crc:
+        return None   # torn write: caller rebuilds
+    return body
+
+
+# ---------------------------------------------------------------- equality --
+
+def build_equality(reader, table: TableMetadata, column_id: int) -> str:
+    path = component_path(reader.desc, column_id)
+    out = bytearray()
+    n = 0
+    recs = bytearray()
+    for value, pk, ck in _scan_column(reader, table, column_id):
+        vi.write_unsigned_vint(len(value), recs)
+        recs += value
+        vi.write_unsigned_vint(len(pk), recs)
+        recs += pk
+        vi.write_unsigned_vint(len(ck), recs)
+        recs += ck
+        n += 1
+    out += struct.pack("<I", n)
+    out += recs
+    _write(path, bytes(out))
+    return path
+
+
+def load_equality(path: str) -> dict[bytes, list] | None:
+    body = _read(path)
+    if body is None:
+        return None
+    (n,) = struct.unpack_from("<I", body, 0)
+    pos = 4
+    out: dict[bytes, list] = {}
+    for _ in range(n):
+        ln, pos = vi.read_unsigned_vint(body, pos)
+        v = bytes(body[pos:pos + ln])
+        pos += ln
+        ln, pos = vi.read_unsigned_vint(body, pos)
+        pk = bytes(body[pos:pos + ln])
+        pos += ln
+        ln, pos = vi.read_unsigned_vint(body, pos)
+        ck = bytes(body[pos:pos + ln])
+        pos += ln
+        out.setdefault(v, []).append((pk, ck))
+    return out
+
+
+# ------------------------------------------------------------------ vector --
+
+def build_vector(reader, table: TableMetadata, column_id: int,
+                 dim: int) -> str:
+    path = component_path(reader.desc, column_id)
+    rows = []
+    locs = bytearray()
+    for value, pk, ck in _scan_column(reader, table, column_id):
+        rows.append(np.frombuffer(value, dtype=">f4").astype(np.float32))
+        vi.write_unsigned_vint(len(pk), locs)
+        locs += pk
+        vi.write_unsigned_vint(len(ck), locs)
+        locs += ck
+    mat = np.stack(rows) if rows else np.zeros((0, dim), np.float32)
+    out = bytearray()
+    out += struct.pack("<II", len(rows), dim)
+    out += mat.astype("<f4").tobytes()
+    out += locs
+    _write(path, bytes(out))
+    return path
+
+
+def load_vector(path: str):
+    """(matrix float32 [n, dim], [(pk, ck)] locators) or None."""
+    body = _read(path)
+    if body is None:
+        return None
+    n, dim = struct.unpack_from("<II", body, 0)
+    pos = 8
+    mat = np.frombuffer(body, dtype="<f4", count=n * dim,
+                        offset=pos).reshape(n, dim).astype(np.float32)
+    pos += 4 * n * dim
+    keys = []
+    for _ in range(n):
+        ln, pos = vi.read_unsigned_vint(body, pos)
+        pk = bytes(body[pos:pos + ln])
+        pos += ln
+        ln, pos = vi.read_unsigned_vint(body, pos)
+        ck = bytes(body[pos:pos + ln])
+        pos += ln
+        keys.append((pk, ck))
+    return mat, keys

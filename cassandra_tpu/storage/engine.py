@@ -142,9 +142,6 @@ class StorageEngine:
             trace(f"Appending to commitlog and memtable "
                   f"({len(mutation.ops)} ops)")
         cfs.apply(mutation, self.commitlog, durable)
-        t = self.schema.table_by_id(mutation.table_id)
-        if t is not None and getattr(self, "indexes", None) is not None:
-            self.indexes.on_mutation(t, mutation)
         if cfs.should_flush():
             cfs.flush()
 

@@ -252,6 +252,9 @@ class ColumnFamilyStore:
             self.tracker.replace(old, [])
             for sst in old:
                 sst.close()
-                for p in sst.desc.all_paths():
-                    if os.path.exists(p):
-                        os.remove(p)
+                # the whole generation family: standard components AND
+                # attached index components (Index_<col>.db)
+                prefix = f"{sst.desc.version}-{sst.desc.generation}-"
+                for fn in os.listdir(self.directory):
+                    if fn.startswith(prefix):
+                        os.remove(os.path.join(self.directory, fn))

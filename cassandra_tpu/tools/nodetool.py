@@ -97,9 +97,11 @@ def tablestats(engine, keyspace: str | None = None) -> dict:
 
 def repair(node, keyspace: str, table: str | None = None,
            full: bool = False) -> list[dict]:
-    """nodetool repair — incremental by default like the reference
-    (validate/sync only unrepaired data, then anticompact); --full
-    validates everything and leaves repaired status untouched."""
+    """nodetool repair — incremental by default: validation still covers
+    the FULL data set (unrepaired-only trees diverge once repaired
+    status differs across replicas), but afterwards the validated
+    unrepaired sstables are ANTICOMPACTED and stamped repairedAt so the
+    compaction split applies; --full skips the stamping entirely."""
     out = []
     ks = node.schema.keyspaces[keyspace]
     for name in ([table] if table else list(ks.tables)):
