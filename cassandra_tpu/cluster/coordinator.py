@@ -62,13 +62,14 @@ class StorageProxy:
     # --------------------------------------------------------------- plan
 
     def _plan(self, keyspace: str, pk: bytes):
-        """(replicas, strategy) — blockFor math needs the configured RF
-        from the strategy, not the materialized endpoint count."""
+        """(replicas, strategy, token) — blockFor math needs the
+        configured RF from the strategy, not the materialized endpoint
+        count."""
         ks = self.node.schema.keyspaces[keyspace]
         strat = ReplicationStrategy.create(ks.params.replication)
         token = self.node.ring.token_of(pk)
         replicas = strat.replicas(self.node.ring, token)
-        return (replicas or [self.node.endpoint]), strat
+        return (replicas or [self.node.endpoint]), strat, token
 
     def _split_live(self, replicas):
         live = [r for r in replicas if self.node.is_alive(r)]
@@ -86,9 +87,21 @@ class StorageProxy:
 
     # -------------------------------------------------------------- write
 
+    def _pending_targets(self, strat, token, natural) -> list[Endpoint]:
+        """Joining nodes acquiring this token's range: writes are
+        DUPLICATED to them (no blockFor credit) so nothing written
+        mid-bootstrap is missing when ownership flips
+        (locator/ReplicaPlans.forWrite pending replicas)."""
+        ring = self.node.ring
+        if not ring.pending:
+            return []
+        future = ring.future_ring()
+        return [r for r in strat.replicas(future, token)
+                if r not in natural]
+
     def mutate(self, keyspace: str, mutation: Mutation,
                cl: str = ConsistencyLevel.ONE) -> None:
-        replicas, strat = self._plan(keyspace, mutation.pk)
+        replicas, strat, token = self._plan(keyspace, mutation.pk)
         block_for = ConsistencyLevel.block_for(cl, strat,
                                                self.node.endpoint.dc)
         live, dead = self._split_live(replicas)
@@ -128,6 +141,23 @@ class StorageProxy:
                     on_failure=lambda mid, t=target: self._write_timeout(
                         handler, t, mutation),
                     timeout=self.timeout)
+        # pending (joining) replicas get every write too; a failed send
+        # leaves a hint so the join still converges
+        for target in self._pending_targets(strat, token, replicas):
+            if target == self.node.endpoint:
+                try:
+                    self.node.engine.apply(mutation)
+                except Exception:
+                    # same contract as a failed remote send: hint so the
+                    # join converges (the hint loop replays self-hints)
+                    self.node.hints.store(target, mutation)
+            else:
+                self.messaging.send_with_callback(
+                    Verb.MUTATION_REQ, mutation.serialize(), target,
+                    on_response=lambda m: None,
+                    on_failure=lambda mid, t=target:
+                        self.node.hints.store(t, mutation),
+                    timeout=self.timeout)
         if not handler.await_(self.timeout):
             raise TimeoutException(
                 f"{len(handler.responses)}/{block_for} acks for {cl}")
@@ -150,7 +180,7 @@ class StorageProxy:
         if cl == ConsistencyLevel.EACH_QUORUM:
             raise ValueError(
                 "EACH_QUORUM ConsistencyLevel is only supported for writes")
-        replicas, strat = self._plan(keyspace, pk)
+        replicas, strat, _token = self._plan(keyspace, pk)
         block_for = ConsistencyLevel.block_for(cl, strat,
                                                self.node.endpoint.dc)
         live, _ = self._split_live(replicas)

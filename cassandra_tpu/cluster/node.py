@@ -112,9 +112,11 @@ class Node:
 
     def _hint_loop(self):
         while not self._stop_hints.wait(0.5):
-            for ep in list(self.ring.endpoints):
-                if ep != self.endpoint and self.hints.has_hints(ep) \
-                        and self.is_alive(ep):
+            # self included: a failed local apply (e.g. as a pending
+            # replica) leaves a self-hint that replays through the
+            # transport loopback
+            for ep in list(self.ring.endpoints) + [self.endpoint]:
+                if self.hints.has_hints(ep) and self.is_alive(ep):
                     try:
                         self._dispatch_hints(ep)
                     except Exception:
@@ -175,26 +177,31 @@ class Node:
     def bootstrap(self) -> int:
         """Pull this node's replica ranges from existing owners and write
         them as local sstables (reference: tcm/sequences/BootstrapAndJoin
-        -> RangeStreamer -> entire-sstable streaming; writes that land
-        during the stream are healed by hints/repair — pending-range
-        tracking is a listed gap). Call AFTER ring registration. Returns
-        cells streamed."""
+        -> RangeStreamer -> entire-sstable streaming). Preferred flow:
+        ring.add_pending(me) -> bootstrap() -> ring.promote_pending(me):
+        reads keep hitting the old owners while writes are duplicated to
+        this node (coordinator pending targets), so nothing is lost OR
+        prematurely served. Returns cells streamed. Also supports the
+        legacy already-in-ring flow (sources computed from a pre-join
+        clone)."""
         from ..storage import cellbatch as cbmod
         from .repair import filter_token_range
         from .replication import ReplicationStrategy
 
         total = 0
-        # stream sources come from PRE-join ownership: at RF=1 the new
-        # node is the only post-join replica of its ranges — the data
-        # lives with the former owner
-        old_ring = self.ring.clone_without(self.endpoint)
+        if self.endpoint in self.ring.pending:
+            future = self.ring.future_ring()
+            current = self.ring    # the PRE-join ring: stream sources
+        else:
+            future = self.ring
+            current = self.ring.clone_without(self.endpoint)
         for ks in list(self.schema.keyspaces.values()):
             strat = ReplicationStrategy.create(ks.params.replication)
-            for lo, hi in self.ring.all_ranges():
-                replicas = strat.replicas(self.ring, hi)
+            for lo, hi in future.all_ranges():
+                replicas = strat.replicas(future, hi)
                 if self.endpoint not in replicas:
                     continue   # we don't replicate this range
-                owners = [e for e in strat.replicas(old_ring, hi)
+                owners = [e for e in strat.replicas(current, hi)
                           if e != self.endpoint and self.is_alive(e)]
                 if not owners:
                     continue
@@ -345,10 +352,14 @@ class LocalCluster:
     def session(self, i: int = 1) -> Session:
         return self.nodes[i - 1].session()
 
-    def add_node(self, dc: str = "dc1", vnodes: int = 4) -> Node:
-        """Grow the cluster: register in the ring, bootstrap-stream the new
-        node's ranges from existing owners, start serving (the jvm-dtest
-        addInstance + bootstrap flow)."""
+    def add_node(self, dc: str = "dc1", vnodes: int = 4,
+                 mid_join_hook=None) -> Node:
+        """Grow the cluster: register the new node's tokens as PENDING,
+        bootstrap-stream from the pre-join owners (writes arriving
+        meanwhile are duplicated to the joining node), then promote to
+        full ownership (the jvm-dtest addInstance + BootstrapAndJoin
+        flow). mid_join_hook() runs between the pending registration and
+        the ownership flip — tests inject concurrent writes there."""
         import random as _random
 
         from .ring import Endpoint
@@ -379,8 +390,23 @@ class LocalCluster:
             other.gossiper.states.setdefault(ep, EndpointState(generation=1))
             other.gossiper.detector.report(
                 ep, other.gossiper.states[ep], other.gossiper.clock())
-        self.ring.add_node(ep, tokens)
-        node.bootstrap()
+        self.ring.add_pending(ep, tokens)
+        try:
+            node.bootstrap()
+            if mid_join_hook is not None:
+                mid_join_hook()
+            self.ring.promote_pending(ep)
+        except BaseException:
+            self.ring.cancel_pending(ep)
+            # tear the half-created node down fully: engine/commitlog
+            # handles, transport registration, and peers' liveness seeds
+            node._stop_hints.set()
+            node.gossiper.stop()
+            node.messaging.close()
+            node.engine.close()
+            for other in self.nodes:
+                other.gossiper.states.pop(ep, None)
+            raise
         self.nodes.append(node)
         node.gossiper.start()
         return node

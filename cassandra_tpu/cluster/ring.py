@@ -35,6 +35,8 @@ class Ring:
         self._tokens: list[int] = []
         self._owners: dict[int, Endpoint] = {}
         self.endpoints: dict[Endpoint, list[int]] = {}
+        self.pending: dict[Endpoint, list[int]] = {}
+        self._future_cache: "Ring | None" = None
 
     def add_node(self, ep: Endpoint, tokens: list[int]) -> None:
         for t in tokens:
@@ -82,6 +84,47 @@ class Ring:
         for e, toks in self.endpoints.items():
             if e != ep:
                 r.add_node(e, list(toks))
+        return r
+
+    # --------------------------------------------------- pending ranges --
+    # A joining node's tokens are PENDING until its bootstrap stream
+    # completes: reads keep routing to the pre-join owners, while writes
+    # are duplicated to the pending node so nothing written mid-join is
+    # missing when ownership flips (locator/ReplicaPlans pending
+    # replicas; tcm/sequences/BootstrapAndJoin write-survey phase).
+
+    def add_pending(self, ep: Endpoint, tokens: list[int]) -> None:
+        taken = set(self._owners)
+        for toks in self.pending.values():
+            taken.update(toks)
+        for t in tokens:
+            if t in taken:
+                raise ValueError(f"token {t} already owned or pending")
+        self.pending[ep] = list(tokens)
+        self._future_cache = None
+
+    def promote_pending(self, ep: Endpoint) -> None:
+        """Atomically flip ownership to the joined node (the join commit
+        point: reads start routing to it, write duplication stops)."""
+        toks = self.pending.pop(ep)
+        self._future_cache = None
+        self.add_node(ep, toks)
+
+    def cancel_pending(self, ep: Endpoint) -> None:
+        self.pending.pop(ep, None)
+        self._future_cache = None
+
+    def future_ring(self) -> "Ring":
+        """The ring as it will be once every pending join completes —
+        pending-write placement is computed against this (cached: every
+        write during a join consults it)."""
+        if self._future_cache is not None:
+            return self._future_cache
+        r = Ring()
+        for e, toks in self.endpoints.items():
+            r.add_node(e, list(toks))
+        for e, toks in self.pending.items():
+            r.add_node(e, list(toks))
         return r
 
     def all_ranges(self) -> list[tuple[int, int]]:

@@ -45,6 +45,11 @@ class _AttachedIndex:
         must not race the build, and a failed load must NEVER cache None
         (that would silently drop the sstable from every future lookup)."""
         gen = reader.desc.generation
+        if getattr(reader, "released", False):
+            # compaction removed this sstable mid-query (its fd is still
+            # open): serve this one query from memory — writing a
+            # component for a dead generation would orphan a file
+            return self._fresh(reader)
         with self._lock:
             if gen in self._cache:
                 return self._cache[gen]
@@ -83,13 +88,13 @@ class EqualityIndex(_AttachedIndex):
     def _fresh(self, reader):
         out: dict = {}
         for seg in reader.scanner():
-            for v, pk, ck in ssi.iter_column_cells(seg, self.col_id):
+            for v, pk, ck, _ts in ssi.iter_column_cells(seg, self.col_id):
                 out.setdefault(v, []).append((pk, ck))
         return out
 
     def lookup(self, value: bytes) -> list:
         out = set()
-        for v, pk, ck in self._memtable_entries():
+        for v, pk, ck, _ts in self._memtable_entries():
             if v == value:
                 out.add((pk, ck))
         for reader in self._cfs().live_sstables():
@@ -114,15 +119,16 @@ class VectorIndex(_AttachedIndex):
         return ssi.load_vector(path)
 
     def _fresh(self, reader):
-        rows, keys = [], []
+        rows, tss, keys = [], [], []
         for seg in reader.scanner():
-            for v, pk, ck in ssi.iter_column_cells(seg, self.col_id):
+            for v, pk, ck, ts in ssi.iter_column_cells(seg, self.col_id):
                 rows.append(np.frombuffer(v, dtype=">f4")
                             .astype(np.float32))
+                tss.append(ts)
                 keys.append((pk, ck))
         mat = np.stack(rows) if rows \
             else np.zeros((0, self.dim), np.float32)
-        return mat, keys
+        return mat, np.asarray(tss, dtype=np.int64), keys
 
     def _gather(self):
         """(matrix, keys): memtable vectors + every live sstable's
@@ -137,36 +143,29 @@ class VectorIndex(_AttachedIndex):
         cached = getattr(self, "_gather_cache", None)
         if cached is not None and cached[0] == ver:
             return cached[1]
-        mats = []
-        keys: list = []
-        seen: set = set()
-        mem_rows = []
-        for value, pk, ck in self._memtable_entries():
+        # newest CELL TIMESTAMP wins per (pk, ck): generation order is
+        # not write order (USING TIMESTAMP), and a stale embedding must
+        # not rank the row
+        best: dict = {}     # (pk, ck) -> (ts, vector)
+        for value, pk, ck, ts in self._memtable_entries():
             k = (pk, ck)
-            if k in seen:
-                continue
-            seen.add(k)
-            mem_rows.append(np.frombuffer(value, dtype=">f4")
-                            .astype(np.float32))
-            keys.append(k)
-        if mem_rows:
-            mats.append(np.stack(mem_rows))
-        # newest sstables first: later-generation data wins dedup
-        for reader in sorted(self._cfs().live_sstables(),
-                             key=lambda r: -r.desc.generation):
+            if k not in best or ts > best[k][0]:
+                best[k] = (ts, np.frombuffer(value, dtype=">f4")
+                           .astype(np.float32))
+        for reader in self._cfs().live_sstables():
             comp = self._component(reader)
             if comp is None:
                 continue
-            mat, locs = comp
-            take = [i for i, k in enumerate(locs) if k not in seen]
-            seen.update(locs[i] for i in take)
-            if take:
-                mats.append(mat[take])
-                keys.extend(locs[i] for i in take)
-        if not mats:
+            mat, tss, locs = comp
+            for i, k in enumerate(locs):
+                ts = int(tss[i])
+                if k not in best or ts > best[k][0]:
+                    best[k] = (ts, mat[i])
+        if not best:
             result = (np.zeros((0, self.dim), np.float32), [])
         else:
-            result = (np.concatenate(mats, axis=0), keys)
+            keys = list(best)
+            result = (np.stack([best[k][1] for k in keys]), keys)
         self._gather_cache = (ver, result)
         return result
 

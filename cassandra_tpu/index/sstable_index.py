@@ -9,7 +9,7 @@ its component built once from that sstable alone.
 
 Formats (little-endian, CRC-trailed):
   equality  [u32 n][records: vint vlen, v, vint pklen, pk, vint cklen, ck]
-  vector    [u32 n][u32 dim][f32 matrix n*dim]
+  vector    [u32 n][u32 dim][f32 matrix n*dim][i64 ts]*n
             [locators: vint pklen, pk, vint cklen, ck]*n
 Both end with [u32 crc32(body)].
 """
@@ -44,7 +44,8 @@ def iter_column_cells(batch, column_id: int):
     for i in hits:
         ck, _path, value = batch.cell_payload(int(i))
         if value:
-            yield value, batch.partition_key(int(i)), ck
+            yield value, batch.partition_key(int(i)), ck, \
+                int(batch.ts[int(i)])
 
 
 def _scan_column(reader, table: TableMetadata, column_id: int):
@@ -84,7 +85,7 @@ def build_equality(reader, table: TableMetadata, column_id: int) -> str:
     out = bytearray()
     n = 0
     recs = bytearray()
-    for value, pk, ck in _scan_column(reader, table, column_id):
+    for value, pk, ck, _ts in _scan_column(reader, table, column_id):
         vi.write_unsigned_vint(len(value), recs)
         recs += value
         vi.write_unsigned_vint(len(pk), recs)
@@ -125,9 +126,11 @@ def build_vector(reader, table: TableMetadata, column_id: int,
                  dim: int) -> str:
     path = component_path(reader.desc, column_id)
     rows = []
+    tss = []
     locs = bytearray()
-    for value, pk, ck in _scan_column(reader, table, column_id):
+    for value, pk, ck, ts in _scan_column(reader, table, column_id):
         rows.append(np.frombuffer(value, dtype=">f4").astype(np.float32))
+        tss.append(ts)
         vi.write_unsigned_vint(len(pk), locs)
         locs += pk
         vi.write_unsigned_vint(len(ck), locs)
@@ -136,13 +139,14 @@ def build_vector(reader, table: TableMetadata, column_id: int,
     out = bytearray()
     out += struct.pack("<II", len(rows), dim)
     out += mat.astype("<f4").tobytes()
+    out += np.asarray(tss, dtype="<i8").tobytes()
     out += locs
     _write(path, bytes(out))
     return path
 
 
 def load_vector(path: str):
-    """(matrix float32 [n, dim], [(pk, ck)] locators) or None."""
+    """(matrix float32 [n, dim], ts int64 [n], [(pk, ck)] locators)."""
     body = _read(path)
     if body is None:
         return None
@@ -151,6 +155,8 @@ def load_vector(path: str):
     mat = np.frombuffer(body, dtype="<f4", count=n * dim,
                         offset=pos).reshape(n, dim).astype(np.float32)
     pos += 4 * n * dim
+    tss = np.frombuffer(body, dtype="<i8", count=n, offset=pos).copy()
+    pos += 8 * n
     keys = []
     for _ in range(n):
         ln, pos = vi.read_unsigned_vint(body, pos)
@@ -160,4 +166,4 @@ def load_vector(path: str):
         ck = bytes(body[pos:pos + ln])
         pos += ln
         keys.append((pk, ck))
-    return mat, keys
+    return mat, tss, keys

@@ -472,3 +472,52 @@ def test_lwt_completes_across_replica_restarts(cluster):
                    "IF NOT EXISTS")
     assert rs.rows[0][0] is False
     assert s.execute("SELECT v FROM kv WHERE k = 88").rows == [("first",)]
+
+
+def test_pending_range_writes_during_bootstrap(tmp_path):
+    """Writes landing while a node bootstraps must reach it for the
+    ranges it is acquiring: at RF=1 ownership MOVES, so a write that only
+    hit the old owner and never streamed would vanish at the flip
+    (locator/ReplicaPlans pending replicas)."""
+    c = LocalCluster(2, str(tmp_path), rf=1, gossip_interval=0.05)
+    try:
+        s = c.session(1)
+        s.execute("CREATE KEYSPACE ks WITH replication = "
+                  "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+        s.execute("USE ks")
+        s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+        for i in range(30):
+            s.execute(f"INSERT INTO kv (k, v) VALUES ({i}, 'pre{i}')")
+
+        def mid_join():
+            # the stream has completed; these writes arrive before the
+            # ownership flip and must be duplicated to the pending node
+            for i in range(30, 60):
+                s.execute(f"INSERT INTO kv (k, v) VALUES ({i}, 'mid{i}')")
+
+        c.add_node(mid_join_hook=mid_join)
+        # every row readable after the join, from any coordinator
+        s3 = c.session(3)
+        s3.keyspace = "ks"
+        got = {r[0]: r[1] for r in s3.execute("SELECT k, v FROM kv").rows}
+        assert set(got) == set(range(60)), \
+            sorted(set(range(60)) - set(got))
+        assert all(got[i] == f"pre{i}" for i in range(30))
+        assert all(got[i] == f"mid{i}" for i in range(30, 60))
+        # specifically: rows now owned by the NEW node exist locally there
+        new = c.nodes[2]
+        t = c.schema.get_table("ks", "kv")
+        from cassandra_tpu.cluster.replication import ReplicationStrategy
+        strat = ReplicationStrategy.create(
+            c.schema.keyspaces["ks"].params.replication)
+        owned_locally = 0
+        for i in range(60):
+            pk = t.columns["k"].cql_type.serialize(i)
+            if strat.replicas(c.ring, c.ring.token_of(pk))[0] \
+                    == new.endpoint:
+                batch = new.engine.store("ks", "kv").read_partition(pk)
+                assert len(batch) > 0, f"row {i} missing on joined node"
+                owned_locally += 1
+        assert owned_locally > 0   # the new node really owns some rows
+    finally:
+        c.shutdown()
