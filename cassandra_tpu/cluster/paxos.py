@@ -367,6 +367,7 @@ class PaxosService:
                     self._quorum_round(
                         "PAXOS_COMMIT", (table.id, pk, ballot.pack(), iv),
                         live, timeout, need)
+                    self._commit_to_pending(strat, token, all_replicas, iv)
                 # either way: retry our own round on fresh state
                 continue
 
@@ -387,8 +388,32 @@ class PaxosService:
             self._quorum_round("PAXOS_COMMIT",
                                (table.id, pk, ballot.pack(), value),
                                live, timeout, need)
+            self._commit_to_pending(strat, token, all_replicas, value)
             return True, current
         raise last_contention or CasContention("cas retries exhausted")
+
+    def _commit_to_pending(self, strat, token, natural, value) -> None:
+        """Duplicate the decided mutation to pending (joining) replicas
+        acquiring this token — an LWT decided mid-bootstrap must exist on
+        the new owner after the ownership flip, exactly like plain
+        writes (StorageProxy pending targets); hint on failure."""
+        if not value:
+            return
+        for target in self.node.proxy._pending_targets(
+                strat, token, natural):
+            mutation = Mutation.deserialize(value)
+            if target == self.node.endpoint:
+                try:
+                    self.node.engine.apply(mutation)
+                except Exception:
+                    self.node.hints.store(target, mutation)
+            else:
+                self.node.messaging.send_with_callback(
+                    Verb.MUTATION_REQ, value, target,
+                    on_response=lambda m: None,
+                    on_failure=lambda mid, t=target, mm=mutation:
+                        self.node.hints.store(t, mm),
+                    timeout=self.node.proxy.timeout)
 
     _last_ballot_ts = 0
     _ballot_lock = threading.Lock()

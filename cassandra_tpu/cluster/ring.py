@@ -45,11 +45,13 @@ class Ring:
             bisect.insort(self._tokens, t)
             self._owners[t] = ep
         self.endpoints.setdefault(ep, []).extend(tokens)
+        self._future_cache = None
 
     def remove_node(self, ep: Endpoint) -> None:
         for t in self.endpoints.pop(ep, []):
             self._tokens.remove(t)
             del self._owners[t]
+        self._future_cache = None
 
     def successors(self, token: int):
         """Endpoints in ring order starting at the first token >= token."""
@@ -125,6 +127,7 @@ class Ring:
             r.add_node(e, list(toks))
         for e, toks in self.pending.items():
             r.add_node(e, list(toks))
+        self._future_cache = r
         return r
 
     def all_ranges(self) -> list[tuple[int, int]]:

@@ -146,21 +146,26 @@ class VectorIndex(_AttachedIndex):
         # newest CELL TIMESTAMP wins per (pk, ck): generation order is
         # not write order (USING TIMESTAMP), and a stale embedding must
         # not rank the row
-        best: dict = {}     # (pk, ck) -> (ts, vector)
+        # rank key: (cell ts, source recency) — ties on USING TIMESTAMP
+        # resolve to the newer source like the read path's reconcile
+        best: dict = {}     # (pk, ck) -> ((ts, src), vector)
+        MEM_SRC = 1 << 62   # memtable outranks any generation on ties
         for value, pk, ck, ts in self._memtable_entries():
             k = (pk, ck)
-            if k not in best or ts > best[k][0]:
-                best[k] = (ts, np.frombuffer(value, dtype=">f4")
+            rank = (ts, MEM_SRC)
+            if k not in best or rank > best[k][0]:
+                best[k] = (rank, np.frombuffer(value, dtype=">f4")
                            .astype(np.float32))
         for reader in self._cfs().live_sstables():
             comp = self._component(reader)
             if comp is None:
                 continue
             mat, tss, locs = comp
+            gen = reader.desc.generation
             for i, k in enumerate(locs):
-                ts = int(tss[i])
-                if k not in best or ts > best[k][0]:
-                    best[k] = (ts, mat[i])
+                rank = (int(tss[i]), gen)
+                if k not in best or rank > best[k][0]:
+                    best[k] = (rank, mat[i])
         if not best:
             result = (np.zeros((0, self.dim), np.float32), [])
         else:

@@ -7,9 +7,13 @@ restart reopens components from disk, and an sstable that appears through
 any path (flush, compaction, anticompaction, streaming, bulk load) gets
 its component built once from that sstable alone.
 
-Formats (little-endian, CRC-trailed):
-  equality  [u32 n][records: vint vlen, v, vint pklen, pk, vint cklen, ck]
-  vector    [u32 n][u32 dim][f32 matrix n*dim][i64 ts]*n
+Formats (little-endian, CRC-trailed, 4-byte magic = format version; a
+component with an older/unknown magic or any parse error loads as None
+and is simply rebuilt from its sstable — the worst case of format
+evolution is one re-scan):
+  equality  "EQI1" [u32 n][records: vint vlen, v, vint pklen, pk,
+            vint cklen, ck]
+  vector    "VEC2" [u32 n][u32 dim][f32 matrix n*dim][i64 ts]*n
             [locators: vint pklen, pk, vint cklen, ck]*n
 Both end with [u32 crc32(body)].
 """
@@ -93,6 +97,7 @@ def build_equality(reader, table: TableMetadata, column_id: int) -> str:
         vi.write_unsigned_vint(len(ck), recs)
         recs += ck
         n += 1
+    out += b"EQI1"
     out += struct.pack("<I", n)
     out += recs
     _write(path, bytes(out))
@@ -101,10 +106,17 @@ def build_equality(reader, table: TableMetadata, column_id: int) -> str:
 
 def load_equality(path: str) -> dict[bytes, list] | None:
     body = _read(path)
-    if body is None:
+    if body is None or body[:4] != b"EQI1":
         return None
-    (n,) = struct.unpack_from("<I", body, 0)
-    pos = 4
+    try:
+        return _parse_equality(body)
+    except (ValueError, IndexError, struct.error):
+        return None   # malformed: rebuild
+
+
+def _parse_equality(body: bytes) -> dict[bytes, list]:
+    (n,) = struct.unpack_from("<I", body, 4)
+    pos = 8
     out: dict[bytes, list] = {}
     for _ in range(n):
         ln, pos = vi.read_unsigned_vint(body, pos)
@@ -137,6 +149,7 @@ def build_vector(reader, table: TableMetadata, column_id: int,
         locs += ck
     mat = np.stack(rows) if rows else np.zeros((0, dim), np.float32)
     out = bytearray()
+    out += b"VEC2"
     out += struct.pack("<II", len(rows), dim)
     out += mat.astype("<f4").tobytes()
     out += np.asarray(tss, dtype="<i8").tobytes()
@@ -148,10 +161,17 @@ def build_vector(reader, table: TableMetadata, column_id: int,
 def load_vector(path: str):
     """(matrix float32 [n, dim], ts int64 [n], [(pk, ck)] locators)."""
     body = _read(path)
-    if body is None:
+    if body is None or body[:4] != b"VEC2":
         return None
-    n, dim = struct.unpack_from("<II", body, 0)
-    pos = 8
+    try:
+        return _parse_vector(body)
+    except (ValueError, IndexError, struct.error):
+        return None   # malformed: rebuild
+
+
+def _parse_vector(body: bytes):
+    n, dim = struct.unpack_from("<II", body, 4)
+    pos = 12
     mat = np.frombuffer(body, dtype="<f4", count=n * dim,
                         offset=pos).reshape(n, dim).astype(np.float32)
     pos += 4 * n * dim

@@ -117,6 +117,28 @@ def repl(session, stdin=None, stdout=None):
                 except Exception as e:
                     emit(f"error: {e}")
                 continue
+            if low.startswith("copy "):
+                from . import copyutil
+                spec = copyutil.parse_copy(stripped)
+                if spec is None:
+                    emit("Bad COPY syntax: COPY <table> [(cols)] TO|FROM "
+                         "'<file>' [WITH HEADER = true]")
+                    continue
+                try:
+                    if spec["direction"] == "to":
+                        n = copyutil.copy_to(session, spec["table"],
+                                             spec["columns"], spec["path"],
+                                             spec["header"])
+                        emit(f"Exported {n} rows to {spec['path']}")
+                    else:
+                        n = copyutil.copy_from(
+                            session, session.processor.executor.schema,
+                            session.keyspace, spec["table"],
+                            spec["columns"], spec["path"], spec["header"])
+                        emit(f"Imported {n} rows from {spec['path']}")
+                except Exception as e:
+                    emit(f"{type(e).__name__}: {e}")
+                continue
             if low == "tracing on":
                 tracing = True
                 emit("Tracing enabled")
