@@ -153,6 +153,18 @@ class CreateTypeStatement:
 
 
 @dataclass
+class CreateViewStatement:
+    keyspace: str | None
+    name: str
+    base_keyspace: str | None
+    base_table: str
+    selected: list          # column names, or ["*"]
+    partition_key: list
+    clustering: list
+    if_not_exists: bool = False
+
+
+@dataclass
 class DropStatement:
     what: str            # keyspace | table | index | type
     keyspace: str | None

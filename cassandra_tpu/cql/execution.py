@@ -146,6 +146,7 @@ class Executor:
         "CreateTableStatement": "CREATE", "CreateIndexStatement": "CREATE",
         "CreateTypeStatement": "CREATE",
         "CreateKeyspaceStatement": "CREATE",
+        "CreateViewStatement": "CREATE",
         "DropStatement": "DROP", "AlterTableStatement": "ALTER",
         "RoleStatement": "AUTHORIZE", "GrantStatement": "AUTHORIZE",
         "ListRolesStatement": "AUTHORIZE",
@@ -391,6 +392,190 @@ class Executor:
         self.backend.add_table(t)
         return ResultSet([], [])
 
+    def _exec_CreateViewStatement(self, s, params, keyspace, now):
+        """CREATE MATERIALIZED VIEW (db/view/ + schema/ViewMetadata):
+        the view is a real table whose rows the DML path derives from
+        base-table writes; creation backfills from existing data
+        (ViewBuilder role)."""
+        ks = s.keyspace or keyspace
+        bks = s.base_keyspace or keyspace
+        if ks is None or bks is None:
+            raise InvalidRequest("no keyspace for CREATE MATERIALIZED VIEW")
+        if (ks, s.name) in self.schema.views:
+            if s.if_not_exists:
+                return ResultSet([], [])
+            raise InvalidRequest(f"view {ks}.{s.name} exists")
+        if s.name in self.schema.keyspaces[ks].tables:
+            raise InvalidRequest(f"{ks}.{s.name} already names a table")
+        base = self.schema.get_table(bks, s.base_table)
+        base_pk = set(base.primary_key_names())
+        view_pk = s.partition_key + s.clustering
+        missing = base_pk - set(view_pk)
+        if missing:
+            raise InvalidRequest(
+                f"view primary key must include every base primary key "
+                f"column (missing {sorted(missing)})")
+        extra = [c for c in view_pk if c not in base_pk]
+        if len(extra) > 1:
+            raise InvalidRequest(
+                "view primary key may include at most ONE non-primary-key "
+                "base column")
+        for c in view_pk:
+            col = base.columns.get(c)
+            if col is None:
+                raise InvalidRequest(f"unknown column {c}")
+            if col.kind == schema_mod.ColumnKind.STATIC:
+                raise InvalidRequest("static columns cannot be in a view")
+        selected = [c.name for c in base.partition_key_columns
+                    + base.clustering_columns + base.regular_columns] \
+            if s.selected == ["*"] else list(s.selected)
+        for c in view_pk:
+            if c not in selected:
+                selected.append(c)
+        regulars = [(c, base.columns[c].cql_type) for c in selected
+                    if c not in view_pk]
+        vt = schema_mod.TableMetadata(
+            ks, s.name,
+            [(c, base.columns[c].cql_type) for c in s.partition_key],
+            [(c, base.columns[c].cql_type, False) for c in s.clustering],
+            regulars)
+        if bks != ks:
+            raise InvalidRequest(
+                "a materialized view must be in the same keyspace as its "
+                "base table")
+        self.backend.add_table(vt)
+        self.schema.views[(ks, s.name)] = {"base": (bks, s.base_table)}
+        self.schema._changed()   # persist the view registration
+        try:
+            self._backfill_view(base, vt)
+        except BaseException:
+            # roll the half-created view back fully
+            self.schema.views.pop((ks, s.name), None)
+            try:
+                self.backend.drop_table(ks, s.name)
+            except Exception:
+                pass
+            self.schema._changed()
+            raise
+        return ResultSet([], [])
+
+    def _backfill_view(self, base, vt) -> None:
+        from ..storage.paging import paged_rows
+        cfs = self.backend.store(base.keyspace, base.name)
+        now = timeutil.now_micros()
+        for row in paged_rows(cfs, base):
+            if row.is_static:
+                continue
+            d = row_to_dict(base, row)
+            if self._view_key(vt, d) is None:
+                continue   # null in a view key column: row not in view
+            self._view_row_mutation(vt, d, now, apply=True)
+
+    def _views_of(self, t):
+        out = []
+        for (ks, name), v in self.schema.views.items():
+            if v["base"] == (t.keyspace, t.name):
+                try:
+                    out.append(self.schema.get_table(ks, name))
+                except KeyError:
+                    pass
+        return out
+
+    def _apply_dml(self, m, now) -> None:
+        """backend.apply + materialized-view maintenance: read the
+        affected rows before and after the base write and derive view
+        deletes/inserts (db/view/ViewUpdateGenerator; generation happens
+        at the coordinator, so view mutations get their own replication,
+        hints and consistency like any write)."""
+        t = self.schema.table_by_id(m.table_id)
+        views = self._views_of(t) if t is not None else []
+        if not views:
+            self.backend.apply(m)
+            return
+        pre = self._affected_rows(t, m)
+        self.backend.apply(m)
+        post = self._affected_rows(t, m)
+        for vt in views:
+            for key in set(pre) | set(post):
+                self._update_view(vt, pre.get(key), post.get(key), now)
+
+    def _affected_rows(self, t, m) -> dict:
+        """ck_frame -> row dict for the rows this mutation touches (the
+        whole partition when it carries partition/range-level ops).
+        NOTE: reads the whole base partition (the store's read primitive
+        is per-partition), so view-backed writes cost O(partition) — a
+        clustering-slice read primitive would narrow this; the reference
+        pays an analogous read-before-write on every view update."""
+        whole = any(op[1] in (schema_mod.COL_PARTITION_DEL,
+                              schema_mod.COL_RANGE_TOMB)
+                    for op in m.ops)
+        cks = {op[0] for op in m.ops
+               if op[1] not in (schema_mod.COL_PARTITION_DEL,
+                                schema_mod.COL_RANGE_TOMB)}
+        batch = self.backend.store(t.keyspace, t.name).read_partition(m.pk)
+        out = {}
+        for r in rows_from_batch(t, batch):
+            if r.is_static:
+                continue
+            if whole or r.ck_frame in cks:
+                out[r.ck_frame] = row_to_dict(t, r)
+        return out
+
+    def _view_key(self, vt, row: dict | None):
+        if row is None:
+            return None
+        vals = [row.get(c.name) for c in vt.partition_key_columns
+                + vt.clustering_columns]
+        if any(v is None for v in vals):
+            return None          # view rows require every pk column set
+        return tuple(vals)
+
+    def _view_row_mutation(self, vt, row: dict, now: int,
+                           apply: bool = False,
+                           pre: dict | None = None):
+        pk = vt.serialize_partition_key(
+            [row[c.name] for c in vt.partition_key_columns])
+        ck = vt.serialize_clustering(
+            [row[c.name] for c in vt.clustering_columns])
+        m = Mutation(vt.id, pk)
+        now_s = timeutil.now_seconds()
+        self._add_liveness(m, ck, now, 0, now_s)
+        for c in vt.regular_columns:
+            v = row.get(c.name)
+            if v is not None:
+                m.add(ck, c.column_id, b"", c.cql_type.serialize(v), now)
+            elif pre is not None and pre.get(c.name) is not None:
+                # base write null-ed the column: shadow the view's copy
+                m.add(ck, c.column_id, b"", b"", now, now_s, 0,
+                      cb.FLAG_TOMBSTONE)
+        if apply:
+            self.backend.apply(m)
+        return m
+
+    def _update_view(self, vt, pre: dict | None, post: dict | None,
+                     now: int) -> None:
+        old_key = self._view_key(vt, pre)
+        new_key = self._view_key(vt, post)
+        now_s = timeutil.now_seconds()
+        if old_key is not None and old_key != new_key:
+            pk = vt.serialize_partition_key(
+                [pre[c.name] for c in vt.partition_key_columns])
+            ck = vt.serialize_clustering(
+                [pre[c.name] for c in vt.clustering_columns])
+            m = Mutation(vt.id, pk)
+            m.add(ck, schema_mod.COL_ROW_DEL, b"", b"", now, now_s, 0,
+                  cb.FLAG_ROW_DEL)
+            self.backend.apply(m)
+        if new_key is not None:
+            self._view_row_mutation(
+                vt, post, now, apply=True,
+                pre=pre if old_key == new_key else None)
+
+    def _reject_view_write(self, t) -> None:
+        if (t.keyspace, t.name) in self.schema.views:
+            raise InvalidRequest(
+                "cannot directly modify a materialized view")
+
     def _table_params(self, options: dict) -> TableParams:
         p = TableParams()
         if "compression" in options:
@@ -438,11 +623,31 @@ class Executor:
                 ksm = self.schema.keyspaces.get(s.name)
                 if ksm is None:
                     raise KeyError(s.name)
+                for vks, vname in list(self.schema.views):
+                    if vks == s.name:
+                        del self.schema.views[(vks, vname)]
                 for tname in list(ksm.tables):
                     self.backend.drop_table(s.name, tname)
                 self.schema.drop_keyspace(s.name)
             elif s.what == "table":
+                if (ks, s.name) in self.schema.views:
+                    raise InvalidRequest(
+                        f"{ks}.{s.name} is a materialized view — use "
+                        "DROP MATERIALIZED VIEW")
+                dependents = [nm for (vks, nm), v in
+                              self.schema.views.items()
+                              if v["base"] == (ks, s.name)]
+                if dependents:
+                    raise InvalidRequest(
+                        f"cannot drop {ks}.{s.name}: materialized views "
+                        f"depend on it: {dependents}")
                 self.backend.drop_table(ks, s.name)
+            elif s.what == "view":
+                if (ks, s.name) not in self.schema.views:
+                    raise KeyError(s.name)
+                del self.schema.views[(ks, s.name)]
+                self.backend.drop_table(ks, s.name)
+                self.schema._changed()
             elif s.what == "type":
                 del self.schema.keyspaces[ks].user_types[s.name]
                 self.schema._changed()
@@ -507,6 +712,7 @@ class Executor:
 
     def _exec_InsertStatement(self, s, params, keyspace, now):
         t = self._table(s, keyspace)
+        self._reject_view_write(t)
         now = now or timeutil.now_micros()
         ts = now if s.timestamp is None \
             else int(bind_term(s.timestamp, None, params))
@@ -558,7 +764,7 @@ class Executor:
             existing = self._read_row(t, pk, ck, now)
             if existing is not None:
                 return self._not_applied(t, existing)
-        self.backend.apply(m)
+        self._apply_dml(m, now)
         return APPLIED if s.if_not_exists else ResultSet([], [])
 
     def _add_liveness(self, m, ck, ts, ttl, now_s):
@@ -609,6 +815,7 @@ class Executor:
 
     def _exec_UpdateStatement(self, s, params, keyspace, now):
         t = self._table(s, keyspace)
+        self._reject_view_write(t)
         now = now or timeutil.now_micros()
         ts = now if s.timestamp is None \
             else int(bind_term(s.timestamp, None, params))
@@ -641,7 +848,7 @@ class Executor:
                 existing = self._read_row(t, pk, ck, now)
                 if not check(existing):
                     return self._not_applied(t, existing)
-            self.backend.apply(m)
+            self._apply_dml(m, now)
         if conditional:
             return APPLIED
         return ResultSet([], [])
@@ -718,6 +925,7 @@ class Executor:
 
     def _exec_DeleteStatement(self, s, params, keyspace, now):
         t = self._table(s, keyspace)
+        self._reject_view_write(t)
         now = now or timeutil.now_micros()
         ts = now if s.timestamp is None \
             else int(bind_term(s.timestamp, None, params))
@@ -778,7 +986,7 @@ class Executor:
                     m.add(slc.start, schema_mod.COL_RANGE_TOMB,
                           slc.encode_path(), b"", ts, now_s, 0,
                           cb.FLAG_RANGE_BOUND | cb.FLAG_TOMBSTONE)
-            self.backend.apply(m)
+            self._apply_dml(m, ts)
         if s.if_exists or s.conditions:
             return APPLIED
         return ResultSet([], [])
@@ -809,7 +1017,7 @@ class Executor:
                                  user=user)
             bid = batchlog.store(collector.mutations)
             for m in collector.mutations:
-                self.backend.apply(m)
+                self._apply_dml(m, now)
             batchlog.remove(bid)
             return ResultSet([], [])
         for sub in s.statements:

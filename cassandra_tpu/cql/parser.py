@@ -454,6 +454,9 @@ class Parser:
             return self._create_type()
         if what.kind == "KEYWORD" and what.value in ("role", "user"):
             return self._create_role()
+        if what.kind == "KEYWORD" and what.value == "materialized":
+            self.expect_kw("view")
+            return self._create_view()
         raise ParseError(f"unsupported CREATE {what}")
 
     def _create_role(self):
@@ -666,6 +669,58 @@ class Parser:
             s += "<" + "".join(parts) + ">"
         return s
 
+    def _create_view(self):
+        """CREATE MATERIALIZED VIEW [IF NOT EXISTS] name AS
+        SELECT cols FROM base WHERE <pk IS NOT NULL ...>
+        PRIMARY KEY ((..), ..) — cql3/statements/schema/
+        CreateViewStatement grammar subset."""
+        ine = self._if_not_exists()
+        ks, name = self.qualified_name()
+        self.expect_kw("as")
+        self.expect_kw("select")
+        selected = []
+        if self.accept_op("*"):
+            selected = ["*"]
+        else:
+            while True:
+                selected.append(self.ident())
+                if not self.accept_op(","):
+                    break
+        self.expect_kw("from")
+        bks, btable = self.qualified_name()
+        if self.accept_kw("where"):
+            # the standard guards: <col> IS NOT NULL [AND ...]
+            while True:
+                self.ident()
+                self.expect_kw("is")
+                self.expect_kw("not")
+                self.expect_kw("null")
+                if not self.accept_kw("and"):
+                    break
+        self.expect_kw("primary")
+        self.expect_kw("key")
+        pk, ck = self._primary_key_spec()
+        return ast.CreateViewStatement(ks, name, bks, btable, selected,
+                                       pk, ck, ine)
+
+    def _primary_key_spec(self):
+        """((a, b), c, d) or (a, b, c): partition key + clustering."""
+        self.expect_op("(")
+        pk = []
+        if self.accept_op("("):
+            while True:
+                pk.append(self.ident())
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        else:
+            pk.append(self.ident())
+        ck = []
+        while self.accept_op(","):
+            ck.append(self.ident())
+        self.expect_op(")")
+        return pk, ck
+
     def _create_index(self, custom: bool):
         ine = self._if_not_exists()
         name = None
@@ -713,7 +768,10 @@ class Parser:
                 ife = True
             return ast.RoleStatement("drop", self.ident(),
                                      if_not_exists=ife)
-        if what not in ("keyspace", "table", "index", "type"):
+        if what == "materialized":
+            self.expect_kw("view")
+            what = "view"
+        if what not in ("keyspace", "table", "index", "type", "view"):
             raise ParseError(f"unsupported DROP {what}")
         ife = False
         if self.accept_kw("if"):

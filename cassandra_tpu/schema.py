@@ -219,6 +219,10 @@ class Schema:
         self._lock = threading.RLock()
         self.version = 0
         self.listeners: list = []  # persistence hooks (one per engine)
+        # (keyspace, view_name) -> {"base": (ks, table)}; the view's own
+        # TableMetadata lives in ks.tables like any table
+        # (schema/ViewMetadata role)
+        self.views: dict[tuple, dict] = {}
 
     def table_by_id(self, table_id) -> "TableMetadata | None":
         return self._by_id.get(table_id)
@@ -336,6 +340,8 @@ def schema_to_dict(schema: Schema) -> dict:
                            for tn, t in ks.user_types.items()},
             "tables": {tn: table_to_dict(t) for tn, t in ks.tables.items()},
         }
+    out["views"] = [{"keyspace": ks, "name": nm, "base": list(v["base"])}
+                    for (ks, nm), v in schema.views.items()]
     return out
 
 
@@ -357,6 +363,9 @@ def load_schema_dict(schema: Schema, data: dict) -> None:
         for tn, td in ksd.get("tables", {}).items():
             if tn not in ks.tables:
                 schema.add_table(table_from_dict(td, ks.user_types))
+    for v in data.get("views", []):
+        schema.views.setdefault((v["keyspace"], v["name"]),
+                                {"base": tuple(v["base"])})
 
 
 def make_table(keyspace: str, name: str, *, pk: list[str], ck: list[str] = (),

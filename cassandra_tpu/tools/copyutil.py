@@ -52,6 +52,8 @@ def _cql_literal(v) -> str:
         return "'" + v.replace("'", "''") + "'"
     if isinstance(v, uuid.UUID):
         return str(v)
+    if isinstance(v, (datetime.datetime, datetime.date, datetime.time)):
+        return "'" + v.isoformat() + "'"
     if isinstance(v, (set, frozenset)):
         return "{" + ", ".join(sorted(_cql_literal(x) for x in v)) + "}"
     if isinstance(v, tuple):
