@@ -1,0 +1,236 @@
+"""Minimal native-protocol client driver.
+
+Reference counterpart: the DataStax python-driver's Cluster/Session
+surface (the reference ships no in-tree driver; this one exists so the
+framework is drivable over the WIRE without any external dependency, and
+doubles as the conformance test harness for transport_server.py).
+
+    from cassandra_tpu.client import Cluster
+    session = Cluster("127.0.0.1", 9042).connect()
+    session.execute("USE ks")
+    rows = session.execute("SELECT ... WHERE k = ?", [b"..."]).rows
+
+Bound values are sent in wire encoding: pass `bytes` you serialized with
+the column's CQL type, or let `serialize_params` do it from a schema
+table. Paging: pass fetch_size / paging_state like the server-side
+Session.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from . import transport_server as ts
+
+
+class DriverError(Exception):
+    pass
+
+
+class Rows:
+    def __init__(self, column_names, rows, paging_state=None):
+        self.column_names = column_names
+        self.rows = rows
+        self.paging_state = paging_state
+
+    def __iter__(self):
+        return iter(self.rows)
+
+
+_DECODERS = {
+    0x02: lambda b: struct.unpack(">q", b)[0],
+    0x03: lambda b: b,
+    0x04: lambda b: b != b"\x00",
+    0x07: lambda b: struct.unpack(">d", b)[0],
+    0x0B: lambda b: struct.unpack(">q", b)[0],
+    0x0C: lambda b: __import__("uuid").UUID(bytes=b),
+    0x0D: lambda b: b.decode(),
+}
+
+
+class ClientSession:
+    def __init__(self, host: str, port: int, user: str | None = None,
+                 password: str | None = None):
+        self._sock = socket.create_connection((host, port), timeout=10.0)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._stream = 0
+        self._lock = threading.Lock()
+        op, body = self._request(ts.OP_STARTUP,
+                                 struct.pack(">H", 1)
+                                 + ts._string("CQL_VERSION")
+                                 + ts._string("3.4.5"))
+        if op == ts.OP_AUTHENTICATE:
+            token = b"\x00" + (user or "").encode() + b"\x00" \
+                + (password or "").encode()
+            op, body = self._request(ts.OP_AUTH_RESPONSE, ts._bytes(token))
+            if op != ts.OP_AUTH_SUCCESS:
+                raise DriverError("authentication failed")
+        elif op != ts.OP_READY:
+            raise DriverError(f"unexpected startup response {op}")
+
+    # ------------------------------------------------------------- frames
+
+    def _request(self, opcode: int, body: bytes):
+        with self._lock:
+            self._stream = (self._stream + 1) % 32768
+            stream = self._stream
+            self._sock.sendall(struct.pack(
+                ">BBhBI", ts.VERSION_REQ, 0, stream, opcode, len(body))
+                + body)
+            hdr = self._read_exact(9)
+            _ver, _flags, rstream, op = struct.unpack(">BBhB", hdr[:5])
+            (length,) = struct.unpack(">I", hdr[5:9])
+            rbody = self._read_exact(length) if length else b""
+            if rstream != stream:
+                raise DriverError("stream mismatch")
+            return op, rbody
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise DriverError("connection closed")
+            buf += chunk
+        return bytes(buf)
+
+    # -------------------------------------------------------------- query
+
+    def execute(self, query: str, params: list[bytes | None] | None = None,
+                fetch_size: int | None = None,
+                paging_state: bytes | None = None) -> Rows:
+        body = bytearray()
+        body += ts._long_string(query)
+        body += struct.pack(">H", 1)        # consistency ONE (server CL
+                                            # policy governs for now)
+        flags = 0
+        if params:
+            flags |= 0x01
+        if fetch_size is not None:
+            flags |= 0x04
+        if paging_state is not None:
+            flags |= 0x08
+        body.append(flags)
+        if params:
+            body += struct.pack(">H", len(params))
+            for p in params:
+                body += ts._bytes(p)
+        if fetch_size is not None:
+            body += struct.pack(">i", fetch_size)
+        if paging_state is not None:
+            body += ts._bytes(paging_state)
+        op, rbody = self._request(ts.OP_QUERY, bytes(body))
+        return self._decode_result(op, rbody)
+
+    def _decode_result(self, op: int, body: bytes) -> Rows:
+        if op == ts.OP_ERROR:
+            (code,) = struct.unpack_from(">i", body, 0)
+            msg, _ = ts._read_string(body, 4)
+            raise DriverError(f"[{code:#06x}] {msg}")
+        if op != ts.OP_RESULT:
+            raise DriverError(f"unexpected opcode {op}")
+        (kind,) = struct.unpack_from(">i", body, 0)
+        pos = 4
+        if kind in (ts.RESULT_VOID, ts.RESULT_SCHEMA_CHANGE):
+            return Rows([], [])
+        if kind == ts.RESULT_SET_KEYSPACE:
+            ks, _ = ts._read_string(body, pos)
+            return Rows([], [])
+        if kind != ts.RESULT_ROWS:
+            raise DriverError(f"unsupported result kind {kind}")
+        (flags,) = struct.unpack_from(">I", body, pos)
+        pos += 4
+        (ncols,) = struct.unpack_from(">i", body, pos)
+        pos += 4
+        paging = None
+        if flags & 0x0002:
+            paging, pos = ts._read_bytes(body, pos)
+        if flags & 0x0001:
+            _, pos = ts._read_string(body, pos)
+            _, pos = ts._read_string(body, pos)
+        names = []
+        tids = []
+        for _ in range(ncols):
+            name, pos = ts._read_string(body, pos)
+            (tid,) = struct.unpack_from(">H", body, pos)
+            pos += 2
+            names.append(name)
+            tids.append(tid)
+        (nrows,) = struct.unpack_from(">i", body, pos)
+        pos += 4
+        rows = []
+        for _ in range(nrows):
+            row = []
+            for tid in tids:
+                b, pos = ts._read_bytes(body, pos)
+                if b is None:
+                    row.append(None)
+                else:
+                    row.append(_DECODERS.get(tid, lambda x: x)(b))
+            rows.append(tuple(row))
+        return Rows(names, rows, paging)
+
+    def prepare(self, query: str) -> bytes:
+        op, body = self._request(ts.OP_PREPARE, ts._long_string(query))
+        if op == ts.OP_ERROR:
+            (code,) = struct.unpack_from(">i", body, 0)
+            msg, _ = ts._read_string(body, 4)
+            raise DriverError(f"[{code:#06x}] {msg}")
+        (kind,) = struct.unpack_from(">i", body, 0)
+        if kind != ts.RESULT_PREPARED:
+            raise DriverError(f"unexpected result kind {kind}")
+        (n,) = struct.unpack_from(">H", body, 4)
+        return bytes(body[6:6 + n])
+
+    def execute_prepared(self, qid: bytes,
+                         params: list[bytes | None] | None = None,
+                         fetch_size: int | None = None,
+                         paging_state: bytes | None = None) -> Rows:
+        body = bytearray()
+        body += struct.pack(">H", len(qid)) + qid
+        body += struct.pack(">H", 1)
+        flags = 0
+        if params:
+            flags |= 0x01
+        if fetch_size is not None:
+            flags |= 0x04
+        if paging_state is not None:
+            flags |= 0x08
+        body.append(flags)
+        if params:
+            body += struct.pack(">H", len(params))
+            for p in params:
+                body += ts._bytes(p)
+        if fetch_size is not None:
+            body += struct.pack(">i", fetch_size)
+        if paging_state is not None:
+            body += ts._bytes(paging_state)
+        op, rbody = self._request(ts.OP_EXECUTE, bytes(body))
+        return self._decode_result(op, rbody)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class Cluster:
+    def __init__(self, host: str = "127.0.0.1", port: int = 9042,
+                 user: str | None = None, password: str | None = None):
+        self.host, self.port = host, port
+        self.user, self.password = user, password
+
+    def connect(self) -> ClientSession:
+        return ClientSession(self.host, self.port, self.user,
+                             self.password)
+
+
+def serialize_params(table, columns: list[str], values: list) -> list:
+    """Wire-encode bind values using a schema table's column types."""
+    out = []
+    for c, v in zip(columns, values):
+        out.append(None if v is None
+                   else table.columns[c].cql_type.serialize(v))
+    return out

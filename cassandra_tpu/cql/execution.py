@@ -59,10 +59,24 @@ def bind_term(term, cql_type, params):
         if isinstance(params, dict):
             if term.name is None or term.name not in params:
                 raise InvalidRequest(f"missing named parameter {term.name}")
-            return params[term.name]
-        if term.index >= len(params):
-            raise InvalidRequest("not enough bind parameters")
-        return params[term.index]
+            v = params[term.name]
+        else:
+            if term.index >= len(params):
+                raise InvalidRequest("not enough bind parameters")
+            v = params[term.index]
+        # native-protocol bound values arrive in wire encoding and
+        # deserialize against the statement's target type HERE — the one
+        # place the type is known (transport_server.WireValue)
+        from ..transport_server import WireValue
+        if isinstance(v, WireValue):
+            if cql_type is not None:
+                return cql_type.deserialize(bytes(v))
+            # no column type (LIMIT / TTL / USING TIMESTAMP binds):
+            # fixed-width big-endian integers cover the numeric contexts
+            if len(v) in (1, 2, 4, 8):
+                return int.from_bytes(bytes(v), "big", signed=True)
+            return bytes(v)
+        return v
     if isinstance(term, ast.Literal):
         if term.kind == "null":
             return None
@@ -117,6 +131,8 @@ class _MutationCollector:
     def __init__(self, backend):
         self._backend = backend
         self.mutations: list[Mutation] = []
+
+    collects_only = True   # _apply_dml: no view derivation on collect
 
     def apply(self, mutation, durable: bool = True) -> None:
         self.mutations.append(mutation)
@@ -429,6 +445,9 @@ class Executor:
         selected = [c.name for c in base.partition_key_columns
                     + base.clustering_columns + base.regular_columns] \
             if s.selected == ["*"] else list(s.selected)
+        for c in selected:
+            if c not in base.columns:
+                raise InvalidRequest(f"unknown column {c}")
         for c in view_pk:
             if c not in selected:
                 selected.append(c)
@@ -466,7 +485,8 @@ class Executor:
         for row in paged_rows(cfs, base):
             if row.is_static:
                 continue
-            d = row_to_dict(base, row)
+            d = row_to_dict(base, row, with_meta=True)
+            d["__liveness__"] = row.liveness_meta
             if self._view_key(vt, d) is None:
                 continue   # null in a view key column: row not in view
             self._view_row_mutation(vt, d, now, apply=True)
@@ -486,18 +506,26 @@ class Executor:
         affected rows before and after the base write and derive view
         deletes/inserts (db/view/ViewUpdateGenerator; generation happens
         at the coordinator, so view mutations get their own replication,
-        hints and consistency like any write)."""
+        hints and consistency like any write). View mutations use the
+        BASE write's timestamp so USING TIMESTAMP ordering carries over
+        (a ts-200 delete must shadow the view row of a ts-100 write)."""
         t = self.schema.table_by_id(m.table_id)
         views = self._views_of(t) if t is not None else []
-        if not views:
+        if not views or getattr(self.backend, "collects_only", False):
+            # a collecting backend (logged batch) records the base
+            # mutation only: pre==post there and deriving view updates
+            # from it would log stale rows — maintenance happens when
+            # the collected mutations are REALLY applied
             self.backend.apply(m)
             return
+        view_ts = max((op[4] for op in m.ops), default=now)
         pre = self._affected_rows(t, m)
         self.backend.apply(m)
         post = self._affected_rows(t, m)
         for vt in views:
             for key in set(pre) | set(post):
-                self._update_view(vt, pre.get(key), post.get(key), now)
+                self._update_view(vt, pre.get(key), post.get(key),
+                                  view_ts)
 
     def _affected_rows(self, t, m) -> dict:
         """ck_frame -> row dict for the rows this mutation touches (the
@@ -518,7 +546,9 @@ class Executor:
             if r.is_static:
                 continue
             if whole or r.ck_frame in cks:
-                out[r.ck_frame] = row_to_dict(t, r)
+                d = row_to_dict(t, r, with_meta=True)
+                d["__liveness__"] = r.liveness_meta
+                out[r.ck_frame] = d
         return out
 
     def _view_key(self, vt, row: dict | None):
@@ -539,11 +569,25 @@ class Executor:
             [row[c.name] for c in vt.clustering_columns])
         m = Mutation(vt.id, pk)
         now_s = timeutil.now_seconds()
-        self._add_liveness(m, ck, now, 0, now_s)
+        # base TTLs carry over: an expiring base row/cell must expire in
+        # the view too, or the view outlives its base row forever
+        lm = row.get("__liveness__")
+        live_ttl = 0
+        if lm is not None and lm[1]:
+            live_ttl = max(int(lm[2]) - now_s, 1)
+        self._add_liveness(m, ck, now, live_ttl, now_s)
+        meta = row.get("__meta__", {})
         for c in vt.regular_columns:
             v = row.get(c.name)
             if v is not None:
-                m.add(ck, c.column_id, b"", c.cql_type.serialize(v), now)
+                cm = meta.get(c.name)
+                if cm is not None and cm[1]:          # expiring base cell
+                    rem = max(int(cm[2]) - now_s, 1)
+                    m.add(ck, c.column_id, b"", c.cql_type.serialize(v),
+                          now, now_s + rem, rem, cb.FLAG_EXPIRING)
+                else:
+                    m.add(ck, c.column_id, b"",
+                          c.cql_type.serialize(v), now)
             elif pre is not None and pre.get(c.name) is not None:
                 # base write null-ed the column: shadow the view's copy
                 m.add(ck, c.column_id, b"", b"", now, now_s, 0,
@@ -764,7 +808,7 @@ class Executor:
             existing = self._read_row(t, pk, ck, now)
             if existing is not None:
                 return self._not_applied(t, existing)
-        self._apply_dml(m, now)
+        self._apply_dml(m, ts)
         return APPLIED if s.if_not_exists else ResultSet([], [])
 
     def _add_liveness(self, m, ck, ts, ttl, now_s):
@@ -848,7 +892,7 @@ class Executor:
                 existing = self._read_row(t, pk, ck, now)
                 if not check(existing):
                     return self._not_applied(t, existing)
-            self._apply_dml(m, now)
+            self._apply_dml(m, ts)
         if conditional:
             return APPLIED
         return ResultSet([], [])

@@ -182,6 +182,10 @@ class Parser:
         t = self.peek()
         if t.kind != "EOF":
             raise ParseError(f"trailing input at {t}")
+        try:
+            stmt.n_markers = self.n_markers   # bind-variable count for
+        except Exception:                     # prepared-statement metadata
+            pass
         return stmt
 
     # SELECT

@@ -30,6 +30,7 @@ class RowData:
     multicell: dict = field(default_factory=dict)  # column_id -> {path: bytes}
     cell_meta: dict = field(default_factory=dict)  # column_id -> (ts, ttl, ldt)
     liveness_ts: int | None = None
+    liveness_meta: tuple | None = None             # (ts, ttl, ldt)
     max_ts: int = 0
     is_static: bool = False
 
@@ -67,6 +68,9 @@ def rows_from_batch(table: TableMetadata, batch: CellBatch):
         if col == COL_ROW_LIVENESS:
             if not (flags & FLAG_TOMBSTONE):
                 current.liveness_ts = int(batch.ts[i])
+                current.liveness_meta = (int(batch.ts[i]),
+                                         int(batch.ttl[i]),
+                                         int(batch.ldt[i]))
             continue
         if flags & FLAG_COMPLEX_DEL:
             # collection overwrite marker: column present but reset

@@ -78,11 +78,20 @@ def main(argv=None) -> int:
         import jax
         jax.config.update("jax_platforms", cfg["jax_platform"])
     node, transport = build_node(cfg)
+    native = None
+    if cfg.get("native_port") is not None:
+        # client-facing CQL native protocol endpoint (port 9042 role)
+        from ..transport_server import CQLServer
+        native = CQLServer(node, cfg.get("host", "127.0.0.1"),
+                           int(cfg["native_port"]))
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
-    print(f"READY {transport.bound_port}", flush=True)
+    print(f"READY {transport.bound_port}"
+          + (f" NATIVE {native.port}" if native else ""), flush=True)
     stop.wait()
+    if native is not None:
+        native.close()
     node.engine.close()
     return 0
 

@@ -1,0 +1,337 @@
+"""CQL native protocol server — the client-facing socket endpoint.
+
+Reference counterpart: transport/Server.java + Dispatcher.java:104 +
+CQLMessageHandler.java (the v4/v5 binary protocol on port 9042, spec:
+doc/native_protocol_v4.spec in the reference tree).
+
+Implemented subset (protocol v4 framing):
+  STARTUP -> READY (or AUTHENTICATE -> AUTH_RESPONSE -> AUTH_SUCCESS
+  with PasswordAuthenticator semantics when auth is enabled)
+  OPTIONS -> SUPPORTED
+  QUERY / PREPARE / EXECUTE -> RESULT (Void / Rows / SetKeyspace /
+  Prepared / SchemaChange) or ERROR
+  paging: page_size + paging_state flags round-trip
+  bound values: wire bytes deserialize against the target column's type
+  at bind time (WireValue marker consumed by cql.execution.bind_term)
+
+Result metadata declares types inferred from the Python values with a
+matching encoding, so any decoder that honours the metadata reads the
+rows correctly.
+"""
+from __future__ import annotations
+
+import struct
+import threading
+import socket
+
+from .cql.processor import QueryProcessor
+
+VERSION_REQ = 0x04
+VERSION_RSP = 0x84
+
+OP_ERROR = 0x00
+OP_STARTUP = 0x01
+OP_READY = 0x02
+OP_AUTHENTICATE = 0x03
+OP_OPTIONS = 0x05
+OP_SUPPORTED = 0x06
+OP_QUERY = 0x07
+OP_RESULT = 0x08
+OP_PREPARE = 0x09
+OP_EXECUTE = 0x0A
+OP_AUTH_RESPONSE = 0x0F
+OP_AUTH_SUCCESS = 0x10
+
+RESULT_VOID = 0x0001
+RESULT_ROWS = 0x0002
+RESULT_SET_KEYSPACE = 0x0003
+RESULT_PREPARED = 0x0004
+RESULT_SCHEMA_CHANGE = 0x0005
+
+ERR_SERVER = 0x0000
+ERR_PROTOCOL = 0x000A
+ERR_BAD_CREDENTIALS = 0x0100
+ERR_INVALID = 0x2200
+
+
+class WireValue(bytes):
+    """A bound value still in wire encoding; bind_term deserializes it
+    against the statement's target type."""
+
+
+# --------------------------------------------------------- body primitives --
+
+def _string(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">H", len(b)) + b
+
+
+def _long_string(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">I", len(b)) + b
+
+
+def _bytes(b: bytes | None) -> bytes:
+    if b is None:
+        return struct.pack(">i", -1)
+    return struct.pack(">i", len(b)) + b
+
+
+def _read_string(buf: bytes, pos: int) -> tuple[str, int]:
+    (n,) = struct.unpack_from(">H", buf, pos)
+    return buf[pos + 2:pos + 2 + n].decode(), pos + 2 + n
+
+
+def _read_long_string(buf: bytes, pos: int) -> tuple[str, int]:
+    (n,) = struct.unpack_from(">I", buf, pos)
+    return buf[pos + 4:pos + 4 + n].decode(), pos + 4 + n
+
+
+def _read_bytes(buf: bytes, pos: int):
+    (n,) = struct.unpack_from(">i", buf, pos)
+    pos += 4
+    if n < 0:
+        return None, pos
+    return bytes(buf[pos:pos + n]), pos + n
+
+
+def _read_string_map(buf: bytes, pos: int) -> tuple[dict, int]:
+    (n,) = struct.unpack_from(">H", buf, pos)
+    pos += 2
+    out = {}
+    for _ in range(n):
+        k, pos = _read_string(buf, pos)
+        v, pos = _read_string(buf, pos)
+        out[k] = v
+    return out, pos
+
+
+# ------------------------------------------------------- result encoding ---
+
+def _infer_type(v):
+    """(option_id, encoder) inferred from the Python value — metadata and
+    encoding stay consistent with each other."""
+    import datetime
+    import uuid as uuid_mod
+    if isinstance(v, bool):
+        return 0x04, lambda x: b"\x01" if x else b"\x00"
+    if isinstance(v, int):
+        return 0x02, lambda x: struct.pack(">q", x)       # bigint
+    if isinstance(v, float):
+        return 0x07, lambda x: struct.pack(">d", x)       # double
+    if isinstance(v, uuid_mod.UUID):
+        return 0x0C, lambda x: x.bytes
+    if isinstance(v, bytes):
+        return 0x03, lambda x: x
+    if isinstance(v, datetime.datetime):
+        return 0x0B, lambda x: struct.pack(
+            ">q", int(x.timestamp() * 1000))
+    return 0x0D, lambda x: str(x).encode()                # varchar
+
+
+def _encode_rows(rs) -> bytes:
+    names = rs.column_names
+    rows = rs.rows
+    # per-column type from the first non-null value (varchar fallback)
+    col_types = []
+    for i in range(len(names)):
+        sample = next((r[i] for r in rows if r[i] is not None), None)
+        col_types.append(_infer_type(sample))
+    flags = 0x0001                       # global table spec
+    paging = getattr(rs, "paging_state", None)
+    if paging is not None:
+        flags |= 0x0002                  # has_more_pages
+    body = bytearray()
+    body += struct.pack(">i", RESULT_ROWS)
+    body += struct.pack(">I", flags)
+    body += struct.pack(">i", len(names))
+    if paging is not None:
+        body += _bytes(paging)
+    body += _string("") + _string("")    # keyspace/table (opaque here)
+    for name, (tid, _enc) in zip(names, col_types):
+        body += _string(name)
+        body += struct.pack(">H", tid)
+    body += struct.pack(">i", len(rows))
+    for r in rows:
+        for v, (_tid, enc) in zip(r, col_types):
+            body += _bytes(None if v is None else enc(v))
+    return bytes(body)
+
+
+class CQLServer:
+    """Threaded native-protocol endpoint over a backend (StorageEngine or
+    cluster Node) — transport/Server.java role."""
+
+    def __init__(self, backend, host: str = "127.0.0.1", port: int = 0):
+        self.backend = backend
+        self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listen.bind((host, port))
+        self._listen.listen(64)
+        self.port = self._listen.getsockname()[1]
+        self._closed = False
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name=f"cql-server-{self.port}").start()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listen.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------ transport
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                sock, _ = self._listen.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(sock,),
+                             daemon=True).start()
+
+    @staticmethod
+    def _read_exact(sock, n: int) -> bytes | None:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return bytes(buf)
+
+    def _serve(self, sock: socket.socket) -> None:
+        processor = QueryProcessor(self.backend)
+        state = {"keyspace": None, "user": None, "authed": False}
+        auth = getattr(self.backend, "auth", None)
+        need_auth = auth is not None and auth.enabled
+        try:
+            while not self._closed:
+                hdr = self._read_exact(sock, 9)
+                if hdr is None:
+                    return
+                _ver, _flags, stream, opcode = struct.unpack(">BBhB",
+                                                             hdr[:5])
+                (length,) = struct.unpack(">I", hdr[5:9])
+                if length > (256 << 20):
+                    return
+                body = self._read_exact(sock, length) if length else b""
+                if body is None:
+                    return
+                try:
+                    op, rsp = self._dispatch(processor, state, need_auth,
+                                             auth, opcode, body)
+                except Exception as e:
+                    code = ERR_INVALID if isinstance(e, ValueError) \
+                        else ERR_SERVER
+                    op, rsp = OP_ERROR, struct.pack(">i", code) \
+                        + _string(f"{type(e).__name__}: {e}")
+                sock.sendall(struct.pack(">BBhBI", VERSION_RSP, 0, stream,
+                                         op, len(rsp)) + rsp)
+        except OSError:
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------- opcodes
+
+    def _dispatch(self, processor, state, need_auth, auth, opcode, body):
+        if opcode == OP_OPTIONS:
+            return OP_SUPPORTED, struct.pack(">H", 1) + \
+                _string("CQL_VERSION") + struct.pack(">H", 1) + \
+                _string("3.4.5")
+        if opcode == OP_STARTUP:
+            if need_auth:
+                return OP_AUTHENTICATE, _string(
+                    "org.apache.cassandra.auth.PasswordAuthenticator")
+            state["authed"] = True
+            return OP_READY, b""
+        if opcode == OP_AUTH_RESPONSE:
+            token, _ = _read_bytes(body, 0)
+            parts = (token or b"").split(b"\x00")
+            if len(parts) >= 3:
+                user, pw = parts[1].decode(), parts[2].decode()
+                try:
+                    auth.authenticate(user, pw)
+                except Exception:
+                    return OP_ERROR, struct.pack(
+                        ">i", ERR_BAD_CREDENTIALS) + _string(
+                        "bad credentials")
+                state["user"] = user
+                state["authed"] = True
+                return OP_AUTH_SUCCESS, _bytes(None)
+            return OP_ERROR, struct.pack(">i", ERR_BAD_CREDENTIALS) \
+                + _string("malformed SASL token")
+        if not state["authed"]:
+            return OP_ERROR, struct.pack(">i", ERR_PROTOCOL) \
+                + _string("STARTUP required")
+        if opcode == OP_QUERY:
+            query, pos = _read_long_string(body, 0)
+            return self._run(processor, state, query, body, pos)
+        if opcode == OP_PREPARE:
+            query, _ = _read_long_string(body, 0)
+            qid = processor.prepare(query)
+            prep = processor._prepared[qid]
+            n_binds = getattr(prep.statement, "n_markers", 0)
+            rsp = bytearray()
+            rsp += struct.pack(">i", RESULT_PREPARED)
+            rsp += struct.pack(">H", len(qid)) + qid
+            # bind metadata: declared as BLOB — the server deserializes
+            # wire bytes against the real column type at bind time, so
+            # clients pass pre-serialized values (documented subset)
+            rsp += struct.pack(">Ii", 0x0001, n_binds)   # flags, count
+            rsp += struct.pack(">i", 0)                   # pk_count
+            rsp += _string("") + _string("")              # global spec
+            for i in range(n_binds):
+                rsp += _string(f"p{i}") + struct.pack(">H", 0x03)
+            # result metadata: clients re-read it from each RESULT
+            rsp += struct.pack(">Ii", 0, 0)
+            return OP_RESULT, bytes(rsp)
+        if opcode == OP_EXECUTE:
+            (n,) = struct.unpack_from(">H", body, 0)
+            qid = bytes(body[2:2 + n])
+            pos = 2 + n
+            prep = processor._prepared.get(qid)
+            if prep is None:
+                return OP_ERROR, struct.pack(">i", ERR_INVALID) \
+                    + _string("unknown prepared statement")
+            return self._run(processor, state, prep.query, body, pos)
+        return OP_ERROR, struct.pack(">i", ERR_PROTOCOL) \
+            + _string(f"unsupported opcode {opcode}")
+
+    def _run(self, processor, state, query: str, body: bytes, pos: int):
+        _consistency, = struct.unpack_from(">H", body, pos)
+        pos += 2
+        flags = body[pos]
+        pos += 1
+        params: tuple = ()
+        page_size = None
+        paging_state = None
+        if flags & 0x01:                 # values
+            (nv,) = struct.unpack_from(">H", body, pos)
+            pos += 2
+            vals = []
+            for _ in range(nv):
+                b, pos = _read_bytes(body, pos)
+                vals.append(None if b is None else WireValue(b))
+            params = tuple(vals)
+        if flags & 0x04:                 # page_size
+            (page_size,) = struct.unpack_from(">i", body, pos)
+            pos += 4
+        if flags & 0x08:                 # paging_state
+            paging_state, pos = _read_bytes(body, pos)
+        rs = processor.process(query, params, state["keyspace"],
+                               user=state["user"], page_size=page_size,
+                               paging_state=paging_state)
+        new_ks = getattr(rs, "keyspace", None)
+        if new_ks is not None:
+            state["keyspace"] = new_ks
+            return OP_RESULT, struct.pack(">i", RESULT_SET_KEYSPACE) \
+                + _string(new_ks)
+        if not rs.column_names:
+            return OP_RESULT, struct.pack(">i", RESULT_VOID)
+        return OP_RESULT, _encode_rows(rs)

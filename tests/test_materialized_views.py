@@ -151,3 +151,35 @@ def test_view_nulled_column_and_null_backfill(session):
                     "NULL PRIMARY KEY ((city), id)")
     rs = session.execute("SELECT id FROM by_city2 WHERE city = 'graz'")
     assert rs.rows == [(31,)]
+
+
+def test_view_ttl_propagates(session):
+    import time
+    session.execute("INSERT INTO users (id, city) VALUES (41, 'turin') "
+                    "USING TTL 1")
+    assert session.execute(
+        "SELECT id FROM users_by_city WHERE city = 'turin'").rows \
+        == [(41,)]
+    time.sleep(1.5)
+    assert session.execute(
+        "SELECT id FROM users_by_city WHERE city = 'turin'").rows == []
+
+
+def test_view_timestamped_delete_shadows(session):
+    session.execute("INSERT INTO users (id, city) VALUES (42, 'nice') "
+                    "USING TIMESTAMP 100")
+    session.execute("DELETE FROM users USING TIMESTAMP 200 WHERE id = 42")
+    assert session.execute(
+        "SELECT id FROM users_by_city WHERE city = 'nice'").rows == []
+
+
+def test_view_logged_batch(session):
+    session.execute("INSERT INTO users (id, city, age) VALUES "
+                    "(51, 'rome', 99)")
+    session.execute("BEGIN BATCH "
+                    "UPDATE users SET age = 5 WHERE id = 51; "
+                    "UPDATE users SET city = 'rome' WHERE id = 51; "
+                    "APPLY BATCH;")
+    rs = session.execute("SELECT id, age FROM users_by_city "
+                         "WHERE city = 'rome'")
+    assert rs.rows == [(51, 5)]
