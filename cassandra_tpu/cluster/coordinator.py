@@ -58,6 +58,25 @@ class StorageProxy:
         self.node = node
         self.messaging: MessagingService = node.messaging
         self.timeout = 5.0
+        # speculative retry: if the read round is still short of blockFor
+        # after this delay, a redundant request goes to the next replica
+        # (service/reads/AbstractReadExecutor speculate; the reference
+        # default is the p99 percentile — a fixed floor stands in)
+        self.speculative_delay = 0.05
+        # EWMA read latency per endpoint (locator/DynamicEndpointSnitch
+        # role): data-replica selection prefers the fastest
+        self._latency: dict[Endpoint, float] = {}
+        self._lat_lock = threading.Lock()
+
+    def _record_latency(self, ep: Endpoint, seconds: float) -> None:
+        with self._lat_lock:
+            prev = self._latency.get(ep)
+            self._latency[ep] = seconds if prev is None \
+                else prev * 0.8 + seconds * 0.2
+
+    def _latency_of(self, ep: Endpoint) -> float:
+        with self._lat_lock:
+            return self._latency.get(ep, 0.0)
 
     # --------------------------------------------------------------- plan
 
@@ -191,12 +210,16 @@ class StorageProxy:
             raise UnavailableException(
                 f"{cl} requires {block_for} replicas, "
                 f"{len(countable)} countable alive")
-        # prefer self as the data replica; only countable replicas serve
-        # the blockFor set (LOCAL_* never reads across DCs for the quorum)
-        countable.sort(key=lambda r: r != self.node.endpoint)
+        # replica ordering: self first, then fastest by EWMA latency
+        # (dynamic snitch role); only countable replicas serve the
+        # blockFor set (LOCAL_* never reads across DCs for the quorum)
+        countable.sort(key=lambda r: (r != self.node.endpoint,
+                                      self._latency_of(r)))
         targets = countable[:block_for]
+        spares = countable[block_for:]
         results, digests = self._fetch(keyspace, table_name, pk,
-                                       targets[:1], targets[1:])
+                                       targets[:1], targets[1:],
+                                       spares=spares)
         if len(results) + len(digests) < block_for:
             raise TimeoutException(
                 f"{len(results) + len(digests)}/{block_for} read responses")
@@ -213,18 +236,25 @@ class StorageProxy:
         return merged
 
     def _fetch(self, keyspace, table_name, pk, data_targets,
-               digest_targets):
+               digest_targets, spares=()):
         """One round: full READ_REQ to data_targets, digest-only READ_REQ
-        to digest_targets. Returns ([(ep, batch)], [(ep, digest)])."""
+        to digest_targets. If the round is still short of blockFor after
+        the speculative delay, ONE spare replica gets a redundant
+        full-data request (speculative retry —
+        service/reads/AbstractReadExecutor). Returns
+        ([(ep, batch)], [(ep, digest)])."""
+        import time as _time
+
         ck_comp = self.node.schema.get_table(
             keyspace, table_name).clustering_comp
         handler = _Await(len(data_targets) + len(digest_targets))
         results: list = []
         digests: list = []
         lock = threading.Lock()
+        t0 = _time.monotonic()
 
-        for target in data_targets + digest_targets:
-            digest_only = target in digest_targets
+        def send_to(target, digest_only):
+            sent = _time.monotonic()
             if target == self.node.endpoint:
                 batch = self.node.engine.store(
                     keyspace, table_name).read_partition(pk)
@@ -233,9 +263,10 @@ class StorageProxy:
                         digests.append((target, cb.content_digest(batch)))
                     else:
                         results.append((target, batch))
+                self._record_latency(target, _time.monotonic() - sent)
                 handler.ack()
             else:
-                def on_rsp(m, t=target, dg=digest_only):
+                def on_rsp(m, t=target, dg=digest_only, ts=sent):
                     with lock:
                         if dg:
                             digests.append((t, m.payload))
@@ -243,14 +274,31 @@ class StorageProxy:
                             b = cb_deserialize(m.payload)
                             b.ck_comp = ck_comp
                             results.append((t, b))
+                    self._record_latency(t, _time.monotonic() - ts)
                     handler.ack()
+
+                def on_fail(mid, t=target):
+                    # timeouts/failures must poison the snitch ranking —
+                    # otherwise a blackholed replica keeps looking fast
+                    self._record_latency(t, self.timeout)
+                    handler.fail()
                 self.messaging.send_with_callback(
                     Verb.READ_REQ,
                     (keyspace, table_name, pk, digest_only), target,
-                    on_response=on_rsp,
-                    on_failure=lambda mid: handler.fail(),
+                    on_response=on_rsp, on_failure=on_fail,
                     timeout=self.timeout)
-        handler.await_(self.timeout)
+
+        for target in data_targets + digest_targets:
+            send_to(target, target in digest_targets)
+        done = handler.await_(min(self.speculative_delay, self.timeout))
+        if not done and spares:
+            from ..service.metrics import GLOBAL
+            GLOBAL.incr("reads.speculative_retries")
+            # a redundant data read: its full payload can substitute for
+            # a straggling digest (ack tallies are read-resolver inputs)
+            send_to(spares[0], False)
+        # the read budget is self.timeout TOTAL, not per wait
+        handler.await_(max(self.timeout - (_time.monotonic() - t0), 0.0))
         with lock:
             return list(results), list(digests)
 

@@ -39,13 +39,20 @@ class QueryProcessor:
 
     def execute_prepared(self, qid: bytes, params=(),
                          keyspace: str | None = None,
-                         user: str | None = None) -> ResultSet:
+                         user: str | None = None,
+                         page_size: int | None = None,
+                         paging_state: bytes | None = None) -> ResultSet:
         with self._lock:
             prep = self._prepared.get(qid)
         if prep is None:
             raise InvalidRequest("unknown prepared statement")
+        audit = getattr(self.executor.backend, "audit_log", None)
+        if audit is not None:
+            audit.log(type(prep.statement).__name__, prep.query, user,
+                      keyspace, params=params)
         return self.executor.execute(prep.statement, params, keyspace,
-                                     user=user)
+                                     user=user, page_size=page_size,
+                                     paging_state=paging_state)
 
     def process(self, query: str, params=(),
                 keyspace: str | None = None,
@@ -55,6 +62,10 @@ class QueryProcessor:
         stmt = parse(query)
         kind = type(stmt).__name__.removesuffix("Statement").lower()
         GLOBAL.incr(f"cql.{kind}")
+        audit = getattr(self.executor.backend, "audit_log", None)
+        if audit is not None:
+            audit.log(type(stmt).__name__, query, user, keyspace,
+                      params=params)
         with GLOBAL.timer("cql.request"):
             return self.executor.execute(stmt, params, keyspace, user=user,
                                          page_size=page_size,

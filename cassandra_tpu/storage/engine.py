@@ -21,7 +21,8 @@ class StorageEngine:
                  durable_writes: bool = True,
                  commitlog_sync: str = "periodic",
                  flush_threshold: int | None = None,
-                 auth_enabled: bool = False):
+                 auth_enabled: bool = False,
+                 audit_log_path: str | None = None):
         self.data_dir = data_dir
         self.schema = schema or Schema()
         self.durable = durable_writes
@@ -43,6 +44,11 @@ class StorageEngine:
         self._replay_batchlog()
         from ..index import IndexManager
         self.indexes = IndexManager(self)
+        # audit/FQL stream (service/audit.py); None = disabled
+        self.audit_log = None
+        if audit_log_path:
+            from ..service.audit import AuditLog
+            self.audit_log = AuditLog(audit_log_path)
         self._restore_indexes()
         from .virtual import build_engine_virtuals
         self.virtual_tables = build_engine_virtuals(self)
@@ -186,6 +192,8 @@ class StorageEngine:
             pass
         if self.commitlog:
             self.commitlog.close()
+        if self.audit_log is not None:
+            self.audit_log.close()
         for cfs in self.stores.values():
             for sst in cfs.live_sstables():
                 sst.close()

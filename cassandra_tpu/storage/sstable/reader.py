@@ -174,6 +174,21 @@ class SSTableReader:
     # ------------------------------------------------------------- decode
 
     def _read_segment(self, i: int) -> CellBatch:
+        from ..chunk_cache import GLOBAL as chunk_cache
+        key = (self.desc.directory, self.desc.generation, i)
+        cached = chunk_cache.get(key)
+        if cached is not None:
+            if cached.ck_comp is None and self._table is not None:
+                # a schema-less (offline-tool) reader may have warmed
+                # this entry; range-tombstone reconciliation needs the
+                # composite translator back
+                cached.ck_comp = self._table.clustering_comp
+            return cached
+        batch = self._decode_segment(i)
+        chunk_cache.put(key, batch)
+        return batch
+
+    def _decode_segment(self, i: int) -> CellBatch:
         n = int(self._seg_n[i])
         pos = int(self._seg_off[i])
         cls = [int(self._blk[i, b, 0]) for b in range(3)]

@@ -250,8 +250,11 @@ class ColumnFamilyStore:
             self.memtable = Memtable(self.table)
             old = self.tracker.view()
             self.tracker.replace(old, [])
+            from .chunk_cache import GLOBAL as chunk_cache
             for sst in old:
                 sst.close()
+                chunk_cache.invalidate_generation(sst.desc.directory,
+                                                  sst.desc.generation)
                 # the whole generation family: standard components AND
                 # attached index components (Index_<col>.db)
                 prefix = f"{sst.desc.version}-{sst.desc.generation}-"

@@ -164,6 +164,11 @@ class CQLServer:
 
     def __init__(self, backend, host: str = "127.0.0.1", port: int = 0):
         self.backend = backend
+        # ONE processor for the whole server: prepared-statement ids are
+        # server-global like the reference's (drivers prepare on one
+        # connection and execute on another); keyspace/user stay
+        # per-connection via the state dict
+        self.processor = QueryProcessor(backend)
         self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listen.bind((host, port))
@@ -202,7 +207,7 @@ class CQLServer:
         return bytes(buf)
 
     def _serve(self, sock: socket.socket) -> None:
-        processor = QueryProcessor(self.backend)
+        processor = self.processor
         state = {"keyspace": None, "user": None, "authed": False}
         auth = getattr(self.backend, "auth", None)
         need_auth = auth is not None and auth.enabled
@@ -295,15 +300,15 @@ class CQLServer:
             (n,) = struct.unpack_from(">H", body, 0)
             qid = bytes(body[2:2 + n])
             pos = 2 + n
-            prep = processor._prepared.get(qid)
-            if prep is None:
+            if processor._prepared.get(qid) is None:
                 return OP_ERROR, struct.pack(">i", ERR_INVALID) \
                     + _string("unknown prepared statement")
-            return self._run(processor, state, prep.query, body, pos)
+            return self._run(processor, state, None, body, pos, qid=qid)
         return OP_ERROR, struct.pack(">i", ERR_PROTOCOL) \
             + _string(f"unsupported opcode {opcode}")
 
-    def _run(self, processor, state, query: str, body: bytes, pos: int):
+    def _run(self, processor, state, query, body: bytes, pos: int,
+             qid: bytes | None = None):
         _consistency, = struct.unpack_from(">H", body, pos)
         pos += 2
         flags = body[pos]
@@ -324,9 +329,15 @@ class CQLServer:
             pos += 4
         if flags & 0x08:                 # paging_state
             paging_state, pos = _read_bytes(body, pos)
-        rs = processor.process(query, params, state["keyspace"],
-                               user=state["user"], page_size=page_size,
-                               paging_state=paging_state)
+        if qid is not None:   # EXECUTE: cached statement, no re-parse
+            rs = processor.execute_prepared(
+                qid, params, state["keyspace"], user=state["user"],
+                page_size=page_size, paging_state=paging_state)
+        else:
+            rs = processor.process(query, params, state["keyspace"],
+                                   user=state["user"],
+                                   page_size=page_size,
+                                   paging_state=paging_state)
         new_ks = getattr(rs, "keyspace", None)
         if new_ks is not None:
             state["keyspace"] = new_ks

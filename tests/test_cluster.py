@@ -521,3 +521,30 @@ def test_pending_range_writes_during_bootstrap(tmp_path):
         assert owned_locally > 0   # the new node really owns some rows
     finally:
         c.shutdown()
+
+
+def test_speculative_retry_rescues_slow_replica(cluster):
+    """A digest replica that never answers must not stall the read until
+    the full timeout: after the speculative delay a redundant request to
+    a spare replica completes the quorum
+    (service/reads/AbstractReadExecutor speculate)."""
+    from cassandra_tpu.service.metrics import GLOBAL
+    s = cluster.session(1)
+    s.keyspace = "ks"
+    n1 = cluster.node(1)
+    n1.default_cl = ConsistencyLevel.ALL
+    s.execute("INSERT INTO kv (k, v) VALUES (70, 'spec')")
+    n1.default_cl = ConsistencyLevel.QUORUM
+    # deterministic target choice: node2 looks fastest -> digest target;
+    # node3 becomes the spare
+    ep2, ep3 = cluster.nodes[1].endpoint, cluster.nodes[2].endpoint
+    n1.proxy._latency = {ep2: 0.001, ep3: 0.5}
+    cluster.filters.drop(verb=Verb.READ_REQ, to=ep2)
+    n1.proxy.timeout = 5.0
+    before = GLOBAL.counter("reads.speculative_retries")
+    import time
+    t0 = time.time()
+    assert s.execute("SELECT v FROM kv WHERE k = 70").rows == [("spec",)]
+    assert time.time() - t0 < 2.0, "speculation should beat the timeout"
+    assert GLOBAL.counter("reads.speculative_retries") > before
+    cluster.filters.clear()

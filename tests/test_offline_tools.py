@@ -109,3 +109,26 @@ def test_copy_roundtrip_collections(session, engine, tmp_path):
     a = sorted(session.execute("SELECT id, tags, nums, m FROM cc").rows)
     b = sorted(session.execute("SELECT id, tags, nums, m FROM cc2").rows)
     assert a == b
+
+
+def test_audit_fql_log(tmp_path):
+    import json as _json
+    from cassandra_tpu.cql import Session as _S
+    eng = StorageEngine(str(tmp_path / "adata"), Schema(),
+                        commitlog_sync="batch",
+                        audit_log_path=str(tmp_path / "audit.jsonl"))
+    try:
+        s = _S(eng)
+        s.execute("CREATE KEYSPACE ks WITH replication = "
+                  "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+        s.execute("USE ks")
+        s.execute("CREATE TABLE t (k int PRIMARY KEY)")
+        s.execute("INSERT INTO t (k) VALUES (1)")
+        s.execute("SELECT k FROM t")
+        recs = [_json.loads(l) for l in
+                open(tmp_path / "audit.jsonl")]
+        cats = [r["category"] for r in recs]
+        assert "DDL" in cats and "DML" in cats and "QUERY" in cats
+        assert any("INSERT INTO t" in r["query"] for r in recs)
+    finally:
+        eng.close()
