@@ -165,6 +165,31 @@ class CreateViewStatement:
 
 
 @dataclass
+class CreateFunctionStatement:
+    keyspace: str | None
+    name: str
+    arg_names: list
+    arg_types: list
+    returns: str
+    language: str
+    body: str
+    or_replace: bool = False
+    if_not_exists: bool = False
+
+
+@dataclass
+class CreateAggregateStatement:
+    keyspace: str | None
+    name: str
+    arg_type: str
+    sfunc: str
+    stype: str
+    finalfunc: str | None = None
+    initcond: object = None
+    or_replace: bool = False
+
+
+@dataclass
 class DropStatement:
     what: str            # keyspace | table | index | type
     keyspace: str | None

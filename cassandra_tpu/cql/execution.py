@@ -154,6 +154,14 @@ class Executor:
     def schema(self):
         return self.backend.schema
 
+    @property
+    def udfs(self):
+        from .functions import FunctionRegistry
+        sch = self.backend.schema
+        if not hasattr(sch, "udfs"):
+            sch.udfs = FunctionRegistry()
+        return sch.udfs
+
     PERMISSION_OF = {
         "SelectStatement": "SELECT",
         "InsertStatement": "MODIFY", "UpdateStatement": "MODIFY",
@@ -163,6 +171,8 @@ class Executor:
         "CreateTypeStatement": "CREATE",
         "CreateKeyspaceStatement": "CREATE",
         "CreateViewStatement": "CREATE",
+        "CreateFunctionStatement": "CREATE",
+        "CreateAggregateStatement": "CREATE",
         "DropStatement": "DROP", "AlterTableStatement": "ALTER",
         "RoleStatement": "AUTHORIZE", "GrantStatement": "AUTHORIZE",
         "ListRolesStatement": "AUTHORIZE",
@@ -620,6 +630,45 @@ class Executor:
             raise InvalidRequest(
                 "cannot directly modify a materialized view")
 
+    def _exec_CreateFunctionStatement(self, s, params, keyspace, now):
+        from .functions import UDF, FunctionError
+        ks = s.keyspace or keyspace
+        if ks is None:
+            raise InvalidRequest("no keyspace for CREATE FUNCTION")
+        if s.language != "expr":
+            raise InvalidRequest(
+                "only LANGUAGE expr is supported (a sandboxed expression "
+                "language — see cql/functions.py)")
+        if self.udfs.get_function(ks, s.name) is not None \
+                and s.if_not_exists:
+            return ResultSet([], [])
+        try:
+            self.udfs.add_function(
+                UDF(ks, s.name, s.arg_names, s.arg_types, s.returns,
+                    s.body), replace=s.or_replace)
+        except FunctionError as e:
+            raise InvalidRequest(str(e))
+        self.schema._changed()
+        return ResultSet([], [])
+
+    def _exec_CreateAggregateStatement(self, s, params, keyspace, now):
+        from .functions import UDA, FunctionError
+        ks = s.keyspace or keyspace
+        if ks is None:
+            raise InvalidRequest("no keyspace for CREATE AGGREGATE")
+        if self.udfs.get_function(ks, s.sfunc) is None:
+            raise InvalidRequest(f"unknown SFUNC {s.sfunc}")
+        if s.finalfunc and self.udfs.get_function(ks, s.finalfunc) is None:
+            raise InvalidRequest(f"unknown FINALFUNC {s.finalfunc}")
+        try:
+            self.udfs.add_aggregate(
+                UDA(ks, s.name, s.arg_type, s.sfunc, s.stype,
+                    s.finalfunc, s.initcond), replace=s.or_replace)
+        except FunctionError as e:
+            raise InvalidRequest(str(e))
+        self.schema._changed()
+        return ResultSet([], [])
+
     def _table_params(self, options: dict) -> TableParams:
         p = TableParams()
         if "compression" in options:
@@ -628,6 +677,10 @@ class Executor:
             p.compaction = dict(options["compaction"])
         if "gc_grace_seconds" in options:
             p.gc_grace_seconds = int(options["gc_grace_seconds"])
+        if "cdc" in options:
+            v = options["cdc"]
+            p.cdc = v if isinstance(v, bool) \
+                else str(v).lower() in ("true", "1")
         if "default_time_to_live" in options:
             p.default_ttl = int(options["default_time_to_live"])
         if "comment" in options:
@@ -700,6 +753,9 @@ class Executor:
                 if registry is not None:
                     registry.drop(ks, s.name)
                     self.schema._changed()
+            elif s.what in ("function", "aggregate"):
+                self.udfs.drop(ks, s.name, kind=s.what)
+                self.schema._changed()
         except KeyError:
             if not s.if_exists:
                 raise InvalidRequest(f"unknown {s.what} {s.name}")
@@ -1221,7 +1277,7 @@ class Executor:
         rows feeding them."""
         limit = int(bind_term(s.limit, None, params)) \
             if s.limit is not None else None
-        post = self._limit_after_projection(s)
+        post = self._limit_after_projection(s, t)
         if limit is not None and not post:
             rows = rows[:limit]
         rs = self._project(t, s, rows)
@@ -1229,14 +1285,20 @@ class Executor:
             rs = ResultSet(rs.column_names, rs.rows[:limit])
         return rs
 
-    @staticmethod
-    def _limit_after_projection(s) -> bool:
+    def _limit_after_projection(self, s, t=None) -> bool:
         if getattr(s, "group_by", None) or getattr(s, "distinct", False):
             return True
         agg_fns = {"count", "min", "max", "sum", "avg"}
-        return any(isinstance(expr, ast.FunctionCall)
-                   and expr.name.lower() in agg_fns
-                   for expr, _ in s.selectors)
+        for expr, _ in s.selectors:
+            if not isinstance(expr, ast.FunctionCall):
+                continue
+            name = expr.name.lower()
+            if name in agg_fns:
+                return True
+            if t is not None and self.udfs.get_aggregate(
+                    t.keyspace, name) is not None:
+                return True
+        return False
 
     def _paged_scan(self, t, cfs, s, params, ck_rel, filters, want_meta,
                     page_size, paging_state):
@@ -1249,7 +1311,7 @@ class Executor:
 
         state = paging_mod.PagingState.deserialize(paging_state) \
             if paging_state else None
-        post_agg = self._limit_after_projection(s) or bool(s.order_by)
+        post_agg = self._limit_after_projection(s, t) or bool(s.order_by)
         if post_agg:
             # aggregates / GROUP BY / DISTINCT / sorted scans consume all
             # windows internally (AggregationQueryPager role) — memory
@@ -1455,16 +1517,21 @@ class Executor:
         for expr, alias in sel:
             if isinstance(expr, ast.FunctionCall):
                 fname = expr.name.lower()
-                arg = expr.args[0] if expr.args else None
-                colname = arg if isinstance(arg, str) else \
-                    (arg.value if isinstance(arg, ast.Literal) else None)
-                names.append(alias or f"{fname}({colname})")
-                exprs.append((fname, colname))
+                argnames = []
+                for a in expr.args:
+                    argnames.append(a if isinstance(a, str)
+                                    else (a.value
+                                          if isinstance(a, ast.Literal)
+                                          else None))
+                colname = argnames[0] if argnames else None
+                names.append(alias or
+                             f"{fname}({', '.join(map(str, argnames))})")
+                exprs.append((fname, colname, argnames))
             else:
                 if expr not in t.columns:
                     raise InvalidRequest(f"unknown column {expr}")
                 names.append(alias or expr)
-                exprs.append((None, expr))
+                exprs.append((None, expr, [expr]))
         _now_s = timeutil.now_seconds()   # one 'now' for the whole result
         agg_fns = {"count", "min", "max", "sum", "avg"}
 
@@ -1483,7 +1550,7 @@ class Executor:
             if pk_prefix[:len(s.group_by)] != s.group_by:
                 raise InvalidRequest(
                     "GROUP BY columns must form a primary-key prefix")
-            for f, cname in exprs:
+            for f, cname, _args in exprs:
                 if f is None and cname not in s.group_by:
                     raise InvalidRequest(
                         f"selecting {cname} without an aggregate requires "
@@ -1495,13 +1562,16 @@ class Executor:
             out_rows = []
             for key, grp in groups.items():
                 row = []
-                for f, cname in exprs:
+                for f, cname, _args in exprs:
                     if f is None:
                         row.append(grp[0].get(cname))
                         continue
                     vals = [r.get(cname) for r in grp
                             if r.get(cname) is not None]
-                    if f == "count":
+                    uda = self.udfs.get_aggregate(t.keyspace, f)
+                    if uda is not None:
+                        row.append(uda.aggregate(self.udfs, vals))
+                    elif f == "count":
                         row.append(len(grp) if cname in ("*", None)
                                    else len(vals))
                     elif f == "min":
@@ -1518,12 +1588,17 @@ class Executor:
                 out_rows.append(tuple(row))
             return ResultSet(names, out_rows)
 
-        if any(f in agg_fns for f, _ in exprs if f):
+        is_uda = lambda f: f is not None \
+            and self.udfs.get_aggregate(t.keyspace, f) is not None
+        if any(f in agg_fns or is_uda(f) for f, _c, _a in exprs if f):
             out = []
-            for f, cname in exprs:
+            for f, cname, _args in exprs:
                 vals = [r.get(cname) for r in rows
                         if r.get(cname) is not None]
-                if f == "count":
+                uda = self.udfs.get_aggregate(t.keyspace, f) if f else None
+                if uda is not None:
+                    out.append(uda.aggregate(self.udfs, vals))
+                elif f == "count":
                     out.append(len(rows) if cname in ("*", None)
                                else len(vals))
                 elif f == "min":
@@ -1540,7 +1615,16 @@ class Executor:
         result_rows = []
         for r in rows:
             row = []
-            for f, cname in exprs:
+            for f, cname, fargs in exprs:
+                if f is not None and f not in ("token", "writetime",
+                                               "ttl"):
+                    udf = self.udfs.get_function(t.keyspace, f)
+                    if udf is None:
+                        raise InvalidRequest(f"unknown function {f}")
+                    row.append(udf([
+                        r.get(a) if isinstance(a, str) and a in t.columns
+                        else a for a in fargs]))
+                    continue
                 if f == "token":
                     from ..utils import murmur3
                     pkb = t.serialize_partition_key(

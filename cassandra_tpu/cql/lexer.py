@@ -22,7 +22,8 @@ KEYWORDS = {
     "timestamp", "type", "index", "on", "add", "to", "rename", "static",
     "distinct", "as", "contains", "per", "partition", "is", "null", "token",
     "or", "replace", "materialized", "view", "custom", "options", "role",
-    "user", "grant", "revoke", "of", "list",
+    "user", "grant", "revoke", "of", "list", "function", "aggregate",
+    "returns", "language",
 }
 
 UUID_RE = re.compile(

@@ -70,7 +70,9 @@ class Parser:
         if t.kind == "KEYWORD" and t.value in ("key", "type", "timestamp",
                                                "ttl", "list", "index", "role",
                                                "user", "counter", "token",
-                                               "options", "custom", "view"):
+                                               "options", "custom", "view",
+                                               "function", "aggregate",
+                                               "returns", "language"):
             return t.value  # unreserved keywords usable as identifiers
         raise ParseError(f"expected identifier, got {t}")
 
@@ -461,7 +463,71 @@ class Parser:
         if what.kind == "KEYWORD" and what.value == "materialized":
             self.expect_kw("view")
             return self._create_view()
+        if what.kind == "KEYWORD" and what.value == "or":
+            self.expect_kw("replace")
+            nxt = self.expect_kw("function", "aggregate")
+            if nxt == "function":
+                return self._create_function(or_replace=True)
+            return self._create_aggregate(or_replace=True)
+        if what.kind == "KEYWORD" and what.value == "function":
+            return self._create_function()
+        if what.kind == "KEYWORD" and what.value == "aggregate":
+            return self._create_aggregate()
         raise ParseError(f"unsupported CREATE {what}")
+
+    def _create_function(self, or_replace: bool = False):
+        """CREATE [OR REPLACE] FUNCTION [IF NOT EXISTS] name
+        (arg type, ...) RETURNS type LANGUAGE <lang> AS '<body>'
+        (cql3/functions/UDFunction grammar subset)."""
+        ine = self._if_not_exists()
+        ks, name = self.qualified_name()
+        self.expect_op("(")
+        arg_names, arg_types = [], []
+        if not self.accept_op(")"):
+            while True:
+                arg_names.append(self.ident())
+                arg_types.append(self._type_string())
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        self.expect_kw("returns")
+        returns = self._type_string()
+        self.expect_kw("language")
+        language = self.ident()
+        self.expect_kw("as")
+        t = self.next()
+        if t.kind != "STRING":
+            raise ParseError(f"expected function body string, got {t}")
+        return ast.CreateFunctionStatement(ks, name, arg_names, arg_types,
+                                           returns, language, t.value,
+                                           or_replace, ine)
+
+    def _create_aggregate(self, or_replace: bool = False):
+        """CREATE [OR REPLACE] AGGREGATE name (type) SFUNC f STYPE t
+        [FINALFUNC g] [INITCOND x] (UDAggregate grammar subset)."""
+        ks, name = self.qualified_name()
+        self.expect_op("(")
+        arg_type = self._type_string()
+        self.expect_op(")")
+        if not self.accept_ident("sfunc"):
+            raise ParseError("expected SFUNC")
+        sfunc = self.ident()
+        if not self.accept_ident("stype"):
+            raise ParseError("expected STYPE")
+        stype = self._type_string()
+        finalfunc = None
+        initcond = None
+        if self.accept_ident("finalfunc"):
+            finalfunc = self.ident()
+        if self.accept_ident("initcond"):
+            t = self.next()
+            if t.kind in ("INT", "FLOAT", "STRING"):
+                initcond = t.value
+            else:
+                raise ParseError(f"bad INITCOND {t}")
+        return ast.CreateAggregateStatement(ks, name, arg_type, sfunc,
+                                            stype, finalfunc, initcond,
+                                            or_replace)
 
     def _create_role(self):
         ine = self._if_not_exists()
@@ -775,7 +841,8 @@ class Parser:
         if what == "materialized":
             self.expect_kw("view")
             what = "view"
-        if what not in ("keyspace", "table", "index", "type", "view"):
+        if what not in ("keyspace", "table", "index", "type", "view",
+                        "function", "aggregate"):
             raise ParseError(f"unsupported DROP {what}")
         ife = False
         if self.accept_kw("if"):

@@ -48,6 +48,7 @@ class TableParams:
     default_ttl: int = 0
     memtable_flush_period_ms: int = 0
     comment: str = ""
+    cdc: bool = False       # change data capture stream (storage/cdc.py)
     # TPU-format knob: bytes of clustering prefix carried in key lanes
     clustering_prefix_bytes: int = 16
 
@@ -298,6 +299,7 @@ def table_to_dict(t: TableMetadata) -> dict:
             "default_ttl": t.params.default_ttl,
             "comment": t.params.comment,
             "clustering_prefix_bytes": t.params.clustering_prefix_bytes,
+            "cdc": t.params.cdc,
         },
     }
 
@@ -310,7 +312,8 @@ def table_from_dict(d: dict, udts: dict | None = None) -> TableMetadata:
         gc_grace_seconds=int(p["gc_grace_seconds"]),
         default_ttl=int(p["default_ttl"]),
         comment=p.get("comment", ""),
-        clustering_prefix_bytes=int(p.get("clustering_prefix_bytes", 16)))
+        clustering_prefix_bytes=int(p.get("clustering_prefix_bytes", 16)),
+        cdc=bool(p.get("cdc", False)))
     t = TableMetadata(
         d["keyspace"], d["name"],
         [(n, parse_type(ts, udts)) for n, ts in d["partition_key"]],
@@ -342,6 +345,9 @@ def schema_to_dict(schema: Schema) -> dict:
         }
     out["views"] = [{"keyspace": ks, "name": nm, "base": list(v["base"])}
                     for (ks, nm), v in schema.views.items()]
+    udfs = getattr(schema, "udfs", None)
+    if udfs is not None:
+        out["udfs"] = udfs.to_list()
     return out
 
 
@@ -366,6 +372,11 @@ def load_schema_dict(schema: Schema, data: dict) -> None:
     for v in data.get("views", []):
         schema.views.setdefault((v["keyspace"], v["name"]),
                                 {"base": tuple(v["base"])})
+    if data.get("udfs"):
+        from .cql.functions import FunctionRegistry
+        if not hasattr(schema, "udfs"):
+            schema.udfs = FunctionRegistry()
+        schema.udfs.load_list(data["udfs"])
 
 
 def make_table(keyspace: str, name: str, *, pk: list[str], ck: list[str] = (),

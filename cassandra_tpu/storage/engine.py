@@ -28,6 +28,8 @@ class StorageEngine:
         self.durable = durable_writes
         self.flush_threshold = flush_threshold
         os.makedirs(data_dir, exist_ok=True)
+        from .cdc import CDCLog
+        self.cdc = CDCLog(os.path.join(data_dir, "cdc_raw"))
         self.commitlog = CommitLog(os.path.join(data_dir, "commitlog"),
                                    sync_mode=commitlog_sync) \
             if durable_writes else None
@@ -147,6 +149,11 @@ class StorageEngine:
         if active() is not None:
             trace(f"Appending to commitlog and memtable "
                   f"({len(mutation.ops)} ops)")
+        if cfs.table.params.cdc:
+            # durable CDC record BEFORE the memtable apply — a write the
+            # consumer never sees must not exist (CommitLogSegmentManagerCDC
+            # ordering); a full cdc_raw FAILS the write like the reference
+            self.cdc.append(mutation)
         cfs.apply(mutation, self.commitlog, durable)
         if cfs.should_flush():
             cfs.flush()

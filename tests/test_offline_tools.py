@@ -132,3 +132,40 @@ def test_audit_fql_log(tmp_path):
         assert any("INSERT INTO t" in r["query"] for r in recs)
     finally:
         eng.close()
+
+
+def test_cdc_stream(tmp_path):
+    from cassandra_tpu.cql import Session as _S
+    from cassandra_tpu.storage.cdc import CDCFullException
+    eng = StorageEngine(str(tmp_path / "cdata"), Schema(),
+                        commitlog_sync="batch")
+    try:
+        s = _S(eng)
+        s.execute("CREATE KEYSPACE ks WITH replication = "
+                  "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+        s.execute("USE ks")
+        s.execute("CREATE TABLE ev (k int PRIMARY KEY, v text) "
+                  "WITH cdc = true")
+        s.execute("CREATE TABLE quiet (k int PRIMARY KEY)")
+        t = eng.schema.get_table("ks", "ev")
+        for i in range(5):
+            s.execute(f"INSERT INTO ev (k, v) VALUES ({i}, 'v{i}')")
+        s.execute("INSERT INTO quiet (k) VALUES (1)")   # not captured
+        records = list(eng.cdc.read(t.id))
+        assert len(records) == 5
+        # the stream replays to real mutations
+        _, m = records[0]
+        assert m.table_id == t.id and len(m.ops) > 0
+        qt = eng.schema.get_table("ks", "quiet")
+        assert list(eng.cdc.read(qt.id)) == []
+        # consumer checkpoint discards consumed prefix
+        off3 = records[2][0]
+        eng.cdc.discard(t.id, off3)
+        assert len(list(eng.cdc.read(t.id))) == 2
+        # capacity: a full stream FAILS cdc writes
+        eng.cdc.space_cap = eng.cdc.size(t.id) + 1
+        import pytest as _pt
+        with _pt.raises(Exception, match="capacity"):
+            s.execute("INSERT INTO ev (k, v) VALUES (99, 'x')")
+    finally:
+        eng.close()

@@ -1,0 +1,93 @@
+"""User-defined functions/aggregates with the sandboxed expression
+language (cql3/functions/UDFunction + UDAggregate roles)."""
+import pytest
+
+from cassandra_tpu.cql import Session
+from cassandra_tpu.cql.functions import FunctionError, compile_expression
+from cassandra_tpu.schema import Schema
+from cassandra_tpu.storage.engine import StorageEngine
+
+
+@pytest.fixture
+def tmp_data(tmp_path):
+    return str(tmp_path / "data")
+
+
+@pytest.fixture
+def engine(tmp_data):
+    eng = StorageEngine(tmp_data, Schema(), commitlog_sync="batch")
+    yield eng
+    eng.close()
+
+
+@pytest.fixture
+def session(engine):
+    s = Session(engine)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE t (k int PRIMARY KEY, a int, b int)")
+    for i in range(5):
+        s.execute(f"INSERT INTO t (k, a, b) VALUES ({i}, {i}, {i * 10})")
+    return s
+
+
+def test_scalar_udf(session):
+    session.execute("CREATE FUNCTION plus2 (x int) RETURNS int "
+                    "LANGUAGE expr AS 'x + 2'")
+    rs = session.execute("SELECT plus2(a) FROM t WHERE k = 3")
+    assert rs.rows == [(5,)]
+    session.execute("CREATE FUNCTION addab (x int, y int) RETURNS int "
+                    "LANGUAGE expr AS 'x + y'")
+    rs = session.execute("SELECT addab(a, b) FROM t WHERE k = 2")
+    assert rs.rows == [(22,)]
+
+
+def test_udf_null_propagates(session):
+    session.execute("INSERT INTO t (k) VALUES (9)")
+    session.execute("CREATE FUNCTION neg (x int) RETURNS int "
+                    "LANGUAGE expr AS '-x'")
+    rs = session.execute("SELECT neg(a) FROM t WHERE k = 9")
+    assert rs.rows == [(None,)]
+
+
+def test_uda(session):
+    session.execute("CREATE FUNCTION acc (st int, x int) RETURNS int "
+                    "LANGUAGE expr AS 'st + x * x'")
+    session.execute("CREATE AGGREGATE sumsq (int) SFUNC acc STYPE int "
+                    "INITCOND 0")
+    rs = session.execute("SELECT sumsq(a) FROM t")
+    assert rs.rows == [(sum(i * i for i in range(5)),)]
+
+
+def test_sandbox_rejects_escapes(session):
+    for body in ("__import__('os')", "x.__class__", "open('/etc/passwd')",
+                 "[i for i in (1,2)]", "lambda: 1", "x[0]"):
+        with pytest.raises(Exception):
+            session.execute(
+                f"CREATE OR REPLACE FUNCTION evil (x int) RETURNS int "
+                f"LANGUAGE expr AS '{body}'")
+
+
+def test_compile_expression_directly():
+    f = compile_expression("max(x, y) * 2", ["x", "y"])
+    assert f([3, 7]) == 14
+    with pytest.raises(FunctionError):
+        compile_expression("().__class__", ["x"])
+
+
+def test_udf_persists_across_restart(tmp_data, engine, session):
+    session.execute("CREATE FUNCTION twice (x int) RETURNS int "
+                    "LANGUAGE expr AS 'x * 2'")
+    engine.close()
+    eng2 = StorageEngine(tmp_data, Schema(), commitlog_sync="batch")
+    try:
+        s2 = Session(eng2)
+        s2.keyspace = "ks"
+        assert s2.execute("SELECT twice(b) FROM t WHERE k = 4").rows \
+            == [(80,)]
+        s2.execute("DROP FUNCTION twice")
+        with pytest.raises(Exception, match="unknown function"):
+            s2.execute("SELECT twice(b) FROM t WHERE k = 4")
+    finally:
+        eng2.close()
