@@ -360,19 +360,12 @@ class LocalCluster:
         full ownership (the jvm-dtest addInstance + BootstrapAndJoin
         flow). mid_join_hook() runs between the pending registration and
         the ownership flip — tests inject concurrent writes there."""
-        import random as _random
-
-        from .ring import Endpoint
+        from .ring import Endpoint, allocate_tokens
         i = len(self.nodes) + 1
         ep = Endpoint(f"node{i}", dc=dc)
-        taken = {t for toks in self.ring.endpoints.values() for t in toks}
-        rng = _random.Random(i * 7919)
-        tokens = []
-        while len(tokens) < vnodes:
-            t = rng.randrange(-(1 << 63) + 1, (1 << 63) - 1)
-            if t not in taken:
-                tokens.append(t)
-                taken.add(t)
+        # balanced growth: bisect the widest current ranges
+        # (dht/tokenallocator role)
+        tokens = allocate_tokens(self.ring, vnodes)
         node = Node(ep, os.path.join(self.base_dir, ep.name), self.schema,
                     self.ring, self.transport,
                     seeds=[self.nodes[0].endpoint],

@@ -138,6 +138,45 @@ class Ring:
                 for i, t in enumerate(self._tokens)]
 
 
+def allocate_tokens(ring: "Ring", vnodes: int = 4) -> list[int]:
+    """Tokens for a JOINING node: bisect the current largest ranges so
+    ownership stays balanced as the cluster grows (the
+    dht/tokenallocator role — the reference optimizes per-RF ownership
+    variance; bisection of the widest arcs is the core of it)."""
+    MIN, MAX = -(1 << 63) + 1, (1 << 63) - 1
+    existing = sorted(ring._owners)
+    for toks in ring.pending.values():
+        existing.extend(toks)
+    existing.sort()
+    if not existing:
+        span = (1 << 64) // vnodes
+        return [MIN + i * span for i in range(vnodes)]
+    out: list[int] = []
+    for _ in range(vnodes):
+        pts = sorted(existing + out)
+        best_gap, best_mid = -1, None
+        n = len(pts)
+        for i, t in enumerate(pts):
+            prev = pts[(i - 1) % n]
+            gap = (t - prev) % (1 << 64)
+            if gap == 0:
+                gap = 1 << 64        # single token: the arc IS the ring
+            mid = prev + gap // 2
+            if mid > MAX:
+                mid -= 1 << 64
+            if gap > best_gap and mid not in pts:
+                best_gap, best_mid = gap, int(mid)
+        if best_mid is None:         # pathological density: fall back
+            import random
+            while True:
+                c = random.randrange(MIN, MAX)
+                if c not in pts:
+                    best_mid = c
+                    break
+        out.append(best_mid)
+    return out
+
+
 def even_tokens(n_nodes: int, vnodes: int = 1) -> list[list[int]]:
     """Evenly spread initial tokens (dht/tokenallocator role, simplified
     to the uniform case)."""

@@ -51,6 +51,33 @@ class ResultSet:
 APPLIED = ResultSet(["[applied]"], [(True,)])
 
 
+def _jsonify_resultset(rs: ResultSet) -> ResultSet:
+    """SELECT JSON: one '[json]' column whose values are JSON documents
+    of the selected row (cql3 Json.java semantics, subset)."""
+    import json as json_mod
+
+    def conv(v):
+        if isinstance(v, (bytes, bytearray)):
+            return "0x" + bytes(v).hex()
+        if isinstance(v, (set, frozenset)):
+            return sorted(conv(x) for x in v)
+        if isinstance(v, (list, tuple)):
+            return [conv(x) for x in v]
+        if isinstance(v, dict):
+            return {str(k): conv(x) for k, x in v.items()}
+        if isinstance(v, (int, float, bool)) or v is None:
+            return v
+        return str(v)
+
+    out = []
+    for row in rs.rows:
+        doc = {n: conv(v) for n, v in zip(rs.column_names, row)}
+        out.append((json_mod.dumps(doc),))
+    new = ResultSet(["[json]"], out)
+    new.paging_state = rs.paging_state
+    return new
+
+
 # ------------------------------------------------------------ term binding --
 
 def bind_term(term, cql_type, params):
@@ -813,6 +840,41 @@ class Executor:
     def _exec_InsertStatement(self, s, params, keyspace, now):
         t = self._table(s, keyspace)
         self._reject_view_write(t)
+        if getattr(s, "json", False):
+            import copy
+            import json as json_mod
+            from ..transport_server import WireValue
+            doc = s.json_payload
+            if isinstance(doc, ast.BindMarker):
+                # resolve the marker OURSELVES: the generic no-type wire
+                # heuristic would decode small byte payloads as integers
+                doc = params[doc.name] if isinstance(params, dict) \
+                    else params[doc.index]
+            else:
+                doc = bind_term(doc, None, params)
+            if isinstance(doc, (WireValue, bytes, bytearray)):
+                doc = bytes(doc).decode()
+            try:
+                data = json_mod.loads(doc)
+            except (TypeError, ValueError) as e:
+                raise InvalidRequest(f"bad JSON payload: {e}")
+            if not isinstance(data, dict):
+                raise InvalidRequest("INSERT JSON expects an object")
+            s = copy.copy(s)
+            s.columns, s.values = [], []
+            from ..types.marshal import SetType, TupleType
+            for k, v in data.items():
+                col = t.columns.get(k)
+                if col is None:
+                    raise InvalidRequest(f"unknown column {k}")
+                if isinstance(col.cql_type, SetType) \
+                        and isinstance(v, list):
+                    v = set(v)        # JSON has no set literal
+                elif isinstance(col.cql_type, TupleType) \
+                        and isinstance(v, list):
+                    v = tuple(v)
+                s.columns.append(k)
+                s.values.append(ast.Literal(v, "json"))
         now = now or timeutil.now_micros()
         ts = now if s.timestamp is None \
             else int(bind_term(s.timestamp, None, params))
@@ -1173,14 +1235,20 @@ class Executor:
                         [bind_term(x, typ, params) for x in rel.value]
                     rows = [r for r in rows
                             if self._match(r.get(rel.column), rel.op, v)]
-                return self._project_with_limit(vt.table, s, rows, params)
+                rs = self._project_with_limit(vt.table, s, rows, params)
+                if getattr(s, "json", False):
+                    rs = _jsonify_resultset(rs)
+                return rs
 
         t = self._table(s, keyspace)
         cfs = self.backend.store(t.keyspace, t.name)
         pk_vals, ck_rel, filters = self._split_where(t, s.where, params)
 
         if s.ann is not None:
-            return self._ann_select(t, cfs, s, params)
+            rs = self._ann_select(t, cfs, s, params)
+            if getattr(s, "json", False):
+                rs = _jsonify_resultset(rs)
+            return rs
 
         index_rows = None
         if filters and not s.allow_filtering:
@@ -1268,6 +1336,8 @@ class Executor:
             rows = out
         rs = self._project_with_limit(t, s, rows, params)
         rs.paging_state = new_paging_state
+        if getattr(s, "json", False):
+            rs = _jsonify_resultset(rs)
         return rs
 
     def _project_with_limit(self, t, s, rows, params) -> ResultSet:

@@ -194,6 +194,16 @@ class Parser:
     def select(self):
         self.expect_kw("select")
         json = False
+        t = self.peek()
+        if t.kind == "IDENT" and t.value == "json":
+            # 'json' only acts as the modifier when another selector
+            # follows — `SELECT json FROM t` must keep reading a column
+            # named json (the reference grammar backtracks the same way)
+            nxt = self.toks[self.i + 1]
+            if not (nxt.kind == "KEYWORD" and nxt.value == "from") \
+                    and not (nxt.kind == "OP" and nxt.value in (",", "(")):
+                self.next()
+                json = True
         distinct = bool(self.accept_kw("distinct"))
         selectors = []
         if self.accept_op("*"):
@@ -303,6 +313,18 @@ class Parser:
         self.expect_kw("insert")
         self.expect_kw("into")
         ks, table = self.qualified_name()
+        if self.accept_ident("json"):
+            payload = self.term()     # string literal or bind marker
+            ine = False
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                ine = True
+            ttl, ts = self._using()
+            stmt = ast.InsertStatement(ks, table, [], [], ine, ttl, ts)
+            stmt.json = True
+            stmt.json_payload = payload
+            return stmt
         self.expect_op("(")
         cols = []
         while True:

@@ -149,6 +149,63 @@ def clearsnapshot(engine, tag: str | None = None) -> int:
                for cfs in engine.stores.values())
 
 
+def scrub(engine, keyspace: str | None = None,
+          table: str | None = None) -> list[dict]:
+    """nodetool scrub: rewrite each sstable keeping every readable
+    segment, dropping corrupt ones (io/sstable/format/
+    SortedTableScrubber role). The unreadable cells are gone either way;
+    scrub turns a read-aborting sstable into a clean one."""
+    from ..storage.lifecycle import LifecycleTransaction
+    from ..storage.sstable import Descriptor, SSTableReader, SSTableWriter
+    from ..storage.sstable.reader import CorruptSSTableError
+    out = []
+    for cfs in list(engine.stores.values()):
+        if keyspace and cfs.table.keyspace != keyspace:
+            continue
+        if table and cfs.table.name != table:
+            continue
+        for sst in list(cfs.live_sstables()):
+            kept = dropped = 0
+            txn = LifecycleTransaction(cfs.directory)
+            gen = cfs.next_generation()
+            desc = Descriptor(cfs.directory, gen)
+            txn.track_new(gen)
+            w = SSTableWriter(desc, cfs.table,
+                              estimated_partitions=sst.n_partitions)
+            w.repaired_at = sst.repaired_at
+            w.level = sst.level
+            try:
+                for i in range(sst.n_segments):
+                    try:
+                        seg = sst._read_segment(i)
+                    except CorruptSSTableError:
+                        dropped += 1
+                        continue
+                    w.append(seg)
+                    kept += 1
+                w.finish()
+                new = SSTableReader(desc, cfs.table)
+                txn.track_obsolete(sst.desc.generation)
+                replacement = []
+                if new.n_cells > 0:
+                    replacement = [new]
+                else:               # nothing salvageable: drop entirely
+                    new.close()
+                    txn.track_obsolete(gen)
+                txn.commit()
+                cfs.tracker.replace([sst], replacement)
+                sst.release()
+            except BaseException:
+                w.abort()
+                txn.abort()
+                raise
+            out.append({"table": cfs.table.full_name(),
+                        "generation": sst.desc.generation,
+                        "segments_kept": kept,
+                        "segments_dropped": dropped})
+    return out
+
+
 def garbagecollect(engine, keyspace: str | None = None,
                    table: str | None = None) -> list[dict]:
     """Single-sstable rewrite dropping gc-able tombstones
@@ -169,7 +226,7 @@ def main(argv=None):
     p = argparse.ArgumentParser(prog="nodetool")
     p.add_argument("command", choices=["info", "flush", "compact",
                                        "compactionstats", "tablestats",
-                                       "garbagecollect"])
+                                       "garbagecollect", "scrub"])
     p.add_argument("--data", required=True, help="data directory")
     p.add_argument("--keyspace")
     p.add_argument("--table")

@@ -415,3 +415,40 @@ def test_distinct_limit_after_dedup(session):
                 f"INSERT INTO dl (k, c, v) VALUES ({k}, {c}, 1)")
     rs = session.execute("SELECT DISTINCT k FROM dl LIMIT 2")
     assert len(rs.rows) == 2 and len({r[0] for r in rs.rows}) == 2
+
+
+def test_select_and_insert_json(session):
+    session.execute("CREATE TABLE js (k int PRIMARY KEY, name text, "
+                    "nums list<int>, tags set<text>)")
+    session.execute('INSERT INTO js JSON '
+                    '\'{"k": 1, "name": "ann", "nums": [3, 1], '
+                    '"tags": ["x", "y"]}\'')
+    import json
+    rs = session.execute("SELECT JSON k, name, nums FROM js WHERE k = 1")
+    assert rs.column_names == ["[json]"]
+    doc = json.loads(rs.rows[0][0])
+    assert doc == {"k": 1, "name": "ann", "nums": [3, 1]}
+    rs = session.execute("SELECT tags FROM js WHERE k = 1")
+    assert rs.rows == [({"x", "y"},)]
+
+
+def test_token_allocator_balances():
+    from cassandra_tpu.cluster.ring import (Endpoint, Ring,
+                                            allocate_tokens, even_tokens)
+    ring = Ring()
+    toks = even_tokens(2, vnodes=4)
+    ring.add_node(Endpoint("n1"), toks[0])
+    ring.add_node(Endpoint("n2"), toks[1])
+    new = allocate_tokens(ring, 4)
+    assert len(set(new)) == 4
+    all_t = sorted([t for ts in toks for t in ts] + new)
+    gaps = [(b - a) for a, b in zip(all_t, all_t[1:])]
+    # bisection keeps the spread tight: max gap <= 2.5x min positive gap
+    assert max(gaps) <= 2.5 * max(min(gaps), 1)
+
+
+def test_column_named_json_still_selects(session):
+    session.execute("CREATE TABLE j2 (k int PRIMARY KEY, json text)")
+    session.execute("INSERT INTO j2 (k, json) VALUES (1, 'doc')")
+    assert session.execute("SELECT json FROM j2").rows == [("doc",)]
+    assert session.execute("SELECT json, k FROM j2").rows == [("doc", 1)]
