@@ -34,6 +34,7 @@ class Verb:
     GOSSIP_SYN = "GOSSIP_SYN"
     GOSSIP_ACK = "GOSSIP_ACK"
     SCHEMA_PUSH = "SCHEMA_PUSH"
+    SCHEMA_PULL = "SCHEMA_PULL"
     STREAM_REQ = "STREAM_REQ"
     STREAM_DATA = "STREAM_DATA"
     REPAIR_VALIDATION_REQ = "REPAIR_VALIDATION_REQ"

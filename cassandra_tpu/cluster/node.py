@@ -168,6 +168,7 @@ class Node:
         self.schema.drop_table(keyspace, name)
 
     cluster_nodes: list = ()
+    schema_sync = None   # TCM-lite DDL replication (cluster/schema_sync)
 
     def session(self) -> Session:
         return Session(self)

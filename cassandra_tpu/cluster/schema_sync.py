@@ -1,0 +1,253 @@
+"""Distributed schema agreement — the TCM-lite epoch log.
+
+Reference counterpart: tcm/ClusterMetadata.java:81 + the log-based
+transformation model (every metadata change is an ordered log entry;
+replicas converge by applying the same entries in the same order).
+Scaled to this framework: the replicated unit is the DDL STATEMENT
+TEXT, ordered by a per-cluster epoch counter.
+
+  - Coordinating node: epoch = local+1, apply locally, append to the
+    durable log, broadcast SCHEMA_PUSH(epoch, ddl) to every peer.
+  - Receiving node: expected epoch -> apply + append; future epoch ->
+    SCHEMA_PULL the gap from the sender; stale -> ignore.
+  - A (re)starting node replays its persisted log, then pulls anything
+    newer from the first live peer.
+
+Concurrent DDL on two coordinators can race an epoch; the push of the
+loser is rejected (its entry conflicts) and the coordinator retries
+after pulling — last-writer-wins at statement granularity, which is the
+pre-TCM reference's effective behaviour too (full TCM serializes through
+Paxos leadership; that upgrade slot is documented in ARCHITECTURE.md).
+
+Enabled for per-process schemas (TCP deployments); LocalCluster shares
+one Schema object in-process and needs no sync.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from .messaging import Verb
+
+
+DDL_STATEMENTS = {
+    "CreateKeyspaceStatement", "CreateTableStatement",
+    "CreateIndexStatement", "CreateTypeStatement", "CreateViewStatement",
+    "CreateFunctionStatement", "CreateAggregateStatement",
+    "DropStatement", "AlterTableStatement",
+    # NOT TruncateStatement: truncation is a DATA operation with its own
+    # cluster fan-out (TRUNCATE_REQ); replaying it from the schema log on
+    # a late-joining node would wipe rows written after the original
+}
+
+
+class SchemaSync:
+    def __init__(self, node, directory: str):
+        self.node = node
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, "schema_log.jsonl")
+        self.epoch = 0
+        self._lock = threading.RLock()
+        self._load()
+        ms = node.messaging
+        ms.register_handler(Verb.SCHEMA_PUSH, self._handle_push)
+        ms.register_handler(Verb.SCHEMA_PULL, self._handle_pull)
+
+    # ------------------------------------------------------------- log --
+
+    def _load(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        with open(self.path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    break               # torn tail
+                self.epoch = max(self.epoch, int(rec["epoch"]))
+
+    def _append(self, epoch: int, query: str, keyspace, extra,
+                coord: str | None = None) -> None:
+        with open(self.path, "a") as f:
+            f.write(json.dumps({"epoch": epoch, "query": query,
+                                "keyspace": keyspace, "extra": extra,
+                                "coord": coord
+                                or self.node.endpoint.name}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def entries_after(self, epoch: int) -> list[tuple[int, str]]:
+        out = []
+        if not os.path.exists(self.path):
+            return out
+        with open(self.path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    break
+                if int(rec["epoch"]) > epoch:
+                    out.append((int(rec["epoch"]), rec["query"],
+                                rec.get("keyspace"),
+                                rec.get("extra") or {}))
+        return sorted(out)
+
+    # ------------------------------------------------------- application --
+
+    def _apply_local(self, query: str, keyspace, extra: dict) -> None:
+        """Execute the DDL against the local node WITHOUT re-entering
+        the coordination path. Object ids the coordinator assigned ride
+        in `extra` so every node agrees (mutations route by table id)."""
+        from ..cql.parser import parse
+        from ..cql.execution import Executor
+        stmt = parse(query)
+        tid = extra.get("table_id")
+        if tid is not None:
+            name = type(stmt).__name__
+            if name == "CreateTableStatement":
+                stmt.options = dict(stmt.options or {})
+                stmt.options["id"] = tid
+            elif name == "CreateViewStatement":
+                stmt.view_id = tid
+        # NODE-LOCAL application: replayed entries must never re-enter
+        # any distributed fan-out path
+        Executor(self.node.engine).execute(stmt, keyspace=keyspace)
+
+    def _extra_for(self, stmt, keyspace) -> dict:
+        """After the coordinator applied the DDL: the ids peers must
+        reuse."""
+        if stmt is None:
+            return {}
+        name = type(stmt).__name__
+        try:
+            if name == "CreateTableStatement":
+                ks = stmt.keyspace or keyspace
+                return {"table_id":
+                        str(self.node.schema.get_table(ks, stmt.name).id)}
+            if name == "CreateViewStatement":
+                ks = stmt.keyspace or keyspace
+                return {"table_id":
+                        str(self.node.schema.get_table(ks, stmt.name).id)}
+        except KeyError:
+            pass
+        return {}
+
+    def coordinate(self, query: str, keyspace, stmt, local_exec):
+        """Coordinator path: catch up with peers FIRST (narrows the
+        concurrent-coordinator window), then apply locally (via
+        local_exec, so the CQL session's own execution/result flow is
+        preserved), log and broadcast. A same-epoch collision that still
+        slips through resolves deterministically at the receivers
+        (higher coordinator name wins the epoch; the loser's entry is
+        re-coordinated at a fresh epoch by its origin node — see
+        _handle_push)."""
+        self.pull_from_peers(timeout=1.0)
+        with self._lock:
+            result = local_exec()
+            extra = self._extra_for(stmt, keyspace)
+            self.epoch += 1
+            self._append(self.epoch, query, keyspace, extra)
+            epoch = self.epoch
+        for ep in list(self.node.ring.endpoints):
+            if ep != self.node.endpoint:
+                self.node.messaging.send_one_way(
+                    Verb.SCHEMA_PUSH, (epoch, query, keyspace, extra), ep)
+        return result
+
+    # ---------------------------------------------------------- handlers --
+
+    def _handle_push(self, msg):
+        epoch, query, keyspace, extra = msg.payload
+        with self._lock:
+            if epoch <= self.epoch:
+                # possible same-epoch collision from a concurrent
+                # coordinator: resolve deterministically — the higher
+                # coordinator name's entry owns the epoch; our displaced
+                # local DDL is re-coordinated at a fresh epoch
+                mine = self._entry_at(epoch)
+                if mine is not None and mine[1] != query \
+                        and msg.sender.name > (mine[4] or ""):
+                    self._apply_local(query, keyspace, extra or {})
+                    self._append(epoch, query, keyspace, extra or {},
+                                 coord=msg.sender.name)
+                    requeue = mine
+                else:
+                    requeue = None
+            elif epoch == self.epoch + 1:
+                self._apply_entry(epoch, query, keyspace, extra or {})
+                return None
+            else:
+                requeue = "pull"
+        if requeue == "pull":
+            # gap: pull the missing prefix from the sender
+            self.node.messaging.send_with_callback(
+                Verb.SCHEMA_PULL, self.epoch, msg.sender,
+                on_response=self._on_pull_response,
+                timeout=self.node.proxy.timeout)
+        elif requeue is not None:
+            _e, q, k, x, _c = requeue
+            self.coordinate(q, k, None, lambda: None)
+        return None
+
+    def _entry_at(self, epoch: int):
+        if not os.path.exists(self.path):
+            return None
+        last = None
+        with open(self.path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    break
+                if int(rec["epoch"]) == epoch:
+                    last = (epoch, rec["query"], rec.get("keyspace"),
+                            rec.get("extra") or {}, rec.get("coord"))
+        return last
+        # gap: pull the missing prefix from the sender
+        self.node.messaging.send_with_callback(
+            Verb.SCHEMA_PULL, self.epoch, msg.sender,
+            on_response=self._on_pull_response,
+            timeout=self.node.proxy.timeout)
+        return None
+
+    def _handle_pull(self, msg):
+        after = int(msg.payload)
+        return Verb.SCHEMA_PUSH, ("entries", self.entries_after(after))
+
+    def _on_pull_response(self, msg):
+        tag, entries = msg.payload
+        with self._lock:
+            for epoch, query, keyspace, extra in entries:
+                if epoch == self.epoch + 1:
+                    self._apply_entry(epoch, query, keyspace,
+                                      extra or {})
+
+    def _apply_entry(self, epoch: int, query: str, keyspace,
+                     extra: dict) -> None:
+        try:
+            self._apply_local(query, keyspace, extra)
+        except Exception:
+            # an entry that fails locally (e.g. already-applied effect)
+            # still advances the epoch — convergence over strictness,
+            # matching pre-TCM schema-merge behaviour
+            pass
+        self.epoch = epoch
+        self._append(epoch, query, keyspace, extra)
+
+    def pull_from_peers(self, timeout: float = 5.0) -> None:
+        """Startup catch-up: ask the first live peer for newer entries."""
+        for ep in list(self.node.ring.endpoints):
+            if ep == self.node.endpoint or not self.node.is_alive(ep):
+                continue
+            done = threading.Event()
+
+            def on_rsp(msg):
+                self._on_pull_response(msg)
+                done.set()
+
+            self.node.messaging.send_with_callback(
+                Verb.SCHEMA_PULL, self.epoch, ep,
+                on_response=on_rsp, timeout=timeout)
+            if done.wait(timeout):
+                return

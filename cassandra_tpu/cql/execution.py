@@ -51,6 +51,42 @@ class ResultSet:
 APPLIED = ResultSet(["[applied]"], [(True,)])
 
 
+def _from_json(v, cql_type):
+    """JSON value -> the Python value the column type serializes
+    (cql3 Json.java fromJson subset): hex strings for blobs, string
+    uuids, set/tuple shapes, recursive collections."""
+    import uuid as _uuid
+
+    from ..types.marshal import (BlobType, ListType, MapType, SetType,
+                                 TimeUUIDType, TupleType, UUIDType,
+                                 VectorType)
+    if v is None:
+        return None
+    t = cql_type
+    if isinstance(t, BlobType) and isinstance(v, str):
+        return bytes.fromhex(v[2:] if v.startswith("0x") else v)
+    if isinstance(t, (UUIDType, TimeUUIDType)) and isinstance(v, str):
+        return _uuid.UUID(v)
+    if isinstance(t, SetType) and isinstance(v, list):
+        return {_from_json(x, t.elem) for x in v}
+    if isinstance(t, TupleType) and isinstance(v, list):
+        return tuple(_from_json(x, e) for x, e in zip(v, t.elems))
+    if isinstance(t, (ListType, VectorType)) and isinstance(v, list):
+        elem = getattr(t, "elem", None)
+        return [_from_json(x, elem) for x in v] if elem is not None else v
+    if isinstance(t, MapType) and isinstance(v, dict):
+        def key_conv(k):
+            kt = type(t.key).__name__
+            if kt in ("Int32Type", "LongType", "SmallIntType",
+                      "TinyIntType", "IntegerType"):
+                return int(k)
+            if kt in ("FloatType", "DoubleType"):
+                return float(k)
+            return _from_json(k, t.key)
+        return {key_conv(k): _from_json(x, t.val) for k, x in v.items()}
+    return v
+
+
 def _jsonify_resultset(rs: ResultSet) -> ResultSet:
     """SELECT JSON: one '[json]' column whose values are JSON documents
     of the selected row (cql3 Json.java semantics, subset)."""
@@ -490,11 +526,15 @@ class Executor:
                 selected.append(c)
         regulars = [(c, base.columns[c].cql_type) for c in selected
                     if c not in view_pk]
+        view_id = None
+        if getattr(s, "view_id", None):
+            import uuid as _uuid
+            view_id = _uuid.UUID(str(s.view_id))
         vt = schema_mod.TableMetadata(
             ks, s.name,
             [(c, base.columns[c].cql_type) for c in s.partition_key],
             [(c, base.columns[c].cql_type, False) for c in s.clustering],
-            regulars)
+            regulars, table_id=view_id)
         if bks != ks:
             raise InvalidRequest(
                 "a materialized view must be in the same keyspace as its "
@@ -848,8 +888,15 @@ class Executor:
             if isinstance(doc, ast.BindMarker):
                 # resolve the marker OURSELVES: the generic no-type wire
                 # heuristic would decode small byte payloads as integers
-                doc = params[doc.name] if isinstance(params, dict) \
-                    else params[doc.index]
+                if isinstance(params, dict):
+                    if doc.name not in params:
+                        raise InvalidRequest(
+                            f"missing named parameter {doc.name}")
+                    doc = params[doc.name]
+                else:
+                    if doc.index >= len(params):
+                        raise InvalidRequest("not enough bind parameters")
+                    doc = params[doc.index]
             else:
                 doc = bind_term(doc, None, params)
             if isinstance(doc, (WireValue, bytes, bytearray)):
@@ -862,19 +909,20 @@ class Executor:
                 raise InvalidRequest("INSERT JSON expects an object")
             s = copy.copy(s)
             s.columns, s.values = [], []
-            from ..types.marshal import SetType, TupleType
             for k, v in data.items():
                 col = t.columns.get(k)
                 if col is None:
                     raise InvalidRequest(f"unknown column {k}")
-                if isinstance(col.cql_type, SetType) \
-                        and isinstance(v, list):
-                    v = set(v)        # JSON has no set literal
-                elif isinstance(col.cql_type, TupleType) \
-                        and isinstance(v, list):
-                    v = tuple(v)
                 s.columns.append(k)
-                s.values.append(ast.Literal(v, "json"))
+                s.values.append(ast.Literal(
+                    _from_json(v, col.cql_type), "json"))
+            # DEFAULT NULL semantics (reference Json.java): columns the
+            # document omits are written null, replacing the whole row
+            named = set(data)
+            for col in t.regular_columns + t.static_columns:
+                if col.name not in named:
+                    s.columns.append(col.name)
+                    s.values.append(ast.Literal(None, "null"))
         now = now or timeutil.now_micros()
         ts = now if s.timestamp is None \
             else int(bind_term(s.timestamp, None, params))

@@ -66,6 +66,16 @@ class QueryProcessor:
         if audit is not None:
             audit.log(type(stmt).__name__, query, user, keyspace,
                       params=params)
+        sync = getattr(self.executor.backend, "schema_sync", None)
+        if sync is not None:
+            from ..cluster.schema_sync import DDL_STATEMENTS
+            if type(stmt).__name__ in DDL_STATEMENTS:
+                # DDL replicates through the epoch log (TCM-lite)
+                with GLOBAL.timer("cql.request"):
+                    return sync.coordinate(
+                        query, keyspace, stmt,
+                        lambda: self.executor.execute(
+                            stmt, params, keyspace, user=user))
         with GLOBAL.timer("cql.request"):
             return self.executor.execute(stmt, params, keyspace, user=user,
                                          page_size=page_size,

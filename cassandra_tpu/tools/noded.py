@@ -58,10 +58,34 @@ def build_node(cfg: dict):
                 seeds=seeds,
                 gossip_interval=float(cfg.get("gossip_interval", 0.2)))
     node.cluster_nodes = [node]   # DDL opens stores on this engine only
+    # TCM-lite: per-process schemas replicate DDL through the epoch log
+    from ..cluster.schema_sync import SchemaSync
+    node.schema_sync = SchemaSync(node, cfg["data_dir"])
     session = node.session()
     for stmt in cfg.get("ddl", []):
-        session.execute(stmt)
+        # config DDL is per-node bootstrap state, not coordinated
+        sync, node.schema_sync = node.schema_sync, None
+        try:
+            session.execute(stmt)
+        finally:
+            node.schema_sync = sync
     node.gossiper.start()
+
+    def _catch_up():
+        # wait for gossip to mark a peer alive, then pull newer schema —
+        # pulling immediately would no-op (no peer looks alive yet)
+        import time as _t
+        deadline = _t.monotonic() + 15.0
+        while _t.monotonic() < deadline:
+            if any(node.is_alive(ep) for ep in node.ring.endpoints
+                   if ep != node.endpoint):
+                node.schema_sync.pull_from_peers(timeout=3.0)
+                return
+            _t.sleep(0.2)
+
+    import threading as _threading
+    _threading.Thread(target=_catch_up, daemon=True,
+                      name="schema-catchup").start()
     return node, transport
 
 

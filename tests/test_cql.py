@@ -452,3 +452,11 @@ def test_column_named_json_still_selects(session):
     session.execute("INSERT INTO j2 (k, json) VALUES (1, 'doc')")
     assert session.execute("SELECT json FROM j2").rows == [("doc",)]
     assert session.execute("SELECT json, k FROM j2").rows == [("doc", 1)]
+
+
+def test_insert_json_default_null_and_blob(session):
+    session.execute("CREATE TABLE j3 (k int PRIMARY KEY, v text, b blob)")
+    session.execute("INSERT INTO j3 (k, v, b) VALUES (1, 'old', 0xaa)")
+    session.execute('INSERT INTO j3 JSON \'{"k": 1, "b": "0xff"}\'')
+    rs = session.execute("SELECT v, b FROM j3 WHERE k = 1")
+    assert rs.rows == [(None, b"\xff")], rs.rows   # omitted v -> null

@@ -186,3 +186,86 @@ def test_delete_range_duplicate_bound_rejected(tmp_path):
             s.execute("DELETE FROM t WHERE k = 1 AND c > 5 AND c > 2")
     finally:
         eng.close()
+
+
+@pytest.mark.slow
+def test_ddl_replicates_across_processes(tmp_path):
+    """TCM-lite: DDL issued on one node AFTER startup reaches the other
+    OS processes through the epoch log, with agreed table ids — writes
+    routed by id work cluster-wide (tcm/ClusterMetadata role)."""
+    import time
+
+    from cassandra_tpu.cluster.node import Node
+    from cassandra_tpu.cluster.replication import ConsistencyLevel
+    from cassandra_tpu.cluster.ring import Ring
+    from cassandra_tpu.cluster.schema_sync import SchemaSync
+    from cassandra_tpu.cluster.tcp import TcpTransport
+    from cassandra_tpu.schema import Schema
+
+    ports = _free_ports(3)
+    tokens = even_tokens(3, vnodes=4)
+    names = ["node1", "node2", "node3"]
+    eps = [Endpoint(n, host="127.0.0.1", port=p)
+           for n, p in zip(names, ports)]
+
+    def peer_cfg(i):
+        return {"name": names[i], "host": "127.0.0.1", "port": ports[i],
+                "tokens": tokens[i]}
+
+    procs = []
+    try:
+        for i in (1, 2):
+            cfg = {**peer_cfg(i),
+                   "data_dir": str(tmp_path / names[i]),
+                   "peers": [peer_cfg(j) for j in range(3) if j != i],
+                   "seeds": ["node1"], "gossip_interval": 0.1,
+                   "jax_platform": "cpu", "ddl": []}
+            cfile = tmp_path / f"{names[i]}.json"
+            cfile.write_text(json.dumps(cfg))
+            p = subprocess.Popen(
+                [sys.executable, "-m", "cassandra_tpu.tools.noded",
+                 str(cfile)],
+                cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True)
+            procs.append(p)
+        for p in procs:
+            line = p.stdout.readline()
+            assert line.startswith("READY"), (line, p.stderr.read())
+
+        ring = Ring()
+        for ep, toks in zip(eps, tokens):
+            ring.add_node(ep, toks)
+        node = Node(eps[0], str(tmp_path / "node1"), Schema(), ring,
+                    TcpTransport(), seeds=[eps[0]], gossip_interval=0.1)
+        node.cluster_nodes = [node]
+        node.schema_sync = SchemaSync(node, str(tmp_path / "node1"))
+        node.gossiper.start()
+        s = node.session()
+
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if all(node.gossiper.is_alive(e) for e in eps[1:]):
+                break
+            time.sleep(0.2)
+
+        # DDL issued NOW — no pre-agreed config schema, no WITH id
+        s.execute("CREATE KEYSPACE ks WITH replication = "
+                  "{'class': 'SimpleStrategy', 'replication_factor': 3}")
+        s.execute("USE ks")
+        s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+        time.sleep(1.0)   # pushes drain
+
+        node.default_cl = ConsistencyLevel.ALL   # proves ALL nodes
+        for i in range(6):                       # learned the table
+            s.execute(f"INSERT INTO kv (k, v) VALUES ({i}, 'd{i}')")
+        node.default_cl = ConsistencyLevel.QUORUM
+        got = {r[0] for r in s.execute("SELECT k FROM kv").rows}
+        assert got == set(range(6))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
