@@ -35,6 +35,7 @@ class Verb:
     GOSSIP_ACK = "GOSSIP_ACK"
     SCHEMA_PUSH = "SCHEMA_PUSH"
     SCHEMA_PULL = "SCHEMA_PULL"
+    SCHEMA_FORWARD = "SCHEMA_FORWARD"
     STREAM_REQ = "STREAM_REQ"
     STREAM_DATA = "STREAM_DATA"
     REPAIR_VALIDATION_REQ = "REPAIR_VALIDATION_REQ"

@@ -142,65 +142,45 @@ class RepairService:
         Returns the number of sstables rewritten."""
         import numpy as np
 
-        from ..storage.lifecycle import LifecycleTransaction
-        from ..storage.sstable import Descriptor, SSTableReader, \
-            SSTableWriter
+        from ..storage.rewrite import rewrite_sstable
 
         cfs = self.node.engine.store(keyspace, table_name)
         MIN = -(1 << 63)
         done = 0
-        for sst in list(cfs.live_sstables()):
-            if sst.is_repaired:
-                continue
-            if gens is not None and sst.desc.generation not in gens:
-                continue   # flushed after validation: never validated
-            segs = list(sst.scanner())
-            if not segs:
-                continue
-            cat = cb.CellBatch.concat(segs)
-            cat.sorted = True
-            toks = batch_tokens(cat)
-            in_mask = np.zeros(len(cat), dtype=bool)
-            for lo, hi in ranges:
-                if lo == MIN:
-                    in_mask |= toks <= hi
-                else:
-                    in_mask |= (toks > lo) & (toks <= hi)
-            txn = LifecycleTransaction(cfs.directory)
-            new_readers = []
-            writers = []
-            try:
-                for mask, rep in ((in_mask, repaired_at), (~in_mask, 0)):
-                    idx = np.flatnonzero(mask)
-                    if len(idx) == 0:
-                        continue
-                    gen = cfs.next_generation()
-                    desc = Descriptor(cfs.directory, gen)
-                    txn.track_new(gen)
-                    w = SSTableWriter(desc, cfs.table,
-                                      estimated_partitions=sst.n_partitions)
-                    writers.append(w)
-                    w.repaired_at = rep
-                    part = cat.apply_permutation(idx)
-                    part.sorted = True
-                    w.append(part)
-                    w.finish()
-                    new_readers.append(SSTableReader(desc, cfs.table))
-                txn.track_obsolete(sst.desc.generation)
-                txn.commit()
-                cfs.tracker.replace([sst], new_readers)
-                sst.release()
+        with self.node.engine.compactions.cfs_lock(cfs):
+            for sst in list(cfs.live_sstables()):
+                if sst.is_repaired:
+                    continue
+                if gens is not None \
+                        and sst.desc.generation not in gens:
+                    continue  # flushed after validation: not validated
+                segs = list(sst.scanner())
+                if not segs:
+                    continue
+                cat = cb.CellBatch.concat(segs)
+                cat.sorted = True
+                toks = batch_tokens(cat)
+                in_mask = np.zeros(len(cat), dtype=bool)
+                for lo, hi in ranges:
+                    if lo == MIN:
+                        in_mask |= toks <= hi
+                    else:
+                        in_mask |= (toks > lo) & (toks <= hi)
+
+                def fill_for(mask, cat=cat):
+                    def fill(w):
+                        idx = np.flatnonzero(mask)
+                        if len(idx):
+                            part = cat.apply_permutation(idx)
+                            part.sorted = True
+                            w.append(part)
+                    return fill
+
+                rewrite_sstable(
+                    cfs, sst,
+                    [(repaired_at, sst.level, fill_for(in_mask)),
+                     (0, sst.level, fill_for(~in_mask))])
                 done += 1
-            except BaseException:
-                for w in writers:
-                    try:
-                        w.abort()
-                    except Exception:
-                        pass
-                for r in new_readers:
-                    r.close()
-                txn.abort()
-                raise
         return done
 
     # --------------------------------------------------------- coordinator

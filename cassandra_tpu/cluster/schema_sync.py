@@ -6,18 +6,30 @@ replicas converge by applying the same entries in the same order).
 Scaled to this framework: the replicated unit is the DDL STATEMENT
 TEXT, ordered by a per-cluster epoch counter.
 
-  - Coordinating node: epoch = local+1, apply locally, append to the
+Serialization model (the TCM analogue of "all transformations commit
+through the CMS leader"): every DDL is COORDINATED BY ONE DESIGNATED
+NODE — the lowest-named live endpoint. A node receiving DDL while not
+designated forwards it (SCHEMA_FORWARD) and applies the resulting entry
+from the ack, so the statement is visible locally when execute()
+returns. With a single coordinator there are no same-epoch collisions
+in steady state; the only race window is a designation handover (the
+old designated node dies mid-broadcast), which the deterministic
+winner rule below repairs.
+
+  - Designated node: epoch = local+1, apply locally, append to the
     durable log, broadcast SCHEMA_PUSH(epoch, ddl) to every peer.
   - Receiving node: expected epoch -> apply + append; future epoch ->
-    SCHEMA_PULL the gap from the sender; stale -> ignore.
+    SCHEMA_PULL the gap from the sender (async — the response callback
+    runs on this same dispatch thread later; nothing here may block on
+    a response); stale -> ignore unless it is a same-epoch conflict.
+  - Same-epoch conflict (handover window only): the entry whose
+    coordinator has the HIGHER name owns the epoch everywhere; a node
+    holding the losing entry applies + re-logs the winner, then
+    re-coordinates its displaced statement at a fresh epoch from a
+    separate thread (never from the dispatch thread), carrying the
+    original object ids so every node converges on them.
   - A (re)starting node replays its persisted log, then pulls anything
     newer from the first live peer.
-
-Concurrent DDL on two coordinators can race an epoch; the push of the
-loser is rejected (its entry conflicts) and the coordinator retries
-after pulling — last-writer-wins at statement granularity, which is the
-pre-TCM reference's effective behaviour too (full TCM serializes through
-Paxos leadership; that upgrade slot is documented in ARCHITECTURE.md).
 
 Enabled for per-process schemas (TCP deployments); LocalCluster shares
 one Schema object in-process and needs no sync.
@@ -26,6 +38,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 
 from .messaging import Verb
@@ -42,7 +55,18 @@ DDL_STATEMENTS = {
 }
 
 
+class SchemaForwardError(ValueError):
+    """The designated coordinator rejected the DDL (e.g. parse or
+    execution error there) — surfaced to the issuing session."""
+
+
 class SchemaSync:
+    FORWARD_TIMEOUT = 5.0
+    # pulls re-fetch a window of already-seen epochs so a conflict
+    # winner whose one-way push was lost still reconciles on the next
+    # pull (startup catch-up or any gap pull) via the winner rule
+    PULL_OVERLAP = 8
+
     def __init__(self, node, directory: str):
         self.node = node
         os.makedirs(directory, exist_ok=True)
@@ -53,10 +77,15 @@ class SchemaSync:
         ms = node.messaging
         ms.register_handler(Verb.SCHEMA_PUSH, self._handle_push)
         ms.register_handler(Verb.SCHEMA_PULL, self._handle_pull)
+        ms.register_handler(Verb.SCHEMA_FORWARD, self._handle_forward)
 
     # ------------------------------------------------------------- log --
 
     def _load(self) -> None:
+        # the file is durability; _entries (epoch -> LAST record at that
+        # epoch, i.e. the conflict winner) is the read path — handlers
+        # consult it under _lock, so lookups must not re-read the file
+        self._entries: dict[int, tuple] = {}
         if not os.path.exists(self.path):
             return
         with open(self.path) as f:
@@ -65,33 +94,35 @@ class SchemaSync:
                     rec = json.loads(line)
                 except ValueError:
                     break               # torn tail
-                self.epoch = max(self.epoch, int(rec["epoch"]))
+                e = int(rec["epoch"])
+                self._entries[e] = (e, rec["query"], rec.get("keyspace"),
+                                    rec.get("extra") or {},
+                                    rec.get("coord"))
+                self.epoch = max(self.epoch, e)
 
     def _append(self, epoch: int, query: str, keyspace, extra,
                 coord: str | None = None) -> None:
+        coord = coord or self.node.endpoint.name
         with open(self.path, "a") as f:
             f.write(json.dumps({"epoch": epoch, "query": query,
                                 "keyspace": keyspace, "extra": extra,
-                                "coord": coord
-                                or self.node.endpoint.name}) + "\n")
+                                "coord": coord}) + "\n")
             f.flush()
             os.fsync(f.fileno())
+        self._entries[epoch] = (epoch, query, keyspace, extra or {},
+                                coord)
 
-    def entries_after(self, epoch: int) -> list[tuple[int, str]]:
-        out = []
-        if not os.path.exists(self.path):
-            return out
-        with open(self.path) as f:
-            for line in f:
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    break
-                if int(rec["epoch"]) > epoch:
-                    out.append((int(rec["epoch"]), rec["query"],
-                                rec.get("keyspace"),
-                                rec.get("extra") or {}))
-        return sorted(out)
+    def entries_after(self, epoch: int) -> list[tuple]:
+        """Entries newer than `epoch`, ONE record per epoch: an epoch
+        rewritten by conflict resolution keeps only its LAST (winning)
+        record, so pullers apply exactly what push-path nodes applied."""
+        with self._lock:
+            return [self._entries[e] for e in sorted(self._entries)
+                    if e > epoch]
+
+    def _entry_at(self, epoch: int):
+        """Last (i.e. winning) record logged at `epoch`, or None."""
+        return self._entries.get(epoch)
 
     # ------------------------------------------------------- application --
 
@@ -121,11 +152,7 @@ class SchemaSync:
             return {}
         name = type(stmt).__name__
         try:
-            if name == "CreateTableStatement":
-                ks = stmt.keyspace or keyspace
-                return {"table_id":
-                        str(self.node.schema.get_table(ks, stmt.name).id)}
-            if name == "CreateViewStatement":
+            if name in ("CreateTableStatement", "CreateViewStatement"):
                 ks = stmt.keyspace or keyspace
                 return {"table_id":
                         str(self.node.schema.get_table(ks, stmt.name).id)}
@@ -133,19 +160,73 @@ class SchemaSync:
             pass
         return {}
 
-    def coordinate(self, query: str, keyspace, stmt, local_exec):
-        """Coordinator path: catch up with peers FIRST (narrows the
-        concurrent-coordinator window), then apply locally (via
-        local_exec, so the CQL session's own execution/result flow is
-        preserved), log and broadcast. A same-epoch collision that still
-        slips through resolves deterministically at the receivers
-        (higher coordinator name wins the epoch; the loser's entry is
-        re-coordinated at a fresh epoch by its origin node — see
-        _handle_push)."""
-        self.pull_from_peers(timeout=1.0)
+    # ----------------------------------------------------- coordination --
+
+    def _designated(self):
+        """The one node that serializes DDL: lowest-named live endpoint
+        (the CMS-leader role; re-evaluated per statement so designation
+        fails over with liveness)."""
+        live = [ep for ep in self.node.ring.endpoints
+                if ep == self.node.endpoint or self.node.is_alive(ep)]
+        return min(live, key=lambda e: e.name) if live \
+            else self.node.endpoint
+
+    def coordinate(self, query: str, keyspace, stmt, local_exec,
+                   extra_override: dict | None = None):
+        """Entry point from the CQL processor. Runs on a client/session
+        thread (never the messaging dispatch thread), so it MAY block
+        on responses. If this node is not designated, forward and apply
+        the acked entry; fall back to coordinating locally only when
+        the designated node is unreachable."""
+        des = self._designated()
+        if des != self.node.endpoint:
+            pre_epoch = self.epoch
+            ack = self._forward(des, query, keyspace, extra_override)
+            if ack is None:
+                # AMBIGUOUS: the designated node may have committed the
+                # statement and only the ack was lost. Re-coordinating
+                # a committed CREATE would fork its table id across the
+                # cluster — pull first and, if our exact statement now
+                # appears in the log, it committed: done.
+                self.pull_from_peers(timeout=self.FORWARD_TIMEOUT,
+                                     prefer=des)
+                if any(rec[1] == query
+                       for rec in self.entries_after(pre_epoch)):
+                    from ..cql.execution import ResultSet
+                    return ResultSet([], [])
+            if ack is not None:
+                epoch, extra = ack
+                with self._lock:
+                    behind = epoch > self.epoch + 1
+                if behind:
+                    # missed entries: the designated node has them all
+                    # (it just appended `epoch`). Pull OUTSIDE the lock:
+                    # the response is processed on the dispatch thread,
+                    # and _on_pull_response needs this same lock — a
+                    # pull under the lock would deadlock-till-timeout
+                    # and stall every message on the node.
+                    self.pull_from_peers(timeout=self.FORWARD_TIMEOUT,
+                                         prefer=des)
+                with self._lock:
+                    if epoch == self.epoch + 1:
+                        self._apply_entry(epoch, query, keyspace,
+                                          extra or {}, coord=des.name)
+                    if self.epoch < epoch:
+                        # committed cluster-wide, but this node could
+                        # not catch up (peers unreachable mid-pull) —
+                        # surface that rather than return success for a
+                        # table this node does not have yet
+                        raise SchemaForwardError(
+                            f"DDL committed at epoch {epoch} but local "
+                            f"catch-up failed (local epoch "
+                            f"{self.epoch}); retry")
+                from ..cql.execution import ResultSet
+                return ResultSet([], [])   # DDL result shape
+            # designated unreachable: coordinate locally (handover)
+        result = local_exec()
         with self._lock:
-            result = local_exec()
-            extra = self._extra_for(stmt, keyspace)
+            extra = extra_override if extra_override is not None \
+                else self._extra_for(stmt, keyspace)
             self.epoch += 1
             self._append(self.epoch, query, keyspace, extra)
             epoch = self.epoch
@@ -155,61 +236,120 @@ class SchemaSync:
                     Verb.SCHEMA_PUSH, (epoch, query, keyspace, extra), ep)
         return result
 
+    def _forward(self, des, query: str, keyspace, extra_override):
+        """Send the DDL to the designated node; block for its ack.
+        Returns (epoch, extra) on success, None if unreachable; raises
+        SchemaForwardError if the designated node rejected the DDL."""
+        done = threading.Event()
+        box: dict = {}
+
+        def on_rsp(msg):
+            box["payload"] = msg.payload
+            done.set()
+
+        def on_fail(_msg_id):
+            done.set()
+
+        self.node.messaging.send_with_callback(
+            Verb.SCHEMA_FORWARD, (query, keyspace, extra_override or {}),
+            des, on_response=on_rsp, on_failure=on_fail,
+            timeout=self.FORWARD_TIMEOUT)
+        if not done.wait(self.FORWARD_TIMEOUT) or "payload" not in box:
+            return None
+        payload = box["payload"]
+        if payload[0] == "err":
+            raise SchemaForwardError(
+                f"DDL rejected by designated coordinator "
+                f"{des.name}: {payload[1]}")
+        return int(payload[1]), payload[2] or {}
+
     # ---------------------------------------------------------- handlers --
+
+    def _handle_forward(self, msg):
+        """Designated-coordinator side of a forwarded DDL. Runs on the
+        dispatch thread: applies + logs + broadcasts, all non-blocking,
+        then acks (epoch, extra) to the origin."""
+        query, keyspace, fwd_extra = msg.payload
+        from ..cql.parser import parse
+        with self._lock:
+            try:
+                extra = fwd_extra or {}
+                stmt = parse(query)
+                self._apply_local(query, keyspace, extra)
+                extra = extra or self._extra_for(stmt, keyspace)
+            except Exception as e:
+                return Verb.SCHEMA_FORWARD, ("err", repr(e), None)
+            self.epoch += 1
+            self._append(self.epoch, query, keyspace, extra)
+            epoch = self.epoch
+        for ep in list(self.node.ring.endpoints):
+            if ep != self.node.endpoint and ep != msg.sender:
+                self.node.messaging.send_one_way(
+                    Verb.SCHEMA_PUSH, (epoch, query, keyspace, extra), ep)
+        return Verb.SCHEMA_FORWARD, ("ok", epoch, extra)
 
     def _handle_push(self, msg):
         epoch, query, keyspace, extra = msg.payload
+        displaced = None
         with self._lock:
-            if epoch <= self.epoch:
-                # possible same-epoch collision from a concurrent
-                # coordinator: resolve deterministically — the higher
-                # coordinator name's entry owns the epoch; our displaced
-                # local DDL is re-coordinated at a fresh epoch
-                mine = self._entry_at(epoch)
-                if mine is not None and mine[1] != query \
-                        and msg.sender.name > (mine[4] or ""):
-                    self._apply_local(query, keyspace, extra or {})
-                    self._append(epoch, query, keyspace, extra or {},
-                                 coord=msg.sender.name)
-                    requeue = mine
-                else:
-                    requeue = None
-            elif epoch == self.epoch + 1:
-                self._apply_entry(epoch, query, keyspace, extra or {})
+            if epoch == self.epoch + 1:
+                self._apply_entry(epoch, query, keyspace, extra or {},
+                                  coord=msg.sender.name)
                 return None
-            else:
-                requeue = "pull"
-        if requeue == "pull":
-            # gap: pull the missing prefix from the sender
+            if epoch <= self.epoch:
+                displaced = self._adopt_winner_locked(
+                    epoch, query, keyspace, extra, msg.sender.name)
+        if epoch > self.epoch + 1:
+            # gap: pull the missing prefix from the sender. Async on
+            # purpose — this handler runs on the single dispatch thread,
+            # and the pull response can only be processed by that same
+            # thread, so blocking here would deadlock the node.
             self.node.messaging.send_with_callback(
-                Verb.SCHEMA_PULL, self.epoch, msg.sender,
+                Verb.SCHEMA_PULL,
+                max(0, self.epoch - self.PULL_OVERLAP), msg.sender,
                 on_response=self._on_pull_response,
                 timeout=self.node.proxy.timeout)
-        elif requeue is not None:
-            _e, q, k, x, _c = requeue
-            self.coordinate(q, k, None, lambda: None)
+        elif displaced is not None:
+            self._recoordinate_async(displaced)
         return None
 
-    def _entry_at(self, epoch: int):
-        if not os.path.exists(self.path):
+    def _adopt_winner_locked(self, epoch, query, keyspace, extra,
+                             coord: str):
+        """Same-epoch conflict (designation-handover window only): the
+        entry whose coordinator has the HIGHER name owns the epoch,
+        deterministically at every node. Adopts the winner and returns
+        our displaced entry (for re-coordination), or None if the
+        incoming entry is stale/identical/losing. Caller holds _lock."""
+        mine = self._entry_at(epoch)
+        if mine is None or mine[1] == query \
+                or (coord or "") <= (mine[4] or ""):
             return None
-        last = None
-        with open(self.path) as f:
-            for line in f:
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    break
-                if int(rec["epoch"]) == epoch:
-                    last = (epoch, rec["query"], rec.get("keyspace"),
-                            rec.get("extra") or {}, rec.get("coord"))
-        return last
-        # gap: pull the missing prefix from the sender
-        self.node.messaging.send_with_callback(
-            Verb.SCHEMA_PULL, self.epoch, msg.sender,
-            on_response=self._on_pull_response,
-            timeout=self.node.proxy.timeout)
-        return None
+        self._apply_entry(epoch, query, keyspace, extra or {},
+                          coord=coord)
+        return mine
+
+    def _recoordinate_async(self, displaced) -> None:
+        """A displaced statement re-coordinates at a fresh epoch,
+        keeping its assigned object ids. Runs on a separate thread:
+        coordinate() blocks on responses, and callers here are on the
+        dispatch thread."""
+        _e, q, k, x, _c = displaced
+
+        def run():
+            try:
+                self.coordinate(q, k, None, lambda: None,
+                                extra_override=x)
+            except Exception as e:
+                # the statement's local side effects exist but it lost
+                # its epoch and could not be re-committed — tell the
+                # operator to re-issue it instead of losing it silently
+                print(f"[schema-sync] {self.node.endpoint.name}: "
+                      f"re-coordination of displaced DDL failed "
+                      f"({q!r}): {e!r} — re-issue it manually",
+                      file=sys.stderr)
+
+        threading.Thread(target=run, daemon=True,
+                         name="schema-recoordinate").start()
 
     def _handle_pull(self, msg):
         after = int(msg.payload)
@@ -217,14 +357,30 @@ class SchemaSync:
 
     def _on_pull_response(self, msg):
         tag, entries = msg.payload
+        displaced_all = []
         with self._lock:
-            for epoch, query, keyspace, extra in entries:
+            for epoch, query, keyspace, extra, coord in entries:
                 if epoch == self.epoch + 1:
                     self._apply_entry(epoch, query, keyspace,
-                                      extra or {})
+                                      extra or {}, coord=coord)
+                elif epoch <= self.epoch:
+                    # overlap window: adopt a conflict winner this node
+                    # missed (same deterministic rule as _handle_push) —
+                    # and our displaced entry re-commits at a fresh
+                    # epoch, exactly as if the push had arrived
+                    d = self._adopt_winner_locked(epoch, query, keyspace,
+                                                  extra, coord)
+                    if d is not None:
+                        displaced_all.append(d)
+        for d in displaced_all:
+            self._recoordinate_async(d)
 
     def _apply_entry(self, epoch: int, query: str, keyspace,
-                     extra: dict) -> None:
+                     extra: dict, coord: str | None = None) -> None:
+        """Apply + log a received entry. The coordinator NAME is
+        recorded as received (never this node's own), because the
+        same-epoch conflict rule compares against it — every node must
+        store the same name or different nodes pick different winners."""
         try:
             self._apply_local(query, keyspace, extra)
         except Exception:
@@ -232,14 +388,19 @@ class SchemaSync:
             # still advances the epoch — convergence over strictness,
             # matching pre-TCM schema-merge behaviour
             pass
-        self.epoch = epoch
-        self._append(epoch, query, keyspace, extra)
+        self.epoch = max(self.epoch, epoch)
+        self._append(epoch, query, keyspace, extra, coord=coord)
 
-    def pull_from_peers(self, timeout: float = 5.0) -> None:
-        """Startup catch-up: ask the first live peer for newer entries."""
-        for ep in list(self.node.ring.endpoints):
-            if ep == self.node.endpoint or not self.node.is_alive(ep):
-                continue
+    def pull_from_peers(self, timeout: float = 5.0, prefer=None) -> None:
+        """Catch-up: ask a live peer (preferring `prefer`) for newer
+        entries. Blocks on the response — callers must be off the
+        dispatch thread (startup threads, session threads)."""
+        peers = [ep for ep in self.node.ring.endpoints
+                 if ep != self.node.endpoint and self.node.is_alive(ep)]
+        if prefer is not None and prefer in peers:
+            peers.remove(prefer)
+            peers.insert(0, prefer)
+        for ep in peers:
             done = threading.Event()
 
             def on_rsp(msg):
@@ -247,7 +408,8 @@ class SchemaSync:
                 done.set()
 
             self.node.messaging.send_with_callback(
-                Verb.SCHEMA_PULL, self.epoch, ep,
+                Verb.SCHEMA_PULL,
+                max(0, self.epoch - self.PULL_OVERLAP), ep,
                 on_response=on_rsp, timeout=timeout)
             if done.wait(timeout):
                 return

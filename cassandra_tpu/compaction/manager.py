@@ -46,6 +46,7 @@ class CompactionManager:
         self._queue: queue.Queue = queue.Queue()
         self._pending_cfs: set = set()
         self._lock = threading.Lock()
+        self._cfs_locks: dict = {}   # table_id -> rewrite mutex
         self._stop = threading.Event()
         self._worker: threading.Thread | None = None
         self.completed: list[dict] = []
@@ -60,6 +61,16 @@ class CompactionManager:
         """Hook the CFS flush notification (Tracker -> strategy manager
         notification path in the reference)."""
         cfs.compaction_listener = self.submit_background
+
+    def enable_auto(self) -> None:
+        """Start the background worker (daemon deployments; tests keep
+        auto off and drain with run_pending())."""
+        if self.auto:
+            return
+        self.auto = True
+        self._worker = threading.Thread(target=self._run_loop,
+                                        daemon=True)
+        self._worker.start()
 
     def submit_background(self, cfs) -> None:
         with self._lock:
@@ -87,25 +98,39 @@ class CompactionManager:
 
     MAX_TASKS_PER_SUBMISSION = 4  # bounds livelock if a strategy re-selects
 
+    def cfs_lock(self, cfs) -> threading.Lock:
+        """Per-store mutex serializing sstable-set rewrites: background
+        compaction vs cleanup/scrub/anticompaction. Without it, a
+        compaction selected before a maintenance rewrite could merge
+        the REPLACED original back into the live set, resurrecting the
+        cells the maintenance op dropped. Task SELECTION and execution
+        must both happen under it."""
+        with self._lock:
+            return self._cfs_locks.setdefault(cfs.table.id,
+                                              threading.Lock())
+
     def _maybe_compact(self, cfs) -> int:
-        strategy = get_strategy(cfs)
         n = 0
-        while n < self.MAX_TASKS_PER_SUBMISSION:
-            task = strategy.next_background_task()
-            if task is None:
-                break
-            self.limiter.acquire(sum(r.data_size for r in task.inputs))
-            stats = task.execute()
-            self.completed.append(stats)
-            n += 1
+        with self.cfs_lock(cfs):
+            strategy = get_strategy(cfs)
+            while n < self.MAX_TASKS_PER_SUBMISSION:
+                task = strategy.next_background_task()
+                if task is None:
+                    break
+                self.limiter.acquire(
+                    sum(r.data_size for r in task.inputs))
+                stats = task.execute()
+                self.completed.append(stats)
+                n += 1
         return n
 
     def major_compaction(self, cfs) -> dict | None:
         """nodetool compact equivalent."""
-        task = get_strategy(cfs).major_task()
-        if task is None:
-            return None
-        stats = task.execute()
+        with self.cfs_lock(cfs):
+            task = get_strategy(cfs).major_task()
+            if task is None:
+                return None
+            stats = task.execute()
         self.completed.append(stats)
         return stats
 

@@ -75,15 +75,13 @@ def _from_json(v, cql_type):
         elem = getattr(t, "elem", None)
         return [_from_json(x, elem) for x in v] if elem is not None else v
     if isinstance(t, MapType) and isinstance(v, dict):
-        def key_conv(k):
-            kt = type(t.key).__name__
-            if kt in ("Int32Type", "LongType", "SmallIntType",
-                      "TinyIntType", "IntegerType"):
-                return int(k)
-            if kt in ("FloatType", "DoubleType"):
-                return float(k)
-            return _from_json(k, t.key)
-        return {key_conv(k): _from_json(x, t.val) for k, x in v.items()}
+        # JSON object keys are always strings: convert by the map's
+        # KEY TYPE (a boolean map key "false" must not serialize as a
+        # truthy non-empty string). "" stays "" — JSON keys are never
+        # null, unlike CSV cells where empty means null.
+        from ..types.textval import parse_text_value
+        return {(parse_text_value(k, t.key) if k != "" else k):
+                _from_json(x, t.val) for k, x in v.items()}
     return v
 
 

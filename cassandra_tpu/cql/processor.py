@@ -50,9 +50,28 @@ class QueryProcessor:
         if audit is not None:
             audit.log(type(prep.statement).__name__, prep.query, user,
                       keyspace, params=params)
+        sync = self._ddl_sync_for(prep.statement)
+        if sync is not None:
+            # prepared DDL replicates exactly like direct DDL — a
+            # bypass here would apply locally only, with no epoch
+            from ..service.metrics import GLOBAL
+            with GLOBAL.timer("cql.request"):
+                return sync.coordinate(
+                    prep.query, keyspace, prep.statement,
+                    lambda: self.executor.execute(
+                        prep.statement, params, keyspace, user=user))
         return self.executor.execute(prep.statement, params, keyspace,
                                      user=user, page_size=page_size,
                                      paging_state=paging_state)
+
+    def _ddl_sync_for(self, stmt):
+        """The schema-sync service, iff `stmt` is DDL that must
+        replicate through the epoch log (TCM-lite); else None."""
+        sync = getattr(self.executor.backend, "schema_sync", None)
+        if sync is None:
+            return None
+        from ..cluster.schema_sync import DDL_STATEMENTS
+        return sync if type(stmt).__name__ in DDL_STATEMENTS else None
 
     def process(self, query: str, params=(),
                 keyspace: str | None = None,
@@ -66,16 +85,13 @@ class QueryProcessor:
         if audit is not None:
             audit.log(type(stmt).__name__, query, user, keyspace,
                       params=params)
-        sync = getattr(self.executor.backend, "schema_sync", None)
+        sync = self._ddl_sync_for(stmt)
         if sync is not None:
-            from ..cluster.schema_sync import DDL_STATEMENTS
-            if type(stmt).__name__ in DDL_STATEMENTS:
-                # DDL replicates through the epoch log (TCM-lite)
-                with GLOBAL.timer("cql.request"):
-                    return sync.coordinate(
-                        query, keyspace, stmt,
-                        lambda: self.executor.execute(
-                            stmt, params, keyspace, user=user))
+            with GLOBAL.timer("cql.request"):
+                return sync.coordinate(
+                    query, keyspace, stmt,
+                    lambda: self.executor.execute(
+                        stmt, params, keyspace, user=user))
         with GLOBAL.timer("cql.request"):
             return self.executor.execute(stmt, params, keyspace, user=user,
                                          page_size=page_size,

@@ -35,6 +35,11 @@ class StorageEngine:
             if durable_writes else None
         self.stores: dict = {}  # table_id -> ColumnFamilyStore
         self._lock = threading.RLock()
+        # background compaction (CompactionManager role): flushes enqueue
+        # the store; daemons turn the worker on via enable_auto(), tests
+        # drain explicitly with run_pending()
+        from ..compaction.manager import CompactionManager
+        self.compactions = CompactionManager(auto=False)
         self._load_schema()
         self._schema_listener = lambda s: self._save_schema()
         self.schema.listeners.append(self._schema_listener)
@@ -108,6 +113,7 @@ class StorageEngine:
     def _open_store(self, t: TableMetadata) -> ColumnFamilyStore:
         cfs = ColumnFamilyStore(t, self.data_dir, self.commitlog,
                                 flush_threshold=self.flush_threshold)
+        self.compactions.register(cfs)
         self.stores[t.id] = cfs
         return cfs
 
@@ -197,6 +203,7 @@ class StorageEngine:
             self.schema.listeners.remove(self._schema_listener)
         except ValueError:
             pass
+        self.compactions.close()
         if self.commitlog:
             self.commitlog.close()
         if self.audit_log is not None:

@@ -79,30 +79,7 @@ def _fmt(v) -> str:
     return str(v)
 
 
-def _parse_value(text: str, cql_type):
-    """CSV text -> python value for the column's type (the subset the
-    reference cqlsh converters handle for scalars)."""
-    if text == "":
-        return None
-    name = type(cql_type).__name__
-    if name in ("Int32Type", "LongType", "SmallIntType", "TinyIntType",
-                "IntegerType", "CounterColumnType"):
-        return int(text)
-    if name in ("FloatType", "DoubleType", "DecimalType"):
-        return float(text)
-    if name == "BooleanType":
-        return text.strip().lower() in ("true", "1", "yes")
-    if name in ("UUIDType", "TimeUUIDType"):
-        return uuid.UUID(text)
-    if name == "BlobType":
-        return bytes.fromhex(text[2:] if text.startswith("0x") else text)
-    if name == "TimestampType":
-        try:
-            return datetime.datetime.fromisoformat(text)
-        except ValueError:
-            return datetime.datetime.fromtimestamp(
-                float(text) / 1000.0, tz=datetime.timezone.utc)
-    return text      # text/ascii/inet and unknowns pass through
+from ..types.textval import parse_text_value as _parse_value  # noqa: E402
 
 
 def copy_to(session, table_name: str, columns: list[str],

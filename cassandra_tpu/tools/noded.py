@@ -70,6 +70,7 @@ def build_node(cfg: dict):
         finally:
             node.schema_sync = sync
     node.gossiper.start()
+    node.engine.compactions.enable_auto()
 
     def _catch_up():
         # wait for gossip to mark a peer alive, then pull newer schema —

@@ -54,16 +54,15 @@ def flush(engine, keyspace: str | None = None,
 def compact(engine, keyspace: str | None = None,
             table: str | None = None) -> list[dict]:
     """nodetool compact: major compaction."""
-    from ..compaction import CompactionManager, get_strategy
     out = []
     for cfs in list(engine.stores.values()):
         if keyspace and cfs.table.keyspace != keyspace:
             continue
         if table and cfs.table.name != table:
             continue
-        task = get_strategy(cfs).major_task()
-        if task is not None:
-            out.append(task.execute())
+        stats = engine.compactions.major_compaction(cfs)
+        if stats is not None:
+            out.append(stats)
     return out
 
 
@@ -111,6 +110,138 @@ def repair(node, keyspace: str, table: str | None = None,
     return out
 
 
+def cleanup(node, keyspace: str | None = None,
+            table: str | None = None) -> list[dict]:
+    """nodetool cleanup: rewrite sstables dropping cells for token
+    ranges this node no longer replicates (post-bootstrap/move data
+    reclamation — CompactionManager.performCleanup role)."""
+    import numpy as np
+
+    from ..cluster.replication import ReplicationStrategy
+    from ..storage.cellbatch import CellBatch, batch_tokens
+    from ..storage.rewrite import rewrite_sstable
+    out = []
+    engine = node.engine
+    for cfs in list(engine.stores.values()):
+        t = cfs.table
+        if keyspace and t.keyspace != keyspace:
+            continue
+        if table and t.name != table:
+            continue
+        ksm = node.schema.keyspaces.get(t.keyspace)
+        if ksm is None:
+            continue
+        strat = ReplicationStrategy.create(ksm.params.replication)
+        owned = []
+        for lo, hi in node.ring.all_ranges():
+            if node.endpoint in strat.replicas(node.ring, hi):
+                if lo == hi:               # single-token ring: the one
+                    owned.append((-(1 << 63), (1 << 63) - 1))  # arc IS
+                elif lo <= hi:                         # the full ring
+                    owned.append((lo, hi))
+                else:                      # wrap arc
+                    owned.append((-(1 << 63), hi))
+                    owned.append((lo, (1 << 63) - 1))
+        with engine.compactions.cfs_lock(cfs):
+            for sst in list(cfs.live_sstables()):
+                segs = list(sst.scanner())
+                if not segs:
+                    continue
+                cat = CellBatch.concat(segs)
+                cat.sorted = True
+                toks = batch_tokens(cat)
+                keep = np.zeros(len(cat), dtype=bool)
+                for lo, hi in owned:
+                    if lo == -(1 << 63):
+                        keep |= toks <= hi
+                    else:
+                        keep |= (toks > lo) & (toks <= hi)
+                dropped = int((~keep).sum())
+                if dropped == 0:
+                    continue
+
+                def fill(w, cat=cat, keep=keep):
+                    idx = np.flatnonzero(keep)
+                    if len(idx):
+                        part = cat.apply_permutation(idx)
+                        part.sorted = True
+                        w.append(part)
+
+                rewrite_sstable(cfs, sst,
+                                [(sst.repaired_at, sst.level, fill)])
+                out.append({"table": t.full_name(),
+                            "generation": sst.desc.generation,
+                            "cells_dropped": dropped})
+    return out
+
+
+def getendpoints(node, keyspace: str, table: str, key: str) -> list[str]:
+    """nodetool getendpoints: replicas for a partition key. Values are
+    converted by the COLUMN TYPE (never guessed from the text — a text
+    key '7' must not tokenize as an int), and composite partition keys
+    take ':'-separated components so the token matches the write path's
+    composite framing."""
+    from ..cluster.replication import ReplicationStrategy
+    from .copyutil import _parse_value
+    t = node.schema.get_table(keyspace, table)
+    cols = t.partition_key_columns
+    parts = key.split(":") if len(cols) > 1 else [key]
+    if len(parts) != len(cols):
+        raise ValueError(
+            f"partition key of {keyspace}.{table} has {len(cols)} "
+            f"components ({', '.join(c.name for c in cols)}); pass them "
+            "':'-separated")
+    vals = [_parse_value(p, c.cql_type) for p, c in zip(parts, cols)]
+    pk = t.serialize_partition_key(vals)
+    strat = ReplicationStrategy.create(
+        node.schema.keyspaces[keyspace].params.replication)
+    return [e.name for e in strat.replicas(node.ring,
+                                           node.ring.token_of(pk))]
+
+
+def gossipinfo(node) -> dict:
+    """nodetool gossipinfo."""
+    out = {}
+    for ep, st in node.gossiper.states.items():
+        out[ep.name] = {"generation": st.generation,
+                        "version": st.version,
+                        "alive": bool(st.alive),
+                        "app_states": dict(st.app_states)}
+    return out
+
+
+def version(engine=None) -> dict:
+    """nodetool version."""
+    return {"release": "cassandra-tpu 2.0", "cql": "3.4.5",
+            "sstable_format": "ctpu/ca"}
+
+
+def describecluster(node) -> dict:
+    """nodetool describecluster."""
+    return {
+        "name": "cassandra_tpu",
+        "partitioner": "Murmur3Partitioner",
+        "endpoints": [e.name for e in node.ring.endpoints],
+        "schema_epoch": getattr(getattr(node, "schema_sync", None),
+                                "epoch", None),
+        "pending_joins": [e.name for e in node.ring.pending],
+    }
+
+
+def setcompactionthroughput(engine, mib_s: int) -> dict:
+    """nodetool setcompactionthroughput (0 = unthrottled). Applies to
+    the engine's background CompactionManager (wired at engine init;
+    daemons run its worker via enable_auto)."""
+    engine.compactions.limiter.rate = mib_s * 2**20
+    return {"compaction_throughput_mib": mib_s}
+
+
+def getcompactionthroughput(engine) -> dict:
+    """nodetool getcompactionthroughput."""
+    return {"compaction_throughput_mib":
+            int(engine.compactions.limiter.rate // 2**20)}
+
+
 def ring(node) -> list[dict]:
     out = []
     for ep, toks in sorted(node.ring.endpoints.items(),
@@ -155,8 +286,7 @@ def scrub(engine, keyspace: str | None = None,
     segment, dropping corrupt ones (io/sstable/format/
     SortedTableScrubber role). The unreadable cells are gone either way;
     scrub turns a read-aborting sstable into a clean one."""
-    from ..storage.lifecycle import LifecycleTransaction
-    from ..storage.sstable import Descriptor, SSTableReader, SSTableWriter
+    from ..storage.rewrite import rewrite_sstable
     from ..storage.sstable.reader import CorruptSSTableError
     out = []
     for cfs in list(engine.stores.values()):
@@ -164,45 +294,26 @@ def scrub(engine, keyspace: str | None = None,
             continue
         if table and cfs.table.name != table:
             continue
-        for sst in list(cfs.live_sstables()):
-            kept = dropped = 0
-            txn = LifecycleTransaction(cfs.directory)
-            gen = cfs.next_generation()
-            desc = Descriptor(cfs.directory, gen)
-            txn.track_new(gen)
-            w = SSTableWriter(desc, cfs.table,
-                              estimated_partitions=sst.n_partitions)
-            w.repaired_at = sst.repaired_at
-            w.level = sst.level
-            try:
-                for i in range(sst.n_segments):
-                    try:
-                        seg = sst._read_segment(i)
-                    except CorruptSSTableError:
-                        dropped += 1
-                        continue
-                    w.append(seg)
-                    kept += 1
-                w.finish()
-                new = SSTableReader(desc, cfs.table)
-                txn.track_obsolete(sst.desc.generation)
-                replacement = []
-                if new.n_cells > 0:
-                    replacement = [new]
-                else:               # nothing salvageable: drop entirely
-                    new.close()
-                    txn.track_obsolete(gen)
-                txn.commit()
-                cfs.tracker.replace([sst], replacement)
-                sst.release()
-            except BaseException:
-                w.abort()
-                txn.abort()
-                raise
-            out.append({"table": cfs.table.full_name(),
-                        "generation": sst.desc.generation,
-                        "segments_kept": kept,
-                        "segments_dropped": dropped})
+        with engine.compactions.cfs_lock(cfs):
+            for sst in list(cfs.live_sstables()):
+                counts = {"kept": 0, "dropped": 0}
+
+                def fill(w, sst=sst, counts=counts):
+                    for i in range(sst.n_segments):
+                        try:
+                            seg = sst._read_segment(i)
+                        except CorruptSSTableError:
+                            counts["dropped"] += 1
+                            continue
+                        w.append(seg)
+                        counts["kept"] += 1
+
+                rewrite_sstable(cfs, sst,
+                                [(sst.repaired_at, sst.level, fill)])
+                out.append({"table": cfs.table.full_name(),
+                            "generation": sst.desc.generation,
+                            "segments_kept": counts["kept"],
+                            "segments_dropped": counts["dropped"]})
     return out
 
 
@@ -217,8 +328,9 @@ def garbagecollect(engine, keyspace: str | None = None,
             continue
         if table and cfs.table.name != table:
             continue
-        for sst in cfs.live_sstables():
-            out.append(CompactionTask(cfs, [sst]).execute())
+        with engine.compactions.cfs_lock(cfs):
+            for sst in list(cfs.live_sstables()):
+                out.append(CompactionTask(cfs, [sst]).execute())
     return out
 
 

@@ -221,3 +221,30 @@ def test_lifecycle_crash_rollforward(tmp_path):
     replay_directory(cfs.directory)
     assert Descriptor.list_in(cfs.directory) == []  # rolled forward
     eng.close()
+
+
+def test_engine_wires_background_compaction(tmp_path):
+    """The engine itself owns a CompactionManager: flushes enqueue the
+    store (no per-test manager needed), run_pending() drains it, and
+    nodetool's throughput knobs act on the live limiter."""
+    from cassandra_tpu.tools import nodetool
+
+    eng, t, cfs = new_engine(tmp_path)
+    try:
+        for gen in range(4):
+            for p in range(20):
+                put(eng, t, p, gen, f"g{gen}-p{p}")
+            cfs.flush()
+        assert len(cfs.live_sstables()) == 4
+        assert eng.compactions.run_pending() >= 1     # flush enqueued it
+        assert len(cfs.live_sstables()) < 4
+        assert len(read_all(t, cfs)) == 80    # all rows survive the merge
+
+        nodetool.setcompactionthroughput(eng, 16)
+        assert nodetool.getcompactionthroughput(eng) == \
+            {"compaction_throughput_mib": 16}
+        assert eng.compactions.limiter.rate == 16 * 2**20
+        nodetool.setcompactionthroughput(eng, 0)      # unthrottle
+        assert eng.compactions.limiter.rate == 0
+    finally:
+        eng.close()

@@ -460,3 +460,16 @@ def test_insert_json_default_null_and_blob(session):
     session.execute('INSERT INTO j3 JSON \'{"k": 1, "b": "0xff"}\'')
     rs = session.execute("SELECT v, b FROM j3 WHERE k = 1")
     assert rs.rows == [(None, b"\xff")], rs.rows   # omitted v -> null
+
+
+def test_insert_json_typed_map_keys(session):
+    """JSON object keys arrive as strings; they convert by the map's
+    KEY TYPE — a boolean key "false" must store as false, not as a
+    truthy non-empty string."""
+    session.execute("CREATE TABLE jmk (k int PRIMARY KEY, "
+                    "bm map<boolean,int>, im map<int,text>)")
+    session.execute('INSERT INTO jmk JSON \'{"k": 1, '
+                    '"bm": {"false": 10, "true": 20}, '
+                    '"im": {"7": "seven"}}\'')
+    rs = session.execute("SELECT bm, im FROM jmk WHERE k = 1")
+    assert rs.rows == [({False: 10, True: 20}, {7: "seven"})], rs.rows

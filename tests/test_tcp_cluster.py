@@ -269,3 +269,70 @@ def test_ddl_replicates_across_processes(tmp_path):
                     p.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     p.kill()
+
+
+# -------------------------------------------- designated DDL coordinator --
+
+def test_ddl_forwarded_to_designated_coordinator(tmp_path):
+    """Schema sync serializes DDL through ONE node (lowest live name,
+    the CMS-leader role): a statement issued on another node is
+    forwarded, the entry is applied locally from the ack (visible the
+    moment execute() returns), and the log on every node records the
+    DESIGNATED node as coordinator — the name the same-epoch conflict
+    rule compares against."""
+    import time as _t
+
+    from cassandra_tpu.cluster.messaging import LocalTransport
+    from cassandra_tpu.cluster.node import Node
+    from cassandra_tpu.cluster.ring import Ring
+    from cassandra_tpu.cluster.schema_sync import SchemaSync
+    from cassandra_tpu.schema import Schema
+
+    eps = [Endpoint(n, host="127.0.0.1", port=0)
+           for n in ("node1", "node2")]
+    tokens = even_tokens(2, vnodes=4)
+    transport = LocalTransport()
+    ring = Ring()
+    for ep, toks in zip(eps, tokens):
+        ring.add_node(ep, toks)
+    nodes = []
+    try:
+        for ep in eps:
+            n = Node(ep, str(tmp_path / ep.name), Schema(), ring,
+                     transport, seeds=[eps[0]], gossip_interval=0.05)
+            n.cluster_nodes = [n]
+            n.schema_sync = SchemaSync(n, str(tmp_path / ep.name))
+            n.gossiper.start()
+            nodes.append(n)
+        deadline = _t.time() + 10
+        while _t.time() < deadline:
+            if nodes[1].is_alive(eps[0]) and nodes[0].is_alive(eps[1]):
+                break
+            _t.sleep(0.05)
+
+        s = nodes[1].session()   # NOT the designated node
+        s.execute("CREATE KEYSPACE ks WITH replication = "
+                  "{'class': 'SimpleStrategy', 'replication_factor': 2}")
+        s.execute("CREATE TABLE ks.kv (k int PRIMARY KEY, v text)")
+
+        # synchronously visible on the issuing node, and on the
+        # designated node which coordinated it
+        t_origin = nodes[1].schema.get_table("ks", "kv")
+        t_des = nodes[0].schema.get_table("ks", "kv")
+        assert t_origin.id == t_des.id      # coordinator-assigned id
+        assert nodes[0].schema_sync.epoch == 2
+        assert nodes[1].schema_sync.epoch == 2
+        # both logs name the designated node as the epoch's coordinator
+        for n in nodes:
+            assert n.schema_sync._entry_at(2)[4] == "node1"
+
+        # prepared DDL coordinates identically (no local-only bypass)
+        qid = s.prepare("CREATE TABLE ks.kv2 (k int PRIMARY KEY)")
+        s.execute_prepared(qid)
+        assert nodes[0].schema.get_table("ks", "kv2").id \
+            == nodes[1].schema.get_table("ks", "kv2").id
+        assert nodes[0].schema_sync.epoch == 3
+        assert nodes[1].schema_sync.epoch == 3
+    finally:
+        for n in nodes:
+            n.engine.close()

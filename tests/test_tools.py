@@ -148,3 +148,112 @@ def test_guardrails(tmp_path):
     with pytest.raises(GuardrailViolation):
         s.execute("CREATE TABLE another (k int PRIMARY KEY)")
     eng.close()
+
+
+def test_nodetool_cleanup_reclaims_foreign_ranges(tmp_path):
+    """After a topology change, cleanup drops cells this node no longer
+    replicates (CompactionManager.performCleanup role)."""
+    from cassandra_tpu.cluster.node import LocalCluster
+    from cassandra_tpu.cluster.replication import ConsistencyLevel
+    from cassandra_tpu.tools import nodetool
+    c = LocalCluster(2, str(tmp_path), rf=1, gossip_interval=0.05)
+    try:
+        s = c.session(1)
+        s.execute("CREATE KEYSPACE ks WITH replication = "
+                  "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+        s.execute("USE ks")
+        s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+        c.node(1).default_cl = ConsistencyLevel.ALL
+        for i in range(40):
+            s.execute(f"INSERT INTO kv (k, v) VALUES ({i}, 'x')")
+        for n in c.nodes:
+            n.engine.store("ks", "kv").flush()
+        # grow the cluster: old nodes now hold ranges the new node owns
+        c.add_node()
+        rep1 = nodetool.cleanup(c.node(1), "ks")
+        rep2 = nodetool.cleanup(c.node(2), "ks")
+        assert sum(r["cells_dropped"] for r in rep1 + rep2) > 0
+        # all data still readable (the new owner has its copies)
+        got = {r[0] for r in s.execute("SELECT k FROM kv").rows}
+        assert got == set(range(40))
+        # second cleanup: nothing left to drop
+        assert nodetool.cleanup(c.node(1), "ks") == []
+    finally:
+        c.shutdown()
+
+
+def test_nodetool_info_commands(tmp_path):
+    from cassandra_tpu.cluster.node import LocalCluster
+    from cassandra_tpu.tools import nodetool
+    c = LocalCluster(2, str(tmp_path), rf=2, gossip_interval=0.05)
+    try:
+        s = c.session(1)
+        s.execute("CREATE KEYSPACE ks WITH replication = "
+                  "{'class': 'SimpleStrategy', 'replication_factor': 2}")
+        s.execute("USE ks")
+        s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+        eps = nodetool.getendpoints(c.node(1), "ks", "kv", "7")
+        assert len(eps) == 2
+        # the key converts by COLUMN TYPE: a text pk '7' must tokenize
+        # as the stored utf8 bytes, matching where the write path put it
+        s.execute("CREATE TABLE txt (k text PRIMARY KEY, v int)")
+        s.execute("INSERT INTO txt (k, v) VALUES ('7', 1)")
+        text_eps = nodetool.getendpoints(c.node(1), "ks", "txt", "7")
+        strat_token = c.node(1).ring.token_of(b"7")
+        from cassandra_tpu.cluster.replication import ReplicationStrategy
+        strat = ReplicationStrategy.create(
+            c.node(1).schema.keyspaces["ks"].params.replication)
+        want = [e.name for e in strat.replicas(c.node(1).ring, strat_token)]
+        assert text_eps == want
+        # composite partition key: ':'-separated components, framed the
+        # same way the write path frames them
+        s.execute("CREATE TABLE comp (a int, b text, c int, "
+                  "PRIMARY KEY ((a, b), c))")
+        comp_eps = nodetool.getendpoints(c.node(1), "ks", "comp", "1:x")
+        t = c.node(1).schema.get_table("ks", "comp")
+        want = [e.name for e in strat.replicas(
+            c.node(1).ring,
+            c.node(1).ring.token_of(t.serialize_partition_key([1, "x"])))]
+        assert comp_eps == want
+        with pytest.raises(ValueError):
+            nodetool.getendpoints(c.node(1), "ks", "comp", "1")
+        gi = nodetool.gossipinfo(c.node(1))
+        assert "node2" in gi
+        dc = nodetool.describecluster(c.node(1))
+        assert dc["partitioner"] == "Murmur3Partitioner"
+        assert len(dc["endpoints"]) == 2
+        assert nodetool.version()["cql"]
+    finally:
+        c.shutdown()
+
+
+def test_nodetool_cleanup_single_token_ring_is_noop(tmp_path):
+    """One node, ONE token: its lone (t, t] arc is the FULL ring, so
+    cleanup must keep every cell — not interpret the degenerate range
+    as empty and wipe the node."""
+    from cassandra_tpu.cluster.node import Node
+    from cassandra_tpu.cluster.ring import Endpoint, Ring
+    from cassandra_tpu.schema import Schema
+    from cassandra_tpu.tools import nodetool
+
+    ep = Endpoint("n1", host="127.0.0.1", port=0)
+    ring = Ring()
+    ring.add_node(ep, [0])                      # num_tokens = 1
+    from cassandra_tpu.cluster.messaging import LocalTransport
+    node = Node(ep, str(tmp_path), Schema(), ring, LocalTransport(),
+                seeds=[ep], gossip_interval=10.0)
+    node.cluster_nodes = [node]
+    try:
+        s = node.session()
+        s.execute("CREATE KEYSPACE ks WITH replication = "
+                  "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+        s.execute("USE ks")
+        s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+        for i in range(20):
+            s.execute(f"INSERT INTO kv (k, v) VALUES ({i}, 'x')")
+        node.engine.store("ks", "kv").flush()
+        assert nodetool.cleanup(node, "ks") == []   # nothing dropped
+        got = {r[0] for r in s.execute("SELECT k FROM kv").rows}
+        assert got == set(range(20))
+    finally:
+        node.engine.close()
