@@ -24,6 +24,8 @@ from .ring import Endpoint
 class Verb:
     MUTATION_REQ = "MUTATION_REQ"
     MUTATION_RSP = "MUTATION_RSP"
+    COUNTER_REQ = "COUNTER_REQ"
+    COUNTER_RSP = "COUNTER_RSP"
     READ_REQ = "READ_REQ"
     READ_RSP = "READ_RSP"
     RANGE_REQ = "RANGE_REQ"
@@ -195,7 +197,11 @@ class MessagingService:
                         else on_response
                     if fn is not None:
                         try:
-                            fn(msg if fn is on_response else msg.reply_to)
+                            # both callbacks receive the Message, so a
+                            # failure handler can inspect the remote
+                            # error payload (callbacks reaped on timeout
+                            # get the bare id instead — see _reap)
+                            fn(msg)
                         except Exception:
                             pass
                 continue

@@ -43,6 +43,8 @@ class Node:
         self.virtual_tables = build_node_virtuals(self)
         from .paxos import PaxosService
         self.paxos = PaxosService(self)
+        from .counters import CounterService
+        self.counters = CounterService(self)
         self.default_cl = ConsistencyLevel.ONE
         # periodic hint dispatch (HintsDispatchExecutor role): hints must
         # flow even when the target was never convicted dead
@@ -147,7 +149,13 @@ class Node:
         t = self.schema.table_by_id(mutation.table_id)
         if t is None:
             raise KeyError(f"unknown table id {mutation.table_id}")
-        self.proxy.mutate(t.keyspace, mutation, self.default_cl)
+        from ..storage.cellbatch import FLAG_COUNTER
+        if any(op[7] & FLAG_COUNTER for op in mutation.ops):
+            # increments are not idempotent: route through the counter
+            # leader (cluster/counters.py), never the plain write path
+            self.counters.mutate(t.keyspace, mutation, self.default_cl)
+        else:
+            self.proxy.mutate(t.keyspace, mutation, self.default_cl)
 
     def store(self, keyspace: str, name: str):
         return _DistributedStore(self, keyspace, name)
@@ -253,6 +261,7 @@ class Node:
 
     def shutdown(self):
         self._stop_hints.set()
+        self.counters.close()
         self.gossiper.stop()
         self.messaging.close()
         self.engine.close()
@@ -424,10 +433,13 @@ class LocalCluster:
         n.proxy = StorageProxy(n)
         # re-register sidecar verb handlers on the fresh MessagingService
         # (paxos state resets too — crash semantics; promises are volatile)
+        from .counters import CounterService
         from .paxos import PaxosService
         from .repair import RepairService
         n.paxos = PaxosService(n)
         n.repair = RepairService(n)
+        n.counters.close()
+        n.counters = CounterService(n)
         n.gossiper.start()
         n._stop_hints = threading.Event()
         n._hint_thread = threading.Thread(target=n._hint_loop, daemon=True)

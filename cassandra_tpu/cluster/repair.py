@@ -159,13 +159,7 @@ class RepairService:
                     continue
                 cat = cb.CellBatch.concat(segs)
                 cat.sorted = True
-                toks = batch_tokens(cat)
-                in_mask = np.zeros(len(cat), dtype=bool)
-                for lo, hi in ranges:
-                    if lo == MIN:
-                        in_mask |= toks <= hi
-                    else:
-                        in_mask |= (toks > lo) & (toks <= hi)
+                in_mask = cb.token_range_mask(batch_tokens(cat), ranges)
 
                 def fill_for(mask, cat=cat):
                     def fill(w):

@@ -1077,8 +1077,13 @@ class Executor:
             delta = bind_term(op.value, typ, params)
             if op.op == "sub":
                 delta = -delta
+            # counters NEVER take the statement/batch timestamp: two
+            # deltas sharing a ts would LWW-collapse instead of summing
+            # (reference: "Cannot provide custom timestamp for counter
+            # updates"); now_micros() is unique per call by contract
             m.add(target_ck, col.column_id, b"",
-                  typ.serialize(delta), ts, 0x7FFFFFFF, 0, cb.FLAG_COUNTER)
+                  typ.serialize(delta), timeutil.now_micros(),
+                  0x7FFFFFFF, 0, cb.FLAG_COUNTER)
             return
         if op.op == "set":
             v = bind_term(op.value, typ, params)
@@ -1212,6 +1217,26 @@ class Executor:
                     "conditional statements are not supported in batches "
                     "(round 1; the reference restricts them to a single "
                     "partition)")
+        def _targets_counter(sub) -> bool:
+            try:
+                t = self.schema.get_table(
+                    getattr(sub, "keyspace", None) or keyspace,
+                    getattr(sub, "table", ""))
+            except KeyError:
+                return False
+            return t.is_counter_table
+
+        n_counter = sum(_targets_counter(sub) for sub in s.statements)
+        if n_counter and s.kind != "counter":
+            # reference BatchStatement.verifyBatchSize/Type: replaying a
+            # LOGGED delta from the batchlog would double-count — the
+            # increment is not idempotent, so it may never be journaled
+            raise InvalidRequest(
+                "cannot include counter updates in a "
+                f"{s.kind.upper()} batch; use BEGIN COUNTER BATCH")
+        if s.kind == "counter" and n_counter != len(s.statements):
+            raise InvalidRequest(
+                "COUNTER batches may only contain counter updates")
         batchlog = getattr(self.backend, "batchlog", None)
         if s.kind == "logged" and batchlog is not None \
                 and len(s.statements) > 1:

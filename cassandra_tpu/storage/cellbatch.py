@@ -106,6 +106,22 @@ def batch_tokens(batch: "CellBatch") -> np.ndarray:
         return (u ^ np.uint64(_BIAS)).astype(np.int64)
 
 
+def token_range_mask(toks: np.ndarray, ranges) -> np.ndarray:
+    """Boolean mask of tokens inside any ring range (lo, hi]. A range
+    starting at MIN_TOKEN means 'from the ring start' and is inclusive
+    of hi — callers split wrap-around arcs at the ring edge before
+    passing them (cleanup and anticompaction share this exact
+    boundary semantics; keep them agreeing HERE, not in two copies)."""
+    MIN = -(1 << 63)
+    mask = np.zeros(len(toks), dtype=bool)
+    for lo, hi in ranges:
+        if lo == MIN:
+            mask |= toks <= hi
+        else:
+            mask |= (toks > lo) & (toks <= hi)
+    return mask
+
+
 def filter_token_range(batch: "CellBatch", lo: int, hi: int) -> "CellBatch":
     """Cells whose partition token falls in [lo, hi] (sorted input -> the
     result is a contiguous slice)."""

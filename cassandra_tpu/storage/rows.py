@@ -78,6 +78,24 @@ def rows_from_batch(table: TableMetadata, batch: CellBatch):
             continue
         meta = table.columns_by_id.get(col)
         dead = bool(flags & FLAG_TOMBSTONE)
+        if meta is not None and getattr(meta.cql_type, "is_counter",
+                                        False):
+            # counter column = SUM of its live cells: one cumulative
+            # shard per leader (distinct paths) in clusters, or the
+            # single reconciled delta-sum cell (path=b"") locally
+            if not dead:
+                prev = current.cells.get(col)
+                base = int.from_bytes(prev, "big", signed=True) \
+                    if prev else 0
+                total = base + int.from_bytes(value, "big", signed=True)
+                current.cells[col] = total.to_bytes(8, "big", signed=True)
+                old = current.cell_meta.get(col)
+                m = (int(batch.ts[i]), int(batch.ttl[i]),
+                     int(batch.ldt[i]))
+                current.cell_meta[col] = max(old, m) if old else m
+            elif col not in current.cells:
+                current.cells[col] = None
+            continue
         if meta is not None and meta.cql_type.is_multicell:
             if path and not dead:
                 current.multicell.setdefault(col, {})[path] = value

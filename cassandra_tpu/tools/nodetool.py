@@ -118,7 +118,8 @@ def cleanup(node, keyspace: str | None = None,
     import numpy as np
 
     from ..cluster.replication import ReplicationStrategy
-    from ..storage.cellbatch import CellBatch, batch_tokens
+    from ..storage.cellbatch import (CellBatch, batch_tokens,
+                                     token_range_mask)
     from ..storage.rewrite import rewrite_sstable
     out = []
     engine = node.engine
@@ -149,13 +150,7 @@ def cleanup(node, keyspace: str | None = None,
                     continue
                 cat = CellBatch.concat(segs)
                 cat.sorted = True
-                toks = batch_tokens(cat)
-                keep = np.zeros(len(cat), dtype=bool)
-                for lo, hi in owned:
-                    if lo == -(1 << 63):
-                        keep |= toks <= hi
-                    else:
-                        keep |= (toks > lo) & (toks <= hi)
+                keep = token_range_mask(batch_tokens(cat), owned)
                 dropped = int((~keep).sum())
                 if dropped == 0:
                     continue

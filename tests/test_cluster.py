@@ -548,3 +548,87 @@ def test_speculative_retry_rescues_slow_replica(cluster):
     assert time.time() - t0 < 2.0, "speculation should beat the timeout"
     assert GLOBAL.counter("reads.speculative_retries") > before
     cluster.filters.clear()
+
+
+# ------------------------------------------------------ counter leader --
+
+def test_counter_leader_shards(cluster):
+    """Increments route through a leader replica and land as CUMULATIVE
+    per-leader shard cells: every coordinator reads the same total
+    (sum of shards), and replaying a shard mutation — the hint/retry
+    case that double-counts naive deltas — changes nothing."""
+    s1, s2 = cluster.session(1), cluster.session(2)
+    for s in (s1, s2):
+        s.keyspace = "ks"
+    for n in cluster.nodes:      # leader waits full replication; reads
+        n.default_cl = ConsistencyLevel.ALL   # then see every shard
+    s1.execute("CREATE TABLE cnt (k int PRIMARY KEY, hits counter)")
+    for _ in range(4):
+        s1.execute("UPDATE cnt SET hits = hits + 3 WHERE k = 1")
+    for _ in range(3):
+        s2.execute("UPDATE cnt SET hits = hits - 2 WHERE k = 1")
+    for s in (s1, s2):
+        assert s.execute("SELECT hits FROM cnt WHERE k = 1").rows \
+            == [(6,)]
+
+    # shards are idempotent state: re-apply node1's current shard cell
+    # verbatim (what a duplicated hint or a retried replication does)
+    from cassandra_tpu.cluster.counters import CounterService
+    from cassandra_tpu.storage.mutation import Mutation
+    t = cluster.schema.get_table("ks", "cnt")
+    pk = t.columns["k"].cql_type.serialize(1)
+    col = t.columns["hits"].column_id
+    n1 = cluster.node(1)
+    batch = n1.engine.store("ks", "cnt").read_partition(pk)
+    shard = n1.endpoint.name.encode()
+    total, ts = CounterService._own_shard(batch, b"", col, shard)
+    assert ts > 0       # node1 coordinated increments -> owns a shard
+    replay = Mutation(t.id, pk)
+    replay.add(b"", col, shard,
+               total.to_bytes(8, "big", signed=True), ts)
+    for n in cluster.nodes:
+        n.engine.apply(replay)          # duplicated delivery
+        n.engine.apply(replay)
+    assert s2.execute("SELECT hits FROM cnt WHERE k = 1").rows == [(6,)]
+
+    # flush + survive compaction: shards are plain LWW cells
+    for n in cluster.nodes:
+        n.engine.store("ks", "cnt").flush()
+    assert s1.execute("SELECT hits FROM cnt WHERE k = 1").rows == [(6,)]
+
+
+def test_counter_hinted_shard_converges(cluster):
+    """A replica that missed shard replication converges through hints
+    WITHOUT double counting — the hinted payload is cumulative shard
+    state, not a delta."""
+    n1 = cluster.node(1)
+    n1.default_cl = ConsistencyLevel.ONE
+    victim = cluster.nodes[2]
+    s = cluster.session(1)
+    s.keyspace = "ks"
+    s.execute("CREATE TABLE cnt2 (k int PRIMARY KEY, hits counter)")
+    t = cluster.schema.get_table("ks", "cnt2")
+    pk = t.columns["k"].cql_type.serialize(7)
+    time.sleep(0.1)     # table reaches all stores
+    n1.gossiper.states[victim.endpoint].alive = False
+    for _ in range(5):
+        s.execute("UPDATE cnt2 SET hits = hits + 2 WHERE k = 7")
+    assert n1.hints.has_hints(victim.endpoint)
+    assert len(victim.engine.store("ks", "cnt2").read_partition(pk)) == 0
+    n1.gossiper.states[victim.endpoint].alive = True
+    n1._on_peer_alive(victim.endpoint)
+    # victim's LOCAL view alone converges to the full total: 5 hinted
+    # cumulative shard mutations collapse to one shard worth +10 (a
+    # delta scheme would replay to +30)
+    from cassandra_tpu.storage.rows import row_to_dict, rows_from_batch
+    store = victim.engine.store("ks", "cnt2")
+    deadline = time.time() + 15
+    got = None
+    while time.time() < deadline:
+        rows = list(rows_from_batch(t, store.read_partition(pk)))
+        got = row_to_dict(t, rows[0])["hits"] if rows else None
+        if got == 10 and not n1.hints.has_hints(victim.endpoint):
+            break
+        time.sleep(0.1)
+    assert got == 10
+    assert not n1.hints.has_hints(victim.endpoint)

@@ -7,6 +7,7 @@ import uuid
 import pytest
 
 from cassandra_tpu.cql import Session
+from cassandra_tpu.cql.execution import InvalidRequest
 from cassandra_tpu.schema import Schema
 from cassandra_tpu.storage.engine import StorageEngine
 
@@ -473,3 +474,26 @@ def test_insert_json_typed_map_keys(session):
                     '"im": {"7": "seven"}}\'')
     rs = session.execute("SELECT bm, im FROM jmk WHERE k = 1")
     assert rs.rows == [({False: 10, True: 20}, {7: "seven"})], rs.rows
+
+
+def test_counter_batch_rules(session):
+    """Counters are barred from LOGGED/UNLOGGED batches (batchlog
+    replay of a delta double-counts); BEGIN COUNTER BATCH applies
+    counter updates and accepts nothing else."""
+    session.execute("CREATE TABLE cb (k int PRIMARY KEY, hits counter)")
+    session.execute("CREATE TABLE plain (k int PRIMARY KEY, v text)")
+    with pytest.raises(InvalidRequest):
+        session.execute("BEGIN BATCH "
+                        "UPDATE cb SET hits = hits + 1 WHERE k = 1; "
+                        "UPDATE cb SET hits = hits + 1 WHERE k = 2; "
+                        "APPLY BATCH")
+    with pytest.raises(InvalidRequest):
+        session.execute("BEGIN COUNTER BATCH "
+                        "INSERT INTO plain (k, v) VALUES (1, 'x'); "
+                        "APPLY BATCH")
+    session.execute("BEGIN COUNTER BATCH "
+                    "UPDATE cb SET hits = hits + 4 WHERE k = 1; "
+                    "UPDATE cb SET hits = hits - 1 WHERE k = 1; "
+                    "APPLY BATCH")
+    assert session.execute("SELECT hits FROM cb WHERE k = 1").rows \
+        == [(3,)]
