@@ -145,6 +145,10 @@ class Node:
     def indexes(self):
         return getattr(self.engine, "indexes", None)
 
+    @property
+    def triggers(self):
+        return getattr(self.engine, "triggers", None)
+
     def apply(self, mutation: Mutation, durable: bool = True) -> None:
         t = self.schema.table_by_id(mutation.table_id)
         if t is None:

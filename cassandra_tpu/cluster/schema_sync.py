@@ -48,6 +48,7 @@ DDL_STATEMENTS = {
     "CreateKeyspaceStatement", "CreateTableStatement",
     "CreateIndexStatement", "CreateTypeStatement", "CreateViewStatement",
     "CreateFunctionStatement", "CreateAggregateStatement",
+    "CreateTriggerStatement", "DropTriggerStatement",
     "DropStatement", "AlterTableStatement",
     # NOT TruncateStatement: truncation is a DATA operation with its own
     # cluster fan-out (TRUNCATE_REQ); replaying it from the schema log on
@@ -383,11 +384,15 @@ class SchemaSync:
         store the same name or different nodes pick different winners."""
         try:
             self._apply_local(query, keyspace, extra)
-        except Exception:
+        except Exception as e:
             # an entry that fails locally (e.g. already-applied effect)
             # still advances the epoch — convergence over strictness,
-            # matching pre-TCM schema-merge behaviour
-            pass
+            # matching pre-TCM schema-merge behaviour. But NOT silently:
+            # e.g. CREATE TRIGGER fails on a node missing the trigger
+            # file, and the operator must learn this node diverged
+            print(f"[schema-sync] {self.node.endpoint.name}: replicated "
+                  f"DDL failed locally at epoch {epoch} ({query!r}): "
+                  f"{e!r}", file=sys.stderr)
         self.epoch = max(self.epoch, epoch)
         self._append(epoch, query, keyspace, extra, coord=coord)
 

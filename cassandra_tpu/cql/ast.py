@@ -190,6 +190,23 @@ class CreateAggregateStatement:
 
 
 @dataclass
+class CreateTriggerStatement:
+    keyspace: str | None
+    table: str
+    name: str
+    using: str           # '<file>:<function>' under <data_dir>/triggers
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropTriggerStatement:
+    keyspace: str | None
+    table: str
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
 class DropStatement:
     what: str            # keyspace | table | index | type
     keyspace: str | None

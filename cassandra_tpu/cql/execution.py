@@ -195,6 +195,12 @@ class _MutationCollector:
 
     collects_only = True   # _apply_dml: no view derivation on collect
 
+    @property
+    def triggers(self):
+        # triggers still augment while collecting: a logged batch must
+        # journal the trigger output with the base writes
+        return getattr(self._backend, "triggers", None)
+
     def apply(self, mutation, durable: bool = True) -> None:
         self.mutations.append(mutation)
 
@@ -234,6 +240,8 @@ class Executor:
         "CreateViewStatement": "CREATE",
         "CreateFunctionStatement": "CREATE",
         "CreateAggregateStatement": "CREATE",
+        "CreateTriggerStatement": "CREATE",
+        "DropTriggerStatement": "DROP",
         "DropStatement": "DROP", "AlterTableStatement": "ALTER",
         "RoleStatement": "AUTHORIZE", "GrantStatement": "AUTHORIZE",
         "ListRolesStatement": "AUTHORIZE",
@@ -576,7 +584,7 @@ class Executor:
                     pass
         return out
 
-    def _apply_dml(self, m, now) -> None:
+    def _apply_dml(self, m, now, augment: bool = True) -> None:
         """backend.apply + materialized-view maintenance: read the
         affected rows before and after the base write and derive view
         deletes/inserts (db/view/ViewUpdateGenerator; generation happens
@@ -585,6 +593,15 @@ class Executor:
         BASE write's timestamp so USING TIMESTAMP ordering carries over
         (a ts-200 delete must shadow the view row of a ts-100 write)."""
         t = self.schema.table_by_id(m.table_id)
+        trig = getattr(self.backend, "triggers", None) if augment else None
+        if trig is not None and t is not None:
+            # coordinator-side augmentation (TriggerExecutor.execute):
+            # extras apply as ordinary writes — no re-triggering, no
+            # view derivation (single augmentation pass, like the
+            # reference). Collecting backends record them so logged
+            # batches journal trigger output alongside the base writes.
+            for em in trig.augment(t, m, self.backend):
+                self.backend.apply(em)
         views = self._views_of(t) if t is not None else []
         if not views or getattr(self.backend, "collects_only", False):
             # a collecting backend (logged batch) records the base
@@ -778,6 +795,33 @@ class Executor:
             self.schema._changed()   # index defs persist with the schema
         return ResultSet([], [])
 
+    def _exec_CreateTriggerStatement(self, s, params, keyspace, now):
+        from ..service.triggers import TriggerError
+        t = self._table(s, keyspace)
+        trig = getattr(self.backend, "triggers", None)
+        if trig is None:
+            raise InvalidRequest("backend has no trigger support")
+        try:
+            trig.create(t.keyspace, t.name, s.name, s.using,
+                        if_not_exists=s.if_not_exists)
+        except TriggerError as e:
+            raise InvalidRequest(str(e))
+        self.schema._changed()   # trigger defs persist with the schema
+        return ResultSet([], [])
+
+    def _exec_DropTriggerStatement(self, s, params, keyspace, now):
+        from ..service.triggers import TriggerError
+        t = self._table(s, keyspace)
+        trig = getattr(self.backend, "triggers", None)
+        if trig is None:
+            raise InvalidRequest("backend has no trigger support")
+        try:
+            trig.drop(t.keyspace, t.name, s.name, if_exists=s.if_exists)
+        except TriggerError as e:
+            raise InvalidRequest(str(e))
+        self.schema._changed()
+        return ResultSet([], [])
+
     def _exec_DropStatement(self, s, params, keyspace, now):
         ks = s.keyspace or keyspace
         try:
@@ -788,8 +832,11 @@ class Executor:
                 for vks, vname in list(self.schema.views):
                     if vks == s.name:
                         del self.schema.views[(vks, vname)]
+                trig = getattr(self.backend, "triggers", None)
                 for tname in list(ksm.tables):
                     self.backend.drop_table(s.name, tname)
+                    if trig is not None:
+                        trig.drop_table(s.name, tname)
                 self.schema.drop_keyspace(s.name)
             elif s.what == "table":
                 if (ks, s.name) in self.schema.views:
@@ -804,6 +851,9 @@ class Executor:
                         f"cannot drop {ks}.{s.name}: materialized views "
                         f"depend on it: {dependents}")
                 self.backend.drop_table(ks, s.name)
+                trig = getattr(self.backend, "triggers", None)
+                if trig is not None:
+                    trig.drop_table(ks, s.name)
             elif s.what == "view":
                 if (ks, s.name) not in self.schema.views:
                     raise KeyError(s.name)
@@ -1250,7 +1300,10 @@ class Executor:
                                  user=user)
             bid = batchlog.store(collector.mutations)
             for m in collector.mutations:
-                self._apply_dml(m, now)
+                # augment=False: triggers already ran during collection
+                # (their output IS in collector.mutations and the
+                # batchlog); a second pass here would double-fire
+                self._apply_dml(m, now, augment=False)
             batchlog.remove(bid)
             return ResultSet([], [])
         for sub in s.statements:

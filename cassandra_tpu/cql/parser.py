@@ -72,7 +72,8 @@ class Parser:
                                                "user", "counter", "token",
                                                "options", "custom", "view",
                                                "function", "aggregate",
-                                               "returns", "language"):
+                                               "returns", "language",
+                                               "trigger"):
             return t.value  # unreserved keywords usable as identifiers
         raise ParseError(f"expected identifier, got {t}")
 
@@ -495,7 +496,26 @@ class Parser:
             return self._create_function()
         if what.kind == "KEYWORD" and what.value == "aggregate":
             return self._create_aggregate()
+        if what.kind == "KEYWORD" and what.value == "trigger":
+            return self._create_trigger()
         raise ParseError(f"unsupported CREATE {what}")
+
+    def _create_trigger(self):
+        # CREATE TRIGGER [IF NOT EXISTS] name ON [ks.]table USING '<src>'
+        ine = False
+        if self.accept_kw("if"):
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            ine = True
+        name = self.ident()
+        self.expect_kw("on")
+        ks, table = self.qualified_name()
+        self.expect_kw("using")
+        src = self.next()
+        if src.kind != "STRING":
+            raise ParseError("USING expects a quoted trigger source")
+        return ast.CreateTriggerStatement(ks, table, name, src.value,
+                                          if_not_exists=ine)
 
     def _create_function(self, or_replace: bool = False):
         """CREATE [OR REPLACE] FUNCTION [IF NOT EXISTS] name
@@ -863,6 +883,17 @@ class Parser:
         if what == "materialized":
             self.expect_kw("view")
             what = "view"
+        if what == "trigger":
+            # DROP TRIGGER [IF EXISTS] name ON [ks.]table
+            ife = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                ife = True
+            tname = self.ident()
+            self.expect_kw("on")
+            ks, table = self.qualified_name()
+            return ast.DropTriggerStatement(ks, table, tname,
+                                            if_exists=ife)
         if what not in ("keyspace", "table", "index", "type", "view",
                         "function", "aggregate"):
             raise ParseError(f"unsupported DROP {what}")

@@ -51,6 +51,8 @@ class StorageEngine:
         self._replay_batchlog()
         from ..index import IndexManager
         self.indexes = IndexManager(self)
+        from ..service.triggers import TriggerManager
+        self.triggers = TriggerManager(os.path.join(data_dir, "triggers"))
         # audit/FQL stream (service/audit.py); None = disabled
         self.audit_log = None
         if audit_log_path:
@@ -87,6 +89,9 @@ class StorageEngine:
             dump["indexes"] = [
                 {"keyspace": ks, "table": tb, "column": col, "name": nm}
                 for (ksn, nm), (ks, tb, col) in idx.by_name.items()]
+        trig = getattr(self, "triggers", None)
+        if trig is not None:
+            dump["triggers"] = trig.to_list()
         tmp = self._schema_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(dump, f)
@@ -104,6 +109,7 @@ class StorageEngine:
                 self.indexes.create(t, d["column"], d["name"])
             except KeyError:
                 pass  # table dropped since
+        self.triggers.load_list(dump.get("triggers", []))
 
     def _register_existing(self) -> None:
         for ks in self.schema.keyspaces.values():

@@ -1,0 +1,220 @@
+"""Triggers: coordinator-side mutation augmentation.
+
+Reference: triggers/TriggerExecutor.java + ITrigger (CREATE TRIGGER ...
+USING 'class' where the class must already be installed on the node —
+DDL names code, never ships it)."""
+import os
+import textwrap
+
+import pytest
+
+from cassandra_tpu.cql import Session
+from cassandra_tpu.cql.execution import InvalidRequest
+from cassandra_tpu.schema import Schema
+from cassandra_tpu.storage.engine import StorageEngine
+
+AUDIT_TRIGGER = textwrap.dedent("""
+    from cassandra_tpu.schema import COL_ROW_LIVENESS
+    from cassandra_tpu.storage.mutation import Mutation
+    from cassandra_tpu.utils import timeutil
+
+    def audit(table, mutation, backend):
+        at = backend.schema.get_table(table.keyspace, "audit_log")
+        ts = timeutil.now_micros()
+        m = Mutation(at.id, mutation.pk)
+        m.add(b"", COL_ROW_LIVENESS, b"", b"", ts)
+        m.add(b"", at.columns["n"].column_id, b"",
+              at.columns["n"].cql_type.serialize(len(mutation.ops)), ts)
+        return [m]
+
+    def boom(table, mutation, backend):
+        raise RuntimeError("no writes for you")
+""")
+
+
+def _engine(tmp_path, name="d"):
+    return StorageEngine(str(tmp_path / name), Schema(),
+                         commitlog_sync="batch")
+
+
+def _install(eng, body=AUDIT_TRIGGER, fname="auditmod"):
+    os.makedirs(eng.triggers.directory, exist_ok=True)
+    with open(os.path.join(eng.triggers.directory, f"{fname}.py"),
+              "w") as f:
+        f.write(body)
+
+
+def test_trigger_augments_writes(tmp_path):
+    eng = _engine(tmp_path)
+    _install(eng)
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    s.execute("CREATE TABLE audit_log (k int PRIMARY KEY, n int)")
+    s.execute("CREATE TRIGGER aud ON kv USING 'auditmod:audit'")
+    s.execute("INSERT INTO kv (k, v) VALUES (1, 'x')")
+    rows = s.execute("SELECT k, n FROM audit_log").rows
+    assert len(rows) == 1 and rows[0][0] == 1 and rows[0][1] >= 1
+    # extras do not re-trigger (audit of audit would loop)
+    s.execute("DROP TRIGGER aud ON kv")
+    s.execute("INSERT INTO kv (k, v) VALUES (2, 'y')")
+    assert len(s.execute("SELECT k FROM audit_log").rows) == 1
+    eng.close()
+
+
+def test_trigger_requires_installed_file(tmp_path):
+    """DDL cannot ship code: USING must name a file the operator
+    already placed in <data_dir>/triggers (conf/triggers role)."""
+    eng = _engine(tmp_path)
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    with pytest.raises(InvalidRequest, match="not installed"):
+        s.execute("CREATE TRIGGER t ON kv USING 'ghost:fn'")
+    with pytest.raises(InvalidRequest):
+        s.execute("CREATE TRIGGER t ON kv USING '../evil:fn'")
+    eng.close()
+
+
+def test_trigger_failure_aborts_statement(tmp_path):
+    """Augmentation failure fails the write BEFORE the base mutation
+    applies (TriggerExecutor: exceptions propagate to the client)."""
+    eng = _engine(tmp_path)
+    _install(eng)
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    s.execute("CREATE TRIGGER t ON kv USING 'auditmod:boom'")
+    from cassandra_tpu.service.triggers import TriggerError
+    with pytest.raises(TriggerError):
+        s.execute("INSERT INTO kv (k, v) VALUES (5, 'x')")
+    assert s.execute("SELECT k FROM kv").rows == []
+    eng.close()
+
+
+def test_trigger_persists_across_restart(tmp_path):
+    eng = _engine(tmp_path)
+    _install(eng)
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    s.execute("CREATE TABLE audit_log (k int PRIMARY KEY, n int)")
+    s.execute("CREATE TRIGGER aud ON kv USING 'auditmod:audit'")
+    eng.close()
+
+    eng2 = _engine(tmp_path)
+    s2 = Session(eng2, keyspace="ks")
+    s2.execute("INSERT INTO kv (k, v) VALUES (9, 'z')")
+    assert [r[0] for r in s2.execute("SELECT k FROM audit_log").rows] \
+        == [9]
+    # duplicate name rejected; IF NOT EXISTS tolerated
+    with pytest.raises(InvalidRequest):
+        s2.execute("CREATE TRIGGER aud ON kv USING 'auditmod:audit'")
+    s2.execute("CREATE TRIGGER IF NOT EXISTS aud ON kv "
+               "USING 'auditmod:audit'")
+    eng2.close()
+
+
+def test_trigger_in_logged_batch(tmp_path):
+    """A logged batch journals trigger output with the base writes."""
+    eng = _engine(tmp_path)
+    _install(eng)
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    s.execute("CREATE TABLE audit_log (k int PRIMARY KEY, n int)")
+    s.execute("CREATE TRIGGER aud ON kv USING 'auditmod:audit'")
+    s.execute("BEGIN BATCH "
+              "INSERT INTO kv (k, v) VALUES (1, 'a'); "
+              "INSERT INTO kv (k, v) VALUES (2, 'b'); "
+              "APPLY BATCH")
+    assert sorted(r[0] for r in
+                  s.execute("SELECT k FROM audit_log").rows) == [1, 2]
+    eng.close()
+
+
+def test_trigger_column_name_still_parses(tmp_path):
+    """'trigger' stays an UNRESERVED keyword: schemas that used it as
+    an identifier keep parsing (their schema-log DDL must replay)."""
+    eng = _engine(tmp_path)
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE evt (trigger text PRIMARY KEY, n int)")
+    s.execute("INSERT INTO evt (trigger, n) VALUES ('go', 1)")
+    assert s.execute("SELECT trigger, n FROM evt").rows == [("go", 1)]
+    eng.close()
+
+
+def test_trigger_gone_from_recreated_keyspace(tmp_path):
+    eng = _engine(tmp_path)
+    _install(eng)
+    s = Session(eng)
+    for _round in range(2):
+        s.execute("CREATE KEYSPACE ks WITH replication = "
+                  "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+        s.execute("USE ks")
+        s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+        s.execute("CREATE TABLE audit_log (k int PRIMARY KEY, n int)")
+        if _round == 0:
+            s.execute("CREATE TRIGGER aud ON kv USING 'auditmod:audit'")
+            s.execute("DROP KEYSPACE ks")
+    # recreated keyspace has NO trigger: writes are not augmented
+    s.execute("INSERT INTO kv (k, v) VALUES (1, 'x')")
+    assert s.execute("SELECT k FROM audit_log").rows == []
+    eng.close()
+
+
+def test_missing_file_after_restart_fails_writes_visibly(tmp_path):
+    """If the trigger file disappears, the trigger comes back BROKEN:
+    writes fail with a clear error instead of silently skipping
+    augmentation (reference: missing ITrigger class fails the write)."""
+    from cassandra_tpu.service.triggers import TriggerError
+    eng = _engine(tmp_path)
+    _install(eng)
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    s.execute("CREATE TABLE audit_log (k int PRIMARY KEY, n int)")
+    s.execute("CREATE TRIGGER aud ON kv USING 'auditmod:audit'")
+    eng.close()
+    os.remove(os.path.join(str(tmp_path / "d"), "triggers",
+                           "auditmod.py"))
+    eng2 = _engine(tmp_path)
+    s2 = Session(eng2, keyspace="ks")
+    with pytest.raises(TriggerError, match="unusable"):
+        s2.execute("INSERT INTO kv (k, v) VALUES (1, 'x')")
+    s2.execute("DROP TRIGGER aud ON kv")        # operator clears it
+    s2.execute("INSERT INTO kv (k, v) VALUES (1, 'x')")
+    assert s2.execute("SELECT k FROM kv").rows == [(1,)]
+    eng2.close()
+
+
+def test_trigger_ddl_respects_auth(tmp_path):
+    from cassandra_tpu.service.auth import UnauthorizedError
+    eng = StorageEngine(str(tmp_path / "auth"), Schema(),
+                        commitlog_sync="batch", auth_enabled=True)
+    _install(eng)
+    s = Session(eng, user="cassandra", password="cassandra")
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    s.execute("CREATE ROLE peon WITH PASSWORD = 'x' AND LOGIN = true")
+    s2 = Session(eng, keyspace="ks", user="peon", password="x")
+    with pytest.raises(UnauthorizedError):
+        s2.execute("CREATE TRIGGER t ON kv USING 'auditmod:audit'")
+    eng.close()
