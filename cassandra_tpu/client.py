@@ -51,9 +51,18 @@ _DECODERS = {
 
 class ClientSession:
     def __init__(self, host: str, port: int, user: str | None = None,
-                 password: str | None = None):
+                 password: str | None = None, tls: bool = False,
+                 cafile: str | None = None, certfile: str | None = None,
+                 keyfile: str | None = None):
+        """tls=True (or any of cafile/certfile) speaks TLS: the server
+        is verified against `cafile` when given, and `certfile`/
+        `keyfile` are presented when the server demands client certs."""
         self._sock = socket.create_connection((host, port), timeout=10.0)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if tls or cafile or certfile:
+            from .cluster.tls import client_side_context
+            self._sock = client_side_context(
+                cafile, certfile, keyfile).wrap_socket(self._sock)
         self._stream = 0
         self._lock = threading.Lock()
         op, body = self._request(ts.OP_STARTUP,
@@ -218,13 +227,19 @@ class ClientSession:
 
 class Cluster:
     def __init__(self, host: str = "127.0.0.1", port: int = 9042,
-                 user: str | None = None, password: str | None = None):
+                 user: str | None = None, password: str | None = None,
+                 tls: bool = False, cafile: str | None = None,
+                 certfile: str | None = None, keyfile: str | None = None):
         self.host, self.port = host, port
         self.user, self.password = user, password
+        self.tls, self.cafile = tls, cafile
+        self.certfile, self.keyfile = certfile, keyfile
 
     def connect(self) -> ClientSession:
         return ClientSession(self.host, self.port, self.user,
-                             self.password)
+                             self.password, tls=self.tls,
+                             cafile=self.cafile, certfile=self.certfile,
+                             keyfile=self.keyfile)
 
 
 def serialize_params(table, columns: list[str], values: list) -> list:

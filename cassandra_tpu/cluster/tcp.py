@@ -12,10 +12,10 @@ Protocol:
 
 Failure model: a send to an unreachable/broken peer drops the frame and
 tears down the cached connection — callers' callback timeouts drive
-retries/hints exactly as with dropped packets. Inbound connections are
-accepted from anyone who completes the handshake (cluster-internal
-network; TLS/auth is a listed gap in SURVEY terms, like the reference's
-optional internode TLS).
+retries/hints exactly as with dropped packets. With a TLSConfig, every
+internode connection is mutual TLS against the cluster CA (reference
+server_encryption_options); without one, inbound connections are
+accepted from anyone who completes the handshake (trusted network).
 """
 from __future__ import annotations
 
@@ -77,13 +77,20 @@ class TcpTransport:
     the listen socket at the endpoint's (host, port); deliver() sends
     through a per-peer pooled connection, dialing on demand."""
 
-    def __init__(self):
+    def __init__(self, tls=None):
+        """tls: a cluster.tls.TLSConfig — when set, every internode
+        connection is mutual TLS against the cluster CA (reference
+        server_encryption_options internode_encryption: all); plaintext
+        dials are rejected at handshake."""
         self.filters = MessageFilters()
         self._svc = None
         self._listen: socket.socket | None = None
         self._out: dict[Endpoint, _Conn] = {}
         self._lock = threading.Lock()
         self._closed = False
+        self.tls = tls
+        self._srv_ctx = tls.server_context() if tls else None
+        self._cli_ctx = tls.client_context() if tls else None
 
     # ---------------------------------------------------------- lifecycle --
 
@@ -144,6 +151,13 @@ class TcpTransport:
         try:
             sock = socket.create_connection((to.host, to.port), timeout=2.0)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self._cli_ctx is not None:
+                import ssl
+                try:
+                    sock = self._cli_ctx.wrap_socket(sock)
+                except (ssl.SSLError, OSError):
+                    sock.close()
+                    return None
             blob = bytearray()
             wire._enc(self._ep, blob)
             sock.sendall(_MAGIC + struct.pack("<II", zlib.crc32(bytes(blob)),
@@ -171,6 +185,17 @@ class TcpTransport:
                              daemon=True).start()
 
     def _serve_conn(self, sock: socket.socket) -> None:
+        if self._srv_ctx is not None:
+            import ssl
+            try:
+                sock = self._srv_ctx.wrap_socket(sock, server_side=True)
+            except (ssl.SSLError, OSError):
+                # plaintext or untrusted-cert dial: refuse silently
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
         try:
             magic = _read_exact(sock, len(_MAGIC))
             if magic != _MAGIC:

@@ -14,6 +14,9 @@ Config:
              "dc": "dc1", "rack": "rack1", "tokens": [...]}, ...],
   "seeds": ["node1"],
   "gossip_interval": 0.2,
+  "server_tls":  {"certfile": ..., "keyfile": ..., "cafile": ...},
+  "native_tls":  {"certfile": ..., "keyfile": ..., "cafile": ...,
+                  "require_client_auth": false},
   "ddl": ["CREATE KEYSPACE ks WITH ...",
           "CREATE TABLE ks.t (...) WITH id = <uuid>"]
 }
@@ -40,6 +43,7 @@ def build_node(cfg: dict):
     from ..cluster.tcp import TcpTransport
     from ..schema import Schema
 
+    from ..cluster.tls import TLSConfig
     me = Endpoint(cfg["name"], cfg.get("dc", "dc1"),
                   cfg.get("rack", "rack1"), cfg.get("host", "127.0.0.1"),
                   int(cfg["port"]))
@@ -53,7 +57,9 @@ def build_node(cfg: dict):
         ring.add_node(ep, [int(t) for t in p["tokens"]])
     seeds = [peers[n] for n in cfg.get("seeds", []) if n in peers] or [me]
 
-    transport = TcpTransport()
+    # "server_tls": internode mutual TLS (server_encryption_options)
+    transport = TcpTransport(
+        tls=TLSConfig.from_dict(cfg.get("server_tls")))
     node = Node(me, cfg["data_dir"], Schema(), ring, transport,
                 seeds=seeds,
                 gossip_interval=float(cfg.get("gossip_interval", 0.2)))
@@ -106,9 +112,12 @@ def main(argv=None) -> int:
     native = None
     if cfg.get("native_port") is not None:
         # client-facing CQL native protocol endpoint (port 9042 role)
+        from ..cluster.tls import TLSConfig
         from ..transport_server import CQLServer
+        # "native_tls": client_encryption_options role
         native = CQLServer(node, cfg.get("host", "127.0.0.1"),
-                           int(cfg["native_port"]))
+                           int(cfg["native_port"]),
+                           tls=TLSConfig.from_dict(cfg.get("native_tls")))
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())

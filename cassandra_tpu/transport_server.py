@@ -162,8 +162,13 @@ class CQLServer:
     """Threaded native-protocol endpoint over a backend (StorageEngine or
     cluster Node) — transport/Server.java role."""
 
-    def __init__(self, backend, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, backend, host: str = "127.0.0.1", port: int = 0,
+                 tls=None):
+        """tls: a cluster.tls.TLSConfig — client_encryption_options
+        role: connections are TLS, with client certs demanded only when
+        the config sets require_client_auth."""
         self.backend = backend
+        self._tls_ctx = tls.server_context() if tls else None
         # ONE processor for the whole server: prepared-statement ids are
         # server-global like the reference's (drivers prepare on one
         # connection and execute on another); keyspace/user stay
@@ -193,8 +198,23 @@ class CQLServer:
                 sock, _ = self._listen.accept()
             except OSError:
                 return
-            threading.Thread(target=self._serve, args=(sock,),
+            threading.Thread(target=self._serve_raw, args=(sock,),
                              daemon=True).start()
+
+    def _serve_raw(self, sock) -> None:
+        # TLS handshake happens on the per-connection thread — a slow
+        # or plaintext client must not stall the accept loop
+        if self._tls_ctx is not None:
+            import ssl
+            try:
+                sock = self._tls_ctx.wrap_socket(sock, server_side=True)
+            except (ssl.SSLError, OSError):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
+        self._serve(sock)
 
     @staticmethod
     def _read_exact(sock, n: int) -> bytes | None:
