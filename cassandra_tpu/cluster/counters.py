@@ -39,10 +39,18 @@ from .messaging import Verb
 
 class CounterService:
     STRIPES = 64
+    CACHE_MAX = 65536   # own-shard entries (counter cache role)
 
     def __init__(self, node):
         self.node = node
         self._locks = [threading.Lock() for _ in range(self.STRIPES)]
+        # counter cache (cache/CounterCacheKey role): this node's OWN
+        # shard per touched counter cell. Coherent because only
+        # apply_as_leader writes our shard, serialized by the stripe
+        # locks — without it every increment pays a full partition read.
+        self._cache: dict[tuple, tuple[int, int]] = {}
+        self._cache_lock = threading.Lock()
+        self._cache_epoch = 0   # bumped by invalidate_table (truncate)
         # the counter write stage: leader-side work blocks on the
         # replication CL, so it must NEVER run on the messaging
         # dispatch thread (the acks it waits for arrive there)
@@ -56,6 +64,17 @@ class CounterService:
     def _lock_for(self, pk: bytes) -> threading.Lock:
         return self._locks[zlib.crc32(pk) % self.STRIPES]
 
+    def invalidate_table(self, table_id) -> None:
+        """TRUNCATE/DROP: cached shard totals for the table must not
+        survive (they would resurrect pre-truncate counts). The epoch
+        bump makes an in-flight apply_as_leader discard its pending
+        cache insert — its shard was computed against pre-truncate
+        state."""
+        with self._cache_lock:
+            self._cache_epoch += 1
+            for k in [k for k in self._cache if k[0] == table_id]:
+                del self._cache[k]
+
     # ------------------------------------------------------------ leader --
 
     def apply_as_leader(self, keyspace: str, mutation: Mutation,
@@ -67,7 +86,7 @@ class CounterService:
         cfs = self.node.engine.store(t.keyspace, t.name)
         shard_path = self.node.endpoint.name.encode()
         with self._lock_for(mutation.pk):
-            current = cfs.read_partition(mutation.pk)
+            current = None        # partition read only on cache miss
             shard_m = Mutation(mutation.table_id, mutation.pk)
             now = timeutil.now_micros()
             deltas: dict[tuple, int] = {}
@@ -80,14 +99,41 @@ class CounterService:
                 else:
                     shard_m.add(ck, column, path, value, ts, ldt, ttl,
                                 flags)
+            new_cache = {}
+            with self._cache_lock:
+                epoch0 = self._cache_epoch
             for (ck, column), delta in deltas.items():
-                old_sum, old_ts = self._own_shard(current, ck, column,
-                                                  shard_path)
+                ckey = (mutation.table_id, mutation.pk, ck, column)
+                with self._cache_lock:
+                    hit = self._cache.get(ckey)
+                if hit is None:
+                    if current is None:
+                        current = cfs.read_partition(mutation.pk)
+                    hit = self._own_shard(current, ck, column,
+                                          shard_path)
+                old_sum, old_ts = hit
+                ts = max(now, old_ts + 1)
                 shard_m.add(ck, column, shard_path,
                             (old_sum + delta).to_bytes(8, "big",
-                                                       signed=True),
-                            max(now, old_ts + 1))
-            self.node.proxy.mutate(t.keyspace, shard_m, cl)
+                                                       signed=True), ts)
+                new_cache[ckey] = (old_sum + delta, ts)
+            try:
+                self.node.proxy.mutate(t.keyspace, shard_m, cl)
+            except Exception:
+                # the shard may have applied to SOME replicas (e.g. a
+                # timeout after the local write): stale cache entries
+                # would roll those shards backwards on the next
+                # increment — evict so it re-reads local truth
+                with self._cache_lock:
+                    for ckey in new_cache:
+                        self._cache.pop(ckey, None)
+                raise
+            with self._cache_lock:
+                if self._cache_epoch != epoch0:
+                    return   # truncated mid-flight: don't resurrect
+                if len(self._cache) + len(new_cache) > self.CACHE_MAX:
+                    self._cache.clear()
+                self._cache.update(new_cache)
 
     @staticmethod
     def _own_shard(batch, ck: bytes, column: int,

@@ -101,7 +101,9 @@ class Node:
 
     def _handle_truncate(self, msg):
         keyspace, table_name = msg.payload
-        self.engine.store(keyspace, table_name).truncate()
+        store = self.engine.store(keyspace, table_name)
+        store.truncate()
+        self.counters.invalidate_table(store.table.id)
         return Verb.TRUNCATE_RSP, b""
 
     # ---------------------------------------------------------- liveness --
@@ -149,6 +151,10 @@ class Node:
     def triggers(self):
         return getattr(self.engine, "triggers", None)
 
+    @property
+    def monitor(self):
+        return getattr(self.engine, "monitor", None)
+
     def apply(self, mutation: Mutation, durable: bool = True) -> None:
         t = self.schema.table_by_id(mutation.table_id)
         if t is None:
@@ -177,6 +183,7 @@ class Node:
             cfs = node.engine.stores.pop(t.id, None)
             if cfs:
                 cfs.truncate()
+            node.counters.invalidate_table(t.id)
         self.schema.drop_table(keyspace, name)
 
     cluster_nodes: list = ()
@@ -313,7 +320,9 @@ class _DistributedStore:
     def truncate(self):
         for ep in list(self.node.ring.endpoints):
             if ep == self.node.endpoint:
-                self.node.engine.store(self.keyspace, self.name).truncate()
+                store = self.node.engine.store(self.keyspace, self.name)
+                store.truncate()
+                self.node.counters.invalidate_table(store.table.id)
             else:
                 self.node.messaging.send_one_way(
                     Verb.TRUNCATE_REQ, (self.keyspace, self.name), ep)

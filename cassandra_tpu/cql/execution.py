@@ -767,6 +767,14 @@ class Executor:
             p.default_ttl = int(options["default_time_to_live"])
         if "comment" in options:
             p.comment = str(options["comment"])
+        if "caching" in options:
+            c = dict(options["caching"])
+            rpp = str(c.get("rows_per_partition", "NONE")).upper()
+            if rpp not in ("NONE", "ALL"):
+                raise InvalidRequest(
+                    "caching rows_per_partition must be NONE or ALL")
+            p.caching = {"keys": str(c.get("keys", "ALL")).upper(),
+                         "rows_per_partition": rpp}
         return p
 
     def _exec_CreateTypeStatement(self, s, params, keyspace, now):
@@ -908,6 +916,20 @@ class Executor:
                 t.params.gc_grace_seconds = p.gc_grace_seconds
             if "default_time_to_live" in s.options:
                 t.params.default_ttl = p.default_ttl
+            if "caching" in s.options:
+                t.params.caching = p.caching
+                # rebuild the LIVE store's row cache to match (the
+                # engine's store, not a cluster read facade)
+                from ..storage.table import RowCache
+                eng = getattr(self.backend, "engine", self.backend)
+                try:
+                    cfs = eng.store(t.keyspace, t.name)
+                except KeyError:
+                    cfs = None
+                if cfs is not None and hasattr(cfs, "row_cache"):
+                    cfs.row_cache = RowCache() if \
+                        p.caching.get("rows_per_partition") != "NONE" \
+                        else None
         self.schema._changed()
         return ResultSet([], [])
 

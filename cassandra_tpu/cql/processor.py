@@ -77,6 +77,8 @@ class QueryProcessor:
                 keyspace: str | None = None,
                 user: str | None = None, page_size: int | None = None,
                 paging_state: bytes | None = None) -> ResultSet:
+        import time as time_mod
+
         from ..service.metrics import GLOBAL
         stmt = parse(query)
         kind = type(stmt).__name__.removesuffix("Statement").lower()
@@ -85,17 +87,25 @@ class QueryProcessor:
         if audit is not None:
             audit.log(type(stmt).__name__, query, user, keyspace,
                       params=params)
-        sync = self._ddl_sync_for(stmt)
-        if sync is not None:
+        t0 = time_mod.perf_counter()
+        try:
+            sync = self._ddl_sync_for(stmt)
+            if sync is not None:
+                with GLOBAL.timer("cql.request"):
+                    return sync.coordinate(
+                        query, keyspace, stmt,
+                        lambda: self.executor.execute(
+                            stmt, params, keyspace, user=user))
             with GLOBAL.timer("cql.request"):
-                return sync.coordinate(
-                    query, keyspace, stmt,
-                    lambda: self.executor.execute(
-                        stmt, params, keyspace, user=user))
-        with GLOBAL.timer("cql.request"):
-            return self.executor.execute(stmt, params, keyspace, user=user,
-                                         page_size=page_size,
-                                         paging_state=paging_state)
+                return self.executor.execute(stmt, params, keyspace,
+                                             user=user,
+                                             page_size=page_size,
+                                             paging_state=paging_state)
+        finally:
+            mon = getattr(self.executor.backend, "monitor", None)
+            if mon is not None:
+                mon.record(query, time_mod.perf_counter() - t0,
+                           keyspace)
 
 
 class Session:

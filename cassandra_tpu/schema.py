@@ -49,6 +49,9 @@ class TableParams:
     memtable_flush_period_ms: int = 0
     comment: str = ""
     cdc: bool = False       # change data capture stream (storage/cdc.py)
+    # row cache (cache/RowCache role): 'NONE' | 'ALL' rows per partition
+    caching: dict = field(default_factory=lambda: {
+        "keys": "ALL", "rows_per_partition": "NONE"})
     # TPU-format knob: bytes of clustering prefix carried in key lanes
     clustering_prefix_bytes: int = 16
 
@@ -300,6 +303,7 @@ def table_to_dict(t: TableMetadata) -> dict:
             "comment": t.params.comment,
             "clustering_prefix_bytes": t.params.clustering_prefix_bytes,
             "cdc": t.params.cdc,
+            "caching": t.params.caching,
         },
     }
 
@@ -313,7 +317,9 @@ def table_from_dict(d: dict, udts: dict | None = None) -> TableMetadata:
         default_ttl=int(p["default_ttl"]),
         comment=p.get("comment", ""),
         clustering_prefix_bytes=int(p.get("clustering_prefix_bytes", 16)),
-        cdc=bool(p.get("cdc", False)))
+        cdc=bool(p.get("cdc", False)),
+        caching=dict(p.get("caching") or
+                     {"keys": "ALL", "rows_per_partition": "NONE"}))
     t = TableMetadata(
         d["keyspace"], d["name"],
         [(n, parse_type(ts, udts)) for n, ts in d["partition_key"]],

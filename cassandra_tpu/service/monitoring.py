@@ -1,0 +1,44 @@
+"""Slow-query reporting (db/monitoring role).
+
+Reference counterpart: db/monitoring/MonitoringTask.java — operations
+exceeding slow_query_log_timeout are collected and periodically
+reported. Here the QueryProcessor times every statement; anything over
+the threshold lands in a bounded ring surfaced through the
+`system_views.slow_queries` virtual table and the
+`cql.slow_queries` metric. Threshold is mutable at runtime
+(nodetool setslowquerythreshold role)."""
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from ..utils import timeutil
+
+
+class QueryMonitor:
+    def __init__(self, threshold_ms: float = 500.0, capacity: int = 100):
+        self.threshold_ms = threshold_ms
+        self._entries: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._ids = 0
+
+    def record(self, query: str, seconds: float,
+               keyspace: str | None = None) -> None:
+        ms = seconds * 1000.0
+        if ms < self.threshold_ms:
+            return
+        from .metrics import GLOBAL
+        GLOBAL.incr("cql.slow_queries")
+        with self._lock:
+            self._ids += 1
+            self._entries.append({
+                "id": self._ids,
+                "query": query[:500],
+                "keyspace": keyspace,
+                "duration_ms": round(ms, 3),
+                "at": timeutil.now_micros() // 1000,
+            })
+
+    def entries(self) -> list[dict]:
+        with self._lock:
+            return list(self._entries)

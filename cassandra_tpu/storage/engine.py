@@ -65,6 +65,8 @@ class StorageEngine:
         self.auth = AuthService(data_dir, enabled=auth_enabled)
         from .guardrails import Guardrails
         self.guardrails = Guardrails()
+        from ..service.monitoring import QueryMonitor
+        self.monitor = QueryMonitor()
 
     @property
     def _schema_path(self) -> str:

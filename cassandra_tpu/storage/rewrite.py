@@ -47,6 +47,11 @@ def rewrite_sstable(cfs, sst, parts) -> list:
         txn.commit()
         cfs.tracker.replace([sst], new_readers)
         sst.release()
+        if cfs.row_cache is not None:
+            # cleanup/scrub/anticompaction CHANGE logical content (drop
+            # foreign ranges / corrupt rows / restamp) — cached merges
+            # of the replaced sstable must go
+            cfs.row_cache.clear()
         return new_readers
     except BaseException:
         for w in writers:

@@ -45,6 +45,66 @@ class Tracker:
                                    key=lambda r: r.desc.generation)
 
 
+class RowCache:
+    """Partition-level row cache (cache/RowCache + RowCacheKey role):
+    caches the MERGED partition at the replica, invalidated on write to
+    the key and on truncate. Flush/compaction never invalidate — they
+    preserve logical content. Partitions holding TTL cells are never
+    cached: their liveness depends on the read clock. Enabled per table
+    via `WITH caching = {'rows_per_partition': 'ALL'}`."""
+
+    def __init__(self, capacity: int = 1024):
+        from collections import OrderedDict
+        self.capacity = capacity
+        self._d: "OrderedDict[bytes, CellBatch]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        # bumped by every invalidation. A reader captures it BEFORE
+        # snapshotting its sources and put() refuses the entry if it
+        # moved — otherwise a read racing a write could re-cache its
+        # pre-write merge AFTER the writer's invalidate and serve stale
+        # data forever (the reference row cache's sentinel protocol)
+        self.generation = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
+
+    def get(self, pk: bytes):
+        with self._lock:
+            batch = self._d.get(pk)
+            if batch is None:
+                self.misses += 1
+                return None
+            self._d.move_to_end(pk)
+            self.hits += 1
+            return batch
+
+    def put(self, pk: bytes, batch: CellBatch,
+            read_generation: int) -> None:
+        from .cellbatch import FLAG_EXPIRING
+        if len(batch) and (batch.flags & FLAG_EXPIRING).any():
+            return
+        with self._lock:
+            if self.generation != read_generation:
+                return    # an invalidation raced this read: don't cache
+            self._d[pk] = batch
+            self._d.move_to_end(pk)
+            while len(self._d) > self.capacity:
+                self._d.popitem(last=False)
+
+    def invalidate(self, pk: bytes) -> None:
+        with self._lock:
+            self.generation += 1
+            self._d.pop(pk, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self.generation += 1
+            self._d.clear()
+
+
 class ColumnFamilyStore:
     DEFAULT_FLUSH_THRESHOLD = 64 * 1024 * 1024  # bytes of live memtable data
 
@@ -69,6 +129,8 @@ class ColumnFamilyStore:
             self.tracker.add(SSTableReader(desc, self.table))
         self.compaction_listener = None  # set by CompactionManager
         self.compaction_history: list[dict] = []
+        self.row_cache = RowCache() if table.params.caching.get(
+            "rows_per_partition", "NONE") != "NONE" else None
         self._gen_lock = threading.Lock()
         self._last_gen = max(
             [d.generation for d in Descriptor.list_in(self.directory)],
@@ -86,6 +148,8 @@ class ColumnFamilyStore:
                 if desc.generation not in known:
                     self.tracker.add(SSTableReader(desc, self.table))
                     self._last_gen = max(self._last_gen, desc.generation)
+        if self.row_cache is not None:
+            self.row_cache.clear()   # bulk-loaded data changes content
 
     def next_generation(self) -> int:
         """Race-free generation allocation shared by flush + compaction
@@ -111,6 +175,8 @@ class ColumnFamilyStore:
                 commitlog.add(mutation)
             self.memtable.apply(mutation)
             self.metrics["writes"] += 1
+        if self.row_cache is not None:
+            self.row_cache.invalidate(mutation.pk)
 
     def should_flush(self) -> bool:
         return self.memtable.live_bytes >= self.flush_threshold
@@ -158,6 +224,15 @@ class ColumnFamilyStore:
         self.metrics["reads"] += 1
         from ..service.tracing import active, trace
         now = now if now is not None else timeutil.now_seconds()
+        read_gen = None
+        if self.row_cache is not None:
+            cached = self.row_cache.get(pk)
+            if cached is not None:
+                if active() is not None:
+                    trace("Row cache hit")
+                return cached
+            # captured BEFORE the source snapshot (see RowCache.put)
+            read_gen = self.row_cache.generation
         sources = []
         with self._switch_lock:
             mem = self.memtable
@@ -172,8 +247,12 @@ class ColumnFamilyStore:
             trace(f"Merging {len(sources)} source(s) for partition read")
         if not sources:
             from .cellbatch import lanes_for_table
-            return CellBatch.empty(lanes_for_table(self.table))
-        return merge_sorted(sources, now=now)
+            merged = CellBatch.empty(lanes_for_table(self.table))
+        else:
+            merged = merge_sorted(sources, now=now)
+        if self.row_cache is not None:
+            self.row_cache.put(pk, merged, read_gen)
+        return merged
 
     def scan_all(self, now: int | None = None) -> CellBatch:
         """Full-table merged view (range-read building block; small data)."""
@@ -246,6 +325,8 @@ class ColumnFamilyStore:
         return self.tracker.view()
 
     def truncate(self) -> None:
+        if self.row_cache is not None:
+            self.row_cache.clear()
         with self._switch_lock:
             self.memtable = Memtable(self.table)
             old = self.tracker.view()
@@ -261,3 +342,7 @@ class ColumnFamilyStore:
                 for fn in os.listdir(self.directory):
                     if fn.startswith(prefix):
                         os.remove(os.path.join(self.directory, fn))
+        if self.row_cache is not None:
+            # again AFTER the switch: a read that raced the truncate
+            # may have re-cached pre-truncate content
+            self.row_cache.clear()

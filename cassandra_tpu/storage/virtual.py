@@ -97,6 +97,19 @@ def build_engine_virtuals(engine) -> VirtualSchema:
                 yield {"name": f"{base}.{k}", "value": float(v)}
     vs.register(VirtualTable(t_metrics, metric_rows))
 
+    t_slow = make_table("system_views", "slow_queries", pk=["id"],
+                        cols={"id": "int", "query": "text",
+                              "keyspace_name": "text",
+                              "duration_ms": "double", "at": "bigint"})
+
+    def slow_rows():
+        mon = getattr(engine, "monitor", None)
+        for e in (mon.entries() if mon else []):
+            yield {"id": e["id"], "query": e["query"],
+                   "keyspace_name": e["keyspace"],
+                   "duration_ms": e["duration_ms"], "at": e["at"]}
+    vs.register(VirtualTable(t_slow, slow_rows))
+
     return vs
 
 

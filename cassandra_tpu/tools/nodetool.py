@@ -90,6 +90,10 @@ def tablestats(engine, keyspace: str | None = None) -> dict:
             "reads": cfs.metrics["reads"],
             "writes": cfs.metrics["writes"],
             "flushes": cfs.metrics["flushes"],
+            "row_cache": (None if cfs.row_cache is None
+                          else {"hits": cfs.row_cache.hits,
+                                "misses": cfs.row_cache.misses,
+                                "entries": len(cfs.row_cache)}),
         }
     return out
 
@@ -235,6 +239,90 @@ def getcompactionthroughput(engine) -> dict:
     """nodetool getcompactionthroughput."""
     return {"compaction_throughput_mib":
             int(engine.compactions.limiter.rate // 2**20)}
+
+
+def setslowquerythreshold(engine, ms: float) -> dict:
+    """slow_query_log_timeout_in_ms knob (db/monitoring role)."""
+    engine.monitor.threshold_ms = float(ms)
+    return {"slow_query_threshold_ms": float(ms)}
+
+
+def upgradesstables(engine, keyspace: str | None = None,
+                    table: str | None = None) -> list[dict]:
+    """nodetool upgradesstables: rewrite every sstable in the current
+    format (compaction/Upgrader role — after a format revision, old
+    generations are re-serialized through the current writer)."""
+    from ..storage.rewrite import rewrite_sstable
+    out = []
+    for cfs in list(engine.stores.values()):
+        if keyspace and cfs.table.keyspace != keyspace:
+            continue
+        if table and cfs.table.name != table:
+            continue
+        with engine.compactions.cfs_lock(cfs):
+            for sst in list(cfs.live_sstables()):
+                def fill(w, sst=sst):
+                    for i in range(sst.n_segments):
+                        w.append(sst._read_segment(i))
+
+                new = rewrite_sstable(
+                    cfs, sst, [(sst.repaired_at, sst.level, fill)])
+                out.append({"table": cfs.table.full_name(),
+                            "from_generation": sst.desc.generation,
+                            "to_generation":
+                                new[0].desc.generation if new else None})
+    return out
+
+
+def sstablesplit(engine, keyspace: str, table: str,
+                 target_mib: int = 50) -> list[dict]:
+    """SSTableSplitter role: carve an oversized sstable into ~target
+    sized outputs, split at partition boundaries."""
+    import numpy as np
+
+    from ..storage.cellbatch import CellBatch
+    from ..storage.rewrite import rewrite_sstable
+    cfs = engine.store(keyspace, table)
+    target = max(1, target_mib * 2**20)
+    out = []
+    with engine.compactions.cfs_lock(cfs):
+        for sst in list(cfs.live_sstables()):
+            if sst.data_size <= target:
+                continue
+            n_parts = min(64, max(2, -(-sst.data_size // target)))
+            segs = list(sst.scanner())
+            if not segs:
+                continue
+            cat = CellBatch.concat(segs)
+            cat.sorted = True
+            # partition boundaries: first cell of each partition (the
+            # token+pkh lanes change)
+            keys = cat.lanes[:, 0].astype(np.uint64) << np.uint64(32) \
+                | cat.lanes[:, 1]
+            starts = np.flatnonzero(np.diff(keys) != 0) + 1
+            cuts = [0]
+            for p in range(1, n_parts):
+                want = p * len(cat) // n_parts
+                j = int(np.searchsorted(starts, want))
+                cut = int(starts[j]) if j < len(starts) else len(cat)
+                if cut > cuts[-1]:
+                    cuts.append(cut)
+            cuts.append(len(cat))
+
+            def fill_for(lo, hi, cat=cat):
+                def fill(w):
+                    part = cat.slice_range(lo, hi)
+                    part.sorted = True
+                    w.append(part)
+                return fill
+
+            parts = [(sst.repaired_at, sst.level, fill_for(lo, hi))
+                     for lo, hi in zip(cuts, cuts[1:]) if hi > lo]
+            new = rewrite_sstable(cfs, sst, parts)
+            out.append({"table": cfs.table.full_name(),
+                        "generation": sst.desc.generation,
+                        "outputs": [r.desc.generation for r in new]})
+    return out
 
 
 def ring(node) -> list[dict]:

@@ -632,3 +632,22 @@ def test_counter_hinted_shard_converges(cluster):
         time.sleep(0.1)
     assert got == 10
     assert not n1.hints.has_hints(victim.endpoint)
+
+
+def test_counter_cache_and_truncate(cluster):
+    """The leader's counter cache makes repeat increments skip the
+    partition read but must never survive TRUNCATE."""
+    s = cluster.session(1)
+    s.keyspace = "ks"
+    for n in cluster.nodes:
+        n.default_cl = ConsistencyLevel.ALL
+    s.execute("CREATE TABLE cc (k int PRIMARY KEY, hits counter)")
+    for _ in range(10):
+        s.execute("UPDATE cc SET hits = hits + 1 WHERE k = 3")
+    assert s.execute("SELECT hits FROM cc WHERE k = 3").rows == [(10,)]
+    n1 = cluster.node(1)
+    assert len(n1.counters._cache) > 0        # warmed
+    s.execute("TRUNCATE cc")
+    assert len(n1.counters._cache) == 0       # invalidated
+    s.execute("UPDATE cc SET hits = hits + 5 WHERE k = 3")
+    assert s.execute("SELECT hits FROM cc WHERE k = 3").rows == [(5,)]

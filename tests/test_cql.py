@@ -497,3 +497,64 @@ def test_counter_batch_rules(session):
                     "APPLY BATCH")
     assert session.execute("SELECT hits FROM cb WHERE k = 1").rows \
         == [(3,)]
+
+
+def test_row_cache(tmp_path):
+    """WITH caching = {'rows_per_partition': 'ALL'}: repeat reads hit
+    the cached merged partition; any write to the key invalidates;
+    TTL'd partitions are never cached (liveness is clock-dependent)."""
+    eng = StorageEngine(str(tmp_path / "rc"), Schema(),
+                        commitlog_sync="batch")
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE kv (k int, c int, v text, "
+              "PRIMARY KEY (k, c)) WITH caching = "
+              "{'keys': 'ALL', 'rows_per_partition': 'ALL'}")
+    cfs = eng.store("ks", "kv")
+    assert cfs.row_cache is not None
+    for c in range(5):
+        s.execute(f"INSERT INTO kv (k, c, v) VALUES (1, {c}, 'x{c}')")
+    cfs.flush()
+    assert len(s.execute("SELECT c FROM kv WHERE k = 1").rows) == 5
+    h0 = cfs.row_cache.hits
+    assert len(s.execute("SELECT c FROM kv WHERE k = 1").rows) == 5
+    assert cfs.row_cache.hits > h0                     # served cached
+    # write invalidates, next read sees the new row
+    s.execute("INSERT INTO kv (k, c, v) VALUES (1, 9, 'new')")
+    assert len(s.execute("SELECT c FROM kv WHERE k = 1").rows) == 6
+    # TTL rows: never cached
+    s.execute("INSERT INTO kv (k, c, v) VALUES (2, 0, 't') USING TTL 60")
+    s.execute("SELECT c FROM kv WHERE k = 2")
+    t = eng.schema.get_table("ks", "kv")
+    pk2 = t.columns["k"].cql_type.serialize(2)
+    assert cfs.row_cache.get(pk2) is None
+    # TRUNCATE clears
+    s.execute("TRUNCATE kv")
+    assert len(cfs.row_cache) == 0
+    # default tables: no row cache
+    s.execute("CREATE TABLE plain (k int PRIMARY KEY)")
+    assert eng.store("ks", "plain").row_cache is None
+    # caching option survives restart
+    eng.close()
+    eng2 = StorageEngine(str(tmp_path / "rc"), Schema(),
+                         commitlog_sync="batch")
+    assert eng2.store("ks", "kv").row_cache is not None
+    eng2.close()
+
+
+def test_alter_table_caching(session):
+    session.execute("CREATE TABLE ac (k int PRIMARY KEY, v text)")
+    cfs = session.processor.executor.backend.store("ks", "ac")
+    assert cfs.row_cache is None
+    session.execute("ALTER TABLE ac WITH caching = "
+                    "{'keys': 'ALL', 'rows_per_partition': 'ALL'}")
+    assert cfs.row_cache is not None
+    session.execute("INSERT INTO ac (k, v) VALUES (1, 'x')")
+    session.execute("SELECT * FROM ac WHERE k = 1")
+    session.execute("SELECT * FROM ac WHERE k = 1")
+    assert cfs.row_cache.hits >= 1
+    session.execute("ALTER TABLE ac WITH caching = "
+                    "{'rows_per_partition': 'NONE'}")
+    assert cfs.row_cache is None

@@ -257,3 +257,53 @@ def test_nodetool_cleanup_single_token_ring_is_noop(tmp_path):
         assert got == set(range(20))
     finally:
         node.engine.close()
+
+
+def test_slow_query_monitor(eng):
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    nodetool.setslowquerythreshold(eng, 0.0)   # everything is "slow"
+    s.execute("INSERT INTO kv (k, v) VALUES (1, 'x')")
+    s.execute("SELECT * FROM kv WHERE k = 1")
+    entries = eng.monitor.entries()
+    assert any("SELECT" in e["query"] for e in entries)
+    rs = s.execute("SELECT query, duration_ms FROM "
+                   "system_views.slow_queries")
+    assert rs.rows and all(r[1] >= 0 for r in rs.rows)
+    nodetool.setslowquerythreshold(eng, 10_000.0)
+    n = len(eng.monitor.entries())
+    s.execute("SELECT * FROM kv WHERE k = 1")
+    assert len(eng.monitor.entries()) == n     # under threshold
+
+
+def test_upgradesstables_and_split(eng):
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE kv (k int, c int, v text, "
+              "PRIMARY KEY (k, c))")
+    for k in range(40):
+        for c in range(5):
+            s.execute(f"INSERT INTO kv (k, c, v) VALUES ({k}, {c}, "
+                      f"'{'x' * 100}')")
+    eng.store("ks", "kv").flush()
+    rep = nodetool.upgradesstables(eng, "ks", "kv")
+    assert rep and rep[0]["to_generation"] != rep[0]["from_generation"]
+    assert len(s.execute("SELECT * FROM kv").rows) == 200
+
+    # split the (single) sstable into tiny chunks
+    rep = nodetool.sstablesplit(eng, "ks", "kv", target_mib=0)
+    [r] = rep
+    assert len(r["outputs"]) >= 2
+    assert len(eng.store("ks", "kv").live_sstables()) == len(r["outputs"])
+    assert len(s.execute("SELECT * FROM kv").rows) == 200
+    # every output holds whole partitions (no partition straddles files)
+    seen = {}
+    for sst in eng.store("ks", "kv").live_sstables():
+        for tok in sst.partition_tokens:
+            assert seen.setdefault(int(tok), sst.desc.generation) \
+                == sst.desc.generation
