@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import os
 import struct
+import threading
 import zlib
 
 import numpy as np
@@ -26,6 +27,14 @@ from .format import SEGMENT_CELLS, Component, Descriptor
 
 
 class SSTableWriter:
+    # trickle fsync (conf trickle_fsync role): push dirty pages to disk
+    # WHILE later segments compress/serialize, so the commit-time fsync
+    # only pays for the tail. Without it a large sstable's entire flush
+    # hits the disk in one blocking call at finish() — measured as the
+    # single largest compaction phase on this box (disk ~128 MiB/s
+    # flushed vs ~2 GiB/s to page cache).
+    TRICKLE_FSYNC_BYTES = 16 << 20
+
     def __init__(self, descriptor: Descriptor, table: TableMetadata,
                  estimated_partitions: int = 1024,
                  segment_cells: int = SEGMENT_CELLS):
@@ -64,6 +73,14 @@ class SSTableWriter:
         # split and incremental repair key off this)
         self.repaired_at = 0
         self._finished = False
+        self._sync_req = threading.Event()
+        self._sync_stop = False
+        self._sync_error: OSError | None = None
+        self._bytes_since_sync = 0
+        self._syncer = threading.Thread(target=self._trickle_sync,
+                                        daemon=True,
+                                        name="sstable-trickle-fsync")
+        self._syncer.start()
 
     # ---------------------------------------------------------------- api --
 
@@ -88,6 +105,9 @@ class SSTableWriter:
             self._cut_segment(min(self.segment_cells, self._pending_cells))
         if self.K is None:
             self.K = 13
+        self._stop_syncer()   # join BEFORE the final fsync + close
+        if self._sync_error is not None:
+            raise self._sync_error
         self._data.flush()
         os.fsync(self._data.fileno())
         self._data.close()
@@ -121,11 +141,39 @@ class SSTableWriter:
     def _write_all(self, mv: memoryview) -> None:
         """Raw FileIO.write may write short (and caps single writes around
         2 GiB on Linux) — loop until every byte lands."""
+        total = mv.nbytes
         while mv.nbytes:
             n = self._data.write(mv)
             if n is None or n <= 0:
                 raise OSError("short write to Data.db")
             mv = mv[n:]
+        self._bytes_since_sync += total
+        if self._bytes_since_sync >= self.TRICKLE_FSYNC_BYTES:
+            self._bytes_since_sync = 0
+            self._sync_req.set()       # syncer flushes in the background
+
+    def _trickle_sync(self) -> None:
+        while True:
+            self._sync_req.wait()
+            self._sync_req.clear()
+            if self._sync_stop:
+                return
+            try:
+                os.fsync(self._data.fileno())
+            except OSError as e:
+                # a writeback error (EIO/ENOSPC) is reported ONCE per
+                # fd; swallowing it here would let finish()'s final
+                # fsync succeed and commit an sstable with lost pages.
+                # Record it — finish() re-raises before the commit point.
+                self._sync_error = e
+                return
+
+    def _stop_syncer(self) -> None:
+        # join blocks for at most one in-flight fsync, bounded by
+        # TRICKLE_FSYNC_BYTES of dirty pages (~0.15s on this disk)
+        self._sync_stop = True
+        self._sync_req.set()
+        self._syncer.join()
 
     @staticmethod
     def _fsync_path(path: str) -> None:
@@ -138,6 +186,7 @@ class SSTableWriter:
             os.close(fd)
 
     def abort(self) -> None:
+        self._stop_syncer()
         if not self._data.closed:
             self._data.close()
         for comp in Component.ALL:
