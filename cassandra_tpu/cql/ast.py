@@ -142,6 +142,7 @@ class CreateIndexStatement:
     column: str
     custom_class: str | None = None
     if_not_exists: bool = False
+    options: dict = field(default_factory=dict)   # WITH OPTIONS = {...}
 
 
 @dataclass

@@ -51,6 +51,30 @@ class ResultSet:
 APPLIED = ResultSet(["[applied]"], [(True,)])
 
 
+def _like_match(value: str, pattern: str) -> bool:
+    """CQL LIKE: '%' is the only wildcard (multi-char), case-sensitive
+    (cql3 Operator.LIKE_* semantics). '_' is NOT a wildcard in CQL."""
+    parts = pattern.split("%")
+    if len(parts) == 1:
+        return value == pattern
+    if len(value) < len(parts[0]) + len(parts[-1]):
+        return False      # anchored prefix/suffix must not overlap
+    if parts[0] and not value.startswith(parts[0]):
+        return False
+    if parts[-1] and not value.endswith(parts[-1]):
+        return False
+    pos = len(parts[0])
+    end = len(value) - len(parts[-1])
+    for mid in parts[1:-1]:
+        if not mid:
+            continue
+        i = value.find(mid, pos, end)
+        if i < 0:
+            return False
+        pos = i + len(mid)
+    return True
+
+
 def _from_json(v, cql_type):
     """JSON value -> the Python value the column type serializes
     (cql3 Json.java fromJson subset): hex strings for blobs, string
@@ -799,7 +823,12 @@ class Executor:
             raise InvalidRequest(f"unknown column {s.column}")
         registry = getattr(self.backend, "indexes", None)
         if registry is not None:
-            registry.create(t, s.column, s.name, s.custom_class)
+            try:
+                registry.create(t, s.column, s.name, s.custom_class,
+                                options=getattr(s, "options", None),
+                                if_not_exists=s.if_not_exists)
+            except ValueError as e:
+                raise InvalidRequest(str(e))
             self.schema._changed()   # index defs persist with the schema
         return ResultSet([], [])
 
@@ -1620,21 +1649,31 @@ class Executor:
         return rows, statics, new_state
 
     def _indexed_lookup(self, t, cfs, filters, params):
-        """Serve a single-equality filter from a secondary index: locators
+        """Serve a single-column filter from a secondary index: locators
         from the index, base rows re-read and re-checked (stale-entry
-        filtering — index/internal 2i semantics)."""
+        filtering — index/internal 2i semantics). Equality uses the 2i;
+        LIKE uses a SASI text index, with candidates re-verified by the
+        case-sensitive predicate."""
         registry = getattr(self.backend, "indexes", None)
         if registry is None or len(filters) != 1:
             return None
         col, op, v = filters[0]
-        if op != "=":
-            return None
-        idx = registry.get(t.keyspace, t.name, col.name)
-        if idx is None or not hasattr(idx, "lookup"):
+        if op == "LIKE":
+            idx = registry.get(t.keyspace, t.name, col.name)
+            if idx is None or not hasattr(idx, "search"):
+                return None
+            locators = idx.search(str(v))
+            if locators is None:     # pattern unservable by this index
+                return None
+        elif op == "=":
+            idx = registry.get(t.keyspace, t.name, col.name)
+            if idx is None or not hasattr(idx, "lookup"):
+                return None
+            locators = idx.lookup(col.cql_type.serialize(v))
+        else:
             return None
         out = []
-        value_b = col.cql_type.serialize(v)
-        for pk, ck in idx.lookup(value_b):
+        for pk, ck in locators:
             batch = cfs.read_partition(pk)
             static_row = None
             hit = None
@@ -1643,7 +1682,10 @@ class Executor:
                     static_row = row_to_dict(t, r)
                 elif r.ck_frame == ck:
                     hit = row_to_dict(t, r, with_meta=True)
-            if hit is not None and hit.get(col.name) == v:  # drop stale
+            cur = None if hit is None else hit.get(col.name)
+            keep = (isinstance(cur, str) and _like_match(cur, str(v))) \
+                if op == "LIKE" else (cur == v)
+            if hit is not None and keep:                   # drop stale
                 if static_row:
                     for c in t.static_columns:
                         if hit.get(c.name) is None:
@@ -1688,6 +1730,8 @@ class Executor:
 
     @staticmethod
     def _match(cur, op, v) -> bool:
+        if op == "LIKE":
+            return isinstance(cur, str) and _like_match(cur, v)
         if op == "CONTAINS":
             return cur is not None and v in cur
         if op == "CONTAINS_KEY":

@@ -23,7 +23,7 @@ KEYWORDS = {
     "distinct", "as", "contains", "per", "partition", "is", "null", "token",
     "or", "replace", "materialized", "view", "custom", "options", "role",
     "user", "grant", "revoke", "of", "list", "function", "aggregate",
-    "returns", "language", "trigger",
+    "returns", "language", "trigger", "like",
 }
 
 UUID_RE = re.compile(

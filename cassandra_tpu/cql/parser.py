@@ -298,6 +298,8 @@ class Parser:
             self.expect_op("(")
             vals = self._term_list(")")
             return ast.Relation(col, "IN", vals)
+        if t.kind == "KEYWORD" and t.value == "like":
+            return ast.Relation(col, "LIKE", self.term())
         if t.kind == "KEYWORD" and t.value == "contains":
             if self.accept_kw("key"):
                 return ast.Relation(col, "CONTAINS_KEY", self.term())
@@ -849,11 +851,13 @@ class Parser:
         if custom:
             self.expect_kw("using")
             cls = self.next().value
+        opts = {}
         if self.accept_kw("with"):
             self.expect_kw("options")
             self.expect_op("=")
-            self._option_value()
-        return ast.CreateIndexStatement(name, ks, table, col, cls, ine)
+            opts = self._option_value() or {}
+        return ast.CreateIndexStatement(name, ks, table, col, cls, ine,
+                                        options=opts)
 
     def _create_type(self):
         ine = self._if_not_exists()

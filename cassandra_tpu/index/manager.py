@@ -39,6 +39,9 @@ class _AttachedIndex:
     def _cfs(self):
         return self.backend.store(self.table.keyspace, self.table.name)
 
+    def _path(self, desc) -> str:
+        return ssi.component_path(desc, self.col_id)
+
     def _component(self, reader):
         """Load (or build-once, then load) this sstable's component.
         Serialized under the index lock: concurrent first-touch queries
@@ -53,7 +56,7 @@ class _AttachedIndex:
         with self._lock:
             if gen in self._cache:
                 return self._cache[gen]
-            path = ssi.component_path(reader.desc, self.col_id)
+            path = self._path(reader.desc)
             loaded = self._load(path)
             if loaded is None:
                 self._build(reader)
@@ -102,6 +105,80 @@ class EqualityIndex(_AttachedIndex):
             if comp:
                 out.update(comp.get(value, ()))
         return sorted(out)
+
+
+class TextIndex(_AttachedIndex):
+    """SASI role: analyzed-term index serving LIKE queries. Candidate
+    generation is case-insensitive over ANALYZED terms (CONTAINS mode:
+    tokens; PREFIX mode: whole lowercased values); the executor
+    re-verifies every candidate against the live row with the
+    case-sensitive LIKE predicate, so false positives drop. Token-
+    boundary behavior matches SASI: a CONTAINS pattern spanning two
+    tokens ('%foo bar%') cannot be served from token terms."""
+
+    def __init__(self, backend, table: TableMetadata, column: str,
+                 mode: str = "CONTAINS"):
+        super().__init__(backend, table, column)
+        self.mode = "PREFIX" if str(mode).upper() == "PREFIX" \
+            else "CONTAINS"
+
+    def _path(self, desc):
+        return ssi.text_component_path(desc, self.col_id)
+
+    def _build(self, reader):
+        ssi.build_text(reader, self.table, self.col_id, self.mode)
+
+    def _load(self, path):
+        return ssi.load_text(path)
+
+    def _fresh(self, reader):
+        out: dict = {}
+        for seg in reader.scanner():
+            for v, pk, ck, _ts in ssi.iter_column_cells(seg, self.col_id):
+                for term in ssi.analyze(v, self.mode):
+                    out.setdefault(term, []).append((pk, ck))
+        return out
+
+    def search(self, pattern: str) -> list | None:
+        """Locators whose analyzed terms can match the LIKE pattern —
+        a SUPERSET; the executor re-verifies with the case-sensitive
+        predicate. Returns None when the pattern cannot be served from
+        this index (the executor then demands ALLOW FILTERING)."""
+        hits = self._term_predicate(pattern)
+        if hits is None:
+            return None
+        out = set()
+        for v, pk, ck, _ts in self._memtable_entries():
+            if any(hits(t) for t in ssi.analyze(v, self.mode)):
+                out.add((pk, ck))
+        for reader in self._cfs().live_sstables():
+            comp = self._component(reader)
+            if comp:
+                for term, locs in comp.items():
+                    if hits(term):
+                        out.update(locs)
+        return sorted(out)
+
+    def _term_predicate(self, pattern: str):
+        """term -> bool candidate test, or None if unservable. In
+        PREFIX mode terms ARE whole lowercased values, so the full
+        (lowercased) LIKE pattern applies exactly. In CONTAINS mode a
+        value matches only if every token-pure literal piece sits
+        inside some token; probing the LONGEST such piece yields a
+        correct superset — a pattern with no token-pure piece (e.g.
+        '%foo bar%', spanning tokens) cannot be served."""
+        low = pattern.lower()
+        if self.mode == "PREFIX":
+            from ..cql.execution import _like_match
+            return lambda term: _like_match(term.decode("utf-8",
+                                                        "ignore"), low)
+        import re
+        pieces = [p for p in low.split("%")
+                  if p and re.fullmatch(r"[0-9a-z]+", p)]
+        if not pieces:
+            return None
+        probe = max(pieces, key=len).encode()
+        return lambda term: probe in term
 
 
 class VectorIndex(_AttachedIndex):
@@ -212,21 +289,39 @@ class IndexManager:
         # (keyspace, table, column) -> index
         self.indexes: dict[tuple, object] = {}
         self.by_name: dict[tuple, tuple] = {}
+        self.meta: dict[tuple, dict] = {}   # key -> {custom_class, options}
 
     def create(self, table: TableMetadata, column: str,
-               name: str | None = None, custom_class: str | None = None):
+               name: str | None = None, custom_class: str | None = None,
+               options: dict | None = None,
+               if_not_exists: bool = False):
         from ..types.marshal import VectorType
         key = (table.keyspace, table.name, column)
         if key in self.indexes:
-            return self.indexes[key]
+            if if_not_exists:
+                return self.indexes[key]
+            # silently returning the EXISTING index would hand back the
+            # wrong kind (e.g. a 2i where SASI was asked for) and never
+            # register the new name — fail like the reference does
+            raise ValueError(
+                f"an index already exists on "
+                f"{table.keyspace}.{table.name}({column})")
         col = table.columns[column]
-        if isinstance(col.cql_type, VectorType):
+        options = options or {}
+        if custom_class and "sasi" in custom_class.lower():
+            # CREATE CUSTOM INDEX ... USING 'SASIIndex'
+            # WITH OPTIONS = {'mode': 'CONTAINS'|'PREFIX'}
+            idx = TextIndex(self.backend, table, column,
+                            mode=options.get("mode", "PREFIX"))
+        elif isinstance(col.cql_type, VectorType):
             idx = VectorIndex(self.backend, table, column)
         else:
             idx = EqualityIndex(self.backend, table, column)
         self.indexes[key] = idx
         self.by_name[(table.keyspace,
                       name or f"{table.name}_{column}_idx")] = key
+        self.meta[key] = {"custom_class": custom_class,
+                          "options": dict(options)}
         return idx
 
     def drop(self, keyspace: str, name: str):
@@ -234,6 +329,7 @@ class IndexManager:
         if key is None:
             raise KeyError(name)
         self.indexes.pop(key, None)
+        self.meta.pop(key, None)
 
     def get(self, keyspace: str, table: str, column: str):
         return self.indexes.get((keyspace, table, column))

@@ -187,3 +187,62 @@ def _parse_vector(body: bytes):
         pos += ln
         keys.append((pk, ck))
     return mat, tss, keys
+
+
+# -------------------------------------------------------------------- text --
+# SASI role (index/sasi): analyzed text terms -> locators, one CRC-trailed
+# component per sstable like the equality/vector components. The analyzer
+# is the SASI StandardAnalyzer subset: lowercase, split on
+# non-alphanumeric runs. PREFIX mode indexes the whole lowercased value
+# instead (SASI's non-tokenizing analyzer) for LIKE 'abc%'.
+
+_TOKEN_RE = None
+
+
+def analyze(value: bytes, mode: str) -> set[bytes]:
+    global _TOKEN_RE
+    if _TOKEN_RE is None:
+        import re
+        _TOKEN_RE = re.compile(r"[0-9a-z]+")
+    text = value.decode("utf-8", "ignore").lower()
+    if mode == "PREFIX":
+        return {text.encode()} if text else set()
+    return {t.encode() for t in _TOKEN_RE.findall(text)}
+
+
+def text_component_path(desc, column_id: int) -> str:
+    return os.path.join(desc.directory,
+                        f"{desc.version}-{desc.generation}"
+                        f"-Text_{column_id}.db")
+
+
+def build_text(reader, table: TableMetadata, column_id: int,
+               mode: str) -> str:
+    path = text_component_path(reader.desc, column_id)
+    recs = bytearray()
+    n = 0
+    for value, pk, ck, _ts in _scan_column(reader, table, column_id):
+        for term in analyze(value, mode):
+            vi.write_unsigned_vint(len(term), recs)
+            recs += term
+            vi.write_unsigned_vint(len(pk), recs)
+            recs += pk
+            vi.write_unsigned_vint(len(ck), recs)
+            recs += ck
+            n += 1
+    out = bytearray()
+    out += b"TXI1"
+    out += struct.pack("<I", n)
+    out += recs
+    _write(path, bytes(out))
+    return path
+
+
+def load_text(path: str) -> dict[bytes, list] | None:
+    body = _read(path)
+    if body is None or body[:4] != b"TXI1":
+        return None
+    try:
+        return _parse_equality(body)   # identical record layout
+    except (ValueError, IndexError, struct.error):
+        return None

@@ -89,7 +89,8 @@ class StorageEngine:
         idx = getattr(self, "indexes", None)
         if idx is not None:
             dump["indexes"] = [
-                {"keyspace": ks, "table": tb, "column": col, "name": nm}
+                {"keyspace": ks, "table": tb, "column": col, "name": nm,
+                 **idx.meta.get((ks, tb, col), {})}
                 for (ksn, nm), (ks, tb, col) in idx.by_name.items()]
         trig = getattr(self, "triggers", None)
         if trig is not None:
@@ -108,7 +109,10 @@ class StorageEngine:
         for d in dump.get("indexes", []):
             try:
                 t = self.schema.get_table(d["keyspace"], d["table"])
-                self.indexes.create(t, d["column"], d["name"])
+                self.indexes.create(t, d["column"], d["name"],
+                                    custom_class=d.get("custom_class"),
+                                    options=d.get("options"),
+                                    if_not_exists=True)
             except KeyError:
                 pass  # table dropped since
         self.triggers.load_list(dump.get("triggers", []))

@@ -77,10 +77,11 @@ class SSTableWriter:
         self._sync_stop = False
         self._sync_error: OSError | None = None
         self._bytes_since_sync = 0
-        self._syncer = threading.Thread(target=self._trickle_sync,
-                                        daemon=True,
-                                        name="sstable-trickle-fsync")
-        self._syncer.start()
+        # started lazily on the first threshold crossing: small writers
+        # (memtable flushes, mesh shards) never pay thread create/join,
+        # and an abandoned writer (caller crashed before finish/abort)
+        # leaks nothing
+        self._syncer: threading.Thread | None = None
 
     # ---------------------------------------------------------------- api --
 
@@ -150,6 +151,11 @@ class SSTableWriter:
         self._bytes_since_sync += total
         if self._bytes_since_sync >= self.TRICKLE_FSYNC_BYTES:
             self._bytes_since_sync = 0
+            if self._syncer is None:
+                self._syncer = threading.Thread(
+                    target=self._trickle_sync, daemon=True,
+                    name="sstable-trickle-fsync")
+                self._syncer.start()
             self._sync_req.set()       # syncer flushes in the background
 
     def _trickle_sync(self) -> None:
@@ -171,6 +177,8 @@ class SSTableWriter:
     def _stop_syncer(self) -> None:
         # join blocks for at most one in-flight fsync, bounded by
         # TRICKLE_FSYNC_BYTES of dirty pages (~0.15s on this disk)
+        if self._syncer is None:
+            return
         self._sync_stop = True
         self._sync_req.set()
         self._syncer.join()

@@ -141,3 +141,168 @@ def test_vector_index_persists(tmp_data):
                     "[5.0, 0.0, 0.0, 1.0] LIMIT 2")
     assert rs.rows[0][0] == 5
     eng2.close()
+
+
+# -------------------------------------------------------------- SASI text --
+
+def test_sasi_text_index_like(tmp_path):
+    """CREATE CUSTOM INDEX ... USING 'SASIIndex' serves LIKE queries:
+    CONTAINS mode over analyzed tokens, candidates verified against the
+    live row (case-sensitive LIKE), components persisted per sstable."""
+    from cassandra_tpu.cql import Session
+    from cassandra_tpu.schema import Schema
+    from cassandra_tpu.storage.engine import StorageEngine
+
+    eng = StorageEngine(str(tmp_path / "sasi"), Schema(),
+                        commitlog_sync="batch")
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE posts (id int PRIMARY KEY, body text)")
+    s.execute("CREATE CUSTOM INDEX body_idx ON posts (body) "
+              "USING 'SASIIndex' WITH OPTIONS = {'mode': 'CONTAINS'}")
+    docs = {1: "The quick brown Fox", 2: "quicksilver linings",
+            3: "slow red fox", 4: "Foxtrot uniform"}
+    for k, v in docs.items():
+        s.execute(f"INSERT INTO posts (id, body) VALUES ({k}, '{v}')")
+    # memtable-served
+    got = {r[0] for r in s.execute(
+        "SELECT id FROM posts WHERE body LIKE '%fox%'").rows}
+    assert got == {3}              # case-sensitive verification
+    got = {r[0] for r in s.execute(
+        "SELECT id FROM posts WHERE body LIKE '%quick%'").rows}
+    assert got == {1, 2}
+    # flush: served from the persisted per-sstable text component
+    eng.store("ks", "posts").flush()
+    got = {r[0] for r in s.execute(
+        "SELECT id FROM posts WHERE body LIKE '%Fox%'").rows}
+    assert got == {1, 4}
+    # update re-verifies against the live row (stale entries drop)
+    s.execute("UPDATE posts SET body = 'nothing here' WHERE id = 3")
+    got = {r[0] for r in s.execute(
+        "SELECT id FROM posts WHERE body LIKE '%fox%'").rows}
+    assert got == set()
+    # survives restart (custom class + options persisted)
+    eng.close()
+    eng2 = StorageEngine(str(tmp_path / "sasi"), Schema(),
+                         commitlog_sync="batch")
+    s2 = Session(eng2, keyspace="ks")
+    got = {r[0] for r in s2.execute(
+        "SELECT id FROM posts WHERE body LIKE '%Fox%'").rows}
+    assert got == {1, 4}
+    eng2.close()
+
+
+def test_sasi_prefix_mode(tmp_path):
+    from cassandra_tpu.cql import Session
+    from cassandra_tpu.schema import Schema
+    from cassandra_tpu.storage.engine import StorageEngine
+
+    eng = StorageEngine(str(tmp_path / "pfx"), Schema(),
+                        commitlog_sync="batch")
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE users (id int PRIMARY KEY, name text)")
+    s.execute("CREATE CUSTOM INDEX ON users (name) USING 'SASIIndex' "
+              "WITH OPTIONS = {'mode': 'PREFIX'}")
+    for k, v in {1: "alice", 2: "alicia", 3: "bob"}.items():
+        s.execute(f"INSERT INTO users (id, name) VALUES ({k}, '{v}')")
+    eng.store("ks", "users").flush()
+    got = {r[0] for r in s.execute(
+        "SELECT id FROM users WHERE name LIKE 'ali%'").rows}
+    assert got == {1, 2}
+    assert s.execute(
+        "SELECT id FROM users WHERE name LIKE 'alice'").rows == [(1,)]
+    eng.close()
+
+
+def test_like_requires_index_or_filtering(tmp_path):
+    from cassandra_tpu.cql import Session
+    from cassandra_tpu.cql.execution import InvalidRequest
+    from cassandra_tpu.schema import Schema
+    from cassandra_tpu.storage.engine import StorageEngine
+
+    eng = StorageEngine(str(tmp_path / "nf"), Schema(),
+                        commitlog_sync="batch")
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    s.execute("INSERT INTO kv (k, v) VALUES (1, 'hello world')")
+    import pytest as _pytest
+    with _pytest.raises(InvalidRequest):
+        s.execute("SELECT k FROM kv WHERE v LIKE '%world%'")
+    got = s.execute("SELECT k FROM kv WHERE v LIKE '%world%' "
+                    "ALLOW FILTERING").rows
+    assert got == [(1,)]
+    eng.close()
+
+
+def test_sasi_interior_wildcard_and_duplicates(tmp_path):
+    from cassandra_tpu.cql import Session
+    from cassandra_tpu.cql.execution import InvalidRequest, _like_match
+    from cassandra_tpu.schema import Schema
+    from cassandra_tpu.storage.engine import StorageEngine
+
+    # the verifier: anchored literals must not overlap
+    assert not _like_match("a", "a%a")
+    assert not _like_match("aba", "ab%ba")
+    assert _like_match("abca", "a%a")
+    assert _like_match("ali_ce", "ali%ce")
+
+    eng = StorageEngine(str(tmp_path / "iw"), Schema(),
+                        commitlog_sync="batch")
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE u (id int PRIMARY KEY, name text)")
+    s.execute("CREATE CUSTOM INDEX ON u (name) USING 'SASIIndex' "
+              "WITH OPTIONS = {'mode': 'PREFIX'}")
+    for k, v in {1: "alice", 2: "aluminice", 3: "bob"}.items():
+        s.execute(f"INSERT INTO u (id, name) VALUES ({k}, '{v}')")
+    # interior wildcard served by PREFIX terms (full pattern over value)
+    got = {r[0] for r in s.execute(
+        "SELECT id FROM u WHERE name LIKE 'al%ice'").rows}
+    assert got == {1, 2}
+    # duplicate index on the column is rejected; IF NOT EXISTS tolerated
+    import pytest as _pytest
+    with _pytest.raises(InvalidRequest):
+        s.execute("CREATE INDEX ON u (name)")
+    s.execute("CREATE INDEX IF NOT EXISTS ON u (name)")
+    eng.close()
+
+
+def test_sasi_contains_unservable_pattern(tmp_path):
+    """A CONTAINS pattern spanning token boundaries cannot be served
+    from token terms: the executor demands ALLOW FILTERING instead of
+    silently returning nothing."""
+    from cassandra_tpu.cql import Session
+    from cassandra_tpu.cql.execution import InvalidRequest
+    from cassandra_tpu.schema import Schema
+    from cassandra_tpu.storage.engine import StorageEngine
+
+    eng = StorageEngine(str(tmp_path / "sp"), Schema(),
+                        commitlog_sync="batch")
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE d (id int PRIMARY KEY, body text)")
+    s.execute("CREATE CUSTOM INDEX ON d (body) USING 'SASIIndex' "
+              "WITH OPTIONS = {'mode': 'CONTAINS'}")
+    s.execute("INSERT INTO d (id, body) VALUES (1, 'foo bar baz')")
+    import pytest as _pytest
+    with _pytest.raises(InvalidRequest):
+        s.execute("SELECT id FROM d WHERE body LIKE '%foo bar%'")
+    got = s.execute("SELECT id FROM d WHERE body LIKE '%foo bar%' "
+                    "ALLOW FILTERING").rows
+    assert got == [(1,)]
+    # interior wildcard with token-pure pieces IS servable
+    got = s.execute("SELECT id FROM d WHERE body LIKE '%foo%baz%'").rows
+    assert got == [(1,)]
+    eng.close()
