@@ -45,6 +45,8 @@ class Node:
         self.paxos = PaxosService(self)
         from .counters import CounterService
         self.counters = CounterService(self)
+        from .streaming import StreamService
+        self.streams = StreamService(self)
         self.default_cl = ConsistencyLevel.ONE
         # periodic hint dispatch (HintsDispatchExecutor role): hints must
         # flow even when the target was never convicted dead
@@ -226,30 +228,40 @@ class Node:
                 if not owners:
                     continue
                 for tname, table in ks.tables.items():
-                    if lo > hi:  # wrap-around range: fetch both arcs
-                        batch2 = self.repair._fetch_range(
-                            owners[0], ks.name, tname,
-                            -(1 << 63), hi, self.proxy.timeout)
-                        batch3 = self.repair._fetch_range(
-                            owners[0], ks.name, tname,
-                            lo + 1, (1 << 63) - 1, self.proxy.timeout)
-                        batch = cbmod.merge_sorted([batch2, batch3])
-                    else:
-                        batch = self.repair._fetch_range(
-                            owners[0], ks.name, tname, lo + 1, hi,
-                            self.proxy.timeout)
-                    if len(batch) == 0:
-                        continue
-                    # stream lands as a local sstable, not mutations
-                    # (entire-sstable streaming role)
                     cfs = self.engine.store(ks.name, tname)
-                    from ..storage.sstable import Descriptor, SSTableWriter
-                    gen = cfs.next_generation()
-                    w = SSTableWriter(Descriptor(cfs.directory, gen), table)
-                    w.append(cbmod.merge_sorted([batch]))
-                    w.finish()
-                    cfs.reload_sstables()
-                    total += len(batch)
+                    arcs = [(-(1 << 63), hi),
+                            (lo, (1 << 63) - 1)] if lo > hi else [(lo, hi)]
+                    batches = []
+                    landed_gens = []
+                    for alo, ahi in arcs:
+                        # entire-sstable streaming: whole in-range
+                        # sstables arrive as component FILES (zero
+                        # re-serialization, attached indexes included);
+                        # only boundary-straddling data comes as batches
+                        files, leftover = self.streams.fetch_range(
+                            owners[0], ks.name, tname, alo, ahi,
+                            self.proxy.timeout)
+                        for comps in files:
+                            landed_gens.append(
+                                self.streams.land_sstable(cfs, comps))
+                        if len(leftover):
+                            batches.append(leftover)
+                    if batches:
+                        batch = cbmod.merge_sorted(batches)
+                        from ..storage.sstable import (Descriptor,
+                                                       SSTableWriter)
+                        gen = cfs.next_generation()
+                        w = SSTableWriter(Descriptor(cfs.directory, gen),
+                                          table)
+                        w.append(batch)
+                        w.finish()
+                        total += len(batch)
+                    if landed_gens or batches:
+                        cfs.reload_sstables()
+                        gens = set(landed_gens)
+                        total += sum(s.n_cells
+                                     for s in cfs.live_sstables()
+                                     if s.desc.generation in gens)
         return total
 
     def decommission(self) -> int:
@@ -449,10 +461,12 @@ class LocalCluster:
         from .counters import CounterService
         from .paxos import PaxosService
         from .repair import RepairService
+        from .streaming import StreamService
         n.paxos = PaxosService(n)
         n.repair = RepairService(n)
         n.counters.close()
         n.counters = CounterService(n)
+        n.streams = StreamService(n)
         n.gossiper.start()
         n._stop_hints = threading.Event()
         n._hint_thread = threading.Thread(target=n._hint_loop, daemon=True)

@@ -1,0 +1,160 @@
+"""Entire-sstable streaming.
+
+Reference counterpart: db/streaming/CassandraEntireSSTableStreamWriter
++ ComponentManifest (streaming/StreamSession): when a whole sstable's
+data falls inside the requested token range, its component FILES ship
+verbatim — no partition decode/re-encode on either side, and every
+attached component (secondary/SASI/vector index files) rides along.
+Only the leftovers (sstables straddling the range boundary) are
+re-serialized as cell batches.
+
+The receiver lands each shipped sstable under a FRESH local generation
+(component contents never embed the generation — it lives only in the
+file names), TOC written last as the commit point, then reloads the
+store. Used by bootstrap; repair keeps its merkle-ranged batch sync
+(its transfers are narrow by construction).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from ..storage import cellbatch as cb
+from .coordinator import cb_serialize, cb_deserialize
+from .messaging import Verb
+
+
+MIN_TOKEN = -(1 << 63)
+
+
+def _filter_token_range(batch, lo: int, hi: int):
+    import numpy as np
+    keep = cb.token_range_mask(cb.batch_tokens(batch), [(lo, hi)])
+    idx = np.flatnonzero(keep)
+    if len(idx) == len(batch):
+        return batch
+    out = batch.apply_permutation(idx)
+    out.sorted = True
+    return out
+
+
+class StreamService:
+    def __init__(self, node):
+        self.node = node
+        node.messaging.register_handler(Verb.STREAM_REQ,
+                                        self._handle_req)
+
+    # -------------------------------------------------------------- source --
+
+    def _handle_req(self, msg):
+        """Owner side: (keyspace, table, lo, hi) -> the in-range data as
+        (whole_sstables, leftover_batch). Flushes first so the memtable
+        is captured by the sstable split."""
+        keyspace, table_name, lo, hi = msg.payload
+        cfs = self.node.engine.store(keyspace, table_name)
+        cfs.flush()
+        whole, partial = [], []
+        for sst in list(cfs.live_sstables()):
+            toks = sst.partition_tokens
+            if len(toks) == 0:
+                continue
+            first, last = int(toks[0]), int(toks[-1])
+            if (lo != MIN_TOKEN and last <= lo) or first > hi:
+                continue   # zero overlap: never scan it
+            if (lo == MIN_TOKEN or lo < first) and last <= hi:
+                whole.append(sst)
+            else:
+                partial.append(sst)
+        files = []
+        for sst in whole:
+            prefix = f"{sst.desc.version}-{sst.desc.generation}-"
+            comps = {}
+            for fn in os.listdir(cfs.directory):
+                if fn.startswith(prefix):
+                    with open(os.path.join(cfs.directory, fn), "rb") as f:
+                        comps[fn[len(prefix):]] = f.read()
+            files.append(comps)
+        if partial:
+            # one sorted batch per sstable, MERGED (cross-sstable concat
+            # is not token-sorted and must never claim to be)
+            per_sst = []
+            for sst in partial:
+                segs = list(sst.scanner())
+                if not segs:
+                    continue
+                cat = cb.CellBatch.concat(segs)
+                cat.sorted = True
+                per_sst.append(cat)
+            merged = cb.merge_sorted(per_sst) if per_sst else None
+            leftover = _filter_token_range(merged, lo, hi) \
+                if merged is not None else None
+        else:
+            leftover = None
+        if leftover is None:
+            from ..storage.cellbatch import lanes_for_table
+            leftover = cb.CellBatch.empty(lanes_for_table(cfs.table))
+        return Verb.STREAM_DATA, (files, cb_serialize(leftover))
+
+    # ------------------------------------------------------------ receiver --
+
+    def fetch_range(self, owner, keyspace: str, table_name: str,
+                    lo: int, hi: int, timeout: float):
+        """(files, leftover_batch) for range (lo, hi] from `owner`."""
+        holder: dict = {}
+        ev = threading.Event()
+
+        def on_rsp(m):
+            holder["p"] = m.payload
+            ev.set()
+
+        self.node.messaging.send_with_callback(
+            Verb.STREAM_REQ, (keyspace, table_name, lo, hi), owner,
+            on_response=on_rsp, timeout=timeout)
+        if not ev.wait(timeout):
+            raise TimeoutError(
+                f"stream of {keyspace}.{table_name} ({lo}, {hi}] from "
+                f"{owner.name} timed out")
+        files, leftover_b = holder["p"]
+        return files, cb_deserialize(leftover_b)
+
+    def land_sstable(self, cfs, comps: dict) -> int:
+        """Write a shipped sstable's components under a fresh local
+        generation; TOC last = commit point (the receiver-side
+        CassandraStreamReceiver contract)."""
+        from ..storage.sstable.format import Component
+        version = None
+        for sst in cfs.live_sstables():
+            version = sst.desc.version
+            break
+        if version is None:
+            from ..storage.sstable import Descriptor
+            version = Descriptor(cfs.directory, 1).version
+        from ..storage.sstable.writer import SSTableWriter
+        gen = cfs.next_generation()
+        toc = comps.get(Component.TOC)
+        for name, data in comps.items():
+            if name == Component.TOC:
+                continue
+            path = os.path.join(cfs.directory, f"{version}-{gen}-{name}")
+            tmp = path + ".stream"
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        # component renames must be durable BEFORE the TOC commit point
+        # (same discipline as SSTableWriter.finish: a crash must never
+        # persist the TOC over missing components), and the TOC rename
+        # itself needs a second directory sync
+        SSTableWriter._fsync_path(cfs.directory)
+        if toc is not None:
+            path = os.path.join(cfs.directory,
+                                f"{version}-{gen}-{Component.TOC}")
+            tmp = path + ".stream"
+            with open(tmp, "wb") as f:
+                f.write(toc)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            SSTableWriter._fsync_path(cfs.directory)
+        return gen

@@ -317,9 +317,14 @@ class IndexManager:
             idx = VectorIndex(self.backend, table, column)
         else:
             idx = EqualityIndex(self.backend, table, column)
+        nm = (table.keyspace, name or f"{table.name}_{column}_idx")
+        if nm in self.by_name and self.by_name[nm] != key:
+            # a silent overwrite would orphan the shadowed index (it
+            # stays live but unreachable by name AND vanishes from the
+            # persisted schema, which iterates by_name)
+            raise ValueError(f"index name {nm[1]!r} already in use")
         self.indexes[key] = idx
-        self.by_name[(table.keyspace,
-                      name or f"{table.name}_{column}_idx")] = key
+        self.by_name[nm] = key
         self.meta[key] = {"custom_class": custom_class,
                           "options": dict(options)}
         return idx

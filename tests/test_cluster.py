@@ -651,3 +651,49 @@ def test_counter_cache_and_truncate(cluster):
     assert len(n1.counters._cache) == 0       # invalidated
     s.execute("UPDATE cc SET hits = hits + 5 WHERE k = 3")
     assert s.execute("SELECT hits FROM cc WHERE k = 3").rows == [(5,)]
+
+
+def test_entire_sstable_streaming(cluster):
+    """A whole in-range sstable ships as verbatim component files
+    (CassandraEntireSSTableStreamWriter role): the receiver's Data.db
+    bytes are identical to the source's, and straddling sstables fall
+    back to batch re-serialization."""
+    import os
+
+    s = cluster.session(1)
+    s.keyspace = "ks"
+    cluster.node(1).default_cl = ConsistencyLevel.ALL
+    for i in range(300, 340):
+        s.execute(f"INSERT INTO kv (k, v) VALUES ({i}, 's{i}')")
+    n1 = cluster.node(1)
+    src_cfs = n1.engine.store("ks", "kv")
+    src_cfs.flush()
+    src = src_cfs.live_sstables()[0]
+    toks = src.partition_tokens
+    lo, hi = int(toks[0]) - 1, int(toks[-1])
+
+    n2 = cluster.node(2)
+    files, leftover = n2.streams.fetch_range(
+        n1.endpoint, "ks", "kv", lo, hi, 5.0)
+    assert files, "whole in-range sstable should ship as files"
+    comps = files[0]
+    from cassandra_tpu.storage.sstable.format import Component
+    assert Component.DATA in comps and Component.TOC in comps
+    with open(os.path.join(
+            src_cfs.directory,
+            f"{src.desc.version}-{src.desc.generation}-"
+            f"{Component.DATA}"), "rb") as f:
+        assert comps[Component.DATA] == f.read()   # verbatim bytes
+
+    # landing under a fresh generation serves reads
+    dst_cfs = n2.engine.store("ks", "kv")
+    before = len(dst_cfs.live_sstables())
+    n2.streams.land_sstable(dst_cfs, comps)
+    dst_cfs.reload_sstables()
+    assert len(dst_cfs.live_sstables()) == before + 1
+
+    # a narrower range makes the same sstable PARTIAL: batch fallback
+    files2, leftover2 = n2.streams.fetch_range(
+        n1.endpoint, "ks", "kv", lo, int(toks[len(toks) // 2]), 5.0)
+    assert files2 == []
+    assert 0 < len(leftover2) < src.n_cells
