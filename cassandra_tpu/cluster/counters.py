@@ -183,8 +183,8 @@ class CounterService:
             done.set()
 
         def on_fail(msg):
-            # FAILURE_RSP carries the leader's repr(error); a reap
-            # timeout passes the bare message id instead
+            # FAILURE_RSP carries {"kind": exc class name, "error": repr};
+            # a reap timeout passes the bare message id instead
             box["err"] = getattr(msg, "payload", None)
             done.set()
 
@@ -200,14 +200,16 @@ class CounterService:
                 f"counter leader {leader.name} did not ack")
         if "ok" not in box:
             err = box.get("err")
-            if isinstance(err, str) and "Unavailable" in err:
+            kind = self.node.messaging.failure_kind(err)
+            text = err.get("error") if isinstance(err, dict) else err
+            if kind == "UnavailableException":
                 # surface the leader's CL failure as what it is — the
                 # caller must not treat 'not enough replicas' as a
                 # maybe-applied timeout
                 raise UnavailableException(
-                    f"counter leader {leader.name}: {err}")
+                    f"counter leader {leader.name}: {text}")
             raise TimeoutException(
-                f"counter leader {leader.name} failed: {err!r}")
+                f"counter leader {leader.name} failed: {text!r}")
 
     def _handle(self, msg):
         """Leader's COUNTER_REQ handler: punt to the counter stage —
@@ -222,8 +224,7 @@ class CounterService:
                 self.apply_as_leader(t.keyspace, m, cl)
                 self.node.messaging.respond(msg, Verb.COUNTER_RSP, True)
             except Exception as e:
-                self.node.messaging.respond(msg, Verb.FAILURE_RSP,
-                                            repr(e))
+                self.node.messaging.respond_failure(msg, e)
 
         self._stage.submit(run)
         return None

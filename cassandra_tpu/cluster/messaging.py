@@ -174,6 +174,18 @@ class MessagingService:
                       next(self._ids), reply_to=original.id)
         self.transport.deliver(msg)
 
+    def respond_failure(self, original: Message, exc: Exception) -> None:
+        """The one definition of the FAILURE_RSP wire shape; classify
+        remote errors with failure_kind(), never by parsing repr text."""
+        self.respond(original, Verb.FAILURE_RSP,
+                     {"kind": type(exc).__name__, "error": repr(exc)})
+
+    @staticmethod
+    def failure_kind(payload) -> str | None:
+        """Exception class name from a FAILURE_RSP payload (None for
+        reap-timeout bare ids or legacy shapes)."""
+        return payload.get("kind") if isinstance(payload, dict) else None
+
     # ------------------------------------------------------------ receiving
 
     def inbound(self, msg: Message) -> None:
@@ -211,7 +223,7 @@ class MessagingService:
             try:
                 result = handler(msg)
             except Exception as e:
-                self.respond(msg, Verb.FAILURE_RSP, repr(e))
+                self.respond_failure(msg, e)
                 continue
             if result is not None:
                 rsp_verb, payload = result

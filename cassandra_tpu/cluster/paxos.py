@@ -78,9 +78,15 @@ class PaxosLog:
 
     def __init__(self, directory: str):
         os.makedirs(directory, exist_ok=True)
+        self.directory = directory
         self.path = os.path.join(directory, "paxos.log")
         self._lock = threading.Lock()
         self._records = 0
+        # single compaction at a time; while one is in flight, appends are
+        # mirrored into _pending so the compactor can carry them into the
+        # new file before the atomic replace (see compact())
+        self._compact_mutex = threading.Lock()
+        self._pending: list[bytes] | None = None
 
     def append(self, table_id, pk: bytes, kind: int, ballot: "Ballot",
                value: bytes | None) -> None:
@@ -90,6 +96,8 @@ class PaxosLog:
                 f.write(frame)
                 f.flush()
                 os.fsync(f.fileno())
+            if self._pending is not None:
+                self._pending.append(frame)
             self._records += 1
 
     def replay(self):
@@ -141,37 +149,78 @@ class PaxosLog:
         return struct.pack("<II", len(body), zlib.crc32(bytes(body))) \
             + bytes(body)
 
-    def compact(self, states: dict) -> None:
+    def compact(self, states) -> None:
         """Rewrite the log as a snapshot of live state (old rounds whose
         commit already landed need no history). Frames are built in
         memory — each state copied under ITS lock so a concurrent accept
         cannot be captured torn — then written + fsynced ONCE (never via
-        append(): that would retake self._lock and fsync per record)."""
-        frames: list[bytes] = []
-        n = 0
-        for (tid, pk), st in states.items():
-            with st.lock:
-                promised, committed = st.promised, st.committed
-                ab, av = st.accepted_ballot, st.accepted_value
-            if promised != ZERO:
-                frames.append(self._frame(tid, pk, self.K_PROMISE,
-                                          promised, None))
-                n += 1
-            if ab is not None:
-                frames.append(self._frame(tid, pk, self.K_ACCEPT, ab, av))
-                n += 1
-            if committed != ZERO:
-                frames.append(self._frame(tid, pk, self.K_COMMIT,
-                                          committed, None))
-                n += 1
-        tmp = self.path + ".tmp"
-        with self._lock:
+        append(): that would retake self._lock and fsync per record).
+
+        Atomic w.r.t. concurrent appends: a promise/accept fsynced between
+        a state's snapshot and the os.replace must not be erased from the
+        durable log (a crash would then replay pre-promise state and
+        re-promise a lower ballot). While this method runs, append()
+        mirrors every frame into _pending (still fsyncing to the old file,
+        so durability never lapses); before the replace — under the log
+        lock, so no new appends race it — the pending frames are appended
+        to the new file and fsynced. Replay is idempotent (max-ballot
+        semantics), so a frame landing in both snapshot and delta is
+        harmless."""
+        if not self._compact_mutex.acquire(blocking=False):
+            return          # a compaction is already rewriting the log
+        try:
+            with self._lock:
+                self._pending = []
+            # snapshot AFTER arming: a state created+appended between a
+            # pre-arm snapshot and the arm would be in neither the
+            # snapshot nor the pending buffer — callers pass a callable
+            # so the copy happens here, inside the mirrored window
+            if callable(states):
+                states = states()
+            frames: list[bytes] = []
+            n = 0
+            for (tid, pk), st in states.items():
+                with st.lock:
+                    promised, committed = st.promised, st.committed
+                    ab, av = st.accepted_ballot, st.accepted_value
+                if promised != ZERO:
+                    frames.append(self._frame(tid, pk, self.K_PROMISE,
+                                              promised, None))
+                    n += 1
+                if ab is not None:
+                    frames.append(self._frame(tid, pk, self.K_ACCEPT,
+                                              ab, av))
+                    n += 1
+                if committed != ZERO:
+                    frames.append(self._frame(tid, pk, self.K_COMMIT,
+                                              committed, None))
+                    n += 1
+            tmp = self.path + ".tmp"
             with open(tmp, "wb") as f:
                 f.write(b"".join(frames))
                 f.flush()
                 os.fsync(f.fileno())
-            os.replace(tmp, self.path)
-            self._records = n
+            with self._lock:
+                if self._pending:
+                    with open(tmp, "ab") as f:
+                        f.write(b"".join(self._pending))
+                        f.flush()
+                        os.fsync(f.fileno())
+                    n += len(self._pending)
+                os.replace(tmp, self.path)
+                dfd = os.open(self.directory, os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+                self._records = n
+        finally:
+            # a failed compaction (disk full mid-tmp-write) must not
+            # leave append-mirroring armed forever; the old log file is
+            # still intact and durable
+            with self._lock:
+                self._pending = None
+            self._compact_mutex.release()
 
 
 class PaxosService:
@@ -217,9 +266,10 @@ class PaxosService:
     def _maybe_compact(self) -> None:
         if self.log is not None \
                 and self.log._records >= PaxosLog.COMPACT_EVERY:
-            with self._lock:
-                states = dict(self._states)
-            self.log.compact(states)
+            def snapshot():
+                with self._lock:
+                    return dict(self._states)
+            self.log.compact(snapshot)
 
     def _state(self, table_id, pk: bytes) -> PaxosState:
         key = (table_id, pk)

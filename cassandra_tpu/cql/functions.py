@@ -17,6 +17,7 @@ sandbox exists to enforce.
 from __future__ import annotations
 
 import ast as py_ast
+import operator as _op_mod
 from dataclasses import dataclass
 
 _ALLOWED_NODES = (
@@ -32,16 +33,88 @@ _ALLOWED_NODES = (
     py_ast.LtE, py_ast.Gt, py_ast.GtE,
 )
 
+class FunctionError(ValueError):
+    pass
+
+
+# Result-size cap for any single evaluation step. Without it the
+# allowlist still permits unbounded MEMORY amplification ('x * 10**9'
+# with a string x allocates gigabytes in one op, before any post-hoc
+# check could run), so every BinOp is rewritten to route through
+# _guarded_binop which estimates the result size from the operands
+# BEFORE executing the op.
+_MAX_RESULT_BYTES = 1 << 20
+
+
+def _approx_size(x) -> int:
+    if isinstance(x, (str, bytes, bytearray)):
+        return len(x)
+    if isinstance(x, (list, tuple)):
+        return 16 * len(x)      # per-element slot cost, contents uncounted
+    if isinstance(x, int):
+        return x.bit_length() >> 3
+    return 8
+
+
+_BINOPS = {
+    "Add": _op_mod.add, "Sub": _op_mod.sub, "Mult": _op_mod.mul,
+    "Div": _op_mod.truediv, "FloorDiv": _op_mod.floordiv,
+    "Mod": _op_mod.mod,
+}
+
+
+def _guarded_binop(op: str, a, b):
+    # list/tuple included: row values hand UDFs real Python lists, and
+    # list * int amplifies exactly like str * int
+    seq = (str, bytes, bytearray, list, tuple)
+    if op == "Mult":
+        if isinstance(a, int) and isinstance(b, seq):
+            est = max(a, 0) * max(_approx_size(b), 1)
+        elif isinstance(b, int) and isinstance(a, seq):
+            est = max(b, 0) * max(_approx_size(a), 1)
+        else:
+            est = _approx_size(a) + _approx_size(b)
+    elif op == "Mod" and isinstance(a, seq):
+        # '%0999999999d' % x pads to a width the operand sizes don't
+        # reveal — printf-style formatting is simply not allowed
+        raise FunctionError("string formatting (%) not allowed in UDFs")
+    else:
+        est = _approx_size(a) + _approx_size(b) + 1
+    if est > _MAX_RESULT_BYTES:
+        raise FunctionError(
+            f"expression result too large (~{est} bytes > "
+            f"{_MAX_RESULT_BYTES} cap)")
+    return _BINOPS[op](a, b)
+
+
+def _guarded_concat(*xs):
+    parts = [str(x) for x in xs]
+    if sum(map(len, parts)) > _MAX_RESULT_BYTES:
+        raise FunctionError("concat result too large")
+    return "".join(parts)
+
+
 _BUILTINS = {
     "abs": abs, "min": min, "max": max, "len": len, "round": round,
     "int": int, "float": float, "str": str,
     "upper": lambda s: s.upper(), "lower": lambda s: s.lower(),
-    "concat": lambda *xs: "".join(str(x) for x in xs),
+    "concat": _guarded_concat,
 }
 
 
-class FunctionError(ValueError):
-    pass
+class _GuardBinOps(py_ast.NodeTransformer):
+    """Rewrite `a <op> b` to `__binop__('<Op>', a, b)` AFTER the
+    allowlist check (the injected name never appears in user source)."""
+
+    def visit_BinOp(self, node):
+        self.generic_visit(node)
+        return py_ast.copy_location(
+            py_ast.Call(
+                func=py_ast.Name(id="__binop__", ctx=py_ast.Load()),
+                args=[py_ast.Constant(type(node.op).__name__),
+                      node.left, node.right],
+                keywords=[]),
+            node)
 
 
 def compile_expression(body: str, arg_names: list[str]):
@@ -49,6 +122,8 @@ def compile_expression(body: str, arg_names: list[str]):
     Anything outside the allowlist (attributes, subscripts, lambdas,
     comprehensions, walrus, f-strings, imports...) is rejected at
     CREATE time."""
+    if "__binop__" in arg_names:
+        raise FunctionError("'__binop__' is a reserved argument name")
     try:
         tree = py_ast.parse(body, mode="eval")
     except SyntaxError as e:
@@ -70,13 +145,19 @@ def compile_expression(body: str, arg_names: list[str]):
         if isinstance(node, py_ast.Name) and node.id not in arg_names \
                 and node.id not in _BUILTINS:
             raise FunctionError(f"unknown name {node.id!r} in body")
+    tree = py_ast.fix_missing_locations(_GuardBinOps().visit(tree))
     code = compile(tree, "<udf>", "eval")
 
     def call(args: list):
         scope = dict(_BUILTINS)
         scope.update(zip(arg_names, args))
+        # after the args: an argument named __binop__ must not shadow
+        # the guard every binary op routes through
+        scope["__binop__"] = _guarded_binop
         try:
             return eval(code, {"__builtins__": {}}, scope)
+        except FunctionError:
+            raise
         except Exception as e:
             raise FunctionError(f"function evaluation failed: {e}")
     return call

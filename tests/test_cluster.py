@@ -697,3 +697,89 @@ def test_entire_sstable_streaming(cluster):
         n1.endpoint, "ks", "kv", lo, int(toks[len(toks) // 2]), 5.0)
     assert files2 == []
     assert 0 < len(leftover2) < src.n_cells
+
+
+def test_paxos_log_compact_preserves_concurrent_append(tmp_path):
+    """A promise fsynced while compaction is rewriting the log must
+    survive the os.replace — otherwise a crash replays pre-promise state
+    and the replica can re-promise a lower ballot (round-2 advisor
+    finding on PaxosLog.compact)."""
+    import threading
+    import uuid
+
+    from cassandra_tpu.cluster.paxos import Ballot, PaxosLog, PaxosState
+
+    log = PaxosLog(str(tmp_path))
+    tid = uuid.uuid4()
+    st = PaxosState()
+    st.promised = Ballot(5, "a")
+    log.append(tid, b"k1", PaxosLog.K_PROMISE, Ballot(5, "a"), None)
+
+    ready, proceed = threading.Event(), threading.Event()
+
+    class Gate(dict):
+        # compact() iterates items() after arming its pending buffer;
+        # block there so the test can interleave an append
+        def items(self):
+            ready.set()
+            proceed.wait(5)
+            return super().items()
+
+    t = threading.Thread(target=log.compact,
+                         args=(Gate({(tid, b"k1"): st}),))
+    t.start()
+    assert ready.wait(5)
+    log.append(tid, b"k2", PaxosLog.K_PROMISE, Ballot(9, "b"), None)
+    proceed.set()
+    t.join(5)
+    assert not t.is_alive()
+
+    recs = list(PaxosLog(str(tmp_path)).replay())
+    by_pk = {pk: ballot for _, pk, _, ballot, _ in recs}
+    assert by_pk.get(b"k1") == Ballot(5, "a")
+    assert by_pk.get(b"k2") == Ballot(9, "b"), \
+        "append during compaction was erased from the durable log"
+
+
+def test_counter_leader_failure_classified_by_kind(cluster):
+    """The origin classifies a remote counter-leader failure by the
+    structured exception kind in FAILURE_RSP: a real Unavailable
+    surfaces as Unavailable, while an unrelated error whose TEXT merely
+    contains 'Unavailable' stays a maybe-applied timeout."""
+    s = cluster.session(1)
+    s.execute("CREATE KEYSPACE ks2 WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 2}")
+    s.keyspace = "ks2"
+    s.execute("CREATE TABLE cnt_err (k int PRIMARY KEY, hits counter)")
+    time.sleep(0.1)
+    n1 = cluster.node(1)
+    t = cluster.schema.get_table("ks2", "cnt_err")
+    key = None
+    for k in range(200):
+        pk = t.columns["k"].cql_type.serialize(k)
+        reps, _, _ = n1.proxy._plan("ks2", pk)
+        if n1.endpoint not in reps:
+            key, leader_ep = k, reps[0]
+            break
+    assert key is not None, "no pk found with node1 as non-replica"
+    leader = next(n for n in cluster.nodes if n.endpoint == leader_ep)
+
+    def raise_unavailable(*a, **kw):
+        raise UnavailableException("replication needs 2, 1 alive")
+
+    orig = leader.counters.apply_as_leader
+    leader.counters.apply_as_leader = raise_unavailable
+    try:
+        with pytest.raises(UnavailableException):
+            s.execute(
+                f"UPDATE cnt_err SET hits = hits + 1 WHERE k = {key}")
+
+        def raise_other(*a, **kw):
+            raise ValueError("text mentioning Unavailable is not a kind")
+
+        leader.counters.apply_as_leader = raise_other
+        with pytest.raises(TimeoutException):
+            s.execute(
+                f"UPDATE cnt_err SET hits = hits + 1 WHERE k = {key}")
+    finally:
+        leader.counters.apply_as_leader = orig

@@ -91,3 +91,46 @@ def test_udf_persists_across_restart(tmp_data, engine, session):
             s2.execute("SELECT twice(b) FROM t WHERE k = 4")
     finally:
         eng2.close()
+
+
+def test_udf_memory_amplification_capped():
+    """A single op may not allocate unbounded memory: seq*int, nested
+    mults, and concat are size-estimated BEFORE execution (round-2
+    advisor finding — 'x * 10**9' on a string allocated gigabytes)."""
+    f = compile_expression("x * 1000000000", ["x"])
+    with pytest.raises(FunctionError):
+        f(["abc"])
+    # int path for the same body is fine
+    assert f([2]) == 2_000_000_000
+    # nested amplification is caught at the step that crosses the cap
+    g = compile_expression("((x * 1000) * 1000) * 1000", ["x"])
+    with pytest.raises(FunctionError):
+        g(["abcdefgh"])
+    # modest string repeat still works
+    h = compile_expression("x * 3", ["x"])
+    assert h(["ab"]) == "ababab"
+    # concat is capped too
+    c = compile_expression("concat(x, x)", ["x"])
+    assert c(["ab"]) == "abab"
+    with pytest.raises(FunctionError):
+        big = "y" * 600_000
+        c([big])
+
+
+def test_udf_string_formatting_rejected():
+    """printf-style '%' on strings pads to widths the operand sizes
+    don't bound — rejected at evaluation."""
+    f = compile_expression("x % y", ["x", "y"])
+    with pytest.raises(FunctionError):
+        f(["%0999999999d", 5])
+    assert f([7, 3]) == 1
+
+
+def test_udf_list_amplification_capped():
+    """Row values hand UDFs real Python lists — list * int is capped
+    like str * int, and '__binop__' is reserved at CREATE time."""
+    f = compile_expression("x * 1000000", ["x"])
+    with pytest.raises(FunctionError):
+        f([[1, 2, 3]])
+    with pytest.raises(FunctionError):
+        compile_expression("__binop__ + 1", ["__binop__"])
