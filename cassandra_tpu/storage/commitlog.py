@@ -17,6 +17,7 @@ import struct
 import threading
 import zlib
 
+from ..utils import fsutil
 from .mutation import Mutation
 
 _SEG_RE = re.compile(r"^commitlog-(\d+)\.log$")
@@ -77,6 +78,14 @@ class CommitLog:
             os.fsync(self._file.fileno())
             self._file.close()
         self._file = open(self._seg_path(self._seg_id), "ab")
+        # reserve the whole segment's blocks up front (KEEP_SIZE: st_size
+        # stays at the append point so replay's EOF/torn-tail detection is
+        # unaffected). The reference pre-creates fixed-size segments for
+        # the same reason (CommitLogSegment); on this box extending
+        # writes are ~75x slower than writes into reserved blocks.
+        fsutil.preallocate_keep_size(
+            self._file.fileno(), self._file.tell(),
+            max(0, self.segment_size - self._file.tell()))
 
     # ----------------------------------------------------------------- add
 

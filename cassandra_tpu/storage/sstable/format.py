@@ -26,7 +26,9 @@ import os
 import re
 
 SEGMENT_CELLS = 65536  # cells per segment (device batch granularity)
-FORMAT_VERSION = "ca"  # bumped on layout changes
+# bumped on layout changes; "cb": Digest.crc32 holds crc32 over the
+# per-block crc words instead of the raw Data.db byte stream
+FORMAT_VERSION = "cb"
 
 
 class Component:

@@ -194,38 +194,47 @@ class SSTableReader:
         cls = [int(self._blk[i, b, 0]) for b in range(3)]
         uls = [int(self._blk[i, b, 1]) for b in range(3)]
         crcs = [int(self._blk[i, b, 2]) for b in range(3)]
-        # ONE pread for all three blocks (they are adjacent on disk), then
-        # decompress straight into the arrays the CellBatch will own —
-        # no per-block bytes objects, no post-decode astype copies.
-        # pread: stateless positional read — readers share this handle
-        # across threads (reference: FileHandle/RandomAccessReader are
-        # per-thread; pread avoids the seek/read race entirely)
-        raw = os.pread(self._data.fileno(), sum(cls), pos)
-        src = np.frombuffer(raw, dtype=np.uint8)
-        offs = [0, cls[0], cls[0] + cls[1]]
-        for b in range(3):
-            if zlib.crc32(memoryview(raw)[offs[b]:offs[b] + cls[b]]) \
-                    != crcs[b]:
-                raise CorruptSSTableError(
-                    f"{self.desc}: segment {i} block {b} CRC mismatch")
-
+        # ONE scatter-preadv for all three blocks (adjacent on disk):
+        # raw-stored blocks land DIRECTLY in the arrays the CellBatch will
+        # own; compressed blocks land in scratch and are decompressed into
+        # place — no staging bytes object, no memcpy for raw blocks.
+        # Positional read: readers share this handle across threads
+        # (reference: FileHandle/RandomAccessReader are per-thread; pread
+        # avoids the seek/read race entirely).
         meta = np.empty(uls[0], dtype=np.uint8)
         lanes = np.empty((n, self.K), dtype=np.uint32)
         payload = np.empty(uls[2], dtype=np.uint8)
         dsts = [meta, lanes, payload]
-        iov_offs, iov_lens, iov_dsts = [], [], []
+        iovs = []
+        compressed: list[tuple[int, np.ndarray]] = []
         for b in range(3):
             if not self.params.enabled or cls[b] == uls[b]:
-                # stored uncompressed (ratio fallback): straight memcpy
-                dsts[b].reshape(-1).view(np.uint8)[:] = \
-                    src[offs[b]:offs[b] + cls[b]]
+                iovs.append(dsts[b].reshape(-1).view(np.uint8))
             else:
-                iov_offs.append(offs[b])
-                iov_lens.append(cls[b])
-                iov_dsts.append(dsts[b])
-        if iov_dsts:
-            self.compressor.decompress_iov(src, iov_offs, iov_lens,
-                                           iov_dsts)
+                scratch = np.empty(cls[b], dtype=np.uint8)
+                compressed.append((b, scratch))
+                iovs.append(scratch)
+        if hasattr(os, "preadv"):
+            got = os.preadv(self._data.fileno(), iovs, pos)
+        else:   # platforms without preadv: one read + scatter copy
+            raw = os.pread(self._data.fileno(), sum(cls), pos)
+            got = len(raw)
+            if got == sum(cls):
+                src = np.frombuffer(raw, dtype=np.uint8)
+                o = 0
+                for v in iovs:
+                    v[:] = src[o:o + v.nbytes]
+                    o += v.nbytes
+        if got != sum(cls):
+            raise CorruptSSTableError(
+                f"{self.desc}: segment {i} short read ({got}/{sum(cls)})")
+        for b in range(3):
+            if zlib.crc32(iovs[b]) != crcs[b]:
+                raise CorruptSSTableError(
+                    f"{self.desc}: segment {i} block {b} CRC mismatch")
+        for b, scratch in compressed:
+            self.compressor.decompress_iov(scratch, [0], [cls[b]],
+                                           [dsts[b]])
 
         ts = meta[:8 * n].view("<i8")
         o = 8 * n
@@ -313,6 +322,11 @@ class SSTableReader:
     def scanner(self):
         """Sequential segment iterator for compaction/streaming
         (BigTableScanner role). Yields sorted CellBatches."""
+        try:    # prime kernel readahead for the linear walk
+            os.posix_fadvise(self._data.fileno(), 0, 0,
+                             os.POSIX_FADV_SEQUENTIAL)
+        except (OSError, AttributeError):
+            pass
         for i in range(self.n_segments):
             yield self._read_segment(i)
 
@@ -344,14 +358,23 @@ class SSTableReader:
         return self._cell_range(c0, c1)
 
     def verify_digest(self) -> bool:
+        """Recompute every block's CRC from the data file and fold them
+        into the file digest (digest = crc32 over the stream of per-block
+        crc32 words — every data byte is covered by exactly one block CRC,
+        and the writer computes it without a second full-file pass)."""
         with open(self.desc.path(Component.DIGEST)) as f:
             expected = int(f.read().strip())
         crc = 0
-        pos = 0
-        while True:
-            chunk = os.pread(self._data.fileno(), 1 << 20, pos)
-            if not chunk:
-                break
-            crc = zlib.crc32(chunk, crc)
-            pos += len(chunk)
+        for i in range(self.n_segments):
+            pos = int(self._seg_off[i])
+            for b in range(3):
+                cl = int(self._blk[i, b, 0])
+                data = os.pread(self._data.fileno(), cl, pos)
+                if len(data) != cl:
+                    return False
+                bcrc = zlib.crc32(data)
+                if bcrc != int(self._blk[i, b, 2]):
+                    return False
+                crc = zlib.crc32(struct.pack("<I", bcrc), crc)
+                pos += cl
         return (crc & 0xFFFFFFFF) == expected

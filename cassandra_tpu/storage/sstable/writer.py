@@ -12,6 +12,7 @@ the bloom filter / partition directory / stats as it goes.
 from __future__ import annotations
 
 import json
+import mmap
 import os
 import struct
 import threading
@@ -27,13 +28,24 @@ from .format import SEGMENT_CELLS, Component, Descriptor
 
 
 class SSTableWriter:
-    # trickle fsync (conf trickle_fsync role): push dirty pages to disk
-    # WHILE later segments compress/serialize, so the commit-time fsync
-    # only pays for the tail. Without it a large sstable's entire flush
-    # hits the disk in one blocking call at finish() — measured as the
-    # single largest compaction phase on this box (disk ~128 MiB/s
-    # flushed vs ~2 GiB/s to page cache).
+    # trickle fsync (conf trickle_fsync role), used by the BUFFERED
+    # fallback path only: push dirty pages to disk WHILE later segments
+    # compress/serialize, so the commit-time fsync only pays for the tail.
     TRICKLE_FSYNC_BYTES = 16 << 20
+    # block preallocation ahead of the write cursor: avoids the
+    # delayed-allocation path (and fragmentation) on every extend.
+    PREALLOC_BYTES = 32 << 20
+    # Data.db is written O_DIRECT through an aligned bounce buffer.
+    # Rationale (measured on this box): buffered writes interleaved with
+    # compression CPU work collapse to ~60-90 MiB/s under kernel dirty-
+    # page throttling (state-dependent, not controllable from userspace),
+    # while O_DIRECT runs at ~700 MiB/s steady and leaves the final fsync
+    # nearly free because data blocks are already on disk. It also keeps
+    # compaction output from evicting the read-path page cache — the
+    # reference wants the same and uses posix_fadvise/direct IO options
+    # (io/util/SequentialWriterOption, conf commitlog_disk_access_mode).
+    DIRECT_ALIGN = 4096
+    BOUNCE_BYTES = 8 << 20
 
     def __init__(self, descriptor: Descriptor, table: TableMetadata,
                  estimated_partitions: int = 1024,
@@ -46,12 +58,28 @@ class SSTableWriter:
         self.K = None  # lanes, learned from first batch
 
         os.makedirs(descriptor.directory, exist_ok=True)
-        # unbuffered: segment blocks are MB-sized memoryviews already —
-        # BufferedWriter would only add a copy per write
-        self._data = open(descriptor.tmp_path(Component.DATA), "wb",
-                          buffering=0)
+        data_path = descriptor.tmp_path(Component.DATA)
+        self._direct = True
+        try:
+            self._data_fd = os.open(
+                data_path,
+                os.O_WRONLY | os.O_CREAT | os.O_TRUNC | os.O_DIRECT, 0o644)
+        except OSError:       # fs without O_DIRECT: buffered + trickle
+            self._direct = False
+            self._data_fd = os.open(
+                data_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+        # unbuffered FileIO over the fd: segment blocks are MB-sized
+        # memoryviews already — BufferedWriter would only add a copy
+        self._data = open(self._data_fd, "wb", buffering=0, closefd=True)
+        if self._direct:
+            # page-aligned bounce buffer (mmap is always page-aligned);
+            # O_DIRECT requires aligned address, offset and length
+            self._bounce = mmap.mmap(-1, self.BOUNCE_BYTES)
+            self._bounce_mv = memoryview(self._bounce)
+            self._bounce_fill = 0
         self._data_crc = 0
         self._data_off = 0
+        self._allocated = 0
         self._index_entries: list[bytes] = []
         self._bloom = bloom.BloomFilter.create(max(estimated_partitions, 16))
         # partition directory accumulators
@@ -59,6 +87,15 @@ class SSTableWriter:
         self._part_first_cell: list[int] = []
         self._part_pk: list[bytes] = []
         self._last_lane4: bytes | None = None
+        # adaptive compression skip, per block stream (meta/lanes/payload):
+        # after 8 consecutive raw-stored blocks the next 15 skip the
+        # compression attempt entirely, then one probe re-checks. Random
+        # blob values (the stress default) store ~every payload block raw,
+        # so attempting LZ4 on them was pure CPU waste; compressible
+        # streams never enter skip mode. Chunk-granular analog of lz4's
+        # own acceleration heuristic.
+        self._raw_streak = [0, 0, 0]
+        self._skip_left = [0, 0, 0]
         # pending cells not yet cut into a segment
         self._pending: list[CellBatch] = []
         self._pending_cells = 0
@@ -109,9 +146,17 @@ class SSTableWriter:
         self._stop_syncer()   # join BEFORE the final fsync + close
         if self._sync_error is not None:
             raise self._sync_error
+        if self._direct:
+            self._flush_bounce(final=True)
         self._data.flush()
+        # drop alignment padding / unused preallocation before the
+        # commit-point rename
+        os.ftruncate(self._data.fileno(), self._data_off)
         os.fsync(self._data.fileno())
         self._data.close()
+        if self._direct:
+            self._bounce_mv.release()
+            self._bounce.close()
 
         self._write_index()
         self._write_partitions()
@@ -139,10 +184,36 @@ class SSTableWriter:
         self._finished = True
         return stats
 
+    def _ensure_alloc(self, end: int) -> None:
+        if end <= self._allocated:
+            return
+        new_alloc = end + self.PREALLOC_BYTES
+        try:
+            os.posix_fallocate(self._data.fileno(), self._allocated,
+                               new_alloc - self._allocated)
+            self._allocated = new_alloc
+        except OSError:
+            # fs without fallocate support: fall back to plain extend
+            self._allocated = 1 << 62
+
     def _write_all(self, mv: memoryview) -> None:
-        """Raw FileIO.write may write short (and caps single writes around
-        2 GiB on Linux) — loop until every byte lands."""
         total = mv.nbytes
+        self._ensure_alloc(self._data_off + total)
+        if self._direct:
+            # stage into the aligned bounce buffer; flush full buffers
+            # (BOUNCE_BYTES is a multiple of DIRECT_ALIGN, so steady-state
+            # flushes are always aligned and leave no remainder)
+            while mv.nbytes:
+                take = min(self.BOUNCE_BYTES - self._bounce_fill, mv.nbytes)
+                self._bounce_mv[self._bounce_fill:
+                                self._bounce_fill + take] = mv[:take]
+                self._bounce_fill += take
+                mv = mv[take:]
+                if self._bounce_fill == self.BOUNCE_BYTES:
+                    self._flush_bounce()
+            return
+        # buffered fallback: raw FileIO.write may write short (and caps
+        # single writes around 2 GiB on Linux) — loop until all lands
         while mv.nbytes:
             n = self._data.write(mv)
             if n is None or n <= 0:
@@ -157,6 +228,23 @@ class SSTableWriter:
                     name="sstable-trickle-fsync")
                 self._syncer.start()
             self._sync_req.set()       # syncer flushes in the background
+
+    def _flush_bounce(self, final: bool = False) -> None:
+        end = self._bounce_fill
+        if final:
+            aligned = -(-end // self.DIRECT_ALIGN) * self.DIRECT_ALIGN
+            if aligned > end:   # zero-pad; finish() truncates back
+                self._bounce_mv[end:aligned] = bytes(aligned - end)
+            end = aligned
+        pos = 0
+        while pos < end:
+            n = self._data.write(self._bounce_mv[pos:end])
+            if n is None or n <= 0:
+                raise OSError("short write to Data.db")
+            if n % self.DIRECT_ALIGN and pos + n < end:
+                raise OSError("misaligned partial O_DIRECT write")
+            pos += n
+        self._bounce_fill = 0
 
     def _trickle_sync(self) -> None:
         while True:
@@ -197,6 +285,9 @@ class SSTableWriter:
         self._stop_syncer()
         if not self._data.closed:
             self._data.close()
+        if self._direct and not self._bounce.closed:
+            self._bounce_mv.release()
+            self._bounce.close()
         for comp in Component.ALL:
             p = self.desc.tmp_path(comp)
             if os.path.exists(p):
@@ -298,20 +389,41 @@ class SSTableWriter:
         lanes_b = np.ascontiguousarray(seg.lanes.astype("<u4", copy=False))
         payload_b = np.ascontiguousarray(seg.payload)
         blocks = [meta, lanes_b, payload_b]
-        dst, dst_offs, sizes = self.compressor.compress_iov(blocks)
+        attempt = []
+        for i in range(3):
+            if self._skip_left[i] > 0:
+                self._skip_left[i] -= 1
+                attempt.append(False)
+            else:
+                attempt.append(True)
+        tried = [b for b, a in zip(blocks, attempt) if a]
+        dst, dst_offs, sizes = self.compressor.compress_iov(tried)
         # min_compress_ratio fallback: store uncompressed when too poor
         # (CompressedSequentialWriter.java:160-175 semantics)
         maxlen = self.params.max_compressed_length
         entry = struct.pack("<QI", self._data_off, n)
+        ti = 0
         for i, raw in enumerate(blocks):
-            c = dst[int(dst_offs[i]):int(dst_offs[i]) + int(sizes[i])]
-            if c.nbytes >= min(raw.nbytes, maxlen):
+            if attempt[i]:
+                c = dst[int(dst_offs[ti]):int(dst_offs[ti]) + int(sizes[ti])]
+                ti += 1
+                if c.nbytes >= min(raw.nbytes, maxlen):
+                    c = raw
+                    self._raw_streak[i] += 1
+                    if self._raw_streak[i] >= 8:
+                        self._skip_left[i] = 15
+                else:
+                    self._raw_streak[i] = 0
+            else:
                 c = raw
             mv = memoryview(c).cast("B")
             crc = zlib.crc32(mv)
             entry += struct.pack("<QQI", c.nbytes, raw.nbytes, crc)
             self._write_all(mv)
-            self._data_crc = zlib.crc32(mv, self._data_crc)
+            # file digest = crc32 over the per-block crc words: every byte
+            # is covered (via its block crc) without a second full pass
+            self._data_crc = zlib.crc32(struct.pack("<I", crc),
+                                        self._data_crc)
             self._data_off += c.nbytes
         entry += seg.lanes[0].astype("<u4").tobytes()
         entry += seg.lanes[-1].astype("<u4").tobytes()
