@@ -112,7 +112,7 @@ class StorageProxy:
         mid-bootstrap is missing when ownership flips
         (locator/ReplicaPlans.forWrite pending replicas)."""
         ring = self.node.ring
-        if not ring.pending:
+        if not ring.pending and not ring.replacing:
             return []
         future = ring.future_ring()
         return [r for r in strat.replicas(future, token)

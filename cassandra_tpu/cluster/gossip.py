@@ -26,6 +26,10 @@ class EndpointState:
     arrival_intervals: list = field(default_factory=list)
     last_heartbeat: float = 0.0
     app_states: dict = field(default_factory=dict)  # status, tokens, ...
+    # operator-asserted death (force_convict): only a GENERATION advance
+    # (the node actually restarting) may resurrect, never version churn
+    # relayed through third-party digests
+    forced_down: bool = False
 
 
 class FailureDetector:
@@ -107,11 +111,13 @@ class Gossiper:
                     self.states[ep] = st
                     self.detector.report(ep, st, now)
                 elif (gen, ver) > (st.generation, st.version):
+                    gen_advance = gen > st.generation
                     st.generation, st.version = gen, ver
                     st.app_states.update(apps)
                     self.detector.report(ep, st, now)
-                    if not st.alive:
+                    if not st.alive and (not st.forced_down or gen_advance):
                         st.alive = True
+                        st.forced_down = False
                         if self.on_alive:
                             self.on_alive(ep)
 
@@ -154,7 +160,7 @@ class Gossiper:
                     st.alive = False
                     if self.on_dead:
                         self.on_dead(ep)
-                elif not st.alive and alive:
+                elif not st.alive and alive and not st.forced_down:
                     st.alive = True
                     if self.on_alive:
                         self.on_alive(ep)
@@ -167,6 +173,24 @@ class Gossiper:
         with self._lock:
             st = self.states.get(ep)
             return bool(st and st.alive)
+
+    def force_convict(self, ep: Endpoint, generation: int | None = None,
+                      version: int | None = None) -> None:
+        """Operator-asserted death (nodetool assassinate / the replace
+        flow's precondition). The state keeps its known (generation,
+        version) so silent gossip digests can't resurrect it — only the
+        node actually speaking again (a generation/version advance)
+        does; last_heartbeat is pushed far past so phi stays convicted."""
+        with self._lock:
+            st = self.states.get(ep)
+            if st is None:
+                st = EndpointState(generation=generation or 0,
+                                   version=version or 0)
+                self.states[ep] = st
+            st.alive = False
+            st.forced_down = True
+            st.arrival_intervals.clear()
+            st.last_heartbeat = self.clock() - 1e9
 
     # ------------------------------------------------------------ lifecycle
 

@@ -196,6 +196,148 @@ class Node:
 
     # ------------------------------------------------- topology changes --
 
+    def topology_commit(self, extra: dict) -> None:
+        """Commit one topology transformation. TCP clusters route it
+        through the epoch log (every node applies the same entries in
+        the same order — tcm/Commit); LocalCluster nodes share one Ring
+        object, so the transformation applies directly."""
+        from .schema_sync import apply_topology_to_ring
+        if self.schema_sync is not None:
+            self.schema_sync.commit_topology(extra)
+        else:
+            apply_topology_to_ring(self.ring, extra)
+
+    def _ep_dict(self, ep: Endpoint | None = None) -> dict:
+        ep = ep or self.endpoint
+        return {"name": ep.name, "dc": ep.dc, "rack": ep.rack,
+                "host": ep.host, "port": ep.port}
+
+    def join_cluster(self, tokens: list[int]) -> int:
+        """Full TCM join sequence (tcm/sequences/BootstrapAndJoin):
+        start_join (tokens pending, writes duplicated) -> stream ->
+        finish_join (ownership flip). Resumable: a crash between the
+        two entries leaves start_join in the log; resume_topology()
+        on restart re-streams and commits the finish."""
+        self.topology_commit({"op": "start_join", "node": self._ep_dict(),
+                              "tokens": [int(t) for t in tokens]})
+        try:
+            streamed = self.bootstrap()
+        except BaseException:
+            self.topology_commit({"op": "abort_join",
+                                  "node": self._ep_dict()})
+            raise
+        self.topology_commit({"op": "finish_join",
+                              "node": self._ep_dict()})
+        return streamed
+
+    def move_tokens(self, new_tokens: list[int]) -> int:
+        """nodetool move (tcm/sequences/Move): gained ranges stream IN
+        from their current owners (pending-write duplication active
+        meanwhile); after the flip, data of surrendered ranges streams
+        OUT to its new owners, acked, before this returns."""
+        from ..storage.cellbatch import filter_token_range
+        from .replication import ReplicationStrategy
+        me = self.endpoint
+        old_tokens = [int(t) for t in self.ring.endpoints[me]]
+        new_tokens = [int(t) for t in new_tokens]
+        self.topology_commit({"op": "start_move", "node": self._ep_dict(),
+                              "tokens": new_tokens})
+        try:
+            streamed = self.bootstrap()
+            # ranges this node stops replicating once old tokens release
+            fut = self.ring.future_ring()
+            after = fut.clone_without(me)
+            after.add_node(me, new_tokens)
+            outgoing = []
+            for ks in list(self.schema.keyspaces.values()):
+                strat = ReplicationStrategy.create(ks.params.replication)
+                lost_arcs = []
+                for lo, hi in self.ring.all_ranges():
+                    if me in strat.replicas(self.ring, hi) and \
+                            me not in strat.replicas(after, hi):
+                        lost_arcs += [(-(1 << 63), hi),
+                                      (lo, (1 << 63) - 1)] \
+                            if lo > hi else [(lo, hi)]
+                if not lost_arcs:
+                    continue
+                for tname, table in ks.tables.items():
+                    allb = self.engine.store(ks.name, tname).scan_all()
+                    for alo, ahi in lost_arcs:
+                        part = filter_token_range(allb, alo, ahi)
+                        if len(part):
+                            outgoing.append((ks.name, table, part))
+        except BaseException:
+            self.topology_commit({"op": "abort_move",
+                                  "node": self._ep_dict()})
+            raise
+        self.topology_commit({"op": "finish_move", "node": self._ep_dict(),
+                              "old_tokens": old_tokens})
+        # push surrendered data AFTER the flip so owner routing sees the
+        # new ring (decommission pushes the same way)
+        for ksn, table, part in outgoing:
+            self.repair.apply_batch_to_owners(ksn, table, part)
+            streamed += len(part)
+        return streamed
+
+    def replace_node(self, dead_name: str) -> int:
+        """Replace a DEAD node: this (new, empty) node assumes its
+        tokens, streaming every replica range from the survivors
+        (reference replace_address flow / tcm/sequences startup
+        Replace). The dead node must be convicted down; writes during
+        the replace are duplicated here via the future ring."""
+        dead = next((e for e in self.ring.endpoints
+                     if e.name == dead_name), None)
+        if dead is None:
+            raise ValueError(f"{dead_name} not in ring")
+        # positive evidence of death required: a fresh node has no
+        # gossip state at all, and "never heard of it" must not license
+        # removing a live member (split-brain); the operator/harness
+        # conveys conviction via force_convict or observed heartbeats
+        st = self.gossiper.states.get(dead)
+        if st is None or st.alive:
+            raise ValueError(f"{dead_name} is alive or of unknown "
+                             f"liveness; replace requires the failure "
+                             f"detector to have convicted it")
+        self.topology_commit({"op": "start_replace",
+                              "node": self._ep_dict(),
+                              "target": dead_name})
+        try:
+            streamed = self.bootstrap()
+        except BaseException:
+            self.topology_commit({"op": "abort_replace",
+                                  "node": self._ep_dict()})
+            raise
+        self.topology_commit({"op": "finish_replace",
+                              "node": self._ep_dict()})
+        return streamed
+
+    def resume_topology(self) -> int | None:
+        """Resume a multi-step topology operation this node crashed in
+        the middle of (the epoch log holds the start_* entry; the
+        finish never committed). Returns cells streamed, or None if
+        nothing was pending. Reference: TCM in-progress sequences are
+        resumed from the log at startup (tcm/Startup, MultiStepOperation)."""
+        me = self.endpoint
+        if me in self.ring.pending:
+            if me in self.ring.endpoints:    # interrupted token MOVE
+                new_tokens = [int(t) for t in self.ring.pending[me]]
+                # abort cluster-wide, then re-run the whole sequence at
+                # fresh epochs: streaming is idempotent (timestamp
+                # reconcile dedups re-streamed cells), so repeating is safe
+                self.topology_commit({"op": "abort_move",
+                                      "node": self._ep_dict()})
+                return self.move_tokens(new_tokens)
+            streamed = self.bootstrap()
+            self.topology_commit({"op": "finish_join",
+                                  "node": self._ep_dict()})
+            return streamed
+        if me in self.ring.replacing:
+            streamed = self.bootstrap()
+            self.topology_commit({"op": "finish_replace",
+                                  "node": self._ep_dict()})
+            return streamed
+        return None
+
     def bootstrap(self) -> int:
         """Pull this node's replica ranges from existing owners and write
         them as local sstables (reference: tcm/sequences/BootstrapAndJoin
@@ -211,9 +353,10 @@ class Node:
         from .replication import ReplicationStrategy
 
         total = 0
-        if self.endpoint in self.ring.pending:
+        if self.endpoint in self.ring.pending or \
+                self.endpoint in self.ring.replacing:
             future = self.ring.future_ring()
-            current = self.ring    # the PRE-join ring: stream sources
+            current = self.ring    # the PRE-change ring: stream sources
         else:
             future = self.ring
             current = self.ring.clone_without(self.endpoint)
@@ -223,7 +366,10 @@ class Node:
                 replicas = strat.replicas(future, hi)
                 if self.endpoint not in replicas:
                     continue   # we don't replicate this range
-                owners = [e for e in strat.replicas(current, hi)
+                cur_replicas = strat.replicas(current, hi)
+                if self.endpoint in cur_replicas:
+                    continue   # already a replica (token move keeps it)
+                owners = [e for e in cur_replicas
                           if e != self.endpoint and self.is_alive(e)]
                 if not owners:
                     continue
@@ -352,6 +498,7 @@ class LocalCluster:
         self.schema = Schema()
         self.ring = Ring()
         self.nodes: list[Node] = []
+        self._stopped: set[int] = set()
         endpoints = []
         tokens = even_tokens(n, vnodes=4)
         for i in range(n):
@@ -439,16 +586,71 @@ class LocalCluster:
         node.gossiper.start()
         return node
 
+    def move_node(self, i: int, new_tokens: list[int]) -> int:
+        """nodetool move on node i (see Node.move_tokens)."""
+        return self.nodes[i - 1].move_tokens(new_tokens)
+
+    def replace_dead_node(self, dead_i: int, dc: str = "dc1") -> Node:
+        """Replace a stopped node with a fresh one that assumes its
+        tokens (replace_address flow). The dead node must already be
+        stopped (stop_node); its Node object stays in self.nodes so
+        tests can inspect it, but it is out of the ring afterwards."""
+        from .gossip import EndpointState
+        dead = self.nodes[dead_i - 1]
+        if dead_i not in self._stopped:
+            raise ValueError(f"{dead.endpoint.name} is alive; "
+                             f"decommission it instead of replacing")
+        i = len(self.nodes) + 1
+        ep = Endpoint(f"node{i}", dc=dc)
+        seeds = [n.endpoint for n in self.nodes
+                 if n.endpoint != dead.endpoint][:1]
+        node = Node(ep, os.path.join(self.base_dir, ep.name), self.schema,
+                    self.ring, self.transport, seeds=seeds,
+                    gossip_interval=self.nodes[0].gossiper.interval)
+        node.cluster_nodes = self.nodes
+        # the dead peer must be CONVICTED everywhere before a replace
+        # (the reference requires the FD to see it down): pin its known
+        # (generation, version) so silent digests can't resurrect it
+        dead_st = self.nodes[0].gossiper.states.get(dead.endpoint)
+        dgen = dead_st.generation if dead_st else 1
+        dver = dead_st.version if dead_st else 0
+        node.gossiper.force_convict(dead.endpoint, dgen, dver)
+        for other in self.nodes:
+            if other.endpoint == dead.endpoint:
+                continue
+            other.gossiper.force_convict(dead.endpoint)
+            node.gossiper.states.setdefault(other.endpoint,
+                                            EndpointState(generation=1))
+            node.gossiper.detector.report(
+                other.endpoint, node.gossiper.states[other.endpoint],
+                node.gossiper.clock())
+            other.gossiper.states.setdefault(ep, EndpointState(generation=1))
+            other.gossiper.detector.report(
+                ep, other.gossiper.states[ep], other.gossiper.clock())
+        try:
+            node.replace_node(dead.endpoint.name)
+        except BaseException:
+            node._stop_hints.set()
+            node.gossiper.stop()
+            node.messaging.close()
+            node.engine.close()
+            raise
+        self.nodes.append(node)
+        node.gossiper.start()
+        return node
+
     def stop_node(self, i: int) -> None:
         """Simulate a crash: stop gossip + messaging + hint dispatch
         (a crashed process sends nothing; data stays on disk)."""
         n = self.nodes[i - 1]
+        self._stopped.add(i)
         n._stop_hints.set()
         n.gossiper.stop()
         n.messaging.close()
 
     def restart_node(self, i: int) -> None:
         import threading
+        self._stopped.discard(i)
         n = self.nodes[i - 1]
         n.messaging = MessagingService(n.endpoint, self.transport)
         n.gossiper = Gossiper(n.messaging, [self.nodes[0].endpoint],

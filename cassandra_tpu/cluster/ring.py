@@ -36,6 +36,10 @@ class Ring:
         self._owners: dict[int, Endpoint] = {}
         self.endpoints: dict[Endpoint, list[int]] = {}
         self.pending: dict[Endpoint, list[int]] = {}
+        # replacement in progress: new endpoint -> dead endpoint whose
+        # tokens it will assume (tcm/sequences replace-address flow).
+        # Writes meanwhile go to BOTH (future ring maps dead -> new).
+        self.replacing: dict[Endpoint, Endpoint] = {}
         self._future_cache: "Ring | None" = None
 
     def add_node(self, ep: Endpoint, tokens: list[int]) -> None:
@@ -51,6 +55,44 @@ class Ring:
         for t in self.endpoints.pop(ep, []):
             self._tokens.remove(t)
             del self._owners[t]
+        self._future_cache = None
+
+    def remove_tokens(self, ep: Endpoint, tokens: list[int]) -> None:
+        """Release a subset of ep's tokens (the shrink half of a token
+        move; tcm/sequences/Move releases the old placement last)."""
+        owned = self.endpoints.get(ep, [])
+        for t in tokens:
+            if self._owners.get(t) == ep:
+                self._tokens.remove(t)
+                del self._owners[t]
+                owned.remove(t)
+        if ep in self.endpoints and not self.endpoints[ep]:
+            del self.endpoints[ep]
+        self._future_cache = None
+
+    # ------------------------------------------------------- replacement --
+
+    def start_replace(self, new_ep: Endpoint, dead_ep: Endpoint) -> None:
+        """Begin replace-address: new_ep will assume dead_ep's tokens.
+        Until finish, reads still route to the (dead) owner's replica set
+        and writes are duplicated to new_ep via the future ring."""
+        if dead_ep not in self.endpoints:
+            raise ValueError(f"{dead_ep} not in ring")
+        if new_ep in self.endpoints or new_ep in self.replacing:
+            raise ValueError(f"{new_ep} already joined or replacing")
+        self.replacing[new_ep] = dead_ep
+        self._future_cache = None
+
+    def finish_replace(self, new_ep: Endpoint) -> None:
+        """Commit point: dead node leaves, new node owns its tokens."""
+        dead = self.replacing.pop(new_ep)
+        toks = list(self.endpoints.get(dead, []))
+        self.remove_node(dead)
+        self.add_node(new_ep, toks)
+        self._future_cache = None
+
+    def cancel_replace(self, new_ep: Endpoint) -> None:
+        self.replacing.pop(new_ep, None)
         self._future_cache = None
 
     def successors(self, token: int):
@@ -117,14 +159,15 @@ class Ring:
         self._future_cache = None
 
     def future_ring(self) -> "Ring":
-        """The ring as it will be once every pending join completes —
-        pending-write placement is computed against this (cached: every
-        write during a join consults it)."""
+        """The ring as it will be once every pending join/replace
+        completes — pending-write placement is computed against this
+        (cached: every write during a join consults it)."""
         if self._future_cache is not None:
             return self._future_cache
         r = Ring()
+        swap = {dead: new for new, dead in self.replacing.items()}
         for e, toks in self.endpoints.items():
-            r.add_node(e, list(toks))
+            r.add_node(swap.get(e, e), list(toks))
         for e, toks in self.pending.items():
             r.add_node(e, list(toks))
         self._future_cache = r

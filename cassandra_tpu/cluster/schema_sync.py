@@ -61,6 +61,56 @@ class SchemaForwardError(ValueError):
     execution error there) — surfaced to the issuing session."""
 
 
+TOPOLOGY_PREFIX = "#topology "
+
+
+def apply_topology_to_ring(ring, extra: dict) -> None:
+    """Apply one topology transformation to a Ring. The single
+    definition both the epoch-log path (TCP clusters) and the shared-ring
+    path (LocalCluster) go through — reference
+    tcm/transformations/* applied to ClusterMetadata's tokenMap."""
+    from .ring import Endpoint
+
+    op = extra["op"]
+    nd = extra.get("node") or {}
+    ep = Endpoint(nd["name"], nd.get("dc", "dc1"), nd.get("rack", "rack1"),
+                  nd.get("host", "127.0.0.1"), int(nd.get("port", 0)))
+
+    def existing(name: str):
+        for e in ring.endpoints:
+            if e.name == name:
+                return e
+        raise ValueError(f"endpoint {name} not in ring")
+
+    tokens = [int(t) for t in extra.get("tokens") or []]
+    if op == "register":
+        ring.add_node(ep, tokens)
+    elif op == "start_join":
+        ring.add_pending(ep, tokens)
+    elif op == "finish_join":
+        ring.promote_pending(ep)
+    elif op == "abort_join":
+        ring.cancel_pending(ep)
+    elif op == "leave":
+        ring.remove_node(existing(nd["name"]))
+    elif op == "start_move":
+        ring.add_pending(existing(nd["name"]), tokens)
+    elif op == "finish_move":
+        me = existing(nd["name"])
+        ring.promote_pending(me)
+        ring.remove_tokens(me, [int(t) for t in extra["old_tokens"]])
+    elif op == "abort_move":
+        ring.cancel_pending(existing(nd["name"]))
+    elif op == "start_replace":
+        ring.start_replace(ep, existing(extra["target"]))
+    elif op == "finish_replace":
+        ring.finish_replace(ep)
+    elif op == "abort_replace":
+        ring.cancel_replace(ep)
+    else:
+        raise ValueError(f"unknown topology op {op!r}")
+
+
 class SchemaSync:
     FORWARD_TIMEOUT = 5.0
     # pulls re-fetch a window of already-seen epochs so a conflict
@@ -131,6 +181,9 @@ class SchemaSync:
         """Execute the DDL against the local node WITHOUT re-entering
         the coordination path. Object ids the coordinator assigned ride
         in `extra` so every node agrees (mutations route by table id)."""
+        if query.startswith(TOPOLOGY_PREFIX):
+            apply_topology_to_ring(self.node.ring, extra)
+            return
         from ..cql.parser import parse
         from ..cql.execution import Executor
         stmt = parse(query)
@@ -275,9 +328,12 @@ class SchemaSync:
         with self._lock:
             try:
                 extra = fwd_extra or {}
-                stmt = parse(query)
-                self._apply_local(query, keyspace, extra)
-                extra = extra or self._extra_for(stmt, keyspace)
+                if query.startswith(TOPOLOGY_PREFIX):
+                    self._apply_local(query, keyspace, extra)
+                else:
+                    stmt = parse(query)
+                    self._apply_local(query, keyspace, extra)
+                    extra = extra or self._extra_for(stmt, keyspace)
             except Exception as e:
                 return Verb.SCHEMA_FORWARD, ("err", repr(e), None)
             self.epoch += 1
@@ -396,12 +452,46 @@ class SchemaSync:
         self.epoch = max(self.epoch, epoch)
         self._append(epoch, query, keyspace, extra, coord=coord)
 
-    def pull_from_peers(self, timeout: float = 5.0, prefer=None) -> None:
+    def commit_topology(self, extra: dict) -> None:
+        """Commit a topology transformation as an epoch-log entry —
+        membership/placement rides the SAME ordered log as DDL (the
+        reference's ClusterMetadata holds schema AND tokenMap/placements,
+        all changed through one log). The entry text embeds the op so
+        the same-epoch conflict rule dedups identical retries."""
+        query = TOPOLOGY_PREFIX + json.dumps(extra, sort_keys=True)
+        self.coordinate(
+            query, None, None,
+            lambda: apply_topology_to_ring(self.node.ring, extra),
+            extra_override=extra)
+
+    def replay_all(self) -> None:
+        """Re-apply every logged entry in epoch order (daemon restart).
+        The ring is the log's materialization, so topology entries MUST
+        replay; DDL that already exists fails benignly (warned)."""
+        for e in sorted(self._entries):
+            _epoch, query, keyspace, extra, _coord = self._entries[e]
+            try:
+                self._apply_local(query, keyspace, extra or {})
+            except Exception as ex:
+                print(f"[schema-sync] {self.node.endpoint.name}: replay "
+                      f"of epoch {e} ({query[:60]!r}) failed: {ex!r}",
+                      file=sys.stderr)
+
+    def pull_from_peers(self, timeout: float = 5.0, prefer=None,
+                        peers=None) -> bool:
         """Catch-up: ask a live peer (preferring `prefer`) for newer
         entries. Blocks on the response — callers must be off the
-        dispatch thread (startup threads, session threads)."""
-        peers = [ep for ep in self.node.ring.endpoints
-                 if ep != self.node.endpoint and self.node.is_alive(ep)]
+        dispatch thread (startup threads, session threads). `peers`
+        overrides discovery — a FRESH node joining has an empty ring and
+        only knows its configured seed addresses (tcm/Discovery role).
+        Returns True if any peer answered (callers that REQUIRE the
+        cluster's log — auto-join discovery — must treat False as
+        fatal, not as 'I am the first node')."""
+        if peers is None:
+            peers = [ep for ep in self.node.ring.endpoints
+                     if ep != self.node.endpoint and self.node.is_alive(ep)]
+        else:
+            peers = [ep for ep in peers if ep != self.node.endpoint]
         if prefer is not None and prefer in peers:
             peers.remove(prefer)
             peers.insert(0, prefer)
@@ -417,4 +507,5 @@ class SchemaSync:
                 max(0, self.epoch - self.PULL_OVERLAP), ep,
                 on_response=on_rsp, timeout=timeout)
             if done.wait(timeout):
-                return
+                return True
+        return False

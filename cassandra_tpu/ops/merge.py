@@ -318,6 +318,253 @@ def prev_eq(a):
     return jnp.concatenate([jnp.full((1,), ~a[0], dtype=a.dtype), a[:-1]])
 
 
+# ------------------------------------- compressed key-plane path (v2) -------
+#
+# The tunneled chip moves ~30 MB/s each way once warm, so the device engine
+# lives or dies by BYTES PER CELL. The v2 path pushes a compressed key
+# stream instead of the full (lanes, meta) arrays:
+#
+#   pk rank    u32   partition identity remapped host-side to its dense
+#                    rank among the round's distinct partitions (the 16-byte
+#                    token+hash prefix repeats for every cell of a
+#                    partition; rank preserves order and equality, which is
+#                    all sort/boundary detection needs)
+#   row/col/path lanes   only lanes that actually VARY in this round; a
+#                    constant lane can neither reorder cells nor create a
+#                    boundary, so it travels as one scalar
+#   ts planes    u32+u16(+u16)  timestamps split into lo32/mid16/hi16 —
+#                    hi16 is constant for any real dataset (range < 2^48)
+#                    and travels as a scalar
+#   cdel         u8   only when the round contains complex deletions
+#
+# Purge, TTL expiry and tombstone conversion move to a HOST post-pass:
+# they filter the kept set but never change the sort order or the
+# shadowing carries, so the device doesn't need ldt/flags/purge_ts at all.
+# Typical cost: ~14-18 bytes/cell pushed vs 80 for the v1 packed path.
+# On a locally attached chip the same layout wins on PCIe traffic and
+# leaves HBM bandwidth to the sort itself.
+
+_PAD_QUANTUM = 1 << 18   # above 256K cells: pad to 256K multiples
+                         # (<=12% padding, few program shapes)
+
+
+def _plane_pad(n: int) -> int:
+    """Padded round size: power-of-two buckets below the quantum (a 10K
+    round must not pay a 256K-row transfer), 256K multiples above."""
+    if n <= _PAD_QUANTUM:
+        b = 1024
+        while b < n:
+            b <<= 1
+        return b
+    return -(-n // _PAD_QUANTUM) * _PAD_QUANTUM
+
+
+def _partition_ranks(batches: list[CellBatch]) -> np.ndarray:
+    """Dense rank of each cell's 16-byte partition prefix among the
+    round's distinct partitions. Each input run is sorted, so per-run
+    distinct prefixes come from boundary diffs; the global order is the
+    union (np.unique of the per-run boundary sets, not of all cells)."""
+    run_uniques = []
+    run_counts = []
+    for b in batches:
+        l4 = np.ascontiguousarray(b.lanes[:, :4].astype(">u4"))
+        keys = l4.view("S16").ravel()
+        new = np.ones(len(b), dtype=bool)
+        new[1:] = keys[1:] != keys[:-1]
+        starts = np.flatnonzero(new)
+        run_uniques.append(keys[starts])
+        run_counts.append(np.diff(np.append(starts, len(b))))
+    all_u = np.unique(np.concatenate(run_uniques))
+    parts = []
+    for uniq, counts in zip(run_uniques, run_counts):
+        ranks = np.searchsorted(all_u, uniq).astype(np.uint32)
+        parts.append(np.repeat(ranks, counts))
+    return np.concatenate(parts)
+
+
+def _plane_pack_v2(cat: CellBatch, batches: list[CellBatch]):
+    """Build the compressed plane dict + static config for the device
+    program. Returns (planes, cfg) or None when the layout can't encode
+    this round (ts range >= 2^48 with varying hi16 is still encodable —
+    only a rank overflow bails)."""
+    n = len(cat)
+    N = _plane_pad(n)
+    K = cat.n_lanes
+    ranks = _partition_ranks(batches)
+    if n and int(ranks.max()) >= 0xFFFFFF00:
+        return None   # rank must stay below the padding sentinel
+    rank_plane = np.full(N, 0xFFFFFFFF, dtype=np.uint32)
+    rank_plane[:n] = ranks
+
+    # varying non-partition lanes, classified by boundary group. When
+    # every composite fits the prefix lanes, the ckh hash lanes (K-5,
+    # K-4) are redundant with the prefix (prefix-free encodings) and are
+    # not pushed — 8 bytes/cell of incompressible hash saved.
+    skip = {K - 5, K - 4} if cat.ck_fits_prefix else set()
+    row_idx, col_idx, path_idx = [], [], []
+    for k in range(4, K):
+        if k in skip:
+            continue
+        col_vals = cat.lanes[:, k]
+        if int(col_vals.min()) == int(col_vals.max()):
+            continue
+        if k < K - 3:
+            row_idx.append(k)
+        elif k == K - 3:
+            col_idx.append(k)
+        else:
+            path_idx.append(k)
+    lane_planes = []
+    for k in row_idx + col_idx + path_idx:
+        p = np.full(N, 0xFFFFFFFF, dtype=np.uint32)
+        p[:n] = cat.lanes[:, k]
+        lane_planes.append(p)
+    col_const = int(cat.lanes[0, K - 3]) if not col_idx and n else 0
+
+    with np.errstate(over="ignore"):
+        uts = cat.ts.astype(np.uint64) ^ np.uint64(1 << 63)
+    ts_lo = np.zeros(N, dtype=np.uint32)
+    ts_lo[:n] = (uts & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    mid = ((uts >> np.uint64(32)) & np.uint64(0xFFFF)).astype(np.uint16)
+    hi = (uts >> np.uint64(48)).astype(np.uint16)
+    ts_mid = np.zeros(N, dtype=np.uint16)
+    ts_mid[:n] = mid
+    hi_varies = bool(n) and int(hi.min()) != int(hi.max())
+    ts_hi = None
+    hi_const = int(hi[0]) if n else 0
+    if hi_varies:
+        ts_hi = np.zeros(N, dtype=np.uint16)
+        ts_hi[:n] = hi
+
+    cdel_any = bool(((cat.flags & FLAG_COMPLEX_DEL) != 0).any())
+    cdel = None
+    if cdel_any:
+        cdel = np.zeros(N, dtype=np.uint8)
+        cdel[:n] = ((cat.flags & FLAG_COMPLEX_DEL) != 0).astype(np.uint8)
+
+    planes = {"rank": rank_plane, "ts_lo": ts_lo, "ts_mid": ts_mid,
+              "hi_const": np.uint32(hi_const),
+              "col_const": np.uint32(col_const)}
+    for i, p in enumerate(lane_planes):
+        planes[f"lane{i}"] = p
+    if ts_hi is not None:
+        planes["ts_hi"] = ts_hi
+    if cdel is not None:
+        planes["cdel"] = cdel
+    cfg = (len(row_idx), len(col_idx), len(path_idx),
+           ts_hi is not None, cdel is not None)
+    return planes, cfg
+
+
+def _plane_lsd_sort(planes, cfg):
+    n_row, n_col, n_path, has_hi, has_cdel = cfg
+    N = planes["rank"].shape[0]
+    perm = jnp.arange(N, dtype=jnp.int32)
+
+    def asc(key, perm):
+        _, p = jax.lax.sort((key[perm], perm), num_keys=1, is_stable=True)
+        return p
+
+    def desc(key, perm):
+        k = key[perm]
+        flipped = jnp.array(np.iinfo(key.dtype.name).max, key.dtype) - k
+        _, p = jax.lax.sort((flipped, perm), num_keys=1, is_stable=True)
+        return p
+
+    # least-significant first: ~ts_lo, ~ts_mid, [~ts_hi], path lanes,
+    # col lane, row lanes (reversed), rank. Padding rows carry rank
+    # 0xFFFFFFFF and sort to the tail; stability keeps input order on ties.
+    perm = desc(planes["ts_lo"], perm)
+    perm = desc(planes["ts_mid"], perm)
+    if has_hi:
+        perm = desc(planes["ts_hi"], perm)
+    n_lanes = n_row + n_col + n_path
+    for i in reversed(range(n_lanes)):
+        perm = asc(planes[f"lane{i}"], perm)
+    perm = asc(planes["rank"], perm)
+    return perm
+
+
+def _plane_reconcile(planes, cfg, perm):
+    n_row, n_col, n_path, has_hi, has_cdel = cfg
+    rank = planes["rank"][perm]
+    N = rank.shape[0]
+    valid = rank != jnp.uint32(0xFFFFFFFF)
+    first = jnp.zeros(N, dtype=bool).at[0].set(True)
+
+    def diff(a):
+        prev = jnp.concatenate([jnp.full((1,), ~a[0], dtype=a.dtype),
+                                a[:-1]])
+        return a != prev
+
+    part_new = first | diff(rank)
+    row_new = part_new
+    for i in range(n_row):
+        row_new = row_new | diff(planes[f"lane{i}"][perm])
+    if n_col:
+        col_lane = planes[f"lane{n_row}"][perm]
+        col_new = row_new | diff(col_lane)
+    else:
+        col_lane = jnp.broadcast_to(planes["col_const"], (N,))
+        col_new = row_new
+    cell_new = col_new
+    for i in range(n_row + n_col, n_row + n_col + n_path):
+        cell_new = cell_new | diff(planes[f"lane{i}"][perm])
+
+    hi = planes["ts_hi"][perm].astype(jnp.uint32) if has_hi \
+        else jnp.broadcast_to(planes["hi_const"], (N,))
+    ts_h = (hi << 16) | planes["ts_mid"][perm].astype(jnp.uint32)
+    ts_l = planes["ts_lo"][perm]
+    is_cd = planes["cdel"][perm] == 1 if has_cdel \
+        else jnp.zeros(N, dtype=bool)
+
+    winner = cell_new & valid
+    is_pd = col_lane == COL_PARTITION_DEL
+    is_rd = col_lane == COL_ROW_DEL
+    zero = jnp.uint32(0)
+    pd_h = jnp.where(part_new & is_pd, ts_h, zero)
+    pd_l = jnp.where(part_new & is_pd, ts_l, zero)
+    pd_h, pd_l = _seg_carry_pair(pd_h, pd_l, part_new)
+    rd_h = jnp.where(row_new & is_rd, ts_h, zero)
+    rd_l = jnp.where(row_new & is_rd, ts_l, zero)
+    rd_h, rd_l = _seg_carry_pair(rd_h, rd_l, row_new)
+    use_pd = _lt_pair(rd_h, rd_l, pd_h, pd_l)
+    del_h = jnp.where(use_pd, pd_h, rd_h)
+    del_l = jnp.where(use_pd, pd_l, rd_l)
+    cd_h = jnp.where(col_new & is_cd, ts_h, zero)
+    cd_l = jnp.where(col_new & is_cd, ts_l, zero)
+    cd_h, cd_l = _seg_carry_pair(cd_h, cd_l, col_new)
+    use_cd = _lt_pair(del_h, del_l, cd_h, cd_l)
+    cdel_h = jnp.where(use_cd, cd_h, del_h)
+    cdel_l = jnp.where(use_cd, cd_l, del_l)
+
+    plain = ~is_pd & ~is_rd & ~is_cd
+    shadowed = jnp.where(
+        plain, _le_pair(ts_h, ts_l, cdel_h, cdel_l),
+        jnp.where(is_rd, _le_pair(ts_h, ts_l, pd_h, pd_l),
+                  jnp.where(is_cd, _le_pair(ts_h, ts_l, del_h, del_l),
+                            False)))
+
+    keep0 = winner & ~shadowed
+    same_ts = (ts_h == prev_eq(ts_h)) & (ts_l == prev_eq(ts_l))
+    ambiguous = (~cell_new) & same_ts & valid
+    packed = (keep0.astype(jnp.uint32)
+              | (ambiguous.astype(jnp.uint32) << 1)
+              | (shadowed.astype(jnp.uint32) << 3))
+    return (packed << 24) | perm.astype(jnp.uint32)
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("cfg",))
+def _plane_program(planes, cfg):
+    """One dispatch: LSD sort over the compressed planes + reconcile.
+    Returns (masks << 24) | perm as uint32 (requires N < 2^24)."""
+    perm = _plane_lsd_sort(planes, cfg)
+    return _plane_reconcile(planes, cfg, perm)
+
+
 # ----------------------------------------------------------------- wrapper --
 
 def _bucket(n: int) -> int:
@@ -402,25 +649,40 @@ def merge_sorted_device(batches: list[CellBatch], gc_before: int = 0,
     pts = purgeable_ts_fn(cat).astype(np.int64) \
         if purgeable_ts_fn is not None else None
     t1 = _t()
-    if _bucket(n) >= (1 << 24) or \
+    if _plane_pad(n) >= (1 << 24) or \
             ((cat.flags & FLAG_RANGE_BOUND) != 0).any():
         # fall back to the numpy spec path: the packed perm layout holds
         # 24 bits (a single >16M-cell partition overflows it), and range
         # tombstone coverage is evaluated host-side on full composites
         return cb_merge_fallback(batches, gc_before, now, purgeable_ts_fn)
-    lanes_np, meta_np = pack_host(cat, pts)
+    packed_v2 = _plane_pack_v2(cat, batches)
+    if packed_v2 is None:
+        return cb_merge_fallback(batches, gc_before, now, purgeable_ts_fn)
+    planes, cfg = packed_v2
     t2 = _t()
-    perm, packed = packed_sort_reconcile(lanes_np, meta_np, gc_before, now)
+    planes_d = {k: jax.device_put(v) for k, v in planes.items()}
+    combined = np.asarray(_plane_program(planes_d, cfg))
     t3 = _t()
-    perm_real = perm[:n]
-    keep, ambiguous, expired, shadowed = unpack_masks(packed[:n])
+    perm = (combined & 0x00FFFFFF).astype(np.int64)[:n]
+    bits = (combined >> 24).astype(np.uint8)[:n]
+    keep, ambiguous, _, shadowed = unpack_masks(bits)
 
-    # host tie-break for equal-(identity, ts) runs (host_tiebreak below)
-    pts_sorted = pts[perm_real] if pts is not None else None
-    host_tiebreak(cat, perm_real, keep, ambiguous, shadowed,
+    # host post-pass: TTL expiry, purge and tie-breaks don't affect sort
+    # order or shadow carries, so they never went to the device
+    flags_s = cat.flags[perm]
+    ldt_s = cat.ldt[perm]
+    ts_s = cat.ts[perm]
+    expired = ((flags_s & FLAG_EXPIRING) != 0) & (ldt_s <= now)
+    death_eff = ((flags_s & DEATH_FLAGS) != 0) | expired
+    pts_sorted = pts[perm] if pts is not None else None
+    purgeable = np.ones(n, dtype=bool) if pts_sorted is None \
+        else ts_s < pts_sorted
+    purged = death_eff & (ldt_s < gc_before) & purgeable
+    keep &= ~purged
+    host_tiebreak(cat, perm, keep, ambiguous, shadowed,
                   expired, gc_before, pts_sorted)
 
-    out = finalize_merged(cat, perm_real, keep, expired, shadowed)
+    out = finalize_merged(cat, perm, keep, expired, shadowed)
     t4 = _t()
     if prof is not None:
         prof["purge_fn"] = prof.get("purge_fn", 0.0) + (t1 - t0)

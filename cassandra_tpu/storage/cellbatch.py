@@ -197,6 +197,13 @@ class CellBatch:
     # (table.clustering_comp). Set by builders/readers that know the
     # table; needed only when range tombstones are reconciled.
     ck_comp = None
+    # True when EVERY cell's clustering composite fits entirely in the
+    # prefix lanes: the ckh hash lanes then add no ordering/equality
+    # information (byte-comparable composites are prefix-free), so the
+    # device merge can skip pushing 8 bytes/cell of incompressible hash.
+    # Builders set it from observed composite lengths; it survives the
+    # sstable round-trip via Statistics.db. False = safe default.
+    ck_fits_prefix = False
 
     def __len__(self) -> int:
         return len(self.ts)
@@ -294,6 +301,7 @@ class CellBatch:
                         new_val_start, new_payload, dict(self.pk_map),
                         sorted=True)
         out.ck_comp = self.ck_comp
+        out.ck_fits_prefix = self.ck_fits_prefix
         return out
 
     # ------------------------------------------------------------ concat --
@@ -310,6 +318,7 @@ class CellBatch:
                         self.payload[base:int(self.off[hi])],
                         self.pk_map, sorted=self.sorted)
         out.ck_comp = self.ck_comp
+        out.ck_fits_prefix = self.ck_fits_prefix
         return out
 
     def drop_values(self, mask: np.ndarray) -> "CellBatch":
@@ -333,6 +342,7 @@ class CellBatch:
                         new_off, new_off[:-1] + header_lens,
                         new_payload, dict(self.pk_map), sorted=self.sorted)
         out.ck_comp = self.ck_comp
+        out.ck_fits_prefix = self.ck_fits_prefix
         return out
 
     @staticmethod
@@ -370,6 +380,7 @@ class CellBatch:
             if b.ck_comp is not None:
                 out.ck_comp = b.ck_comp
                 break
+        out.ck_fits_prefix = all(b.ck_fits_prefix for b in batches)
         return out
 
     @staticmethod
@@ -599,6 +610,7 @@ class CellBatchBuilder:
         self._val_start: list[int] = []
         self.pk_map: dict[bytes, bytes] = {}
         self._comp_cache: dict[bytes, bytes] = {}
+        self._ck_fits = True
 
     def __len__(self):
         return len(self._ts)
@@ -629,6 +641,8 @@ class CellBatchBuilder:
                 comp = self.table.clustering_comp(ck_frame)
                 if len(self._comp_cache) < 65536:
                     self._comp_cache[ck_frame] = comp
+        if len(comp) > 4 * self.C:
+            self._ck_fits = False
         pref = _pack_prefix(comp, self.C)
         h1, _ = murmur3.hash128(comp)
         return (*pref, h1 >> 32, h1 & _U32)
@@ -727,6 +741,7 @@ class CellBatchBuilder:
             np.frombuffer(bytes(self._payload), dtype=np.uint8).copy(),
             dict(self.pk_map))
         out.ck_comp = self.table.clustering_comp
+        out.ck_fits_prefix = self._ck_fits
         return out
 
 

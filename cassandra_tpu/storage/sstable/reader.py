@@ -252,6 +252,7 @@ class SSTableReader:
                           ttl.view(np.int32), flags, off.view(np.int64),
                           val_start.view(np.int64), payload, {},
                           sorted=True)
+        batch.ck_fits_prefix = bool(self.stats.get("ck_fits_prefix", False))
         if self._table is not None:
             batch.ck_comp = self._table.clustering_comp
         self._fill_pk_map(batch, i)

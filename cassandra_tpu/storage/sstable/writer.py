@@ -96,6 +96,7 @@ class SSTableWriter:
         # own acceleration heuristic.
         self._raw_streak = [0, 0, 0]
         self._skip_left = [0, 0, 0]
+        self._ck_fits = True   # AND over appended batches' ck_fits_prefix
         # pending cells not yet cut into a segment
         self._pending: list[CellBatch] = []
         self._pending_cells = 0
@@ -130,6 +131,7 @@ class SSTableWriter:
         if self.K is None:
             self.K = batch.n_lanes
         assert batch.n_lanes == self.K
+        self._ck_fits = self._ck_fits and batch.ck_fits_prefix
         self._pending.append(batch)
         self._pending_cells += len(batch)
         while self._pending_cells >= self.segment_cells:
@@ -469,6 +471,7 @@ class SSTableWriter:
             "compression": self.params.to_dict(),
             "level": self.level,
             "repaired_at": self.repaired_at,
+            "ck_fits_prefix": self._ck_fits,
             **self._stats,
         }
         with open(self.desc.tmp_path(Component.STATS), "w") as f:

@@ -114,12 +114,15 @@ def build_int_batch(table: TableMetadata, pk_ints: np.ndarray,
     for i in uniq:
         pk_map[lane4_be[i].tobytes()] = bytes(pk_mat[i])
 
-    return CellBatch(lanes, np.asarray(ts, dtype=np.int64),
-                     np.full(n, 0x7FFFFFFF, dtype=np.int32),
-                     np.zeros(n, dtype=np.int32),
-                     np.zeros(n, dtype=np.uint8),
-                     off, val_start, payload.reshape(-1),
-                     pk_map, sorted=False)
+    out = CellBatch(lanes, np.asarray(ts, dtype=np.int64),
+                    np.full(n, 0x7FFFFFFF, dtype=np.int32),
+                    np.zeros(n, dtype=np.int32),
+                    np.zeros(n, dtype=np.uint8),
+                    off, val_start, payload.reshape(-1),
+                    pk_map, sorted=False)
+    out.ck_comp = table.clustering_comp
+    out.ck_fits_prefix = int(comp_len.max(initial=0)) <= 4 * C
+    return out
 
 
 def selfcheck(table: TableMetadata) -> None:

@@ -47,6 +47,8 @@ def build_node(cfg: dict):
     me = Endpoint(cfg["name"], cfg.get("dc", "dc1"),
                   cfg.get("rack", "rack1"), cfg.get("host", "127.0.0.1"),
                   int(cfg["port"]))
+    if cfg.get("auto_join"):
+        return _build_tcm_node(cfg, me)
     ring = Ring()
     ring.add_node(me, [int(t) for t in cfg["tokens"]])
     peers = {}
@@ -93,6 +95,98 @@ def build_node(cfg: dict):
     import threading as _threading
     _threading.Thread(target=_catch_up, daemon=True,
                       name="schema-catchup").start()
+    return node, transport
+
+
+def _build_tcm_node(cfg: dict, me):
+    """TCM startup (tcm/Startup.initialize role): the RING IS THE LOG.
+    A fresh node pulls the epoch log from its seed addresses, replays it
+    into ring+schema, then either resumes an interrupted multi-step
+    operation, registers as the first node, or runs the full
+    BootstrapAndJoin sequence. No static peer/token config.
+
+    Config keys: auto_join: true, seed_nodes: [{name,host,port,dc,rack}],
+    optional tokens (else allocated), vnodes (default 4)."""
+    import time as _t
+
+    from ..cluster.node import Node
+    from ..cluster.ring import Endpoint, Ring, allocate_tokens
+    from ..cluster.schema_sync import SchemaSync
+    from ..cluster.tcp import TcpTransport
+    from ..cluster.tls import TLSConfig
+
+    from ..schema import Schema
+
+    seed_eps = [Endpoint(s["name"], s.get("dc", "dc1"),
+                         s.get("rack", "rack1"),
+                         s.get("host", "127.0.0.1"), int(s["port"]))
+                for s in cfg.get("seed_nodes", [])]
+    ring = Ring()
+    transport = TcpTransport(tls=TLSConfig.from_dict(cfg.get("server_tls")))
+    node = Node(me, cfg["data_dir"], Schema(), ring, transport,
+                seeds=[e for e in seed_eps if e != me] or [me],
+                gossip_interval=float(cfg.get("gossip_interval", 0.2)))
+    node.cluster_nodes = [node]
+    node.schema_sync = SchemaSync(node, cfg["data_dir"])
+    # local log first (restart), then the cluster's newer entries
+    node.schema_sync.replay_all()
+    others = [e for e in seed_eps if e != me]
+    if others:
+        # discovery MUST succeed: falling through to "I am the first
+        # node" after a failed pull would fork a second cluster with its
+        # own epoch log claiming the same token space
+        ok = False
+        for _ in range(6):
+            if node.schema_sync.pull_from_peers(timeout=5.0, peers=others):
+                ok = True
+                break
+            _t.sleep(1.0)
+        if not ok and node.schema_sync.epoch == 0:
+            raise RuntimeError(
+                f"{me.name}: no configured seed answered the log pull; "
+                f"refusing to start a new cluster (remove seed_nodes to "
+                f"bootstrap a fresh cluster)")
+    node.gossiper.start()
+    if others and (me not in ring.endpoints or me in ring.pending
+                   or me in ring.replacing):
+        # joining/resuming streams from live owners: wait for gossip to
+        # mark a peer alive first, or bootstrap sees zero sources and
+        # would "complete" having streamed nothing
+        deadline = _t.monotonic() + 20.0
+        while _t.monotonic() < deadline and \
+                not any(node.is_alive(e) for e in ring.endpoints
+                        if e != me):
+            _t.sleep(0.1)
+    import os as _os
+    if me in ring.pending or me in ring.replacing:
+        streamed = node.resume_topology()
+        print(f"[noded] {me.name}: resumed interrupted topology op "
+              f"({streamed} cells) at epoch {node.schema_sync.epoch}",
+              flush=True)
+    elif me not in ring.endpoints:
+        tokens = [int(t) for t in cfg.get("tokens") or []] or \
+            allocate_tokens(ring, int(cfg.get("vnodes", 4)))
+        if ring.endpoints:
+            if _os.environ.get("CTPU_TEST_CRASH_AFTER_START_JOIN"):
+                # fault-injection seam for the resume test (the
+                # reference stages the same crash with Byteman rules)
+                node.topology_commit({"op": "start_join",
+                                      "node": node._ep_dict(),
+                                      "tokens": tokens})
+                _os._exit(42)
+            node.join_cluster(tokens)
+            print(f"[noded] {me.name}: joined at epoch "
+                  f"{node.schema_sync.epoch}", flush=True)
+        else:
+            node.topology_commit({"op": "register",
+                                  "node": node._ep_dict(),
+                                  "tokens": tokens})
+            # first node: cfg DDL runs COORDINATED so it lands in the
+            # log and replicates to every later joiner via pull
+            session = node.session()
+            for stmt in cfg.get("ddl", []):
+                session.execute(stmt)
+    node.engine.compactions.enable_auto()
     return node, transport
 
 

@@ -223,7 +223,13 @@ def describecluster(node) -> dict:
         "endpoints": [e.name for e in node.ring.endpoints],
         "schema_epoch": getattr(getattr(node, "schema_sync", None),
                                 "epoch", None),
+        # topology rides the same epoch log (TCM): the metadata epoch IS
+        # the schema_sync epoch; kept as a separate key for operators
+        "metadata_epoch": getattr(getattr(node, "schema_sync", None),
+                                  "epoch", None),
         "pending_joins": [e.name for e in node.ring.pending],
+        "replacing": {n.name: d.name
+                      for n, d in node.ring.replacing.items()},
     }
 
 

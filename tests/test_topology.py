@@ -1,0 +1,187 @@
+"""Topology changes: token move, replace-dead-node, and the epoch-logged
+TCM sequences (reference: tcm/sequences/Move, replace_address flow,
+service/StorageService.java:830 joinRing paths)."""
+import os
+
+import pytest
+
+from cassandra_tpu.cluster.node import LocalCluster
+from cassandra_tpu.cluster.replication import ConsistencyLevel
+from cassandra_tpu.cluster.ring import Ring, Endpoint, allocate_tokens
+from cassandra_tpu.cluster.schema_sync import apply_topology_to_ring
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = LocalCluster(3, str(tmp_path), rf=2)
+    for n in c.nodes:
+        n.proxy.timeout = 1.0
+    s = c.session(1)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 2}")
+    s.execute("USE ks")
+    s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    yield c
+    c.shutdown()
+
+
+def _write_rows(cluster, lo, hi, cl=ConsistencyLevel.QUORUM):
+    s = cluster.session(1)
+    s.keyspace = "ks"
+    cluster.node(1).default_cl = cl
+    for i in range(lo, hi):
+        s.execute(f"INSERT INTO kv (k, v) VALUES ({i}, 'v{i}')")
+
+
+def _assert_rows(cluster, node_i, lo, hi, cl=ConsistencyLevel.QUORUM):
+    s = cluster.session(node_i)
+    s.keyspace = "ks"
+    cluster.node(node_i).default_cl = cl
+    for i in range(lo, hi):
+        rows = s.execute(f"SELECT v FROM kv WHERE k = {i}").rows
+        assert rows == [(f"v{i}",)], f"row {i} missing via node{node_i}"
+
+
+def test_move_tokens_no_lost_rows(cluster):
+    _write_rows(cluster, 0, 120)
+    node2 = cluster.node(2)
+    new_tokens = allocate_tokens(cluster.ring, vnodes=4)
+    cluster.move_node(2, new_tokens)
+    assert sorted(cluster.ring.endpoints[node2.endpoint]) == \
+        sorted(new_tokens)
+    assert node2.endpoint not in cluster.ring.pending
+    # more writes after the move land correctly too
+    _write_rows(cluster, 120, 150)
+    _assert_rows(cluster, 1, 0, 150)
+    _assert_rows(cluster, 3, 0, 150)
+
+
+def test_move_with_concurrent_writes(cluster):
+    """Writes racing the move are never lost: pending-range duplication
+    covers the gained ranges until the flip."""
+    _write_rows(cluster, 0, 60)
+    node2 = cluster.node(2)
+    old = list(cluster.ring.endpoints[node2.endpoint])
+    new_tokens = allocate_tokens(cluster.ring, vnodes=4)
+    # interleave: start the move's pending phase, write, then finish by
+    # driving the same sequence the node would
+    node2.topology_commit({"op": "start_move",
+                           "node": node2._ep_dict(),
+                           "tokens": [int(t) for t in new_tokens]})
+    _write_rows(cluster, 60, 100)     # racing writes (duplicated)
+    streamed = node2.bootstrap()
+    assert streamed >= 0
+    node2.topology_commit({"op": "finish_move",
+                           "node": node2._ep_dict(),
+                           "old_tokens": [int(t) for t in old]})
+    _assert_rows(cluster, 1, 0, 100)
+
+
+def test_replace_dead_node_converges_at_quorum(cluster):
+    _write_rows(cluster, 0, 100, cl=ConsistencyLevel.ALL)
+    cluster.stop_node(3)
+    replacement = cluster.replace_dead_node(3)
+    dead_ep = cluster.nodes[2].endpoint
+    assert dead_ep not in cluster.ring.endpoints
+    assert replacement.endpoint in cluster.ring.endpoints
+    # with node3 still down, QUORUM (RF=2) needs the replacement to
+    # actually hold the streamed data
+    _assert_rows(cluster, 1, 0, 100)
+    # the replacement holds every row it now replicates locally
+    t = cluster.schema.get_table("ks", "kv")
+    from cassandra_tpu.cluster.replication import ReplicationStrategy
+    ks = cluster.schema.keyspaces["ks"]
+    strat = ReplicationStrategy.create(ks.params.replication)
+    held = 0
+    for i in range(100):
+        pk = t.columns["k"].cql_type.serialize(i)
+        tok = cluster.ring.token_of(pk)
+        if replacement.endpoint in strat.replicas(cluster.ring, tok):
+            batch = replacement.engine.store("ks", "kv").read_partition(pk)
+            assert batch is not None and len(batch) > 0, f"row {i}"
+            held += 1
+    assert held > 0
+
+
+def test_replace_alive_node_refused(cluster):
+    with pytest.raises(ValueError, match="alive"):
+        cluster.replace_dead_node(2)
+    # nothing half-applied
+    assert not cluster.ring.replacing
+
+
+def test_writes_during_replace_reach_replacement(cluster):
+    _write_rows(cluster, 0, 30, cl=ConsistencyLevel.ALL)
+    cluster.stop_node(3)
+    dead = cluster.nodes[2].endpoint
+    # drive the replace in steps so we can write mid-way
+    from cassandra_tpu.cluster.gossip import EndpointState
+    i = len(cluster.nodes) + 1
+    ep = Endpoint(f"node{i}")
+    from cassandra_tpu.cluster.node import Node
+    node = Node(ep, os.path.join(cluster.base_dir, ep.name),
+                cluster.schema, cluster.ring, cluster.transport,
+                seeds=[cluster.nodes[0].endpoint],
+                gossip_interval=cluster.nodes[0].gossiper.interval)
+    node.cluster_nodes = cluster.nodes
+    dst = cluster.nodes[0].gossiper.states.get(dead)
+    node.gossiper.force_convict(dead, dst.generation if dst else 1,
+                                dst.version if dst else 0)
+    for other in cluster.nodes[:2]:
+        other.gossiper.force_convict(dead)
+        node.gossiper.states.setdefault(other.endpoint,
+                                        EndpointState(generation=1))
+        node.gossiper.detector.report(
+            other.endpoint, node.gossiper.states[other.endpoint],
+            node.gossiper.clock())
+        other.gossiper.states.setdefault(ep, EndpointState(generation=1))
+        other.gossiper.detector.report(
+            ep, other.gossiper.states[ep], other.gossiper.clock())
+    node.topology_commit({"op": "start_replace", "node": node._ep_dict(),
+                          "target": dead.name})
+    # racing writes at ONE (RF=2 with a dead replica cannot meet QUORUM
+    # until the replace commits); duplication still covers the newcomer
+    _write_rows(cluster, 30, 60, cl=ConsistencyLevel.ONE)
+    node.bootstrap()
+    node.topology_commit({"op": "finish_replace",
+                          "node": node._ep_dict()})
+    cluster.nodes.append(node)
+    _assert_rows(cluster, 1, 0, 60)
+    cluster.shutdown()
+
+
+def test_topology_ops_pure_ring():
+    """apply_topology_to_ring is the single transformation definition:
+    exercise each op against a bare Ring."""
+    r = Ring()
+    n1 = {"name": "n1", "dc": "dc1", "rack": "r1",
+          "host": "127.0.0.1", "port": 1}
+    n2 = {"name": "n2", "dc": "dc1", "rack": "r1",
+          "host": "127.0.0.1", "port": 2}
+    n3 = {"name": "n3", "dc": "dc1", "rack": "r1",
+          "host": "127.0.0.1", "port": 3}
+    apply_topology_to_ring(r, {"op": "register", "node": n1,
+                               "tokens": [0, 100]})
+    apply_topology_to_ring(r, {"op": "start_join", "node": n2,
+                               "tokens": [50]})
+    assert len(r.pending) == 1
+    apply_topology_to_ring(r, {"op": "finish_join", "node": n2})
+    assert len(r.endpoints) == 2 and not r.pending
+    # move n2 50 -> 75
+    apply_topology_to_ring(r, {"op": "start_move", "node": n2,
+                               "tokens": [75]})
+    apply_topology_to_ring(r, {"op": "finish_move", "node": n2,
+                               "old_tokens": [50]})
+    ep2 = next(e for e in r.endpoints if e.name == "n2")
+    assert r.endpoints[ep2] == [75]
+    # replace n1 with n3
+    apply_topology_to_ring(r, {"op": "start_replace", "node": n3,
+                               "target": "n1"})
+    fut = r.future_ring()
+    assert any(e.name == "n3" for e in fut.endpoints)
+    assert not any(e.name == "n1" for e in fut.endpoints)
+    apply_topology_to_ring(r, {"op": "finish_replace", "node": n3})
+    names = {e.name for e in r.endpoints}
+    assert names == {"n2", "n3"}
+    ep3 = next(e for e in r.endpoints if e.name == "n3")
+    assert sorted(r.endpoints[ep3]) == [0, 100]
