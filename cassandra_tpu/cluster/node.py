@@ -245,9 +245,10 @@ class Node:
         try:
             streamed = self.bootstrap()
             # ranges this node stops replicating once old tokens release
-            fut = self.ring.future_ring()
-            after = fut.clone_without(me)
-            after.add_node(me, new_tokens)
+            # (the future ring IS the post-move ring: moving tokens are
+            # excluded from it, so racing writes to surrendered ranges
+            # are already duplicated to their gaining owners)
+            after = self.ring.future_ring()
             outgoing = []
             for ks in list(self.schema.keyspaces.values()):
                 strat = ReplicationStrategy.create(ks.params.replication)
@@ -266,17 +267,21 @@ class Node:
                         part = filter_token_range(allb, alo, ahi)
                         if len(part):
                             outgoing.append((ks.name, table, part))
+            # push surrendered data BEFORE the flip, routed by the
+            # post-move ring: a crash here leaves start_move in the log
+            # and the resume re-runs the whole (idempotent) sequence —
+            # pushing after the flip would lose the handoff on a crash
+            # between the two
+            for ksn, table, part in outgoing:
+                self.repair.apply_batch_to_owners(ksn, table, part,
+                                                  ring=after)
+                streamed += len(part)
         except BaseException:
             self.topology_commit({"op": "abort_move",
                                   "node": self._ep_dict()})
             raise
         self.topology_commit({"op": "finish_move", "node": self._ep_dict(),
                               "old_tokens": old_tokens})
-        # push surrendered data AFTER the flip so owner routing sees the
-        # new ring (decommission pushes the same way)
-        for ksn, table, part in outgoing:
-            self.repair.apply_batch_to_owners(ksn, table, part)
-            streamed += len(part)
         return streamed
 
     def replace_node(self, dead_name: str) -> int:
@@ -372,7 +377,17 @@ class Node:
                 owners = [e for e in cur_replicas
                           if e != self.endpoint and self.is_alive(e)]
                 if not owners:
-                    continue
+                    if any(e != self.endpoint for e in cur_replicas):
+                        # the range HAS owners but none is live: silently
+                        # skipping would let the join/replace "complete"
+                        # with zero data and serve empty reads — fail the
+                        # sequence instead (the caller aborts and the
+                        # operator retries when sources are up)
+                        raise RuntimeError(
+                            f"no live stream source for range "
+                            f"({lo}, {hi}] of {ks.name} "
+                            f"(owners: {cur_replicas})")
+                    continue   # genuinely unowned (empty pre-ring)
                 for tname, table in ks.tables.items():
                     cfs = self.engine.store(ks.name, tname)
                     arcs = [(-(1 << 63), hi),

@@ -357,13 +357,16 @@ class RepairService:
 
     def apply_batch_to_owners(self, keyspace: str, table,
                               batch: cb.CellBatch,
-                              timeout: float = 10.0) -> None:
-        """Push every partition of a batch to that partition's current
-        replica set, acked (decommission / rebalance streaming must be
-        durable before the sender departs)."""
+                              timeout: float = 10.0, ring=None) -> None:
+        """Push every partition of a batch to that partition's replica
+        set, acked (decommission / rebalance streaming must be durable
+        before the sender departs). `ring` overrides the node's current
+        ring — a token move pushes surrendered data to its POST-move
+        owners before committing the flip."""
         node = self.node
         ks = node.schema.keyspaces[keyspace]
         strat = ReplicationStrategy.create(ks.params.replication)
+        route_ring = ring if ring is not None else node.ring
         pending = threading.Semaphore(0)
         failures = []
         sent = 0
@@ -372,7 +375,7 @@ class RepairService:
             m = batch_to_mutation(table, part)
             if m is None:
                 continue
-            for ep in strat.replicas(node.ring, tok):
+            for ep in strat.replicas(route_ring, tok):
                 if ep == node.endpoint:
                     node.engine.apply(m)
                 else:

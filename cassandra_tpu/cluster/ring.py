@@ -40,6 +40,11 @@ class Ring:
         # tokens it will assume (tcm/sequences replace-address flow).
         # Writes meanwhile go to BOTH (future ring maps dead -> new).
         self.replacing: dict[Endpoint, Endpoint] = {}
+        # token move in progress: endpoint -> the OLD tokens it will
+        # release at finish_move. The future ring excludes them, so
+        # writes racing the move are duplicated to the owners gaining
+        # the surrendered ranges (not just the gained ones).
+        self.moving: dict[Endpoint, list[int]] = {}
         self._future_cache: "Ring | None" = None
 
     def add_node(self, ep: Endpoint, tokens: list[int]) -> None:
@@ -167,7 +172,10 @@ class Ring:
         r = Ring()
         swap = {dead: new for new, dead in self.replacing.items()}
         for e, toks in self.endpoints.items():
-            r.add_node(swap.get(e, e), list(toks))
+            drop = set(self.moving.get(e, ()))
+            kept = [t for t in toks if t not in drop]
+            if kept:
+                r.add_node(swap.get(e, e), kept)
         for e, toks in self.pending.items():
             r.add_node(e, list(toks))
         self._future_cache = r

@@ -214,105 +214,6 @@ def merge_reconcile_kernel(operands):
     return perm, packed
 
 
-# --------------------------------------------- packed two-push/one-pull path
-
-# meta column layout for the packed transfer path (one [N, 7] uint32 push
-# instead of nine separate arrays — each push through the tunneled chip
-# costs ~50-100ms of latency regardless of size)
-_M_TSH, _M_TSL, _M_LDT, _M_PRGH, _M_PRGL, _M_FLAGS, _M_VALID = range(7)
-_MF_DEATH, _MF_CDEL, _MF_EXPIRING = 1, 2, 4
-
-
-def pack_host(cat: CellBatch, pts: np.ndarray | None,
-              bucket: int | None = None):
-    """Host-side packing of a CellBatch into (lanes [N,K] u32,
-    meta [N,7] u32) padded arrays for the packed device path."""
-    n = len(cat)
-    N = bucket or _bucket(n)
-    K = cat.n_lanes
-    lanes = np.full((N, K), 0xFFFFFFFF, dtype=np.uint32)
-    lanes[:n] = cat.lanes
-    meta = np.zeros((N, 7), dtype=np.uint32)
-    with np.errstate(over="ignore"):
-        uts = cat.ts.astype(np.uint64) ^ np.uint64(1 << 63)
-        meta[:n, _M_TSH] = (uts >> np.uint64(32)).astype(np.uint32)
-        meta[:n, _M_TSL] = (uts & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-        meta[:n, _M_LDT] = cat.ldt.astype(np.int32).view(np.uint32)
-        if pts is not None:
-            upts = pts.astype(np.uint64) ^ np.uint64(1 << 63)
-            meta[:n, _M_PRGH] = (upts >> np.uint64(32)).astype(np.uint32)
-            meta[:n, _M_PRGL] = (upts & np.uint64(0xFFFFFFFF)) \
-                .astype(np.uint32)
-        else:
-            meta[:n, _M_PRGH] = 0xFFFFFFFF
-            meta[:n, _M_PRGL] = 0xFFFFFFFF
-    flags = np.zeros(n, dtype=np.uint32)
-    flags |= ((cat.flags & DEATH_FLAGS) != 0).astype(np.uint32) * _MF_DEATH
-    flags |= ((cat.flags & FLAG_COMPLEX_DEL) != 0).astype(np.uint32) \
-        * _MF_CDEL
-    flags |= ((cat.flags & FLAG_EXPIRING) != 0).astype(np.uint32) \
-        * _MF_EXPIRING
-    meta[:n, _M_FLAGS] = flags
-    meta[n:, _M_VALID] = 1
-    return lanes, meta
-
-
-@jax.jit
-def _lsd_pass_desc(key: jnp.ndarray, perm: jnp.ndarray) -> jnp.ndarray:
-    """Descending stable radix pass (for the ~ts keys) — complements the
-    ascending _lsd_pass with the bit-flip fused into the same dispatch."""
-    k = _U32_MAX - key[perm]
-    _, new_perm = jax.lax.sort((k, perm), num_keys=1, is_stable=True)
-    return new_perm
-
-
-@jax.jit
-def _reconcile_packed(lanes, meta, perm, gc_before, now):
-    """Reconcile from the packed (lanes, meta) layout; returns ONE uint32
-    array combining masks and permutation: (packed_masks << 24) | perm.
-    One pull instead of two (pulls through the tunnel run at ~25 MB/s,
-    so bytes AND round-trips both matter). Requires N < 2^24."""
-    fl = meta[:, _M_FLAGS]
-    packed = _reconcile_core(
-        lanes, meta[:, _M_TSH], meta[:, _M_TSL], meta[:, _M_VALID],
-        meta[:, _M_LDT].astype(jnp.int32), (fl >> 2) & 1, (fl >> 1) & 1,
-        fl & 1, meta[:, _M_PRGH], meta[:, _M_PRGL], now, gc_before, perm)
-    return (packed.astype(jnp.uint32) << 24) | perm.astype(jnp.uint32)
-
-
-def packed_sort_reconcile(lanes_np: np.ndarray, meta_np: np.ndarray,
-                          gc_before: int, now: int):
-    """Two pushes, ~K+4 cached-jit sort dispatches, one pull. Sort passes
-    for lanes that are constant across the real cells are skipped (the
-    host sees the numpy arrays; a constant key cannot reorder anything —
-    common tables never touch the collection-path lanes, and single-column
-    workloads skip the column lane too). Returns (perm, packed_masks)
-    numpy arrays of length N (padded)."""
-    n_real = int((meta_np[:, _M_VALID] == 0).sum())
-    varying = [k for k in range(lanes_np.shape[1])
-               if n_real and lanes_np[:n_real, k].min()
-               != lanes_np[:n_real, k].max()]
-    lanes_d = jax.device_put(lanes_np)
-    meta_d = jax.device_put(meta_np)
-    N = lanes_np.shape[0]
-    if N >= (1 << 24):   # output integrity guard, must survive python -O
-        raise ValueError("round too large for the packed perm layout")
-    perm = jnp.arange(N, dtype=jnp.int32)
-    # LSD: least-significant first — ~ts_l, ~ts_h, lanes K-1..0, valid
-    perm = _lsd_pass_desc(meta_d[:, _M_TSL], perm)
-    perm = _lsd_pass_desc(meta_d[:, _M_TSH], perm)
-    for k in reversed(varying):
-        perm = _lsd_pass(lanes_d[:, k], perm)
-    perm = _lsd_pass(meta_d[:, _M_VALID], perm)
-    combined = np.asarray(_reconcile_packed(lanes_d, meta_d, perm,
-                                            jnp.int32(gc_before),
-                                            jnp.int32(now)))
-    return (combined & 0x00FFFFFF).astype(np.int64), \
-        (combined >> 24).astype(np.uint8)
-
-
-
-
 def prev_eq(a):
     """a shifted by one (first element compares unequal)."""
     return jnp.concatenate([jnp.full((1,), ~a[0], dtype=a.dtype), a[:-1]])

@@ -1,0 +1,243 @@
+"""harry — seeded operation-stream fuzzer with a model checker.
+
+Reference counterpart: test/harry (deterministic data generator +
+QuiescentChecker: ops are generated reproducibly from a seed, applied to
+the system under test AND to a pure model; reads are verified against
+the model's computed expectation —
+test/harry/main/org/apache/cassandra/harry/model/QuiescentChecker.java).
+
+The model implements the full deletion algebra the storage engine must
+honor: newest-timestamp-wins cells, row liveness (INSERT creates a row;
+UPDATE alone leaves it dependent on live cells), column/row/partition
+tombstones, clustering range tombstones, and flush/compaction as
+visibility no-ops. Any mismatch reports the seed + op index that
+reproduce it.
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Op:
+    index: int
+    kind: str
+    pk: int
+    ck: int | None = None
+    cols: dict | None = None       # col -> value for writes
+    ts: int = 0
+    lo: int | None = None          # range delete bounds [lo, hi)
+    hi: int | None = None
+    col: str | None = None         # single-column delete
+    cond: tuple | None = None      # LWT: (col, expected_value)
+
+    def cql(self, table: str) -> str | None:
+        """The CQL statement for this op (None for flush/compact)."""
+        if self.kind == "insert":
+            v, w = self.cols["v"], self.cols["w"]
+            return (f"INSERT INTO {table} (k, c, v, w) VALUES "
+                    f"({self.pk}, {self.ck}, '{v}', {w}) "
+                    f"USING TIMESTAMP {self.ts}")
+        if self.kind == "update":
+            sets = ", ".join(
+                f"{c} = " + (f"'{x}'" if c == "v" else str(x))
+                for c, x in self.cols.items())
+            return (f"UPDATE {table} USING TIMESTAMP {self.ts} "
+                    f"SET {sets} WHERE k = {self.pk} AND c = {self.ck}")
+        if self.kind == "del_row":
+            return (f"DELETE FROM {table} USING TIMESTAMP {self.ts} "
+                    f"WHERE k = {self.pk} AND c = {self.ck}")
+        if self.kind == "del_col":
+            return (f"DELETE {self.col} FROM {table} "
+                    f"USING TIMESTAMP {self.ts} "
+                    f"WHERE k = {self.pk} AND c = {self.ck}")
+        if self.kind == "del_part":
+            return (f"DELETE FROM {table} USING TIMESTAMP {self.ts} "
+                    f"WHERE k = {self.pk}")
+        if self.kind == "del_range":
+            return (f"DELETE FROM {table} USING TIMESTAMP {self.ts} "
+                    f"WHERE k = {self.pk} AND c >= {self.lo} "
+                    f"AND c < {self.hi}")
+        return None
+
+
+class OpGenerator:
+    """Reproducible op stream from a seed (harry's generators role).
+    Small key universe on purpose: collisions between writes, deletes
+    and range tombstones are where reconcile bugs live."""
+
+    KINDS = [("insert", 38), ("update", 20), ("del_row", 10),
+             ("del_col", 6), ("del_part", 3), ("del_range", 8),
+             ("flush", 10), ("compact", 5)]
+
+    def __init__(self, seed: int, n_pks: int = 8, n_cks: int = 16):
+        self.rng = random.Random(seed)
+        self.seed = seed
+        self.n_pks = n_pks
+        self.n_cks = n_cks
+        self._i = 0
+        self._kinds = [k for k, w in self.KINDS for _ in range(w)]
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Op:
+        rng = self.rng
+        i = self._i
+        self._i += 1
+        kind = rng.choice(self._kinds)
+        pk = rng.randrange(self.n_pks)
+        # timestamps collide on purpose (same-ts tie-breaks are a
+        # reconcile corner): draw from a window ~= op count
+        ts = rng.randrange(1, max(2, self._i * 2))
+        op = Op(i, kind, pk, ts=ts)
+        if kind in ("insert", "update", "del_row", "del_col"):
+            op.ck = rng.randrange(self.n_cks)
+        if kind == "insert":
+            op.cols = {"v": f"s{self.seed}i{i}", "w": i}
+        elif kind == "update":
+            which = rng.randrange(3)
+            op.cols = {}
+            if which in (0, 2):
+                op.cols["v"] = f"s{self.seed}u{i}"
+            if which in (1, 2):
+                op.cols["w"] = i
+        elif kind == "del_col":
+            op.col = rng.choice(["v", "w"])
+        elif kind == "del_range":
+            lo = rng.randrange(self.n_cks)
+            op.lo, op.hi = lo, lo + rng.randrange(1, self.n_cks // 2)
+        return op
+
+
+@dataclass
+class _RowState:
+    liveness_ts: int = -1          # INSERT's row marker
+    cells: dict = field(default_factory=dict)   # col -> (ts, value|None)
+    row_del_ts: int = -1
+
+
+class Model:
+    """Pure-python oracle of CQL read results (QuiescentChecker model).
+
+    Timestamp ties resolve exactly as the engine's Cells.reconcile rules
+    for this op mix: at equal ts, a tombstone beats data and a larger
+    value wins among data (no TTLs here, so eot/ldt ranks don't bite)."""
+
+    COLS = ("v", "w")
+
+    def __init__(self):
+        self.parts: dict = {}      # pk -> {"del_ts", "ranges", "rows"}
+
+    def _part(self, pk):
+        return self.parts.setdefault(
+            pk, {"del_ts": -1, "ranges": [], "rows": {}})
+
+    def _row(self, pk, ck) -> _RowState:
+        return self._part(pk)["rows"].setdefault(ck, _RowState())
+
+    @staticmethod
+    def _put_cell(row: _RowState, col: str, ts: int, value):
+        """LWW with the engine's tie-break: tombstone (value None) beats
+        data at equal ts; among data, larger value bytes win."""
+        old = row.cells.get(col)
+        if old is None:
+            row.cells[col] = (ts, value)
+            return
+        ots, oval = old
+        if ts > ots:
+            row.cells[col] = (ts, value)
+        elif ts == ots:
+            if value is None and oval is not None:
+                row.cells[col] = (ts, value)
+            elif value is not None and oval is not None:
+                enc_new = _enc(col, value)
+                enc_old = _enc(col, oval)
+                if enc_new > enc_old:
+                    row.cells[col] = (ts, value)
+
+    def apply(self, op: Op) -> None:
+        k = op.kind
+        if k in ("flush", "compact"):
+            return
+        p = self._part(op.pk)
+        if k == "insert":
+            row = self._row(op.pk, op.ck)
+            if op.ts >= row.liveness_ts:
+                row.liveness_ts = op.ts
+            for c, val in op.cols.items():
+                self._put_cell(row, c, op.ts, val)
+        elif k == "update":
+            row = self._row(op.pk, op.ck)
+            for c, val in op.cols.items():
+                self._put_cell(row, c, op.ts, val)
+        elif k == "del_row":
+            row = self._row(op.pk, op.ck)
+            row.row_del_ts = max(row.row_del_ts, op.ts)
+        elif k == "del_col":
+            row = self._row(op.pk, op.ck)
+            self._put_cell(row, op.col, op.ts, None)
+        elif k == "del_part":
+            p["del_ts"] = max(p["del_ts"], op.ts)
+        elif k == "del_range":
+            p["ranges"].append((op.lo, op.hi, op.ts))
+
+    # ------------------------------------------------------------ reads --
+
+    def _eff_del(self, pk, ck) -> int:
+        p = self.parts.get(pk)
+        if p is None:
+            return -1
+        d = p["del_ts"]
+        for lo, hi, ts in p["ranges"]:
+            if lo <= ck < hi:
+                d = max(d, ts)
+        row = p["rows"].get(ck)
+        if row is not None:
+            d = max(d, row.row_del_ts)
+        return d
+
+    def read_partition(self, pk) -> dict:
+        """ck -> {col: value} for visible rows (missing col = null)."""
+        p = self.parts.get(pk)
+        if p is None:
+            return {}
+        out = {}
+        for ck, row in p["rows"].items():
+            d = self._eff_del(pk, ck)
+            cols = {}
+            for c, (ts, val) in row.cells.items():
+                if val is not None and ts > d:
+                    cols[c] = val
+            if cols or row.liveness_ts > d:
+                out[ck] = cols
+        return out
+
+
+def _enc(col: str, value) -> bytes:
+    """Serialized bytes of a value, as the engine compares them in
+    equal-timestamp tie-breaks (text -> utf8, int -> 4-byte BE)."""
+    if col == "v":
+        return str(value).encode()
+    return int(value).to_bytes(4, "big", signed=True)
+
+
+def check_partition(session, model: Model, table: str, pk: int,
+                    seed: int, upto: int) -> None:
+    """Compare a SELECT against the model (QuiescentChecker.validate)."""
+    rows = session.execute(
+        f"SELECT c, v, w FROM {table} WHERE k = {pk}").rows
+    got = {}
+    for c, v, w in rows:
+        cols = {}
+        if v is not None:
+            cols["v"] = v
+        if w is not None:
+            cols["w"] = w
+        got[c] = cols
+    expected = model.read_partition(pk)
+    assert got == expected, (
+        f"MISMATCH seed={seed} after op {upto} pk={pk}:\n"
+        f"  engine: {got}\n  model:  {expected}\n"
+        f"reproduce: CTPU_FUZZ_SEED={seed}")

@@ -150,11 +150,12 @@ def _build_tcm_node(cfg: dict, me):
     if others and (me not in ring.endpoints or me in ring.pending
                    or me in ring.replacing):
         # joining/resuming streams from live owners: wait for gossip to
-        # mark a peer alive first, or bootstrap sees zero sources and
-        # would "complete" having streamed nothing
+        # mark the members alive first (bootstrap FAILS on a range with
+        # no live source rather than completing empty — this wait just
+        # avoids failing a healthy join on startup timing)
         deadline = _t.monotonic() + 20.0
         while _t.monotonic() < deadline and \
-                not any(node.is_alive(e) for e in ring.endpoints
+                not all(node.is_alive(e) for e in ring.endpoints
                         if e != me):
             _t.sleep(0.1)
     import os as _os
