@@ -28,10 +28,13 @@ class TimeoutException(Exception):
 
 class _Await:
     """Counts acks toward a blockFor target
-    (AbstractWriteResponseHandler / ReadCallback role)."""
+    (AbstractWriteResponseHandler / ReadCallback role). With
+    fail_fast_total set, the waiter wakes as soon as enough failures
+    make block_for unreachable instead of burning the full timeout."""
 
-    def __init__(self, block_for: int):
+    def __init__(self, block_for: int, fail_fast_total: int | None = None):
         self.block_for = block_for
+        self.fail_fast_total = fail_fast_total
         self.responses: list = []
         self.failures = 0
         self._ev = threading.Event()
@@ -46,11 +49,16 @@ class _Await:
     def fail(self) -> None:
         with self._lock:
             self.failures += 1
+            if self.fail_fast_total is not None and \
+                    self.fail_fast_total - self.failures < self.block_for:
+                self._ev.set()
 
     def await_(self, timeout: float) -> bool:
         if self.block_for == 0:
             return True
-        return self._ev.wait(timeout)
+        self._ev.wait(timeout)
+        with self._lock:
+            return len(self.responses) >= self.block_for
 
 
 class StorageProxy:
@@ -320,6 +328,89 @@ class StorageProxy:
             else:
                 self.messaging.send_one_way(
                     Verb.MUTATION_REQ, m.serialize(), ep)
+
+    # ----------------------------------------------------- filtered read
+
+    def index_candidates(self, keyspace: str, table_name: str, col: str,
+                         op: str, value, cl: str) -> list:
+        """Distributed index-candidate discovery with replica filtering
+        protection semantics (service/reads/ReplicaFilteringProtection.
+        java:66): every vnode range is covered by blockFor live replicas,
+        each contributing its LOCAL index matches; the union goes back to
+        the caller, which re-reads each candidate at the read CL and
+        re-checks the predicate post-merge. Union-over-quorum gives
+        completeness (a match a stale replica missed is found); the CL
+        re-read + re-check gives soundness (a stale local match is
+        dropped). Short-read protection is structural in this design:
+        replicas never truncate (LIMIT applies post-merge at the
+        coordinator), so there is no per-replica cut to read past."""
+        ks = self.node.schema.keyspaces[keyspace]
+        strat = ReplicationStrategy.create(ks.params.replication)
+        block_for = max(ConsistencyLevel.block_for(
+            cl, strat, self.node.endpoint.dc), 1)
+        targets: set[Endpoint] = set()
+        for _lo, hi in self.node.ring.all_ranges() or [(0, 0)]:
+            replicas = strat.replicas(self.node.ring, hi) \
+                or [self.node.endpoint]
+            live = [r for r in replicas if self.node.is_alive(r)]
+            # the same availability contract as the plain read path: a
+            # QUORUM filtered read must not quietly succeed with fewer
+            # live replicas than block_for
+            if len(live) < block_for:
+                raise UnavailableException(
+                    f"filtered read at {cl}: range (..., {hi}] has "
+                    f"{len(live)} live replicas < {block_for}")
+            live.sort(key=lambda r: (r != self.node.endpoint,
+                                     self._latency_of(r)))
+            targets.update(live[:block_for])
+        # every target must answer (its candidates are load-bearing for
+        # completeness); fail fast when one failure makes that impossible
+        handler = _Await(len(targets), fail_fast_total=len(targets))
+        out: list = []
+        lock = threading.Lock()
+        for target in sorted(targets, key=lambda e: e.name):
+            if target == self.node.endpoint:
+                registry = getattr(self.node.engine, "indexes", None)
+                idx = registry.get(keyspace, table_name, col) \
+                    if registry is not None else None
+                loc = []
+                if idx is not None:
+                    if op == "=" and hasattr(idx, "lookup"):
+                        loc = list(idx.lookup(value))
+                    elif op == "LIKE" and hasattr(idx, "search"):
+                        loc = list(idx.search(str(value)) or [])
+                    elif op == "ANN" and hasattr(idx, "ann"):
+                        import numpy as np
+                        q, k = value
+                        loc = [(pk, ck, float(s)) for pk, ck, s in
+                               idx.ann(np.asarray(q, dtype=np.float32),
+                                       int(k))]
+                with lock:
+                    out.extend(loc)
+                handler.ack()
+            else:
+                def on_rsp(m):
+                    with lock:
+                        out.extend(m.payload)
+                    handler.ack()
+                self.messaging.send_with_callback(
+                    Verb.INDEX_REQ,
+                    (keyspace, table_name, col, op, value), target,
+                    on_response=on_rsp,
+                    on_failure=lambda mid: handler.fail(),
+                    timeout=self.timeout)
+        if not handler.await_(self.timeout):
+            raise TimeoutException(
+                f"index candidates: {len(handler.responses)}/"
+                f"{len(targets)} responses")
+        with lock:
+            # dedupe locators by (pk, ck); the caller re-reads and
+            # re-checks every candidate anyway, so which replica's copy
+            # of the locator survives is irrelevant
+            seen: dict = {}
+            for item in out:
+                seen.setdefault((bytes(item[0]), bytes(item[1])), item)
+            return list(seen.values())
 
     # --------------------------------------------------------- range read
 

@@ -49,6 +49,8 @@ class Verb:
     FAILURE_RSP = "FAILURE_RSP"
     TRUNCATE_REQ = "TRUNCATE_REQ"
     TRUNCATE_RSP = "TRUNCATE_RSP"
+    INDEX_REQ = "INDEX_REQ"
+    INDEX_RSP = "INDEX_RSP"
 
 
 @dataclass

@@ -76,6 +76,7 @@ class Node:
         ms.register_handler(Verb.RANGE_REQ, self._handle_range)
         ms.register_handler(Verb.HINT_REQ, self._handle_mutation)
         ms.register_handler(Verb.TRUNCATE_REQ, self._handle_truncate)
+        ms.register_handler(Verb.INDEX_REQ, self._handle_index)
 
     def _handle_mutation(self, msg):
         mutation = Mutation.deserialize(msg.payload)
@@ -100,6 +101,31 @@ class Node:
         else:
             batch = store.scan_all()
         return Verb.RANGE_RSP, cb_serialize(batch)
+
+    def _handle_index(self, msg):
+        """Local index candidates for a distributed filtered read
+        (replica side of ReplicaFilteringProtection: each queried
+        replica contributes ITS view of matching locators; the
+        coordinator re-reads every candidate at the read CL and
+        re-checks the predicate, so stale local matches are dropped and
+        matches another replica missed are found)."""
+        keyspace, table_name, col, op, value = msg.payload
+        registry = getattr(self.engine, "indexes", None)
+        idx = registry.get(keyspace, table_name, col) \
+            if registry is not None else None
+        locators: list = []
+        if idx is not None:
+            if op == "=" and hasattr(idx, "lookup"):
+                locators = list(idx.lookup(value))
+            elif op == "LIKE" and hasattr(idx, "search"):
+                locators = list(idx.search(str(value)) or [])
+            elif op == "ANN" and hasattr(idx, "ann"):
+                import numpy as np
+                q, k = value
+                locators = [(pk, ck, float(score)) for pk, ck, score in
+                            idx.ann(np.asarray(q, dtype=np.float32),
+                                    int(k))]
+        return Verb.INDEX_RSP, locators
 
     def _handle_truncate(self, msg):
         keyspace, table_name = msg.payload

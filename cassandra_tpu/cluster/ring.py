@@ -75,6 +75,27 @@ class Ring:
             del self.endpoints[ep]
         self._future_cache = None
 
+    # -------------------------------------------------------------- move --
+
+    def start_move(self, ep: Endpoint, new_tokens: list[int]) -> None:
+        """Begin a token move: new tokens pending, old tokens marked
+        moving (excluded from the future ring so racing writes reach the
+        owners gaining the surrendered ranges)."""
+        self.add_pending(ep, new_tokens)
+        self.moving[ep] = list(self.endpoints.get(ep, []))
+        self._future_cache = None
+
+    def finish_move(self, ep: Endpoint, old_tokens: list[int]) -> None:
+        self.promote_pending(ep)
+        self.remove_tokens(ep, old_tokens)
+        self.moving.pop(ep, None)
+        self._future_cache = None
+
+    def abort_move(self, ep: Endpoint) -> None:
+        self.cancel_pending(ep)
+        self.moving.pop(ep, None)
+        self._future_cache = None
+
     # ------------------------------------------------------- replacement --
 
     def start_replace(self, new_ep: Endpoint, dead_ep: Endpoint) -> None:

@@ -94,21 +94,12 @@ def apply_topology_to_ring(ring, extra: dict) -> None:
     elif op == "leave":
         ring.remove_node(existing(nd["name"]))
     elif op == "start_move":
-        mover = existing(nd["name"])
-        ring.add_pending(mover, tokens)
-        ring.moving[mover] = list(ring.endpoints.get(mover, []))
-        ring._future_cache = None
+        ring.start_move(existing(nd["name"]), tokens)
     elif op == "finish_move":
-        me = existing(nd["name"])
-        ring.promote_pending(me)
-        ring.remove_tokens(me, [int(t) for t in extra["old_tokens"]])
-        ring.moving.pop(me, None)
-        ring._future_cache = None
+        ring.finish_move(existing(nd["name"]),
+                         [int(t) for t in extra["old_tokens"]])
     elif op == "abort_move":
-        mover = existing(nd["name"])
-        ring.cancel_pending(mover)
-        ring.moving.pop(mover, None)
-        ring._future_cache = None
+        ring.abort_move(existing(nd["name"]))
     elif op == "start_replace":
         ring.start_replace(ep, existing(extra["target"]))
     elif op == "finish_replace":

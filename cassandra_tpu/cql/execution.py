@@ -821,15 +821,30 @@ class Executor:
         t = self._table(s, keyspace)
         if s.column not in t.columns:
             raise InvalidRequest(f"unknown column {s.column}")
-        registry = getattr(self.backend, "indexes", None)
-        if registry is not None:
+        # index definitions are per-node structures: register on EVERY
+        # node of an in-process cluster (TCP clusters replicate the DDL
+        # itself through the schema log, so each process runs this)
+        backends = list(getattr(self.backend, "cluster_nodes", ()) or ()) \
+            or [self.backend]
+        created = False
+        first_err = None
+        for b in backends:
+            registry = getattr(b, "indexes", None)
+            if registry is None:
+                continue
             try:
                 registry.create(t, s.column, s.name, s.custom_class,
                                 options=getattr(s, "options", None),
                                 if_not_exists=s.if_not_exists)
+                created = True
             except ValueError as e:
-                raise InvalidRequest(str(e))
+                # keep going: one node's failure must not leave earlier
+                # nodes' registrations unpersisted/divergent
+                first_err = first_err or e
+        if created:
             self.schema._changed()   # index defs persist with the schema
+        if first_err is not None:
+            raise InvalidRequest(str(first_err))
         return ResultSet([], [])
 
     def _exec_CreateTriggerStatement(self, s, params, keyspace, now):
@@ -901,10 +916,25 @@ class Executor:
                 del self.schema.keyspaces[ks].user_types[s.name]
                 self.schema._changed()
             elif s.what == "index":
-                registry = getattr(self.backend, "indexes", None)
-                if registry is not None:
-                    registry.drop(ks, s.name)
+                backends = list(getattr(self.backend, "cluster_nodes",
+                                        ()) or ()) or [self.backend]
+                dropped = False
+                missing = None
+                for b in backends:
+                    registry = getattr(b, "indexes", None)
+                    if registry is None:
+                        continue
+                    try:
+                        registry.drop(ks, s.name)
+                        dropped = True
+                    except KeyError as e:
+                        # a node without the entry must not stop the
+                        # drop from completing on the others
+                        missing = e
+                if dropped:
                     self.schema._changed()
+                elif missing is not None:
+                    raise missing
             elif s.what in ("function", "aggregate"):
                 self.udfs.drop(ks, s.name, kind=s.what)
                 self.schema._changed()
@@ -1658,20 +1688,38 @@ class Executor:
         if registry is None or len(filters) != 1:
             return None
         col, op, v = filters[0]
+        proxy = getattr(self.backend, "proxy", None)
+        distributed = proxy is not None and \
+            hasattr(proxy, "index_candidates")
         if op == "LIKE":
             idx = registry.get(t.keyspace, t.name, col.name)
             if idx is None or not hasattr(idx, "search"):
                 return None
+            # the local search doubles as the servability probe (None =
+            # pattern this index type can't serve -> caller falls back)
             locators = idx.search(str(v))
-            if locators is None:     # pattern unservable by this index
+            if locators is None:
                 return None
+            dist_value = str(v)
         elif op == "=":
             idx = registry.get(t.keyspace, t.name, col.name)
             if idx is None or not hasattr(idx, "lookup"):
                 return None
-            locators = idx.lookup(col.cql_type.serialize(v))
+            dist_value = col.cql_type.serialize(v)
+            # distributed: the coordinator is one of the queried
+            # targets, so a local materialization here would just be
+            # recomputed — skip it
+            locators = None if distributed else idx.lookup(dist_value)
         else:
             return None
+        if distributed:
+            # candidate discovery must cover every token range at the
+            # read CL, not just this coordinator's local index
+            # (ReplicaFilteringProtection union-over-quorum; the
+            # re-read + re-check below drops stale matches)
+            locators = proxy.index_candidates(
+                t.keyspace, t.name, col.name, op, dist_value,
+                getattr(self.backend, "default_cl", "ONE"))
         out = []
         for pk, ck in locators:
             batch = cfs.read_partition(pk)
@@ -1710,8 +1758,19 @@ class Executor:
                        dtype=np.float32)
         k = int(bind_term(s.limit, None, params)) if s.limit is not None \
             else 10
+        proxy = getattr(self.backend, "proxy", None)
+        if proxy is not None and hasattr(proxy, "index_candidates"):
+            # distributed ANN: per-replica local top-k, global top-k of
+            # the union (bigger score = better)
+            cands = proxy.index_candidates(
+                t.keyspace, t.name, col_name, "ANN",
+                (q.tolist(), k), getattr(self.backend, "default_cl", "ONE"))
+            cands.sort(key=lambda x: -x[2])
+            hits = cands[:k]
+        else:
+            hits = idx.ann(q, k)
         rows = []
-        for pk, ck, score in idx.ann(q, k):
+        for pk, ck, score in hits:
             batch = cfs.read_partition(pk)
             for r in rows_from_batch(t, batch):
                 if r.ck_frame == ck and not r.is_static:

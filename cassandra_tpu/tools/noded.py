@@ -152,11 +152,13 @@ def _build_tcm_node(cfg: dict, me):
         # joining/resuming streams from live owners: wait for gossip to
         # mark the members alive first (bootstrap FAILS on a range with
         # no live source rather than completing empty — this wait just
-        # avoids failing a healthy join on startup timing)
+        # avoids failing a healthy join on startup timing). The node a
+        # replace is displacing is dead by definition and never waited on.
+        being_replaced = ring.replacing.get(me)
         deadline = _t.monotonic() + 20.0
         while _t.monotonic() < deadline and \
                 not all(node.is_alive(e) for e in ring.endpoints
-                        if e != me):
+                        if e != me and e != being_replaced):
             _t.sleep(0.1)
     import os as _os
     if me in ring.pending or me in ring.replacing:
