@@ -53,23 +53,35 @@ class ClientSession:
     def __init__(self, host: str, port: int, user: str | None = None,
                  password: str | None = None, tls: bool = False,
                  cafile: str | None = None, certfile: str | None = None,
-                 keyfile: str | None = None):
+                 keyfile: str | None = None, protocol_version: int = 5):
         """tls=True (or any of cafile/certfile) speaks TLS: the server
         is verified against `cafile` when given, and `certfile`/
-        `keyfile` are presented when the server demands client certs."""
+        `keyfile` are presented when the server demands client certs.
+        protocol_version 5 (default) switches to the v5 segment framing
+        after the handshake; 4 keeps the legacy envelope stream."""
         self._sock = socket.create_connection((host, port), timeout=10.0)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         if tls or cafile or certfile:
             from .cluster.tls import client_side_context
             self._sock = client_side_context(
                 cafile, certfile, keyfile).wrap_socket(self._sock)
+        self.version = protocol_version
+        self._modern = False
+        self._buf = bytearray()    # reassembled envelope bytes (v5)
+        self._rbuf = bytearray()   # raw socket bytes (survives timeouts)
         self._stream = 0
         self._lock = threading.Lock()
+        self._events: list = []
+        self.on_event = None     # fn(event_type, info_dict)
         op, body = self._request(ts.OP_STARTUP,
                                  struct.pack(">H", 1)
                                  + ts._string("CQL_VERSION")
                                  + ts._string("3.4.5"))
+        if op == ts.OP_READY and self.version >= 5:
+            self._modern = True
         if op == ts.OP_AUTHENTICATE:
+            if self.version >= 5:
+                self._modern = True   # auth continues under v5 framing
             token = b"\x00" + (user or "").encode() + b"\x00" \
                 + (password or "").encode()
             op, body = self._request(ts.OP_AUTH_RESPONSE, ts._bytes(token))
@@ -80,29 +92,147 @@ class ClientSession:
 
     # ------------------------------------------------------------- frames
 
+    def _send_envelope(self, stream: int, opcode: int,
+                       body: bytes) -> None:
+        env = struct.pack(">BBhBI", self.version, 0, stream, opcode,
+                          len(body)) + body
+        if self._modern:
+            out = bytearray()
+            for i in range(0, len(env), ts.MAX_SEGMENT_PAYLOAD):
+                chunk = env[i:i + ts.MAX_SEGMENT_PAYLOAD]
+                out += ts.encode_segment(
+                    chunk, self_contained=len(env) == len(chunk))
+            self._sock.sendall(bytes(out))
+        else:
+            self._sock.sendall(env)
+
+    def _fill(self, n: int) -> None:
+        """Buffer at least n raw bytes WITHOUT consuming them — a socket
+        timeout mid-frame leaves everything read so far in _rbuf and the
+        next call resumes cleanly (wait_event polls with timeouts)."""
+        while len(self._rbuf) < n:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise DriverError("connection closed")
+            self._rbuf += chunk
+
+    def _take(self, n: int) -> bytes:
+        out = bytes(self._rbuf[:n])
+        del self._rbuf[:n]
+        return out
+
+    def _read_envelope(self):
+        if not self._modern:
+            self._fill(9)
+            (length,) = struct.unpack_from(">I", self._rbuf, 5)
+            self._fill(9 + length)
+            hdr = self._take(9)
+            _ver, _flags, rstream, op = struct.unpack(">BBhB", hdr[:5])
+            return rstream, op, self._take(length)
+        while True:
+            if len(self._buf) >= 9:
+                (length,) = struct.unpack_from(">I", self._buf, 5)
+                if len(self._buf) >= 9 + length:
+                    hdr = bytes(self._buf[:9])
+                    body = bytes(self._buf[9:9 + length])
+                    del self._buf[:9 + length]
+                    _ver, _flags, rstream, op = struct.unpack(
+                        ">BBhB", hdr[:5])
+                    return rstream, op, body
+            self._fill(6)
+            plen, _sc = ts.decode_segment_header(bytes(self._rbuf[:6]))
+            self._fill(6 + plen + 4)
+            seg = self._take(6 + plen + 4)
+            payload, crc = seg[6:6 + plen], seg[6 + plen:]
+            if int.from_bytes(crc, "little") != ts._crc32_v5(payload):
+                raise DriverError("segment CRC mismatch")
+            self._buf += payload
+
     def _request(self, opcode: int, body: bytes):
         with self._lock:
             self._stream = (self._stream + 1) % 32768
             stream = self._stream
-            self._sock.sendall(struct.pack(
-                ">BBhBI", ts.VERSION_REQ, 0, stream, opcode, len(body))
-                + body)
-            hdr = self._read_exact(9)
-            _ver, _flags, rstream, op = struct.unpack(">BBhB", hdr[:5])
-            (length,) = struct.unpack(">I", hdr[5:9])
-            rbody = self._read_exact(length) if length else b""
-            if rstream != stream:
-                raise DriverError("stream mismatch")
-            return op, rbody
+            self._send_envelope(stream, opcode, body)
+            while True:
+                rstream, op, rbody = self._read_envelope()
+                if rstream == -1 and op == ts.OP_EVENT:
+                    self._deliver_event(rbody)
+                    continue
+                if rstream != stream:
+                    raise DriverError("stream mismatch")
+                break
+        self._fire_callbacks()
+        return op, rbody
 
-    def _read_exact(self, n: int) -> bytes:
-        buf = bytearray()
-        while len(buf) < n:
-            chunk = self._sock.recv(n - len(buf))
-            if not chunk:
-                raise DriverError("connection closed")
-            buf += chunk
-        return bytes(buf)
+    # ------------------------------------------------------------- events
+
+    def register(self, event_types: list[str]) -> None:
+        """REGISTER for server-push events (STATUS_CHANGE /
+        TOPOLOGY_CHANGE / SCHEMA_CHANGE); received events are queued and
+        handed to self.on_event when set."""
+        body = struct.pack(">H", len(event_types))
+        for t in event_types:
+            body += ts._string(t)
+        op, _ = self._request(ts.OP_REGISTER, body)
+        if op != ts.OP_READY:
+            raise DriverError("REGISTER refused")
+
+    def _deliver_event(self, body: bytes) -> None:
+        """Parse an EVENT body onto the queue. Called under _lock;
+        callbacks fire later via _fire_callbacks OUTSIDE the lock so an
+        on_event handler may itself use this session."""
+        etype, pos = ts._read_string(body, 0)
+        info: dict = {"type": etype}
+        if etype in ("STATUS_CHANGE", "TOPOLOGY_CHANGE"):
+            info["change"], pos = ts._read_string(body, pos)
+            alen = body[pos]
+            pos += 1
+            import ipaddress
+            info["host"] = str(ipaddress.ip_address(
+                bytes(body[pos:pos + alen])))
+            pos += alen
+            (info["port"],) = struct.unpack_from(">i", body, pos)
+        elif etype == "SCHEMA_CHANGE":
+            info["change"], pos = ts._read_string(body, pos)
+            info["target"], pos = ts._read_string(body, pos)
+            info["keyspace"], pos = ts._read_string(body, pos)
+            if info["target"] != "KEYSPACE":
+                info["name"], pos = ts._read_string(body, pos)
+        self._events.append(info)
+
+    def _fire_callbacks(self) -> None:
+        cb = self.on_event
+        if cb is None:
+            return
+        while True:
+            with self._lock:
+                if not self._events:
+                    return
+                info = self._events.pop(0)
+            try:
+                cb(info["type"], info)
+            except Exception:
+                pass
+
+    def wait_event(self, timeout: float = 5.0):
+        """Next pushed event (dict) or None on timeout. Must not race
+        concurrent requests on this session (same lock). A timeout
+        mid-frame is safe: partial bytes stay buffered and the next
+        read resumes."""
+        with self._lock:
+            if self._events:
+                return self._events.pop(0)
+            old = self._sock.gettimeout()
+            self._sock.settimeout(timeout)
+            try:
+                rstream, op, body = self._read_envelope()
+                if rstream == -1 and op == ts.OP_EVENT:
+                    self._deliver_event(body)
+            except (TimeoutError, socket.timeout):
+                return None
+            finally:
+                self._sock.settimeout(old)
+            return self._events.pop(0) if self._events else None
 
     # -------------------------------------------------------------- query
 
@@ -120,7 +250,10 @@ class ClientSession:
             flags |= 0x04
         if paging_state is not None:
             flags |= 0x08
-        body.append(flags)
+        if self.version >= 5:
+            body += struct.pack(">I", flags)   # v5 widened flags to [int]
+        else:
+            body.append(flags)
         if params:
             body += struct.pack(">H", len(params))
             for p in params:
@@ -181,7 +314,10 @@ class ClientSession:
         return Rows(names, rows, paging)
 
     def prepare(self, query: str) -> bytes:
-        op, body = self._request(ts.OP_PREPARE, ts._long_string(query))
+        req = ts._long_string(query)
+        if self.version >= 5:
+            req += struct.pack(">I", 0)    # v5 prepare flags
+        op, body = self._request(ts.OP_PREPARE, req)
         if op == ts.OP_ERROR:
             (code,) = struct.unpack_from(">i", body, 0)
             msg, _ = ts._read_string(body, 4)
@@ -198,6 +334,10 @@ class ClientSession:
                          paging_state: bytes | None = None) -> Rows:
         body = bytearray()
         body += struct.pack(">H", len(qid)) + qid
+        if self.version >= 5:
+            # v5 EXECUTE carries the result_metadata_id (server issues
+            # the statement id for both)
+            body += struct.pack(">H", len(qid)) + qid
         body += struct.pack(">H", 1)
         flags = 0
         if params:
@@ -206,7 +346,10 @@ class ClientSession:
             flags |= 0x04
         if paging_state is not None:
             flags |= 0x08
-        body.append(flags)
+        if self.version >= 5:
+            body += struct.pack(">I", flags)
+        else:
+            body.append(flags)
         if params:
             body += struct.pack(">H", len(params))
             for p in params:
@@ -229,17 +372,20 @@ class Cluster:
     def __init__(self, host: str = "127.0.0.1", port: int = 9042,
                  user: str | None = None, password: str | None = None,
                  tls: bool = False, cafile: str | None = None,
-                 certfile: str | None = None, keyfile: str | None = None):
+                 certfile: str | None = None, keyfile: str | None = None,
+                 protocol_version: int = 5):
         self.host, self.port = host, port
         self.user, self.password = user, password
         self.tls, self.cafile = tls, cafile
         self.certfile, self.keyfile = certfile, keyfile
+        self.protocol_version = protocol_version
 
     def connect(self) -> ClientSession:
         return ClientSession(self.host, self.port, self.user,
                              self.password, tls=self.tls,
                              cafile=self.cafile, certfile=self.certfile,
-                             keyfile=self.keyfile)
+                             keyfile=self.keyfile,
+                             protocol_version=self.protocol_version)
 
 
 def serialize_params(table, columns: list[str], values: list) -> list:

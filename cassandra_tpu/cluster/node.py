@@ -35,6 +35,10 @@ class Node:
         self.gossiper = Gossiper(self.messaging, seeds,
                                  interval=gossip_interval)
         self.gossiper.on_alive = self._on_peer_alive
+        self.gossiper.on_dead = self._on_peer_dead
+        # server-push event bus (transport EVENT role): CQL servers and
+        # tests subscribe; liveness/topology/schema transitions fan out
+        self._event_listeners: list = []
         self.proxy = StorageProxy(self)
         self._register_verbs()
         from .repair import RepairService
@@ -139,8 +143,33 @@ class Node:
     def is_alive(self, ep: Endpoint) -> bool:
         return ep == self.endpoint or self.gossiper.is_alive(ep)
 
+    def add_event_listener(self, fn) -> None:
+        """fn(kind, info): kind in STATUS_CHANGE / TOPOLOGY_CHANGE /
+        SCHEMA_CHANGE (the native protocol's registerable events)."""
+        self._event_listeners.append(fn)
+
+    def remove_event_listener(self, fn) -> None:
+        try:
+            self._event_listeners.remove(fn)
+        except ValueError:
+            pass
+
+    def emit_event(self, kind: str, info: dict) -> None:
+        for fn in list(self._event_listeners):
+            try:
+                fn(kind, info)
+            except Exception:
+                pass
+
     def _on_peer_alive(self, ep: Endpoint):
+        self.emit_event("STATUS_CHANGE", {"change": "UP", "host": ep.host,
+                                          "port": ep.port})
         self._dispatch_hints(ep)
+
+    def _on_peer_dead(self, ep: Endpoint):
+        self.emit_event("STATUS_CHANGE", {"change": "DOWN",
+                                          "host": ep.host,
+                                          "port": ep.port})
 
     def _hint_loop(self):
         while not self._stop_hints.wait(0.5):
@@ -227,11 +256,16 @@ class Node:
         through the epoch log (every node applies the same entries in
         the same order — tcm/Commit); LocalCluster nodes share one Ring
         object, so the transformation applies directly."""
-        from .schema_sync import apply_topology_to_ring
+        from .schema_sync import apply_topology_to_ring, \
+            emit_topology_event
         if self.schema_sync is not None:
             self.schema_sync.commit_topology(extra)
         else:
             apply_topology_to_ring(self.ring, extra)
+            # in-process path: peers share the ring object, so each node
+            # emits its own driver-facing event here
+            for n in (self.cluster_nodes or [self]):
+                emit_topology_event(n, extra)
 
     def _ep_dict(self, ep: Endpoint | None = None) -> dict:
         ep = ep or self.endpoint

@@ -110,6 +110,25 @@ def apply_topology_to_ring(ring, extra: dict) -> None:
         raise ValueError(f"unknown topology op {op!r}")
 
 
+def emit_topology_event(node, extra: dict) -> None:
+    """Driver-facing TOPOLOGY_CHANGE for a committed transformation
+    (transport Event.TopologyChange role). Only the COMMIT points of
+    multi-step sequences emit — drivers see the ownership flip, not the
+    intermediate pending states."""
+    op = extra["op"]
+    nd = extra.get("node") or {}
+    info = {"host": nd.get("host", "127.0.0.1"),
+            "port": int(nd.get("port", 0))}
+    change = {"register": "NEW_NODE", "finish_join": "NEW_NODE",
+              "finish_replace": "NEW_NODE", "leave": "REMOVED_NODE",
+              "finish_move": "MOVED_NODE"}.get(op)
+    if change is None:
+        return
+    emit = getattr(node, "emit_event", None)
+    if emit is not None:
+        emit("TOPOLOGY_CHANGE", {"change": change, **info})
+
+
 class SchemaSync:
     FORWARD_TIMEOUT = 5.0
     # pulls re-fetch a window of already-seen epochs so a conflict
@@ -182,6 +201,7 @@ class SchemaSync:
         in `extra` so every node agrees (mutations route by table id)."""
         if query.startswith(TOPOLOGY_PREFIX):
             apply_topology_to_ring(self.node.ring, extra)
+            emit_topology_event(self.node, extra)
             return
         from ..cql.parser import parse
         from ..cql.execution import Executor
@@ -458,10 +478,13 @@ class SchemaSync:
         all changed through one log). The entry text embeds the op so
         the same-epoch conflict rule dedups identical retries."""
         query = TOPOLOGY_PREFIX + json.dumps(extra, sort_keys=True)
-        self.coordinate(
-            query, None, None,
-            lambda: apply_topology_to_ring(self.node.ring, extra),
-            extra_override=extra)
+
+        def local_apply():
+            apply_topology_to_ring(self.node.ring, extra)
+            emit_topology_event(self.node, extra)
+
+        self.coordinate(query, None, None, local_apply,
+                        extra_override=extra)
 
     def replay_all(self) -> None:
         """Re-apply every logged entry in epoch order (daemon restart).

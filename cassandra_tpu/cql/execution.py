@@ -291,7 +291,36 @@ class Executor:
         if name == "SelectStatement":
             return m(stmt, params, keyspace, now_micros,
                      page_size=page_size, paging_state=paging_state)
-        return m(stmt, params, keyspace, now_micros)
+        rs = m(stmt, params, keyspace, now_micros)
+        self._emit_schema_event(name, stmt, keyspace)
+        return rs
+
+    _SCHEMA_EVENTS = {
+        "CreateKeyspaceStatement": ("CREATED", "KEYSPACE"),
+        "CreateTableStatement": ("CREATED", "TABLE"),
+        "CreateViewStatement": ("CREATED", "TABLE"),
+        "CreateIndexStatement": ("UPDATED", "TABLE"),
+        "AlterTableStatement": ("UPDATED", "TABLE"),
+        "DropStatement": ("DROPPED", None),     # target from stmt.what
+    }
+
+    def _emit_schema_event(self, name, stmt, keyspace) -> None:
+        """Server-push schema change events (transport Event.SchemaChange
+        role) — drivers track DDL from other sessions through these."""
+        emit = getattr(self.backend, "emit_event", None)
+        info = self._SCHEMA_EVENTS.get(name)
+        if emit is None or info is None:
+            return
+        change, target = info
+        if target is None:
+            what = getattr(stmt, "what", "table")
+            target = "KEYSPACE" if what == "keyspace" else "TABLE"
+        ks = getattr(stmt, "keyspace", None) or keyspace
+        nm = getattr(stmt, "name", None)
+        if target == "KEYSPACE":
+            ks = nm or ks     # CREATE/DROP KEYSPACE: the name IS the ks
+        emit("SCHEMA_CHANGE", {"change": change, "target": target,
+                               "keyspace": ks, "name": nm})
 
     # ------------------------------------------------------------- auth --
 

@@ -1,15 +1,23 @@
 """CQL native protocol server — the client-facing socket endpoint.
 
 Reference counterpart: transport/Server.java + Dispatcher.java:104 +
-CQLMessageHandler.java (the v4/v5 binary protocol on port 9042, spec:
-doc/native_protocol_v4.spec in the reference tree).
+CQLMessageHandler.java (the v4/v5 binary protocol on port 9042, specs:
+doc/native_protocol_v4.spec and v5.spec in the reference tree).
 
-Implemented subset (protocol v4 framing):
+Implemented:
+  protocol v4 AND v5. v5 connections switch to the modern segment
+  framing after STARTUP (17-bit length + self-contained flag header
+  with CRC24, payload with CRC32 trailer — doc/native_protocol_v5.spec
+  "Crc" section); unsupported versions and compression flags are
+  rejected with a PROTOCOL error.
   STARTUP -> READY (or AUTHENTICATE -> AUTH_RESPONSE -> AUTH_SUCCESS
   with PasswordAuthenticator semantics when auth is enabled)
   OPTIONS -> SUPPORTED
   QUERY / PREPARE / EXECUTE -> RESULT (Void / Rows / SetKeyspace /
   Prepared / SchemaChange) or ERROR
+  REGISTER -> READY, then server-push EVENT envelopes (stream -1) for
+  STATUS_CHANGE / TOPOLOGY_CHANGE / SCHEMA_CHANGE
+  (transport/messages/RegisterMessage.java, EventMessage.java)
   paging: page_size + paging_state flags round-trip
   bound values: wire bytes deserialize against the target column's type
   at bind time (WireValue marker consumed by cql.execution.bind_term)
@@ -28,6 +36,7 @@ from .cql.processor import QueryProcessor
 
 VERSION_REQ = 0x04
 VERSION_RSP = 0x84
+SUPPORTED_VERSIONS = (0x04, 0x05)
 
 OP_ERROR = 0x00
 OP_STARTUP = 0x01
@@ -39,6 +48,8 @@ OP_QUERY = 0x07
 OP_RESULT = 0x08
 OP_PREPARE = 0x09
 OP_EXECUTE = 0x0A
+OP_REGISTER = 0x0B
+OP_EVENT = 0x0C
 OP_AUTH_RESPONSE = 0x0F
 OP_AUTH_SUCCESS = 0x10
 
@@ -52,6 +63,52 @@ ERR_SERVER = 0x0000
 ERR_PROTOCOL = 0x000A
 ERR_BAD_CREDENTIALS = 0x0100
 ERR_INVALID = 0x2200
+
+EVENT_TYPES = ("TOPOLOGY_CHANGE", "STATUS_CHANGE", "SCHEMA_CHANGE")
+
+
+# ------------------------------------------------- v5 segment framing ------
+# doc/native_protocol_v5.spec: post-handshake traffic is framed in
+# segments: 3-byte little-endian header (17-bit payload length, 1-bit
+# self-contained flag) + CRC24 of the header, payload, CRC32 trailer.
+
+_CRC24_INIT = 0x875060
+_CRC24_POLY = 0x1974F0B
+_CRC32_INIT_BYTES = b"\xfa\x2d\x55\xca"
+MAX_SEGMENT_PAYLOAD = (1 << 17) - 1
+
+
+def _crc24(data: bytes) -> int:
+    crc = _CRC24_INIT
+    for b in data:
+        crc ^= b << 16
+        for _ in range(8):
+            crc <<= 1
+            if crc & 0x1000000:
+                crc ^= _CRC24_POLY
+    return crc & 0xFFFFFF
+
+
+def _crc32_v5(data: bytes) -> int:
+    import zlib
+    return zlib.crc32(data, zlib.crc32(_CRC32_INIT_BYTES)) & 0xFFFFFFFF
+
+
+def encode_segment(payload: bytes, self_contained: bool = True) -> bytes:
+    if len(payload) > MAX_SEGMENT_PAYLOAD:
+        raise ValueError("segment payload too large")
+    h = len(payload) | ((1 << 17) if self_contained else 0)
+    hdr = h.to_bytes(3, "little")
+    hdr += _crc24(hdr).to_bytes(3, "little")
+    return hdr + payload + _crc32_v5(payload).to_bytes(4, "little")
+
+
+def decode_segment_header(hdr6: bytes) -> tuple[int, bool]:
+    """(payload_length, self_contained); raises on CRC mismatch."""
+    if int.from_bytes(hdr6[3:6], "little") != _crc24(hdr6[:3]):
+        raise ValueError("segment header CRC mismatch")
+    h = int.from_bytes(hdr6[:3], "little")
+    return h & MAX_SEGMENT_PAYLOAD, bool(h & (1 << 17))
 
 
 class WireValue(bytes):
@@ -158,6 +215,50 @@ def _encode_rows(rs) -> bytes:
     return bytes(body)
 
 
+class _Conn:
+    """Per-connection state (transport ServerConnection role)."""
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.version: int | None = None
+        self.modern = False            # v5 segment framing active
+        self.keyspace: str | None = None
+        self.user: str | None = None
+        self.authed = False
+        self.registrations: set[str] = set()
+        self.buf = bytearray()         # modern-framing reassembly
+        self.wlock = threading.Lock()  # event pushes race responses
+
+    def send_envelope(self, ver_rsp: int, stream: int, op: int,
+                      body: bytes, legacy: bool = False) -> None:
+        env = struct.pack(">BBhBI", ver_rsp, 0, stream, op,
+                          len(body)) + body
+        with self.wlock:
+            if self.modern and not legacy:
+                out = bytearray()
+                if len(env) <= MAX_SEGMENT_PAYLOAD:
+                    out += encode_segment(env, self_contained=True)
+                else:
+                    for i in range(0, len(env), MAX_SEGMENT_PAYLOAD):
+                        out += encode_segment(
+                            env[i:i + MAX_SEGMENT_PAYLOAD],
+                            self_contained=False)
+                self.sock.sendall(bytes(out))
+            else:
+                self.sock.sendall(env)
+
+    def send_error(self, stream: int, code: int, msg: str) -> None:
+        self.send_envelope(0x80 | (self.version or 0x04), stream,
+                           OP_ERROR,
+                           struct.pack(">i", code) + _string(msg))
+
+
+def _inet(host: str, port: int) -> bytes:
+    import ipaddress
+    addr = ipaddress.ip_address(host).packed
+    return bytes([len(addr)]) + addr + struct.pack(">i", port)
+
+
 class CQLServer:
     """Threaded native-protocol endpoint over a backend (StorageEngine or
     cluster Node) — transport/Server.java role."""
@@ -172,7 +273,7 @@ class CQLServer:
         # ONE processor for the whole server: prepared-statement ids are
         # server-global like the reference's (drivers prepare on one
         # connection and execute on another); keyspace/user stay
-        # per-connection via the state dict
+        # per-connection in _Conn
         self.processor = QueryProcessor(backend)
         self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -180,11 +281,98 @@ class CQLServer:
         self._listen.listen(64)
         self.port = self._listen.getsockname()[1]
         self._closed = False
+        self._event_conns: set[_Conn] = set()
+        self._conn_lock = threading.Lock()
+        # server-push events: a cluster Node surfaces liveness/topology/
+        # schema transitions through add_event_listener. Pushes run on a
+        # DEDICATED thread with a bounded per-send deadline — the
+        # emitting thread (gossiper, DDL executor) must never block on a
+        # stalled client socket, and a client that stops reading is
+        # dropped rather than wedging event fan-out.
+        import queue as _queue
+        self._event_q: _queue.Queue = _queue.Queue(maxsize=1024)
+        if hasattr(backend, "add_event_listener"):
+            backend.add_event_listener(self._on_node_event)
+            threading.Thread(target=self._event_loop, daemon=True,
+                             name=f"cql-events-{self.port}").start()
         threading.Thread(target=self._accept_loop, daemon=True,
                          name=f"cql-server-{self.port}").start()
 
+    # -------------------------------------------------------- event push --
+
+    def _on_node_event(self, kind: str, info: dict) -> None:
+        """Translate a node event into a wire EVENT body and enqueue the
+        push (EventMessage + Server.EventNotifier roles). Never blocks
+        the emitter: a full queue drops the oldest event."""
+        body = _string(kind)
+        if kind in ("STATUS_CHANGE", "TOPOLOGY_CHANGE"):
+            body += _string(info["change"])
+            body += _inet(info.get("host", "127.0.0.1"),
+                          int(info.get("port", 0)))
+        elif kind == "SCHEMA_CHANGE":
+            body += _string(info["change"])       # CREATED/UPDATED/DROPPED
+            body += _string(info["target"])       # KEYSPACE/TABLE/...
+            body += _string(info.get("keyspace") or "")
+            if info["target"] != "KEYSPACE":
+                body += _string(info.get("name") or "")
+        else:
+            return
+        import queue as _queue
+        try:
+            self._event_q.put_nowait((kind, body))
+        except _queue.Full:
+            try:
+                self._event_q.get_nowait()
+                self._event_q.put_nowait((kind, body))
+            except _queue.Empty:
+                pass
+
+    def _event_loop(self) -> None:
+        import select
+        import time as _time
+        while not self._closed:
+            try:
+                item = self._event_q.get(timeout=0.5)
+            except Exception:
+                continue
+            kind, body = item
+            with self._conn_lock:
+                conns = [c for c in self._event_conns
+                         if kind in c.registrations]
+            for c in conns:
+                env = struct.pack(">BBhBI", 0x80 | (c.version or 0x04),
+                                  0, -1, OP_EVENT, len(body)) + body
+                if c.modern:
+                    env = encode_segment(env)
+                try:
+                    with c.wlock:
+                        # bounded send: select-writable + partial sends
+                        # under a 5s deadline; a stalled client is
+                        # closed, never waited on
+                        deadline = _time.monotonic() + 5.0
+                        view = memoryview(env)
+                        while view.nbytes:
+                            left = deadline - _time.monotonic()
+                            if left <= 0:
+                                raise OSError("event send timeout")
+                            r = select.select([], [c.sock], [], left)[1]
+                            if not r:
+                                raise OSError("event send timeout")
+                            n = c.sock.send(view)
+                            view = view[n:]
+                except OSError:
+                    with self._conn_lock:
+                        self._event_conns.discard(c)
+                    try:
+                        c.sock.close()   # serve thread unblocks + cleans
+                    except OSError:
+                        pass
+
     def close(self) -> None:
         self._closed = True
+        remove = getattr(self.backend, "remove_event_listener", None)
+        if remove is not None:
+            remove(self._on_node_event)
         try:
             self._listen.close()
         except OSError:
@@ -228,52 +416,116 @@ class CQLServer:
 
     def _serve(self, sock: socket.socket) -> None:
         processor = self.processor
-        state = {"keyspace": None, "user": None, "authed": False}
+        conn = _Conn(sock)
         auth = getattr(self.backend, "auth", None)
         need_auth = auth is not None and auth.enabled
         try:
             while not self._closed:
-                hdr = self._read_exact(sock, 9)
-                if hdr is None:
+                env = self._next_envelope(conn)
+                if env is None:
                     return
-                _ver, _flags, stream, opcode = struct.unpack(">BBhB",
-                                                             hdr[:5])
-                (length,) = struct.unpack(">I", hdr[5:9])
-                if length > (256 << 20):
+                ver, flags, stream, opcode, body = env
+                if ver not in SUPPORTED_VERSIONS:
+                    # reject cleanly (spec: respond with a PROTOCOL error
+                    # naming the supported versions) and close
+                    rsp = struct.pack(">i", ERR_PROTOCOL) + _string(
+                        f"Invalid or unsupported protocol version "
+                        f"({ver}); supported versions are "
+                        f"(4/v4, 5/v5)")
+                    conn.send_envelope(0x80 | max(SUPPORTED_VERSIONS),
+                                       stream, OP_ERROR, rsp,
+                                       legacy=True)
                     return
-                body = self._read_exact(sock, length) if length else b""
-                if body is None:
+                if conn.version is None:
+                    conn.version = ver
+                elif ver != conn.version:
+                    conn.send_error(stream, ERR_PROTOCOL,
+                                    "protocol version changed mid-stream")
+                    return
+                if flags & 0x01:
+                    conn.send_error(stream, ERR_PROTOCOL,
+                                    "compression is not supported")
                     return
                 try:
-                    op, rsp = self._dispatch(processor, state, need_auth,
+                    op, rsp = self._dispatch(processor, conn, need_auth,
                                              auth, opcode, body)
                 except Exception as e:
                     code = ERR_INVALID if isinstance(e, ValueError) \
                         else ERR_SERVER
                     op, rsp = OP_ERROR, struct.pack(">i", code) \
                         + _string(f"{type(e).__name__}: {e}")
-                sock.sendall(struct.pack(">BBhBI", VERSION_RSP, 0, stream,
-                                         op, len(rsp)) + rsp)
-        except OSError:
+                conn.send_envelope(0x80 | conn.version, stream, op, rsp)
+                if opcode == OP_STARTUP and conn.version >= 0x05:
+                    # STARTUP processed: v5 switches to segment framing
+                    # (the STARTUP response itself goes out legacy; any
+                    # auth exchange continues framed)
+                    conn.modern = True
+        except (OSError, ValueError):
             pass
         finally:
+            with self._conn_lock:
+                self._event_conns.discard(conn)
             try:
                 sock.close()
             except OSError:
                 pass
 
+    def _next_envelope(self, conn: "_Conn"):
+        """Read one envelope: legacy = straight off the socket; modern =
+        from the segment reassembly buffer."""
+        if not conn.modern:
+            hdr = self._read_exact(conn.sock, 9)
+            if hdr is None:
+                return None
+            ver_raw, flags, stream, opcode = struct.unpack(">BBhB",
+                                                           hdr[:5])
+            (length,) = struct.unpack(">I", hdr[5:9])
+            if length > (256 << 20):
+                return None
+            body = self._read_exact(conn.sock, length) if length else b""
+            if body is None:
+                return None
+            return ver_raw & 0x7F, flags, stream, opcode, body
+        # modern framing: refill the envelope buffer segment by segment
+        while True:
+            if len(conn.buf) >= 9:
+                (length,) = struct.unpack_from(">I", conn.buf, 5)
+                if length > (256 << 20):   # same cap as the legacy path
+                    return None
+                if len(conn.buf) >= 9 + length:
+                    hdr = bytes(conn.buf[:9])
+                    body = bytes(conn.buf[9:9 + length])
+                    del conn.buf[:9 + length]
+                    ver_raw, flags, stream, opcode = struct.unpack(
+                        ">BBhB", hdr[:5])
+                    return ver_raw & 0x7F, flags, stream, opcode, body
+            seg_hdr = self._read_exact(conn.sock, 6)
+            if seg_hdr is None:
+                return None
+            plen, _self_contained = decode_segment_header(seg_hdr)
+            payload = self._read_exact(conn.sock, plen + 4)
+            if payload is None:
+                return None
+            payload, crc = payload[:plen], payload[plen:]
+            if int.from_bytes(crc, "little") != _crc32_v5(payload):
+                raise ValueError("segment payload CRC mismatch")
+            conn.buf += payload
+
     # ------------------------------------------------------------- opcodes
 
-    def _dispatch(self, processor, state, need_auth, auth, opcode, body):
+    def _dispatch(self, processor, conn: _Conn, need_auth, auth, opcode,
+                  body):
         if opcode == OP_OPTIONS:
-            return OP_SUPPORTED, struct.pack(">H", 1) + \
+            return OP_SUPPORTED, struct.pack(">H", 2) + \
                 _string("CQL_VERSION") + struct.pack(">H", 1) + \
-                _string("3.4.5")
+                _string("3.4.5") + \
+                _string("PROTOCOL_VERSIONS") + struct.pack(">H", 2) + \
+                _string("4/v4") + _string("5/v5")
         if opcode == OP_STARTUP:
             if need_auth:
                 return OP_AUTHENTICATE, _string(
                     "org.apache.cassandra.auth.PasswordAuthenticator")
-            state["authed"] = True
+            conn.authed = True
             return OP_READY, b""
         if opcode == OP_AUTH_RESPONSE:
             token, _ = _read_bytes(body, 0)
@@ -286,25 +538,42 @@ class CQLServer:
                     return OP_ERROR, struct.pack(
                         ">i", ERR_BAD_CREDENTIALS) + _string(
                         "bad credentials")
-                state["user"] = user
-                state["authed"] = True
+                conn.user = user
+                conn.authed = True
                 return OP_AUTH_SUCCESS, _bytes(None)
             return OP_ERROR, struct.pack(">i", ERR_BAD_CREDENTIALS) \
                 + _string("malformed SASL token")
-        if not state["authed"]:
+        if not conn.authed:
             return OP_ERROR, struct.pack(">i", ERR_PROTOCOL) \
                 + _string("STARTUP required")
+        if opcode == OP_REGISTER:
+            (n,) = struct.unpack_from(">H", body, 0)
+            pos = 2
+            for _ in range(n):
+                etype, pos = _read_string(body, pos)
+                if etype not in EVENT_TYPES:
+                    return OP_ERROR, struct.pack(">i", ERR_PROTOCOL) \
+                        + _string(f"unknown event type {etype!r}")
+                conn.registrations.add(etype)
+            with self._conn_lock:
+                self._event_conns.add(conn)
+            return OP_READY, b""
         if opcode == OP_QUERY:
             query, pos = _read_long_string(body, 0)
-            return self._run(processor, state, query, body, pos)
+            return self._run(processor, conn, query, body, pos)
         if opcode == OP_PREPARE:
-            query, _ = _read_long_string(body, 0)
+            query, pos = _read_long_string(body, 0)
+            if conn.version >= 0x05 and pos < len(body):
+                (_pflags,) = struct.unpack_from(">I", body, pos)  # keyspace
             qid = processor.prepare(query)
             prep = processor._prepared[qid]
             n_binds = getattr(prep.statement, "n_markers", 0)
             rsp = bytearray()
             rsp += struct.pack(">i", RESULT_PREPARED)
             rsp += struct.pack(">H", len(qid)) + qid
+            if conn.version >= 0x05:
+                # result_metadata_id (short bytes): stable per statement
+                rsp += struct.pack(">H", len(qid)) + qid
             # bind metadata: declared as BLOB — the server deserializes
             # wire bytes against the real column type at bind time, so
             # clients pass pre-serialized values (documented subset)
@@ -320,19 +589,27 @@ class CQLServer:
             (n,) = struct.unpack_from(">H", body, 0)
             qid = bytes(body[2:2 + n])
             pos = 2 + n
+            if conn.version >= 0x05:
+                # v5 EXECUTE carries the result_metadata_id
+                (mn,) = struct.unpack_from(">H", body, pos)
+                pos += 2 + mn
             if processor._prepared.get(qid) is None:
                 return OP_ERROR, struct.pack(">i", ERR_INVALID) \
                     + _string("unknown prepared statement")
-            return self._run(processor, state, None, body, pos, qid=qid)
+            return self._run(processor, conn, None, body, pos, qid=qid)
         return OP_ERROR, struct.pack(">i", ERR_PROTOCOL) \
             + _string(f"unsupported opcode {opcode}")
 
-    def _run(self, processor, state, query, body: bytes, pos: int,
+    def _run(self, processor, conn: _Conn, query, body: bytes, pos: int,
              qid: bytes | None = None):
         _consistency, = struct.unpack_from(">H", body, pos)
         pos += 2
-        flags = body[pos]
-        pos += 1
+        if conn.version >= 0x05:          # v5 widened flags to [int]
+            (flags,) = struct.unpack_from(">I", body, pos)
+            pos += 4
+        else:
+            flags = body[pos]
+            pos += 1
         params: tuple = ()
         page_size = None
         paging_state = None
@@ -351,16 +628,16 @@ class CQLServer:
             paging_state, pos = _read_bytes(body, pos)
         if qid is not None:   # EXECUTE: cached statement, no re-parse
             rs = processor.execute_prepared(
-                qid, params, state["keyspace"], user=state["user"],
+                qid, params, conn.keyspace, user=conn.user,
                 page_size=page_size, paging_state=paging_state)
         else:
-            rs = processor.process(query, params, state["keyspace"],
-                                   user=state["user"],
+            rs = processor.process(query, params, conn.keyspace,
+                                   user=conn.user,
                                    page_size=page_size,
                                    paging_state=paging_state)
         new_ks = getattr(rs, "keyspace", None)
         if new_ks is not None:
-            state["keyspace"] = new_ks
+            conn.keyspace = new_ks
             return OP_RESULT, struct.pack(">i", RESULT_SET_KEYSPACE) \
                 + _string(new_ks)
         if not rs.column_names:

@@ -157,3 +157,133 @@ def test_wire_prepare_execute(server):
     rows = s.execute_prepared(sel, serialize_params(t, ["k"], [3]))
     assert rows.rows == [("v3",)]
     s.close()
+
+
+def test_wire_v4_still_supported(server):
+    eng, srv = server
+    s = Cluster("127.0.0.1", srv.port, protocol_version=4).connect()
+    s.execute("CREATE KEYSPACE v4ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE v4ks")
+    s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    s.execute("INSERT INTO kv (k, v) VALUES (1, 'legacy')")
+    assert s.execute("SELECT v FROM kv WHERE k = 1").rows == [("legacy",)]
+    s.close()
+
+
+def test_wire_unsupported_version_rejected(server):
+    import socket
+    import struct
+    _eng, srv = server
+    sock = socket.create_connection(("127.0.0.1", srv.port), timeout=5.0)
+    # protocol v3 STARTUP: server must answer a PROTOCOL error, not
+    # misparse the stream
+    body = struct.pack(">H", 1) + b"\x00\x0bCQL_VERSION\x00\x053.4.5"
+    sock.sendall(struct.pack(">BBhBI", 0x03, 0, 0, 0x01, len(body)) + body)
+    hdr = sock.recv(9)
+    opcode = hdr[4]
+    (length,) = struct.unpack(">I", hdr[5:9])
+    rbody = sock.recv(length)
+    (code,) = struct.unpack_from(">i", rbody, 0)
+    assert opcode == 0x00 and code == 0x000A   # ERROR / PROTOCOL
+    sock.close()
+
+
+def test_wire_compression_flag_rejected(server):
+    import socket
+    import struct
+    _eng, srv = server
+    sock = socket.create_connection(("127.0.0.1", srv.port), timeout=5.0)
+    body = struct.pack(">H", 1) + b"\x00\x0bCQL_VERSION\x00\x053.4.5"
+    # flags=0x01 claims lz4 compression that was never negotiated
+    sock.sendall(struct.pack(">BBhBI", 0x04, 0x01, 0, 0x01, len(body))
+                 + body)
+    hdr = sock.recv(9)
+    (length,) = struct.unpack(">I", hdr[5:9])
+    rbody = sock.recv(length)
+    (code,) = struct.unpack_from(">i", rbody, 0)
+    assert hdr[4] == 0x00 and code == 0x000A
+    sock.close()
+
+
+def test_v5_segment_crc_utilities():
+    from cassandra_tpu import transport_server as ts
+    payload = b"hello v5 framing" * 100
+    seg = ts.encode_segment(payload)
+    plen, sc = ts.decode_segment_header(seg[:6])
+    assert plen == len(payload) and sc
+    # corrupt the header -> CRC24 failure
+    import pytest as _pytest
+    bad = bytearray(seg[:6])
+    bad[0] ^= 0xFF
+    with _pytest.raises(ValueError):
+        ts.decode_segment_header(bytes(bad))
+
+
+def test_v5_prepared_roundtrip(server):
+    eng, srv = server
+    s = Cluster("127.0.0.1", srv.port, protocol_version=5).connect()
+    s.execute("CREATE KEYSPACE pks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE pks")
+    s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+    t = eng.schema.get_table("pks", "kv")
+    qid = s.prepare("INSERT INTO kv (k, v) VALUES (?, ?)")
+    for i in range(5):
+        s.execute_prepared(qid, serialize_params(t, ["k", "v"],
+                                                 [i, f"p{i}"]))
+    rid = s.prepare("SELECT v FROM kv WHERE k = ?")
+    rows = s.execute_prepared(rid, serialize_params(t, ["k"], [3]))
+    assert rows.rows == [("p3",)]
+    s.close()
+
+
+def test_events_status_topology_schema(tmp_path):
+    """A registered driver observes a node death, a topology change and
+    DDL performed by ANOTHER session (RegisterMessage/EventMessage +
+    Server push; VERDICT round-2 item 7's done-criterion)."""
+    import time
+    from cassandra_tpu.cluster.node import LocalCluster
+
+    cluster = LocalCluster(2, str(tmp_path), rf=1,
+                           gossip_interval=0.05)
+    srv = CQLServer(cluster.node(1))
+    try:
+        s = Cluster("127.0.0.1", srv.port).connect()
+        s.register(["STATUS_CHANGE", "TOPOLOGY_CHANGE", "SCHEMA_CHANGE"])
+
+        # schema change from a DIFFERENT session (direct node session)
+        other = cluster.session(1)
+        other.execute("CREATE KEYSPACE evks WITH replication = "
+                      "{'class': 'SimpleStrategy', "
+                      "'replication_factor': 2}")
+        ev = s.wait_event(10.0)
+        assert ev and ev["type"] == "SCHEMA_CHANGE" \
+            and ev["change"] == "CREATED" and ev["keyspace"] == "evks"
+
+        # node death: stop node2, wait for conviction -> STATUS DOWN
+        cluster.stop_node(2)
+        deadline = time.time() + 30
+        ev = None
+        while time.time() < deadline:
+            ev = s.wait_event(2.0)
+            if ev and ev["type"] == "STATUS_CHANGE" \
+                    and ev["change"] == "DOWN":
+                break
+        assert ev and ev["type"] == "STATUS_CHANGE" \
+            and ev["change"] == "DOWN"
+
+        # topology change: replace the dead node -> NEW_NODE event
+        cluster.replace_dead_node(2)
+        deadline = time.time() + 10
+        saw_new = False
+        while time.time() < deadline and not saw_new:
+            ev = s.wait_event(2.0)
+            if ev and ev["type"] == "TOPOLOGY_CHANGE" \
+                    and ev["change"] == "NEW_NODE":
+                saw_new = True
+        assert saw_new
+        s.close()
+    finally:
+        srv.close()
+        cluster.shutdown()
