@@ -25,11 +25,14 @@ from .ring import Endpoint, Ring, even_tokens
 class Node:
     def __init__(self, endpoint: Endpoint, data_dir: str, schema: Schema,
                  ring: Ring, transport: LocalTransport,
-                 seeds: list[Endpoint], gossip_interval: float = 0.1):
+                 seeds: list[Endpoint], gossip_interval: float = 0.1,
+                 engine_opts: dict | None = None):
         self.endpoint = endpoint
         self.schema = schema
         self.ring = ring
-        self.engine = StorageEngine(data_dir, schema, commitlog_sync="batch")
+        self.engine = StorageEngine(data_dir, schema,
+                                    commitlog_sync="batch",
+                                    **(engine_opts or {}))
         self.messaging = MessagingService(endpoint, transport)
         self.hints = HintsService(os.path.join(data_dir, "hints"))
         self.gossiper = Gossiper(self.messaging, seeds,

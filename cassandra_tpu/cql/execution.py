@@ -816,6 +816,20 @@ class Executor:
             v = options["cdc"]
             p.cdc = v if isinstance(v, bool) \
                 else str(v).lower() in ("true", "1")
+        if "encryption" in options:
+            v = options["encryption"]
+            if isinstance(v, dict):
+                v = v.get("enabled", False)
+            p.encryption = v if isinstance(v, bool) \
+                else str(v).lower() in ("true", "1")
+            if p.encryption:
+                from ..storage import encryption as enc_mod
+                if enc_mod.get_context() is None:
+                    # reject at DDL time: accepting the table and failing
+                    # at first flush would wedge the memtable forever
+                    raise InvalidRequest(
+                        "encryption requires the node to be started "
+                        "with a keystore (keystore_dir)")
         if "default_time_to_live" in options:
             p.default_ttl = int(options["default_time_to_live"])
         if "comment" in options:

@@ -54,6 +54,9 @@ class TableParams:
         "keys": "ALL", "rows_per_partition": "NONE"})
     # TPU-format knob: bytes of clustering prefix carried in key lanes
     clustering_prefix_bytes: int = 16
+    # at-rest encryption (TDE): sstable components encrypted under the
+    # node's EncryptionContext keystore (storage/encryption.py)
+    encryption: bool = False
 
 
 class TableMetadata:
@@ -304,6 +307,7 @@ def table_to_dict(t: TableMetadata) -> dict:
             "clustering_prefix_bytes": t.params.clustering_prefix_bytes,
             "cdc": t.params.cdc,
             "caching": t.params.caching,
+            "encryption": t.params.encryption,
         },
     }
 
@@ -318,6 +322,7 @@ def table_from_dict(d: dict, udts: dict | None = None) -> TableMetadata:
         comment=p.get("comment", ""),
         clustering_prefix_bytes=int(p.get("clustering_prefix_bytes", 16)),
         cdc=bool(p.get("cdc", False)),
+        encryption=bool(p.get("encryption", False)),
         caching=dict(p.get("caching") or
                      {"keys": "ALL", "rows_per_partition": "NONE"}))
     t = TableMetadata(

@@ -37,18 +37,52 @@ class CommitLogPosition(tuple):
         return self[1]
 
 
+_ENC_MAGIC = b"CTPUCLE1"   # encrypted segment: magic + u32 key id + nonce16
+_ENC_HDR = len(_ENC_MAGIC) + 4 + 16
+
+
 class CommitLog:
     def __init__(self, directory: str, segment_size: int = 32 * 1024 * 1024,
-                 sync_mode: str = "periodic", sync_period_ms: int = 1000):
+                 sync_mode: str = "periodic", sync_period_ms: int = 1000,
+                 archive_dir: str | None = None, encrypt: bool = False):
+        """archive_dir: finished segments are copied there on rotation
+        and at close (CommitLogArchiver role — the restore half is
+        replay_archived / StorageEngine.restore_point_in_time).
+        encrypt: segments carry an AES-CTR header and record payloads
+        are keystream-XORed at their file offset
+        (db/commitlog/EncryptedSegment.java role; CRCs cover ciphertext)."""
         self.directory = directory
         self.segment_size = segment_size
         self.sync_mode = sync_mode
         self.sync_period_ms = sync_period_ms
+        self.archive_dir = archive_dir
+        self.encrypt = encrypt
+        if archive_dir:
+            os.makedirs(archive_dir, exist_ok=True)
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.Lock()
         existing = self.segment_ids()
         self._seg_id = (existing[-1] + 1) if existing else 1
         self._file = None
+        self._seg_enc = None      # (key_id, nonce) of the open segment
+        # archiver worker: rotation must not stall writers on a 32MB
+        # copy+fsync (the reference archives asynchronously too); a
+        # segment awaiting archive is protected from deletion
+        self._archive_q: list[int] = []
+        self._archiving: set[int] = set()
+        self._archive_ev = threading.Event()
+        self._archive_thread = None
+        if archive_dir:
+            # crash recovery: segments already on disk were finished by
+            # the crash and were never archived (there was no clean
+            # close) — archive them NOW, before boot replay flushes and
+            # deletes them, or PITR silently loses the tail
+            for seg in existing:
+                self._archive(seg)
+            self._archive_thread = threading.Thread(
+                target=self._archive_loop, daemon=True,
+                name="commitlog-archiver")
+            self._archive_thread.start()
         self._open_segment()
         # dirty tracking: segment -> set of table ids with unflushed writes
         self._dirty: dict[int, set] = {}
@@ -73,11 +107,41 @@ class CommitLog:
         return sorted(out)
 
     def _open_segment(self) -> None:
+        prev = None
         if self._file:
             self._file.flush()
             os.fsync(self._file.fileno())
             self._file.close()
+            prev = self._seg_id - 1
         self._file = open(self._seg_path(self._seg_id), "ab")
+        if prev is not None and self.archive_dir:
+            # async: the rotated segment is immutable; the worker copies
+            # it off the write path (deletion waits for the archive)
+            self._archiving.add(prev)
+            self._archive_q.append(prev)
+            self._archive_ev.set()
+        if self.encrypt:
+            from . import encryption as enc_mod
+            ctx = enc_mod.get_context()
+            if ctx is None:
+                raise enc_mod.EncryptionError(
+                    "commitlog encryption requires an EncryptionContext")
+            if self._file.tell() == 0:
+                kid = ctx.current_key_id
+                nonce = ctx.new_nonce()
+                self._file.write(_ENC_MAGIC + kid.to_bytes(4, "little")
+                                 + nonce)
+                self._file.flush()
+                self._seg_enc = (kid, nonce)
+            else:   # restart onto a partially-written encrypted segment
+                with open(self._seg_path(self._seg_id), "rb") as f:
+                    hdr = f.read(_ENC_HDR)
+                if not hdr.startswith(_ENC_MAGIC):
+                    raise enc_mod.EncryptionError(
+                        "existing active segment is not encrypted; "
+                        "rotate before enabling encryption")
+                self._seg_enc = (int.from_bytes(hdr[8:12], "little"),
+                                 hdr[12:28])
         # reserve the whole segment's blocks up front (KEEP_SIZE: st_size
         # stays at the append point so replay's EOF/torn-tail detection is
         # unaffected). The reference pre-creates fixed-size segments for
@@ -93,12 +157,18 @@ class CommitLog:
         """Append a mutation; returns its position. With sync_mode='batch'
         the record is durable when this returns (CommitLog.add:300)."""
         payload = mutation.serialize()
-        frame = struct.pack("<II", len(payload), zlib.crc32(payload)) + payload
         with self._lock:
-            if self._file.tell() + len(frame) > self.segment_size:
+            if self._file.tell() + len(payload) + 8 > self.segment_size:
                 self._seg_id += 1
                 self._open_segment()
             pos = CommitLogPosition(self._seg_id, self._file.tell())
+            if self._seg_enc is not None:
+                from . import encryption as enc_mod
+                kid, nonce = self._seg_enc
+                payload = enc_mod.get_context().xor_at(
+                    kid, nonce, pos.offset + 8, payload)
+            frame = struct.pack("<II", len(payload),
+                                zlib.crc32(payload)) + payload
             self._file.write(frame)
             self._dirty.setdefault(self._seg_id, set()).add(mutation.table_id)
             if self.sync_mode == "batch":
@@ -125,20 +195,91 @@ class CommitLog:
         (CommitLogReplayer semantics: stop a segment at the first torn
         record)."""
         for seg_id in self.segment_ids():
-            path = self._seg_path(seg_id)
-            with open(path, "rb") as f:
-                data = f.read()
-            pos = 0
-            while pos + 8 <= len(data):
-                length, crc = struct.unpack_from("<II", data, pos)
-                if length == 0 or pos + 8 + length > len(data):
-                    break  # torn tail
-                payload = data[pos + 8: pos + 8 + length]
-                if zlib.crc32(payload) != crc:
-                    break  # corrupt tail
-                yield CommitLogPosition(seg_id, pos), \
-                    Mutation.deserialize(payload)
-                pos += 8 + length
+            yield from self._replay_file(self._seg_path(seg_id), seg_id)
+
+    @staticmethod
+    def _replay_file(path: str, seg_id: int):
+        with open(path, "rb") as f:
+            data = f.read()
+        pos = 0
+        enc = None
+        if data.startswith(_ENC_MAGIC):
+            from . import encryption as enc_mod
+            ctx = enc_mod.get_context()
+            if ctx is None:
+                raise enc_mod.EncryptionError(
+                    f"{path} is encrypted but no EncryptionContext is "
+                    f"installed")
+            enc = (ctx, int.from_bytes(data[8:12], "little"),
+                   data[12:_ENC_HDR])
+            pos = _ENC_HDR
+        while pos + 8 <= len(data):
+            length, crc = struct.unpack_from("<II", data, pos)
+            if length == 0 or pos + 8 + length > len(data):
+                break  # torn tail
+            payload = data[pos + 8: pos + 8 + length]
+            if zlib.crc32(payload) != crc:
+                break  # corrupt tail
+            if enc is not None:
+                ctx, kid, nonce = enc
+                payload = ctx.xor_at(kid, nonce, pos + 8, payload)
+            yield CommitLogPosition(seg_id, pos), \
+                Mutation.deserialize(payload)
+            pos += 8 + length
+
+    # ------------------------------------------------------------ archive
+
+    def _archive(self, seg_id: int) -> None:
+        """Copy a FINISHED (rotated/closed) segment to the archive
+        (CommitLogArchiver.java:54 role; a directory copy stands in for
+        the archive_command hook)."""
+        if not self.archive_dir:
+            return
+        src = self._seg_path(seg_id)
+        if not os.path.exists(src):
+            return
+        dst = os.path.join(self.archive_dir, os.path.basename(src))
+        import shutil
+        tmp = dst + ".tmp"
+        shutil.copy2(src, tmp)
+        with open(tmp, "rb") as f:
+            os.fsync(f.fileno())
+        os.replace(tmp, dst)
+
+    def _archive_loop(self) -> None:
+        while True:
+            self._archive_ev.wait()
+            self._archive_ev.clear()
+            while True:
+                with self._lock:
+                    if not self._archive_q:
+                        break
+                    seg = self._archive_q.pop(0)
+                try:
+                    self._archive(seg)
+                except OSError:
+                    pass
+                with self._lock:
+                    self._archiving.discard(seg)
+
+    def _deletable(self, seg_id: int) -> bool:
+        """A segment pending archive must not be deleted: its PITR copy
+        hasn't landed yet."""
+        return seg_id not in self._archiving
+
+    @classmethod
+    def replay_archived(cls, archive_dir: str):
+        """Yield (position, Mutation) from archived segments in order —
+        the restore half of PITR (CommitLogArchiver restore_directories
+        + restore_point_in_time)."""
+        segs = []
+        for fn in os.listdir(archive_dir):
+            m = _SEG_RE.match(fn)
+            if m:
+                segs.append((int(m.group(1)), fn))
+        for seg_id, fn in sorted(segs):
+            yield from cls._replay_file(os.path.join(archive_dir, fn),
+                                        seg_id)
 
     # ----------------------------------------------------- flush lifecycle
 
@@ -151,7 +292,8 @@ class CommitLog:
             for seg_id in list(self._dirty):
                 if seg_id < upto.segment_id:
                     self._dirty[seg_id].discard(table_id)
-                    if not self._dirty[seg_id] and seg_id != self._seg_id:
+                    if not self._dirty[seg_id] and seg_id != self._seg_id \
+                            and self._deletable(seg_id):
                         try:
                             os.remove(self._seg_path(seg_id))
                         except FileNotFoundError:
@@ -163,7 +305,8 @@ class CommitLog:
         with self._lock:
             for seg_id in list(self._dirty):
                 self._dirty[seg_id].discard(table_id)
-                if not self._dirty[seg_id] and seg_id != self._seg_id:
+                if not self._dirty[seg_id] and seg_id != self._seg_id \
+                        and self._deletable(seg_id):
                     try:
                         os.remove(self._seg_path(seg_id))
                     except FileNotFoundError:
@@ -176,7 +319,7 @@ class CommitLog:
 
     def delete_segments_before(self, seg_id: int) -> None:
         for s in self.segment_ids():
-            if s < seg_id:
+            if s < seg_id and self._deletable(s):
                 try:
                     os.remove(self._seg_path(s))
                 except FileNotFoundError:
@@ -187,8 +330,17 @@ class CommitLog:
         self._stop.set()
         if self._syncer:
             self._syncer.join(timeout=2)
+        # drain pending async archives BEFORE the final archive so the
+        # directory copy is complete when close() returns
+        deadline = 50
+        while deadline and self._archiving:
+            import time as _t
+            _t.sleep(0.1)
+            deadline -= 1
         with self._lock:
             if self._file and not self._file.closed:
                 self._file.flush()
                 os.fsync(self._file.fileno())
                 self._file.close()
+                # a cleanly-closed active segment is archivable too
+                self._archive(self._seg_id)

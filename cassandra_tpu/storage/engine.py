@@ -22,16 +22,44 @@ class StorageEngine:
                  commitlog_sync: str = "periodic",
                  flush_threshold: int | None = None,
                  auth_enabled: bool = False,
-                 audit_log_path: str | None = None):
+                 audit_log_path: str | None = None,
+                 keystore_dir: str | None = None,
+                 commitlog_archive_dir: str | None = None,
+                 encrypt_commitlog: bool = False):
+        """keystore_dir enables TDE: an EncryptionContext is installed
+        node-wide (tables opt in via WITH encryption = {'enabled': true};
+        encrypt_commitlog covers the WAL). commitlog_archive_dir turns on
+        the segment archiver for point-in-time restore."""
         self.data_dir = data_dir
         self.schema = schema or Schema()
         self.durable = durable_writes
         self.flush_threshold = flush_threshold
         os.makedirs(data_dir, exist_ok=True)
+        self.encryption_ctx = None
+        if keystore_dir:
+            from . import encryption as enc_mod
+            existing = enc_mod.get_context()
+            if existing is not None and \
+                    os.path.realpath(existing.keystore_dir) != \
+                    os.path.realpath(keystore_dir):
+                # the context is process-level state (the reference's
+                # DatabaseDescriptor role) and a cluster must share one
+                # keystore anyway — streamed sstables land encrypted and
+                # every replica needs the keys. Two different keystores
+                # in one process would silently cross-encrypt.
+                raise enc_mod.EncryptionError(
+                    f"an EncryptionContext for "
+                    f"{existing.keystore_dir!r} is already installed; "
+                    f"in-process nodes must share one keystore")
+            if existing is None:
+                enc_mod.set_context(enc_mod.EncryptionContext(keystore_dir))
+            self.encryption_ctx = enc_mod.get_context()
         from .cdc import CDCLog
         self.cdc = CDCLog(os.path.join(data_dir, "cdc_raw"))
         self.commitlog = CommitLog(os.path.join(data_dir, "commitlog"),
-                                   sync_mode=commitlog_sync) \
+                                   sync_mode=commitlog_sync,
+                                   archive_dir=commitlog_archive_dir,
+                                   encrypt=encrypt_commitlog) \
             if durable_writes else None
         self.stores: dict = {}  # table_id -> ColumnFamilyStore
         self._lock = threading.RLock()
@@ -177,6 +205,25 @@ class StorageEngine:
             cfs.flush()
 
     # ------------------------------------------------------------- replay --
+
+    def restore_point_in_time(self, archive_dir: str,
+                              pit_micros: int) -> int:
+        """Replay archived commitlog segments, applying every mutation
+        whose newest cell timestamp is <= pit_micros (CommitLogArchiver
+        restore_point_in_time semantics). Run against a node restored
+        from a snapshot (or empty) BEFORE serving traffic; returns
+        mutations applied. Applied writes go through the normal apply
+        path, so they re-log durably."""
+        applied = 0
+        for _pos, mutation in CommitLog.replay_archived(archive_dir):
+            if mutation.ops and max(op[4] for op in mutation.ops) \
+                    > pit_micros:
+                continue
+            if self.schema.table_by_id(mutation.table_id) is None:
+                continue
+            self.apply(mutation)
+            applied += 1
+        return applied
 
     def _replay(self) -> None:
         """Boot recovery: re-apply intact commitlog records to memtables

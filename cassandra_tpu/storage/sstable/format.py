@@ -39,7 +39,11 @@ class Component:
     STATS = "Statistics.db"
     DIGEST = "Digest.crc32"
     TOC = "TOC.txt"
+    # optional: present only on encrypted tables (TDE envelope: key id +
+    # per-component nonces — security/EncryptionContext role)
+    ENCRYPTION = "Encryption.db"
     ALL = [DATA, INDEX, PARTITIONS, FILTER, STATS, DIGEST, TOC]
+    OPTIONAL = [ENCRYPTION]
 
 
 _NAME_RE = re.compile(r"^(?P<version>[a-z]{2})-(?P<gen>\d+)-(?P<comp>.+)$")
@@ -65,7 +69,7 @@ class Descriptor:
                             f"tmp-{self.version}-{self.generation}-{component}")
 
     def all_paths(self) -> list[str]:
-        return [self.path(c) for c in Component.ALL]
+        return [self.path(c) for c in Component.ALL + Component.OPTIONAL]
 
     def exists(self) -> bool:
         return os.path.exists(self.path(Component.TOC))

@@ -43,9 +43,28 @@ class SSTableReader:
         self.params = CompressionParams.from_dict(self.stats["compression"])
         self.compressor = self.params.compressor_or_noop()
 
+        # TDE: encrypted sstables carry an Encryption.db envelope (key
+        # id + per-component nonces); reads XOR the ciphertext back at
+        # its file offset (storage/encryption.py)
+        self._enc = None
+        enc_path = descriptor.path(Component.ENCRYPTION)
+        if os.path.exists(enc_path):
+            from .. import encryption as enc_mod
+            ctx = enc_mod.get_context()
+            if ctx is None:
+                raise enc_mod.EncryptionError(
+                    f"{descriptor} is encrypted but no EncryptionContext "
+                    f"is installed")
+            with open(enc_path) as f:
+                env = json.load(f)
+            self._enc = (ctx, int(env["key_id"]),
+                         {c: bytes.fromhex(n)
+                          for c, n in env["nonces"].items()})
+
         # index: fixed-width entries
         with open(descriptor.path(Component.INDEX), "rb") as f:
             raw = f.read()
+        raw = self._decrypt_component(Component.INDEX, raw)
         n_seg, k, seg_cells = struct.unpack_from("<III", raw, 0)
         if k != self.K:
             raise CorruptSSTableError("index/stats lane mismatch")
@@ -79,6 +98,7 @@ class SSTableReader:
         # partition directory
         with open(descriptor.path(Component.PARTITIONS), "rb") as f:
             praw = f.read()
+        praw = self._decrypt_component(Component.PARTITIONS, praw)
         (n_part,) = struct.unpack_from("<I", praw, 0)
         self.n_partitions = n_part
         o = 4
@@ -171,6 +191,14 @@ class SSTableReader:
         except Exception:
             pass
 
+    def _decrypt_component(self, comp: str, raw: bytes) -> bytes:
+        if self._enc is None:
+            return raw
+        ctx, kid, nonces = self._enc
+        if comp not in nonces:
+            return raw
+        return ctx.xor_at(kid, nonces[comp], 0, raw)
+
     # ------------------------------------------------------------- decode
 
     def _read_segment(self, i: int) -> CellBatch:
@@ -232,6 +260,16 @@ class SSTableReader:
             if zlib.crc32(iovs[b]) != crcs[b]:
                 raise CorruptSSTableError(
                     f"{self.desc}: segment {i} block {b} CRC mismatch")
+        if self._enc is not None:
+            # CRCs cover the ciphertext; decrypt each block in place at
+            # its file offset before decompression
+            ctx, kid, nonces = self._enc
+            off = pos
+            for b in range(3):
+                plain = ctx.xor_at(kid, nonces[Component.DATA], off,
+                                   iovs[b])
+                iovs[b][:] = np.frombuffer(plain, dtype=np.uint8)
+                off += cls[b]
         for b, scratch in compressed:
             self.compressor.decompress_iov(scratch, [0], [cls[b]],
                                            [dsts[b]])

@@ -97,6 +97,21 @@ class SSTableWriter:
         self._raw_streak = [0, 0, 0]
         self._skip_left = [0, 0, 0]
         self._ck_fits = True   # AND over appended batches' ck_fits_prefix
+        # TDE: encrypted tables XOR the on-disk stream with an AES-CTR
+        # keystream at its file offset; CRCs/digest cover the CIPHERTEXT
+        # so integrity checks don't need keys (storage/encryption.py)
+        self._enc = None
+        if getattr(table.params, "encryption", False):
+            from .. import encryption as enc_mod
+            ctx = enc_mod.get_context()
+            if ctx is None:
+                raise enc_mod.EncryptionError(
+                    f"table {table.keyspace}.{table.name} requires "
+                    f"encryption but no EncryptionContext is installed")
+            self._enc = (ctx, ctx.current_key_id,
+                         {c: ctx.new_nonce()
+                          for c in (Component.DATA, Component.INDEX,
+                                    Component.PARTITIONS)})
         # pending cells not yet cut into a segment
         self._pending: list[CellBatch] = []
         self._pending_cells = 0
@@ -165,15 +180,25 @@ class SSTableWriter:
         self._write_filter()
         stats = self._write_stats()
         self._write_digest()
+        comps = list(Component.ALL)
+        if self._enc is not None:
+            _ctx, kid, nonces = self._enc
+            with open(self.desc.tmp_path(Component.ENCRYPTION), "w") as f:
+                json.dump({"key_id": kid,
+                           "nonces": {c: n.hex()
+                                      for c, n in nonces.items()}}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            comps.insert(-1, Component.ENCRYPTION)
         # TOC last, then atomic renames (TOC rename LAST = commit point).
         # Every component is fsynced before its rename and the directory
         # is fsynced after the TOC rename — otherwise a crash can persist
         # the commit point over truncated/unrenamed components.
         with open(self.desc.tmp_path(Component.TOC), "w") as f:
-            f.write("\n".join(Component.ALL) + "\n")
+            f.write("\n".join(comps) + "\n")
             f.flush()
             os.fsync(f.fileno())
-        for comp in Component.ALL:
+        for comp in comps:
             if comp != Component.TOC:
                 self._fsync_path(self.desc.tmp_path(comp))
                 os.replace(self.desc.tmp_path(comp), self.desc.path(comp))
@@ -290,7 +315,7 @@ class SSTableWriter:
         if self._direct and not self._bounce.closed:
             self._bounce_mv.release()
             self._bounce.close()
-        for comp in Component.ALL:
+        for comp in Component.ALL + Component.OPTIONAL:
             p = self.desc.tmp_path(comp)
             if os.path.exists(p):
                 os.remove(p)
@@ -419,6 +444,10 @@ class SSTableWriter:
             else:
                 c = raw
             mv = memoryview(c).cast("B")
+            if self._enc is not None:
+                ctx, kid, nonces = self._enc
+                mv = memoryview(ctx.xor_at(kid, nonces[Component.DATA],
+                                           self._data_off, mv))
             crc = zlib.crc32(mv)
             entry += struct.pack("<QQI", c.nbytes, raw.nbytes, crc)
             self._write_all(mv)
@@ -435,24 +464,33 @@ class SSTableWriter:
 
     _last_lane_end: bytes | None = None
 
+    def _write_component(self, comp: str, data: bytes) -> None:
+        """Write a small component, encrypting payload-bearing ones on
+        encrypted tables (whole-file keystream from offset 0)."""
+        if self._enc is not None:
+            ctx, kid, nonces = self._enc
+            if comp in nonces:
+                data = ctx.xor_at(kid, nonces[comp], 0, data)
+        with open(self.desc.tmp_path(comp), "wb") as f:
+            f.write(data)
+
     def _write_index(self) -> None:
-        with open(self.desc.tmp_path(Component.INDEX), "wb") as f:
-            f.write(struct.pack("<III", len(self._index_entries), self.K,
-                                self.segment_cells))
-            for e in self._index_entries:
-                f.write(e)
+        out = bytearray(struct.pack("<III", len(self._index_entries),
+                                    self.K, self.segment_cells))
+        for e in self._index_entries:
+            out += e
+        self._write_component(Component.INDEX, bytes(out))
 
     def _write_partitions(self) -> None:
-        with open(self.desc.tmp_path(Component.PARTITIONS), "wb") as f:
-            np_count = len(self._part_lane4)
-            f.write(struct.pack("<I", np_count))
-            f.write(b"".join(self._part_lane4))
-            f.write(np.array(self._part_first_cell,
-                             dtype="<i8").tobytes())
-            pk_off = np.zeros(np_count + 1, dtype="<i8")
-            np.cumsum([len(p) for p in self._part_pk], out=pk_off[1:])
-            f.write(pk_off.tobytes())
-            f.write(b"".join(self._part_pk))
+        np_count = len(self._part_lane4)
+        out = bytearray(struct.pack("<I", np_count))
+        out += b"".join(self._part_lane4)
+        out += np.array(self._part_first_cell, dtype="<i8").tobytes()
+        pk_off = np.zeros(np_count + 1, dtype="<i8")
+        np.cumsum([len(p) for p in self._part_pk], out=pk_off[1:])
+        out += pk_off.tobytes()
+        out += b"".join(self._part_pk)
+        self._write_component(Component.PARTITIONS, bytes(out))
 
     def _write_filter(self) -> None:
         with open(self.desc.tmp_path(Component.FILTER), "wb") as f:

@@ -64,7 +64,8 @@ def build_node(cfg: dict):
         tls=TLSConfig.from_dict(cfg.get("server_tls")))
     node = Node(me, cfg["data_dir"], Schema(), ring, transport,
                 seeds=seeds,
-                gossip_interval=float(cfg.get("gossip_interval", 0.2)))
+                gossip_interval=float(cfg.get("gossip_interval", 0.2)),
+                engine_opts=_engine_opts(cfg))
     node.cluster_nodes = [node]   # DDL opens stores on this engine only
     # TCM-lite: per-process schemas replicate DDL through the epoch log
     from ..cluster.schema_sync import SchemaSync
@@ -98,6 +99,19 @@ def build_node(cfg: dict):
     return node, transport
 
 
+def _engine_opts(cfg: dict) -> dict:
+    """TDE + commitlog archiver knobs (cassandra.yaml
+    transparent_data_encryption_options / commitlog_archiving role)."""
+    out = {}
+    if cfg.get("keystore_dir"):
+        out["keystore_dir"] = cfg["keystore_dir"]
+    if cfg.get("commitlog_archive_dir"):
+        out["commitlog_archive_dir"] = cfg["commitlog_archive_dir"]
+    if cfg.get("encrypt_commitlog"):
+        out["encrypt_commitlog"] = True
+    return out
+
+
 def _build_tcm_node(cfg: dict, me):
     """TCM startup (tcm/Startup.initialize role): the RING IS THE LOG.
     A fresh node pulls the epoch log from its seed addresses, replays it
@@ -125,7 +139,8 @@ def _build_tcm_node(cfg: dict, me):
     transport = TcpTransport(tls=TLSConfig.from_dict(cfg.get("server_tls")))
     node = Node(me, cfg["data_dir"], Schema(), ring, transport,
                 seeds=[e for e in seed_eps if e != me] or [me],
-                gossip_interval=float(cfg.get("gossip_interval", 0.2)))
+                gossip_interval=float(cfg.get("gossip_interval", 0.2)),
+                engine_opts=_engine_opts(cfg))
     node.cluster_nodes = [node]
     node.schema_sync = SchemaSync(node, cfg["data_dir"])
     # local log first (restart), then the cluster's newer entries

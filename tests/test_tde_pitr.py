@@ -1,0 +1,151 @@
+"""At-rest encryption (TDE) + commitlog archiver / point-in-time restore.
+
+Reference: security/EncryptionContext.java:41 (key provider, encrypted
+sstable/commitlog options), db/commitlog/EncryptedSegment.java,
+db/commitlog/CommitLogArchiver.java:54 (archive on close, restore to a
+timestamp)."""
+import os
+
+import pytest
+
+from cassandra_tpu.schema import Schema
+from cassandra_tpu.storage import encryption as enc_mod
+from cassandra_tpu.storage.commitlog import CommitLog
+from cassandra_tpu.storage.engine import StorageEngine
+from cassandra_tpu.storage.sstable import Component, Descriptor
+
+
+@pytest.fixture(autouse=True)
+def _clean_context():
+    yield
+    enc_mod.set_context(None)
+
+
+def _mk_engine(path, **kw):
+    return StorageEngine(str(path), Schema(), commitlog_sync="batch", **kw)
+
+
+def _ddl(eng, extra=""):
+    from cassandra_tpu.cql.processor import Session
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    s.execute(f"CREATE TABLE t (k int PRIMARY KEY, v text){extra}")
+    return s
+
+
+def test_encrypted_sstable_roundtrip_and_opaque_bytes(tmp_path):
+    eng = _mk_engine(tmp_path / "data",
+                     keystore_dir=str(tmp_path / "keys"))
+    s = _ddl(eng, " WITH encryption = {'enabled': true}")
+    secret = "SECRETVALUE-verymuch-unique"
+    for i in range(200):
+        s.execute(f"INSERT INTO t (k, v) VALUES ({i}, '{secret}-{i}')")
+    cfs = eng.store("ks", "t")
+    cfs.flush()
+    rows = s.execute("SELECT v FROM t WHERE k = 7").rows
+    assert rows == [(f"{secret}-7",)]
+    # the on-disk bytes must not contain the plaintext
+    sst = cfs.live_sstables()[0]
+    for comp in (Component.DATA, Component.INDEX, Component.PARTITIONS):
+        with open(sst.desc.path(comp), "rb") as f:
+            blob = f.read()
+        assert secret.encode() not in blob, comp
+    assert os.path.exists(sst.desc.path(Component.ENCRYPTION))
+    # digest verification works on ciphertext (no keys needed for CRCs)
+    assert sst.verify_digest()
+    eng.close()
+
+    # reopen: context reloads from the keystore, data still readable
+    eng2 = _mk_engine(tmp_path / "data",
+                      keystore_dir=str(tmp_path / "keys"))
+    from cassandra_tpu.cql.processor import Session
+    s2 = Session(eng2)
+    s2.keyspace = "ks"
+    assert s2.execute("SELECT v FROM t WHERE k = 7").rows == \
+        [(f"{secret}-7",)]
+    eng2.close()
+
+
+def test_key_rotation_recompaction(tmp_path):
+    eng = _mk_engine(tmp_path / "data",
+                     keystore_dir=str(tmp_path / "keys"))
+    s = _ddl(eng, " WITH encryption = {'enabled': true}")
+    for i in range(50):
+        s.execute(f"INSERT INTO t (k, v) VALUES ({i}, 'old-{i}')")
+    cfs = eng.store("ks", "t")
+    cfs.flush()
+    ctx = enc_mod.get_context()
+    old_kid = ctx.current_key_id
+    new_kid = ctx.create_key()
+    assert new_kid > old_kid
+    for i in range(50, 100):
+        s.execute(f"INSERT INTO t (k, v) VALUES ({i}, 'new-{i}')")
+    cfs.flush()
+    # both keys serve reads
+    assert s.execute("SELECT v FROM t WHERE k = 10").rows == [("old-10",)]
+    assert s.execute("SELECT v FROM t WHERE k = 60").rows == [("new-60",)]
+    # recompaction re-encrypts everything under the current key
+    from cassandra_tpu.compaction.task import CompactionTask
+    CompactionTask(cfs, list(cfs.live_sstables())).execute()
+    import json
+    sst = cfs.live_sstables()[0]
+    with open(sst.desc.path(Component.ENCRYPTION)) as f:
+        assert json.load(f)["key_id"] == new_kid
+    assert s.execute("SELECT v FROM t WHERE k = 10").rows == [("old-10",)]
+    eng.close()
+
+
+def test_encrypted_commitlog_replay(tmp_path):
+    eng = _mk_engine(tmp_path / "data",
+                     keystore_dir=str(tmp_path / "keys"),
+                     encrypt_commitlog=True)
+    s = _ddl(eng)
+    s.execute("INSERT INTO t (k, v) VALUES (1, 'walsecret')")
+    # WAL bytes are opaque
+    segs = [p for p in
+            os.listdir(tmp_path / "data" / "commitlog")]
+    blob = b"".join(open(tmp_path / "data" / "commitlog" / p, "rb").read()
+                    for p in segs)
+    assert b"walsecret" not in blob
+    eng.close()     # memtable NOT flushed: replay must recover the row
+    eng2 = _mk_engine(tmp_path / "data",
+                      keystore_dir=str(tmp_path / "keys"),
+                      encrypt_commitlog=True)
+    from cassandra_tpu.cql.processor import Session
+    s2 = Session(eng2)
+    s2.keyspace = "ks"
+    assert s2.execute("SELECT v FROM t WHERE k = 1").rows == \
+        [("walsecret",)]
+    eng2.close()
+
+
+def test_point_in_time_restore(tmp_path):
+    arch = str(tmp_path / "archive")
+    eng = _mk_engine(tmp_path / "data", commitlog_archive_dir=arch)
+    s = _ddl(eng)
+    # early writes at explicit timestamps <= T, late writes beyond
+    T = 5000
+    for i in range(20):
+        s.execute(f"INSERT INTO t (k, v) VALUES ({i}, 'early-{i}') "
+                  f"USING TIMESTAMP {1000 + i}")
+    for i in range(20, 40):
+        s.execute(f"INSERT INTO t (k, v) VALUES ({i}, 'late-{i}') "
+                  f"USING TIMESTAMP {9000 + i}")
+    tid = eng.schema.get_table("ks", "t").id
+    eng.close()   # close archives the active segment
+    assert os.listdir(arch), "no segments archived"
+
+    # restore into a FRESH node (same schema incl. table id — mutations
+    # route by id), to timestamp T
+    eng2 = _mk_engine(tmp_path / "restored")
+    s2 = _ddl(eng2, f" WITH id = {tid}")
+    applied = eng2.restore_point_in_time(arch, T)
+    assert applied == 20
+    for i in range(20):
+        assert s2.execute(f"SELECT v FROM t WHERE k = {i}").rows == \
+            [(f"early-{i}",)], i
+    for i in range(20, 40):
+        assert s2.execute(f"SELECT v FROM t WHERE k = {i}").rows == [], i
+    eng2.close()
