@@ -227,20 +227,32 @@ def sharded_compact_to_sstables(batches: list[CellBatch], table, mesh,
     from ..storage.sstable.format import Descriptor
     from ..storage.sstable.writer import SSTableWriter
 
+    import os
+
     if shards is None:
         cat = CellBatch.concat(batches)
         shards = materialize_sharded_merge(cat, mesh, gc_before, now)
     results = []
-    for s, shard in enumerate(shards):
-        if len(shard) == 0:
-            continue
-        desc = Descriptor(directory, generation_base + s)
-        w = SSTableWriter(desc, table)
-        try:
-            w.append(shard)
-            stats = w.finish()
-        except BaseException:
-            w.abort()
-            raise
-        results.append((desc, stats))
+    try:
+        for s, shard in enumerate(shards):
+            if len(shard) == 0:
+                continue
+            desc = Descriptor(directory, generation_base + s)
+            w = SSTableWriter(desc, table)
+            try:
+                w.append(shard)
+                stats = w.finish()
+            except BaseException:
+                w.abort()
+                raise
+            results.append((desc, stats))
+    except BaseException:
+        # all-or-nothing round (LifecycleTransaction semantics): a failed
+        # shard write must not leave earlier shards' sstables behind as a
+        # partial compaction output
+        for desc, _stats in results:
+            for p in desc.all_paths():
+                if os.path.exists(p):
+                    os.remove(p)
+        raise
     return results

@@ -126,3 +126,30 @@ def test_sharded_compaction_writes_sstables_roundtrip(tmp_path):
     assert len(got) == len(ref)
     np.testing.assert_array_equal(got.lanes, ref.lanes)
     np.testing.assert_array_equal(got.payload, ref.payload)
+
+
+def test_failed_shard_write_leaves_no_partial_round(tmp_path, monkeypatch):
+    """Fault injection: one shard's writer dies mid-round — the whole
+    round must be all-or-nothing (LifecycleTransaction semantics): no
+    earlier shard's sstable may survive as partial compaction output."""
+    import os
+    import pytest
+    from cassandra_tpu.parallel.mesh import sharded_compact_to_sstables
+    from cassandra_tpu.storage.sstable import writer as writer_mod
+
+    batches = build_workload(n_parts=80, n_cks=4, gens=2)
+    mesh = make_mesh(8)
+    calls = {"n": 0}
+    real_finish = writer_mod.SSTableWriter.finish
+
+    def failing_finish(self):
+        calls["n"] += 1
+        if calls["n"] == 3:          # third shard's commit blows up
+            raise OSError("injected shard write failure")
+        return real_finish(self)
+
+    monkeypatch.setattr(writer_mod.SSTableWriter, "finish", failing_finish)
+    with pytest.raises(OSError, match="injected"):
+        sharded_compact_to_sstables(batches, T, mesh, str(tmp_path))
+    leftovers = [f for f in os.listdir(tmp_path)]
+    assert leftovers == [], f"partial round left files: {leftovers}"
