@@ -12,18 +12,19 @@ numbers (BASELINE.json.published = {}).
 
 Engine selection (CTPU_BENCH_ENGINE = native | device | numpy):
   native  C++ k-way streaming merge + inline reconcile (default here).
-  device  the TPU kernel (ops/merge.py packed path).
+  device  the TPU kernel (ops/merge.py v3 truncated-key planes: ~6 B/cell
+          pushed, 1 B/cell pulled, pipelined rounds).
   numpy   the reference host implementation (executable spec).
 All three are tested bit-identical (tests/test_merge_device.py,
-tests/test_host_merge.py). The default is `native` because THIS
-environment reaches the chip through a tunnel whose measured transfer
-bandwidth collapses to ~30 MiB/s once any sizable program has executed
-(pushes that run at 0.6-1.7 GiB/s on an idle backend drop ~20x) — a
-bandwidth-bound columnar merge cannot win through that straw. On locally
-attached TPU (PCIe/ICI at tens of GiB/s), the device engine's transfer
-cost vanishes and its kernel (sort+reconcile of 1M cells in ~0.45s
-end-to-end incl. transfers, ~0.25s compute) leads; CompactionTask takes
-engine= per deployment. Phase timings are published in detail.phases.
+tests/test_merge_fastpath.py, tests/test_host_merge.py). The default is
+`native` because THIS environment reaches the chip through a tunnel
+whose measured warm bandwidth is ~15-20 MiB/s (idle-backend pushes run
+at 0.6-1.7 GiB/s; they collapse ~20x once any sizable program has
+executed) AND the host has one core — so the device path's remaining
+~0.4s link wait cannot beat the C++ merge's 0.06s. The v3 layout took
+the device engine from 24 to ~73 MiB/s on this link (BASELINE.md has
+the full accounting + the untunneled-chip projection); CompactionTask
+takes engine= per deployment. Phase timings are in detail.phases.
 
 Prints ONE json line. The device kernel is warmed on a separate copy of
 the data so compile time is excluded.

@@ -56,7 +56,12 @@ class _Cursor:
     whole partitions (the reference's CompactionIterator merges per
     partition for the same reason). A partition larger than one segment is
     buffered whole — acceptable for round 1; the reference streams within
-    partitions via its row index."""
+    partitions via its row index.
+
+    (A background decode-prefetch thread was tried here and measured a net
+    LOSS on both engines: segment parsing is numpy-bound, so the extra
+    thread just contends for the GIL with pack/gather — the overlap that
+    pays is the device pipeline + the writer thread.)"""
 
     def __init__(self, reader: SSTableReader, prof: dict | None = None):
         self._it = reader.scanner()
@@ -177,11 +182,14 @@ class CompactionController:
 
 
 class CompactionTask:
-    # cells merged per round. The device engine wants BIG rounds: the
-    # fixed per-round transfer latency dominates (each push ~50-100ms,
-    # pull ~25 MiB/s on a tunneled chip); the cap bounds host buffering
-    # (~100 bytes/cell) and keeps N < 2^24 for the packed perm layout.
-    ROUND_CELLS_DEVICE = 1 << 21
+    # cells merged per round. Device rounds target just under 2^18 cells:
+    # big enough to amortise dispatch latency, small enough that >=4
+    # rounds pipeline (submit round N+1 while N's result is in flight, so
+    # link transfers overlap host decode/gather/write), and sized so the
+    # padded program shape is almost always exactly 2^18 — one compiled
+    # program, warm after the first round.
+    ROUND_CELLS_DEVICE = (1 << 18) - (1 << 14)
+    PIPELINE_DEPTH = 3
     # the host engines want SMALL rounds: per-round cost is near zero and
     # many rounds let the pipelined writer thread overlap compression +
     # file I/O with the next round's decode + merge.
@@ -231,8 +239,7 @@ class CompactionTask:
         controller = CompactionController(cfs, self.inputs)
         prof = self.profile
         if self.engine == "device":
-            def merge_fn(slices, **kw):
-                return dmerge.merge_sorted_device(slices, prof=prof, **kw)
+            merge_fn = None   # device rounds go through submit/collect
         elif self.engine == "native":
             from ..ops.host_merge import merge_sorted_native
 
@@ -299,6 +306,17 @@ class CompactionTask:
                     if wq.get() is None:
                         return
 
+        # device engine: keep rounds in flight (async dispatch) so the
+        # accelerator link overlaps host decode + gather + write
+        from collections import deque
+
+        pending: deque = deque()
+
+        def collect_oldest():
+            merged = dmerge.collect_merge(pending.popleft())
+            if len(merged):
+                wq.put(merged)
+
         wthread = None
         try:
             wstate["writer"] = new_writer()
@@ -331,10 +349,20 @@ class CompactionTask:
                         slices.append(s)
                 if not slices:
                     continue
-                merged = merge_fn(slices, gc_before=gc_before, now=now,
-                                  purgeable_ts_fn=controller.purgeable_ts_fn)
-                if len(merged):
-                    wq.put(merged)
+                if self.engine == "device":
+                    pending.append(dmerge.submit_merge(
+                        slices, gc_before=gc_before, now=now,
+                        purgeable_ts_fn=controller.purgeable_ts_fn,
+                        prof=prof))
+                    while len(pending) >= self.PIPELINE_DEPTH:
+                        collect_oldest()
+                else:
+                    merged = merge_fn(slices, gc_before=gc_before, now=now,
+                                      purgeable_ts_fn=controller.purgeable_ts_fn)
+                    if len(merged):
+                        wq.put(merged)
+            while pending:
+                collect_oldest()
             wq.put(None)
             wthread.join()
             if werr:
@@ -366,6 +394,7 @@ class CompactionTask:
             for r in self.inputs:
                 r.release()
         except BaseException:
+            pending.clear()
             if wthread is not None and wthread.is_alive():
                 # blocking put is safe: the consumer is either processing
                 # or draining toward the sentinel — put_nowait could drop

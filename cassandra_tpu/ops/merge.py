@@ -466,6 +466,202 @@ def _plane_program(planes, cfg):
     return _plane_reconcile(planes, cfg, perm)
 
 
+# ------------------------------------- truncated-key fast path (v3) ---------
+#
+# The common compaction round has NO deletions of any kind — just live and
+# TTL'd cells from sorted runs. For it the device only has to (a) find the
+# merged order and (b) pick newest-version winners; TTL expiry, purge and
+# exact tie-breaks are host post-passes that need data the device never
+# sees. That permits two big cuts in bytes-per-cell over the v2 planes:
+#
+#  push  every plane shrinks to the narrowest dtype its VALUE RANGE needs
+#        (bias by min): partition rank u16 for <65534 distinct partitions,
+#        clustering lanes u8/u16 when their spread fits, and the timestamp
+#        truncated to its top bits (uts >> 24, then range-shrunk) — cells
+#        of the SAME identity whose truncated stamps collide are flagged
+#        ambiguous and ordered exactly on the host (it has full ts).
+#  pull  1 byte/cell: the source-run id (4 bits) + keep/ambiguous bits.
+#        Each input run is sorted, and the device sort is stable over keys
+#        that are order-isomorphic to the true keys, so within a run the
+#        output preserves input order — the host reconstructs the full
+#        permutation from run bases + per-run occurrence counting instead
+#        of pulling a 4-byte perm lane.
+#
+# Reference semantics carried: newest-wins then Cells.resolveRegular
+# (db/rows/Cells.java:79) — the host resolver orders collision runs by
+# exact (ts, expiring-or-tombstone, tombstone, localDeletionTime, value).
+
+TS_TRUNC_SHIFT = 24
+_FAST_EXCLUDED = (DEATH_FLAGS | FLAG_COMPLEX_DEL | FLAG_RANGE_BOUND
+                  | FLAG_COUNTER)
+
+
+def _shrunk(vals: np.ndarray, n: int, N: int, reserve_sentinel: bool):
+    """Bias vals by min and cast to the narrowest uint dtype that holds the
+    range (reserving the dtype max as padding sentinel when asked).
+    Returns (plane, dtype_name, sentinel_value) or None if > u32 needed."""
+    vmin = int(vals.min()) if n else 0
+    rng = (int(vals.max()) - vmin) if n else 0
+    slack = 1 if reserve_sentinel else 0
+    for dt, top in ((np.uint8, 0xFF), (np.uint16, 0xFFFF),
+                    (np.uint32, 0xFFFFFFFF)):
+        if rng <= top - slack:
+            plane = np.full(N, top if reserve_sentinel else 0, dtype=dt)
+            plane[:n] = (vals - vmin).astype(dt)
+            return plane, np.dtype(dt).name, top
+    return None
+
+
+def _plane_pack_fast(cat: CellBatch, batches: list[CellBatch]):
+    """Build the v3 truncated-key planes. Returns (planes, cfg, meta) or
+    None when this round doesn't qualify (unsorted runs, any deletion/
+    counter/range-bound flag, >15 runs, rank overflow)."""
+    n = len(cat)
+    k = len(batches)
+    if k > 15 or not all(getattr(b, "sorted", False) for b in batches):
+        return None
+    if (cat.flags & _FAST_EXCLUDED).any():
+        return None
+    N = _plane_pad(n)
+    K = cat.n_lanes
+
+    ranks = _partition_ranks(batches)
+    r = _shrunk(ranks, n, N, reserve_sentinel=True)
+    if r is None:
+        return None
+    rank_plane, rank_dt, _sent = r
+
+    skip = {K - 5, K - 4} if cat.ck_fits_prefix else set()
+    lane_planes, lane_dts = [], []
+    for kk in range(4, K):
+        if kk in skip:
+            continue
+        col_vals = cat.lanes[:, kk]
+        if n and int(col_vals.min()) == int(col_vals.max()):
+            continue
+        s = _shrunk(col_vals, n, N, reserve_sentinel=False)
+        plane, dt, _ = s
+        lane_planes.append(plane)
+        lane_dts.append(dt)
+
+    # truncated timestamp, DESC via host-side flip (device sorts asc only)
+    with np.errstate(over="ignore"):
+        uts = cat.ts.astype(np.uint64) ^ np.uint64(1 << 63)
+    q = uts >> np.uint64(TS_TRUNC_SHIFT)
+    qmin = int(q.min()) if n else 0
+    qr = q - np.uint64(qmin)
+    qrange = int(qr.max()) if n else 0
+    q_planes, q_dts = [], []
+    if qrange > 0xFFFFFFFF:
+        hi = (qr >> np.uint64(32)).astype(np.uint32)
+        # flip before shrink for desc order (shrink re-biases by min,
+        # which preserves the flipped ascending order)
+        fh = hi.max() - hi if n else hi
+        ph, dth, _ = _shrunk(fh, n, N, False)
+        q_planes.append(ph)
+        q_dts.append(dth)
+        lo = (qr & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        flo = np.uint32(0xFFFFFFFF) - lo
+        pl = np.zeros(N, dtype=np.uint32)
+        pl[:n] = flo
+        q_planes.append(pl)
+        q_dts.append("uint32")
+    else:
+        qv = qr.astype(np.uint64)
+        fq = (np.uint64(qrange) - qv).astype(np.uint32)
+        pq, dtq, _ = _shrunk(fq, n, N, False)
+        q_planes.append(pq)
+        q_dts.append(dtq)
+
+    offs = np.zeros(k + 1, dtype=np.int32)
+    offs[1:] = np.cumsum([len(b) for b in batches])
+    # ONE transfer per round: all planes + the run-offset table serialized
+    # into a single u8 buffer (each device_put pays fixed dispatch/link
+    # latency — ~20 small puts per compaction measurably hurt through the
+    # tunnel). The device program re-slices by the static cfg layout.
+    parts = [rank_plane] + lane_planes + q_planes
+    buf = np.concatenate([np.ascontiguousarray(p).view(np.uint8).ravel()
+                          for p in parts]
+                         + [offs.astype("<i4").view(np.uint8)])
+    cfg = (rank_dt, tuple(lane_dts), tuple(q_dts), k)
+    meta = {"n": n, "k": k,
+            "bases": offs[:-1].astype(np.int64),
+            "counts": np.diff(offs).astype(np.int64)}
+    return buf, cfg, meta
+
+
+@_partial(jax.jit, static_argnames=("cfg",))
+def _plane_program_fast(buf, cfg):
+    """v3 device program: LSD sort over truncated planes, then emit ONE u8
+    per cell: bits 0-3 source-run id, bit4 keep (newest winner), bit5
+    ambiguous (same identity, same truncated ts as predecessor).
+    `buf` is the single packed u8 transfer from _plane_pack_fast; plane
+    slices/dtypes are recovered via the static cfg layout (bitcast on the
+    minor axis — both host and TPU are little-endian)."""
+    rank_dt, lane_dts, q_dts, k = cfg
+    dts = [rank_dt] + list(lane_dts) + list(q_dts)
+    cell_bytes = sum(np.dtype(d).itemsize for d in dts)
+    N = (buf.shape[0] - 4 * (k + 1)) // cell_bytes
+
+    def plane_at(off, dt):
+        isz = np.dtype(dt).itemsize
+        x = jax.lax.slice(buf, (off,), (off + N * isz,))
+        if isz == 1:
+            return x
+        return jax.lax.bitcast_convert_type(
+            x.reshape(N, isz), jnp.dtype(dt))
+
+    planes = {}
+    off = 0
+    names = (["rank"] + [f"lane{i}" for i in range(len(lane_dts))]
+             + [f"q{i}" for i in range(len(q_dts))])
+    for name, dt in zip(names, dts):
+        planes[name] = plane_at(off, dt)
+        off += N * np.dtype(dt).itemsize
+    offsets = jax.lax.bitcast_convert_type(
+        jax.lax.slice(buf, (off,), (off + 4 * (k + 1),)).reshape(k + 1, 4),
+        jnp.int32)
+    perm = jnp.arange(N, dtype=jnp.int32)
+
+    def asc(key, perm):
+        _, p = jax.lax.sort((key[perm], perm), num_keys=1, is_stable=True)
+        return p
+
+    # least-significant first: q planes are pre-flipped (asc == ts desc),
+    # minor q plane last pushed... order: q_lo is LEAST significant
+    n_lanes = len(lane_dts)
+    n_q = len(q_dts)
+    for i in reversed(range(n_q)):
+        perm = asc(planes[f"q{i}"], perm)
+    for i in reversed(range(n_lanes)):
+        perm = asc(planes[f"lane{i}"], perm)
+    perm = asc(planes["rank"], perm)
+
+    rank_s = planes["rank"][perm]
+    sentinel = jnp.array(np.iinfo(np.dtype(rank_dt)).max, rank_s.dtype)
+    valid = rank_s != sentinel
+    first = jnp.zeros(N, dtype=bool).at[0].set(True)
+
+    def diff(a):
+        prev = jnp.concatenate([jnp.full((1,), ~a[0], dtype=a.dtype),
+                                a[:-1]])
+        return a != prev
+
+    cell_new = first | diff(rank_s)
+    for i in range(n_lanes):
+        cell_new = cell_new | diff(planes[f"lane{i}"][perm])
+    same_q = jnp.ones(N, dtype=bool)
+    for i in range(n_q):
+        same_q = same_q & ~diff(planes[f"q{i}"][perm])
+
+    keep = cell_new & valid
+    amb = (~cell_new) & same_q & valid
+    src = (jnp.searchsorted(offsets, perm, side="right") - 1).astype(
+        jnp.uint8)
+    return (src | (keep.astype(jnp.uint8) << 4)
+            | (amb.astype(jnp.uint8) << 5))
+
+
 # ----------------------------------------------------------------- wrapper --
 
 def _bucket(n: int) -> int:
@@ -529,68 +725,157 @@ def build_operands(cat: CellBatch, gc_before: int = 0, now: int = 0,
     }
 
 
+class DeviceMergeHandle:
+    """An in-flight device merge round. `submit_merge` packs + dispatches
+    (returns while transfers/compute are queued asynchronously);
+    `collect_merge` blocks on the device result and runs the host
+    post-passes. Keeping >=2 rounds in flight overlaps the accelerator
+    link with host decode/gather/write — the pipelining the reference gets
+    from the kernel writeback cache (CompactionTask.java:207 hot loop)."""
+
+    __slots__ = ("mode", "result", "cat", "n", "fut", "meta", "cfg",
+                 "gc_before", "now", "purgeable_ts_fn", "prof")
+
+
+def submit_merge(batches: list[CellBatch], gc_before: int = 0,
+                 now: int = 0, purgeable_ts_fn=None,
+                 prof: dict | None = None) -> DeviceMergeHandle:
+    """Pack one merge round and dispatch it to the device (async). Rounds
+    that can't run on-device (range tombstones, huge partitions) compute
+    synchronously on the host instead."""
+    import time as _time
+    from ..storage.cellbatch import merge_sorted as cb_merge_fallback
+
+    h = DeviceMergeHandle()
+    h.gc_before, h.now = gc_before, now
+    h.purgeable_ts_fn = purgeable_ts_fn
+    h.prof = prof
+    cat = CellBatch.concat(batches)
+    h.cat = cat
+    h.n = len(cat)
+    if h.n == 0:
+        h.mode, h.result = "done", cat
+        return h
+    t1 = _time.perf_counter()
+    if ((cat.flags & FLAG_RANGE_BOUND) != 0).any():
+        # range tombstone coverage is evaluated host-side on full
+        # composites — numpy spec path
+        h.mode = "done"
+        h.result = cb_merge_fallback(batches, gc_before, now,
+                                     purgeable_ts_fn)
+        return h
+    fast = _plane_pack_fast(cat, batches)
+    if fast is not None:
+        buf, cfg, meta = fast
+        t2 = _time.perf_counter()
+        h.fut = _plane_program_fast(jax.device_put(buf), cfg)
+        h.mode, h.meta, h.cfg = "fast", meta, cfg
+        if prof is not None:
+            prof["pack"] = prof.get("pack", 0.0) + (t2 - t1)
+        return h
+    if _plane_pad(h.n) >= (1 << 24):
+        # the v2 packed perm layout holds 24 bits — a single >16M-cell
+        # round overflows it
+        h.mode = "done"
+        h.result = cb_merge_fallback(batches, gc_before, now,
+                                     purgeable_ts_fn)
+        return h
+    packed_v2 = _plane_pack_v2(cat, batches)
+    if packed_v2 is None:
+        h.mode = "done"
+        h.result = cb_merge_fallback(batches, gc_before, now,
+                                     purgeable_ts_fn)
+        return h
+    planes, cfg = packed_v2
+    t2 = _time.perf_counter()
+    planes_d = {k: jax.device_put(v) for k, v in planes.items()}
+    h.fut = _plane_program(planes_d, cfg)
+    h.mode, h.cfg = "v2", cfg
+    if prof is not None:
+        prof["pack"] = prof.get("pack", 0.0) + (t2 - t1)
+    return h
+
+
+def collect_merge(h: DeviceMergeHandle) -> CellBatch:
+    """Block on a submitted round and run the host post-passes: TTL
+    expiry, purge, exact tie-breaks, payload gather."""
+    import time as _time
+
+    if h.mode == "done":
+        return h.result
+    cat, n, prof = h.cat, h.n, h.prof
+    t0 = _time.perf_counter()
+    # nothing can expire or be purged when no cell carries a death or
+    # expiring flag (the fast path already guarantees no death flags) —
+    # skip the overlap query and the whole expiry/purge post-pass
+    inert = not ((cat.flags & (DEATH_FLAGS | FLAG_EXPIRING)) != 0).any()
+    pts = h.purgeable_ts_fn(cat).astype(np.int64) \
+        if h.purgeable_ts_fn is not None and not inert else None
+    t1 = _time.perf_counter()
+    combined = np.asarray(h.fut)
+    t2 = _time.perf_counter()
+
+    if h.mode == "fast":
+        bits = combined[:n]
+        src = bits & 0x0F
+        keep = (bits & 0x10) != 0
+        ambiguous = (bits & 0x20) != 0
+        shadowed = np.zeros(n, dtype=bool)
+        # permutation reconstruction: each run is sorted and the device
+        # sort is stable, so sorted positions of run r enumerate r's cells
+        # in input order
+        meta = h.meta
+        perm = np.empty(n, dtype=np.int64)
+        for r in range(meta["k"]):
+            pos = np.flatnonzero(src == r)
+            if len(pos) != meta["counts"][r]:
+                raise RuntimeError(
+                    "device merge src-count mismatch (unsorted input run?)")
+            perm[pos] = meta["bases"][r] + np.arange(len(pos),
+                                                     dtype=np.int64)
+    else:
+        perm = (combined & 0x00FFFFFF).astype(np.int64)[:n]
+        bits8 = (combined >> 24).astype(np.uint8)[:n]
+        keep, ambiguous, _, shadowed = unpack_masks(bits8)
+
+    # host post-pass: TTL expiry, purge and tie-breaks don't affect sort
+    # order or shadow carries, so they never went to the device
+    if inert:
+        expired = np.zeros(n, dtype=bool)
+        pts_sorted = None
+    else:
+        flags_s = cat.flags[perm]
+        ldt_s = cat.ldt[perm]
+        ts_s = cat.ts[perm]
+        expired = ((flags_s & FLAG_EXPIRING) != 0) & (ldt_s <= h.now)
+        death_eff = ((flags_s & DEATH_FLAGS) != 0) | expired
+        pts_sorted = pts[perm] if pts is not None else None
+        purgeable = np.ones(n, dtype=bool) if pts_sorted is None \
+            else ts_s < pts_sorted
+        purged = death_eff & (ldt_s < h.gc_before) & purgeable
+        keep &= ~purged
+    if ambiguous.any():
+        host_tiebreak(cat, perm, keep, ambiguous, shadowed,
+                      expired, h.gc_before, pts_sorted,
+                      order_by_ts=(h.mode == "fast"))
+
+    out = finalize_merged(cat, perm, keep, expired, shadowed)
+    t3 = _time.perf_counter()
+    if prof is not None:
+        prof["purge_fn"] = prof.get("purge_fn", 0.0) + (t1 - t0)
+        prof["device"] = prof.get("device", 0.0) + (t2 - t1)
+        prof["gather"] = prof.get("gather", 0.0) + (t3 - t2)
+    return out
+
+
 def merge_sorted_device(batches: list[CellBatch], gc_before: int = 0,
                         now: int = 0, purgeable_ts_fn=None,
                         prof: dict | None = None) -> CellBatch:
     """Drop-in equivalent of storage.cellbatch.merge_sorted running the
     sort/reconcile on the default JAX device. `prof` (optional) accumulates
     per-phase wall seconds: pack / purge_fn / device / gather."""
-    import time as _time
-
-    def _t():
-        return _time.perf_counter()
-
-    from ..storage.cellbatch import merge_sorted as cb_merge_fallback
-
-    cat = CellBatch.concat(batches)
-    n = len(cat)
-    if n == 0:
-        return cat
-    t0 = _t()
-    pts = purgeable_ts_fn(cat).astype(np.int64) \
-        if purgeable_ts_fn is not None else None
-    t1 = _t()
-    if _plane_pad(n) >= (1 << 24) or \
-            ((cat.flags & FLAG_RANGE_BOUND) != 0).any():
-        # fall back to the numpy spec path: the packed perm layout holds
-        # 24 bits (a single >16M-cell partition overflows it), and range
-        # tombstone coverage is evaluated host-side on full composites
-        return cb_merge_fallback(batches, gc_before, now, purgeable_ts_fn)
-    packed_v2 = _plane_pack_v2(cat, batches)
-    if packed_v2 is None:
-        return cb_merge_fallback(batches, gc_before, now, purgeable_ts_fn)
-    planes, cfg = packed_v2
-    t2 = _t()
-    planes_d = {k: jax.device_put(v) for k, v in planes.items()}
-    combined = np.asarray(_plane_program(planes_d, cfg))
-    t3 = _t()
-    perm = (combined & 0x00FFFFFF).astype(np.int64)[:n]
-    bits = (combined >> 24).astype(np.uint8)[:n]
-    keep, ambiguous, _, shadowed = unpack_masks(bits)
-
-    # host post-pass: TTL expiry, purge and tie-breaks don't affect sort
-    # order or shadow carries, so they never went to the device
-    flags_s = cat.flags[perm]
-    ldt_s = cat.ldt[perm]
-    ts_s = cat.ts[perm]
-    expired = ((flags_s & FLAG_EXPIRING) != 0) & (ldt_s <= now)
-    death_eff = ((flags_s & DEATH_FLAGS) != 0) | expired
-    pts_sorted = pts[perm] if pts is not None else None
-    purgeable = np.ones(n, dtype=bool) if pts_sorted is None \
-        else ts_s < pts_sorted
-    purged = death_eff & (ldt_s < gc_before) & purgeable
-    keep &= ~purged
-    host_tiebreak(cat, perm, keep, ambiguous, shadowed,
-                  expired, gc_before, pts_sorted)
-
-    out = finalize_merged(cat, perm, keep, expired, shadowed)
-    t4 = _t()
-    if prof is not None:
-        prof["purge_fn"] = prof.get("purge_fn", 0.0) + (t1 - t0)
-        prof["pack"] = prof.get("pack", 0.0) + (t2 - t1)
-        prof["device"] = prof.get("device", 0.0) + (t3 - t2)
-        prof["gather"] = prof.get("gather", 0.0) + (t4 - t3)
-    return out
+    return collect_merge(submit_merge(batches, gc_before, now,
+                                      purgeable_ts_fn, prof))
 
 
 def finalize_merged(cat: CellBatch, perm_real: np.ndarray,
@@ -618,13 +903,18 @@ def finalize_merged(cat: CellBatch, perm_real: np.ndarray,
 def host_tiebreak(cat: CellBatch, perm_real: np.ndarray, keep: np.ndarray,
                   amb: np.ndarray, shadowed: np.ndarray,
                   expired: np.ndarray, gc_before: int,
-                  pts_sorted: np.ndarray | None) -> None:
+                  pts_sorted: np.ndarray | None,
+                  order_by_ts: bool = False) -> None:
     """Resolve equal-(identity, ts) runs with exact Cells.resolveRegular
     rules (db/rows/Cells.java:79, CASSANDRA-14592): expiring-or-tombstone
     beats live, pure tombstone beats expiring, larger localDeletionTime,
     larger value bytes, then first-seen. Mutates `keep` in place. Arrays
     are in SORTED order; perm_real maps sorted position -> index into
-    `cat`. Shared by the single-device and the mesh-sharded paths."""
+    `cat`. Shared by the single-device and the mesh-sharded paths.
+
+    order_by_ts: the truncated-key fast path marks runs whose TRUNCATED
+    stamps collide — exact timestamps may differ inside a run, so the
+    winner key leads with the full ts before the resolveRegular ranking."""
     if not amb.any():
         return
     n = len(perm_real)
@@ -655,9 +945,15 @@ def host_tiebreak(cat: CellBatch, perm_real: np.ndarray, keep: np.ndarray,
     for lo, hi in runs:
         if lo < 0 or not cell_new[lo]:
             continue  # run of older duplicates below the winner
-        best = max(range(lo, hi + 1),
-                   key=lambda i: (bool(eot[i]), bool(death_orig[i]),
-                                  int(ldt_sorted[i]), orig_value(i)))
+        if order_by_ts:
+            best = max(range(lo, hi + 1),
+                       key=lambda i: (int(ts_sorted[i]), bool(eot[i]),
+                                      bool(death_orig[i]),
+                                      int(ldt_sorted[i]), orig_value(i)))
+        else:
+            best = max(range(lo, hi + 1),
+                       key=lambda i: (bool(eot[i]), bool(death_orig[i]),
+                                      int(ldt_sorted[i]), orig_value(i)))
         keep[lo:hi + 1] = False
         purgeable = pts_sorted is None or ts_sorted[best] < pts_sorted[best]
         purged = bool(death_eff[best]) and ldt_sorted[best] < gc_before \

@@ -1,0 +1,84 @@
+"""Profile merge_sorted_device sub-phases at bench shape (dev tool)."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+
+from cassandra_tpu.ops import merge as dmerge
+from cassandra_tpu.storage import cellbatch as cb
+from cassandra_tpu.tools import bulk
+from cassandra_tpu.schema import make_table, TableParams
+from cassandra_tpu.ops.codec import CompressionParams
+
+N_RUNS = 4
+CELLS = 262_144
+VB = 64
+NPART = 4096
+
+table = make_table("bench", "stress", pk=["id"], ck=["c"],
+                   cols={"id": "int", "c": "int", "v": "blob"},
+                   params=TableParams(compression=CompressionParams("LZ4Compressor")))
+
+rng = np.random.default_rng(2)
+batches = []
+for run in range(N_RUNS):
+    pk = rng.integers(0, NPART, CELLS)
+    ck = rng.integers(1, 10_000, CELLS)
+    vals = rng.integers(0, 256, (CELLS, VB), dtype=np.uint8)
+    ts = rng.integers(1, 1 << 40, CELLS).astype(np.int64)
+    b = bulk.build_int_batch(table, pk, ck, vals, ts)
+    batches.append(cb.merge_sorted([b]))
+
+
+def one(tag):
+    t = {}
+    t0 = time.perf_counter()
+    cat = cb.CellBatch.concat(batches)
+    n = len(cat)
+    t["concat"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    planes, cfg = dmerge._plane_pack_v2(cat, batches)
+    t["pack"] = time.perf_counter() - t0
+    push_bytes = sum(v.nbytes for v in planes.values() if hasattr(v, "nbytes"))
+
+    t0 = time.perf_counter()
+    planes_d = {k: jax.device_put(v) for k, v in planes.items()}
+    jax.block_until_ready(list(planes_d.values()))
+    t["push"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    out = dmerge._plane_program(planes_d, cfg)
+    out.block_until_ready()
+    t["program"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    combined = np.asarray(out)
+    t["pull"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    perm = (combined & 0x00FFFFFF).astype(np.int64)[:n]
+    bits = (combined >> 24).astype(np.uint8)[:n]
+    keep, ambiguous, _, shadowed = dmerge.unpack_masks(bits)
+    flags_s = cat.flags[perm]
+    ldt_s = cat.ldt[perm]
+    ts_s = cat.ts[perm]
+    expired = ((flags_s & cb.FLAG_EXPIRING) != 0) & (ldt_s <= 0)
+    t["post"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    merged = dmerge.finalize_merged(cat, perm, keep, expired, shadowed)
+    t["finalize"] = time.perf_counter() - t0
+
+    print(tag, f"n={n} push_bytes={push_bytes} ({push_bytes/n:.1f} B/cell)",
+          {k: round(v, 3) for k, v in t.items()}, f"kept={len(merged)}")
+
+
+one("cold")
+one("warm1")
+one("warm2")
+one("warm3")
