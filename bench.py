@@ -38,49 +38,102 @@ import time
 
 import numpy as np
 
-N_RUNS = 4
-CELLS_PER_RUN = 262_144
 VALUE_BYTES = 64
 N_PARTITIONS = 4096
 
+# CTPU_BENCH_CONFIG selects the workload shape (BASELINE.json configs):
+#   stcs  (default) STCS major, 4-way, LZ4 16KiB, random-blob values —
+#         the headline number the driver records.
+#   lcs   LCS-shape many-way merge (L0 overlap + L1 disjoint runs),
+#         Snappy 16KiB, compressible text values.
+#   twcs  TWCS time-series: per-window runs, expired TTLs + gc_before in
+#         the past — measures the tombstone/TTL purge pipeline.
+#   ucs   UCS-shape mixed-density runs, Zstd 64KiB chunks.
+CONFIGS = {
+    "stcs": {"desc": "STCS major, 4-way, LZ4 16KiB",
+             "compressor": ("LZ4Compressor", 16 * 1024),
+             "runs": [262_144] * 4, "values": "blob"},
+    "lcs": {"desc": "LCS many-way (4xL0 + 6xL1), Snappy 16KiB, text",
+            "compressor": ("SnappyCompressor", 16 * 1024),
+            "runs": [131_072] * 4, "l1_runs": 6, "values": "text"},
+    "twcs": {"desc": "TWCS time-series, TTL purge, LZ4 16KiB",
+             "compressor": ("LZ4Compressor", 16 * 1024),
+             "runs": [262_144] * 4, "values": "points", "ttl": True},
+    "ucs": {"desc": "UCS mixed-density, Zstd 64KiB",
+            "compressor": ("ZstdCompressor", 64 * 1024),
+            "runs": [524_288, 262_144, 131_072, 65_536, 65_536],
+            "values": "blob"},
+}
 
-def build_inputs(data_dir, table, seed):
+
+def _values(rng, n, kind):
+    if kind == "text":     # compressible lowercase text
+        return rng.integers(97, 122, (n, VALUE_BYTES), dtype=np.uint8)
+    if kind == "points":   # 8-byte time-series points
+        return rng.integers(0, 256, (n, 8), dtype=np.uint8)
+    return rng.integers(0, 256, (n, VALUE_BYTES), dtype=np.uint8)
+
+
+def build_inputs(data_dir, table, seed, cfg):
     from cassandra_tpu.storage import cellbatch as cb
     from cassandra_tpu.storage.sstable import Descriptor, SSTableWriter
     from cassandra_tpu.tools import bulk
 
     rng = np.random.default_rng(seed)
     os.makedirs(data_dir, exist_ok=True)
-    total = 0
-    for run in range(N_RUNS):
-        n = CELLS_PER_RUN
+    gen = 0
+    now = int(time.time())
+    for run_cells in cfg["runs"]:
+        n = run_cells
         # zipf-ish overlap across runs: same partition space, random rows
         pk = rng.integers(0, N_PARTITIONS, n)
-        ck = rng.integers(1, 10_000, n)
-        # cassandra-stress default columns are blob() — uniform random
-        # bytes (tools/stress SettingsCommand defaults); CTPU_BENCH_TEXT=1
-        # switches to compressible lowercase text instead
-        if os.environ.get("CTPU_BENCH_TEXT", "0") == "1":
-            vals = rng.integers(97, 122, (n, VALUE_BYTES), dtype=np.uint8)
+        if cfg.get("ttl"):
+            # per-window timelines: each run is one time window; half the
+            # windows are fully past their TTL at compaction time
+            ck = (gen * 100_000 + rng.integers(0, 50_000, n))
         else:
-            vals = rng.integers(0, 256, (n, VALUE_BYTES), dtype=np.uint8)
+            ck = rng.integers(1, 10_000, n)
+        vals = _values(rng, n, cfg["values"])
         ts = rng.integers(1, 1 << 40, n).astype(np.int64)
         batch = bulk.build_int_batch(table, pk, ck, vals, ts)
+        if cfg.get("ttl"):
+            ttl_s = 3600
+            expired = gen < len(cfg["runs"]) // 2   # old windows: expired
+            write_age = ttl_s * 3 if expired else 0
+            batch.ttl[:] = ttl_s
+            batch.ldt[:] = now - write_age + ttl_s
+            batch.flags[:] |= cb.FLAG_EXPIRING
         merged = cb.merge_sorted([batch])
-        w = SSTableWriter(Descriptor(data_dir, run + 1), table,
+        gen += 1
+        w = SSTableWriter(Descriptor(data_dir, gen), table,
                           estimated_partitions=N_PARTITIONS)
         w.append(merged)
-        stats = w.finish()
-        total += stats["n_cells"]
-    return total
+        w.finish()
+    # LCS shape: add one disjoint-partition-range layer of L1 runs
+    for i in range(cfg.get("l1_runs", 0)):
+        n = 131_072
+        lo = i * (N_PARTITIONS // cfg["l1_runs"])
+        hi = lo + N_PARTITIONS // cfg["l1_runs"]
+        pk = rng.integers(lo, hi, n)
+        ck = rng.integers(1, 10_000, n)
+        vals = _values(rng, n, cfg["values"])
+        ts = rng.integers(1, 1 << 40, n).astype(np.int64)
+        merged = cb.merge_sorted([bulk.build_int_batch(table, pk, ck,
+                                                       vals, ts)])
+        gen += 1
+        w = SSTableWriter(Descriptor(data_dir, gen), table,
+                          estimated_partitions=N_PARTITIONS)
+        w.append(merged)
+        w.level = 1
+        w.finish()
 
 
-def run_compaction(base_dir, table, seed):
+def run_compaction(base_dir, table, seed, cfg):
     from cassandra_tpu.compaction.task import CompactionTask
     from cassandra_tpu.storage.table import ColumnFamilyStore
 
     cfs = ColumnFamilyStore(table, base_dir, commitlog=None)
-    build_inputs(cfs.directory, table, seed)
+    build_inputs(cfs.directory, table, seed, cfg)
     cfs.reload_sstables()
     inputs = cfs.tracker.view()
     engine = os.environ.get("CTPU_BENCH_ENGINE", "native")
@@ -104,21 +157,27 @@ def main():
     from cassandra_tpu.ops.codec import CompressionParams
     from cassandra_tpu.schema import TableParams, make_table
 
+    cfg_name = os.environ.get("CTPU_BENCH_CONFIG", "stcs")
+    cfg = CONFIGS[cfg_name]
+    comp, chunk = cfg["compressor"]
+    gc_grace = 0 if cfg.get("ttl") else 864000
     table = make_table(
         "bench", "stress", pk=["id"], ck=["c"],
         cols={"id": "int", "c": "int", "v": "blob"},
-        params=TableParams(compression=CompressionParams("LZ4Compressor")))
+        params=TableParams(
+            compression=CompressionParams(comp, chunk_length=chunk),
+            gc_grace_seconds=gc_grace))
 
     engine = os.environ.get("CTPU_BENCH_ENGINE", "native")
     base = tempfile.mkdtemp(prefix="ctpu-bench-")
     try:
-        run_compaction(os.path.join(base, "warm"), table, seed=1)  # compile
-        stats = run_compaction(os.path.join(base, "timed"), table, seed=2)
+        run_compaction(os.path.join(base, "warm"), table, 1, cfg)  # compile
+        stats = run_compaction(os.path.join(base, "timed"), table, 2, cfg)
         mib = stats["bytes_read"] / 2**20
         mib_s = mib / stats["wall"]
         result = {
-            "metric": "compaction MiB/s (STCS major, 4-way, LZ4 16KiB, "
-                      + engine + " engine)",
+            "metric": "compaction MiB/s (%s, %s engine)"
+                      % (cfg["desc"], engine),
             "value": round(mib_s, 2),
             "unit": "MiB/s",
             "vs_baseline": round(mib_s / 64.0, 2),

@@ -1,4 +1,9 @@
-"""Profile merge_sorted_device sub-phases at bench shape (dev tool)."""
+"""Profile merge_sorted_device sub-phases (dev tool).
+
+Defaults to production-like round sizes (4 x 64K cells = one pipelined
+CompactionTask round). CTPU_PROF_CELLS overrides per-run cells — note
+XLA's sort COMPILE time grows with N (~1 min at 1M cells cold), so big
+sizes are slow on the first run; warm dispatch is what this measures."""
 import os
 import sys
 import time
@@ -15,7 +20,7 @@ from cassandra_tpu.schema import make_table, TableParams
 from cassandra_tpu.ops.codec import CompressionParams
 
 N_RUNS = 4
-CELLS = 262_144
+CELLS = int(os.environ.get("CTPU_PROF_CELLS", 65_536))
 VB = 64
 NPART = 4096
 
@@ -35,47 +40,35 @@ for run in range(N_RUNS):
 
 
 def one(tag):
+    """Profile the ACTIVE device path (v3 fast planes when the round
+    qualifies, else v2) through the shipped submit/collect API."""
     t = {}
+    prof = {}
     t0 = time.perf_counter()
     cat = cb.CellBatch.concat(batches)
     n = len(cat)
     t["concat"] = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    planes, cfg = dmerge._plane_pack_v2(cat, batches)
-    t["pack"] = time.perf_counter() - t0
-    push_bytes = sum(v.nbytes for v in planes.values() if hasattr(v, "nbytes"))
+    fast = dmerge._plane_pack_fast(cat, batches)
+    if fast is not None:
+        push_bytes = fast[0].nbytes
+    else:
+        planes, _cfg = dmerge._plane_pack_v2(cat, batches)
+        push_bytes = sum(v.nbytes for v in planes.values()
+                         if hasattr(v, "nbytes"))
 
     t0 = time.perf_counter()
-    planes_d = {k: jax.device_put(v) for k, v in planes.items()}
-    jax.block_until_ready(list(planes_d.values()))
-    t["push"] = time.perf_counter() - t0
-
+    h = dmerge.submit_merge(batches, prof=prof)
+    t["submit"] = time.perf_counter() - t0
     t0 = time.perf_counter()
-    out = dmerge._plane_program(planes_d, cfg)
-    out.block_until_ready()
-    t["program"] = time.perf_counter() - t0
+    merged = dmerge.collect_merge(h)
+    t["collect"] = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    combined = np.asarray(out)
-    t["pull"] = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    perm = (combined & 0x00FFFFFF).astype(np.int64)[:n]
-    bits = (combined >> 24).astype(np.uint8)[:n]
-    keep, ambiguous, _, shadowed = dmerge.unpack_masks(bits)
-    flags_s = cat.flags[perm]
-    ldt_s = cat.ldt[perm]
-    ts_s = cat.ts[perm]
-    expired = ((flags_s & cb.FLAG_EXPIRING) != 0) & (ldt_s <= 0)
-    t["post"] = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    merged = dmerge.finalize_merged(cat, perm, keep, expired, shadowed)
-    t["finalize"] = time.perf_counter() - t0
-
-    print(tag, f"n={n} push_bytes={push_bytes} ({push_bytes/n:.1f} B/cell)",
-          {k: round(v, 3) for k, v in t.items()}, f"kept={len(merged)}")
+    print(tag, f"mode={h.mode} n={n} push_bytes={push_bytes} "
+          f"({push_bytes/n:.1f} B/cell)",
+          {k: round(v, 3) for k, v in t.items()},
+          {k: round(v, 3) for k, v in prof.items()},
+          f"kept={len(merged)}")
 
 
 one("cold")
