@@ -65,7 +65,24 @@ class StorageProxy:
     def __init__(self, node):
         self.node = node
         self.messaging: MessagingService = node.messaging
-        self.timeout = 5.0
+        # per-operation timeouts from the typed config
+        # (read/write/range_request_timeout, cassandra.yaml; mutable at
+        # runtime — DatabaseDescriptor.setReadRpcTimeout etc.)
+        self.read_timeout = 5.0
+        self.write_timeout = 2.0
+        self.range_timeout = 10.0
+        self._settings_subs = []
+        settings = getattr(node.engine, "settings", None)
+        if settings is not None:
+            for cfg_name, attr in (("read_request_timeout", "read_timeout"),
+                                   ("write_request_timeout",
+                                    "write_timeout"),
+                                   ("range_request_timeout",
+                                    "range_timeout")):
+                setattr(self, attr, settings.get(cfg_name))
+                cb_ = (lambda a: lambda v: setattr(self, a, v))(attr)
+                settings.on_change(cfg_name, cb_)
+                self._settings_subs.append((cfg_name, cb_))
         # speculative retry: if the read round is still short of blockFor
         # after this delay, a redundant request goes to the next replica
         # (service/reads/AbstractReadExecutor speculate; the reference
@@ -75,6 +92,19 @@ class StorageProxy:
         # role): data-replica selection prefers the fastest
         self._latency: dict[Endpoint, float] = {}
         self._lat_lock = threading.Lock()
+
+    @property
+    def timeout(self) -> float:
+        """Back-compat alias: the general request timeout. Reading gives
+        the read timeout; assigning sets all three operation classes
+        (tests and control paths that want one blanket budget)."""
+        return self.read_timeout
+
+    @timeout.setter
+    def timeout(self, v: float) -> None:
+        self.read_timeout = v
+        self.write_timeout = v
+        self.range_timeout = v
 
     def _record_latency(self, ep: Endpoint, seconds: float) -> None:
         with self._lat_lock:
@@ -167,7 +197,7 @@ class StorageProxy:
                     else (lambda m: None),
                     on_failure=lambda mid, t=target: self._write_timeout(
                         handler, t, mutation),
-                    timeout=self.timeout)
+                    timeout=self.write_timeout)
         # pending (joining) replicas get every write too; a failed send
         # leaves a hint so the join still converges
         for target in self._pending_targets(strat, token, replicas):
@@ -184,8 +214,8 @@ class StorageProxy:
                     on_response=lambda m: None,
                     on_failure=lambda mid, t=target:
                         self.node.hints.store(t, mutation),
-                    timeout=self.timeout)
-        if not handler.await_(self.timeout):
+                    timeout=self.write_timeout)
+        if not handler.await_(self.write_timeout):
             raise TimeoutException(
                 f"{len(handler.responses)}/{block_for} acks for {cl}")
 
@@ -288,25 +318,25 @@ class StorageProxy:
                 def on_fail(mid, t=target):
                     # timeouts/failures must poison the snitch ranking —
                     # otherwise a blackholed replica keeps looking fast
-                    self._record_latency(t, self.timeout)
+                    self._record_latency(t, self.read_timeout)
                     handler.fail()
                 self.messaging.send_with_callback(
                     Verb.READ_REQ,
                     (keyspace, table_name, pk, digest_only), target,
                     on_response=on_rsp, on_failure=on_fail,
-                    timeout=self.timeout)
+                    timeout=self.read_timeout)
 
         for target in data_targets + digest_targets:
             send_to(target, target in digest_targets)
-        done = handler.await_(min(self.speculative_delay, self.timeout))
+        done = handler.await_(min(self.speculative_delay, self.read_timeout))
         if not done and spares:
             from ..service.metrics import GLOBAL
             GLOBAL.incr("reads.speculative_retries")
             # a redundant data read: its full payload can substitute for
             # a straggling digest (ack tallies are read-resolver inputs)
             send_to(spares[0], False)
-        # the read budget is self.timeout TOTAL, not per wait
-        handler.await_(max(self.timeout - (_time.monotonic() - t0), 0.0))
+        # the read budget is self.read_timeout TOTAL, not per wait
+        handler.await_(max(self.read_timeout - (_time.monotonic() - t0), 0.0))
         with lock:
             return list(results), list(digests)
 
@@ -398,8 +428,8 @@ class StorageProxy:
                     (keyspace, table_name, col, op, value), target,
                     on_response=on_rsp,
                     on_failure=lambda mid: handler.fail(),
-                    timeout=self.timeout)
-        if not handler.await_(self.timeout):
+                    timeout=self.read_timeout)
+        if not handler.await_(self.read_timeout):
             raise TimeoutException(
                 f"index candidates: {len(handler.responses)}/"
                 f"{len(targets)} responses")
@@ -480,8 +510,8 @@ class StorageProxy:
                         (keyspace, table_name, s_lo, s_hi), target,
                         on_response=on_rsp,
                         on_failure=lambda mid: handler.fail(),
-                        timeout=self.timeout)
-            if not handler.await_(self.timeout):
+                        timeout=self.range_timeout)
+            if not handler.await_(self.range_timeout):
                 raise TimeoutException(
                     f"range ({s_lo}, {s_hi}]: "
                     f"{len(handler.responses)}/{len(targets)} responses")
@@ -530,8 +560,8 @@ class StorageProxy:
                     Verb.RANGE_REQ, (keyspace, table_name), target,
                     on_response=on_rsp,
                     on_failure=lambda mid: handler.fail(),
-                    timeout=self.timeout)
-        if not handler.await_(self.timeout):
+                    timeout=self.range_timeout)
+        if not handler.await_(self.range_timeout):
             raise TimeoutException(
                 f"range read: {len(handler.responses)}/{len(peers)} "
                 "responses")

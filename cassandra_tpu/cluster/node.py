@@ -511,6 +511,8 @@ class Node:
         self.counters.close()
         self.gossiper.stop()
         self.messaging.close()
+        for cfg_name, cb_ in getattr(self.proxy, "_settings_subs", []):
+            self.engine.settings.remove_listener(cfg_name, cb_)
         self.engine.close()
 
 

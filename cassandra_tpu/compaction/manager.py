@@ -24,10 +24,20 @@ class RateLimiter:
         self._last = time.monotonic()
         self._lock = threading.Lock()
 
+    def set_rate(self, mib_per_s: float) -> None:
+        """Hot-reload (nodetool setcompactionthroughput /
+        DatabaseDescriptor.setCompactionThroughputMebibytesPerSec)."""
+        with self._lock:
+            self.rate = mib_per_s * 2**20
+            self._allowance = min(self._allowance, self.rate)
+            self._last = time.monotonic()
+
     def acquire(self, nbytes: int) -> None:
         if self.rate <= 0:
             return
         with self._lock:
+            if self.rate <= 0:   # re-check: set_rate(0) may have raced
+                return
             now = time.monotonic()
             self._allowance = min(
                 self.rate, self._allowance + (now - self._last) * self.rate)
@@ -54,6 +64,9 @@ class CompactionManager:
             self._worker = threading.Thread(target=self._run_loop,
                                             daemon=True)
             self._worker.start()
+
+    def set_throughput(self, mib_per_s: float) -> None:
+        self.limiter.set_rate(mib_per_s)
 
     # ----------------------------------------------------------- register --
 

@@ -1,0 +1,304 @@
+"""Typed node configuration — the DatabaseDescriptor role.
+
+Reference counterparts: config/Config.java (typed field catalog),
+config/DatabaseDescriptor.java (validated access + mutable runtime
+settings), config/DurationSpec.java / DataStorageSpec.java /
+DataRateSpec.java (unit-string parsing: "10s", "16KiB", "64MiB/s").
+
+Design: one frozen-shape dataclass of typed fields with reference
+defaults; loading validates types, parses unit specs, and REJECTS unknown
+keys (the reference fails startup on unrecognised yaml keys too). A
+subset of fields is runtime-mutable (DatabaseDescriptor setters exposed
+through nodetool/JMX in the reference; here through Settings.set, the
+settings virtual table and nodetool) with change listeners so subsystems
+(compaction throttle, guardrails, hint windows) react without restart.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+class ConfigError(Exception):
+    pass
+
+
+# ------------------------------------------------------------ unit specs --
+
+_DUR_UNITS = {"ns": 1e-9, "us": 1e-6, "ms": 1e-3, "s": 1.0,
+              "m": 60.0, "h": 3600.0, "d": 86400.0}
+_SIZE_UNITS = {"B": 1, "KiB": 1024, "MiB": 1024 ** 2, "GiB": 1024 ** 3}
+
+
+def parse_duration(v, default_unit: str = "ms") -> float:
+    """DurationSpec: '10s' / '200ms' / '1h' / bare number (default_unit).
+    Returns seconds."""
+    if isinstance(v, bool):
+        raise ConfigError(f"invalid duration spec: {v!r}")
+    if isinstance(v, (int, float)):
+        return float(v) * _DUR_UNITS[default_unit]
+    m = re.fullmatch(r"\s*(\d+(?:\.\d+)?)\s*(ns|us|ms|s|m|h|d)\s*", str(v))
+    if not m:
+        raise ConfigError(f"invalid duration spec: {v!r}")
+    return float(m.group(1)) * _DUR_UNITS[m.group(2)]
+
+
+def parse_storage(v, default_unit: str = "B") -> int:
+    """DataStorageSpec: '16KiB' / '32MiB' / bare number. Returns bytes."""
+    if isinstance(v, bool):
+        raise ConfigError(f"invalid storage spec: {v!r}")
+    if isinstance(v, (int, float)):
+        return int(v) * _SIZE_UNITS[default_unit]
+    m = re.fullmatch(r"\s*(\d+)\s*(B|KiB|MiB|GiB)\s*", str(v))
+    if not m:
+        raise ConfigError(f"invalid storage spec: {v!r}")
+    return int(m.group(1)) * _SIZE_UNITS[m.group(2)]
+
+
+def parse_rate(v) -> float:
+    """DataRateSpec: '64MiB/s' / bare number (MiB/s). Returns MiB/s."""
+    if isinstance(v, bool):
+        raise ConfigError(f"invalid rate spec: {v!r}")
+    if isinstance(v, (int, float)):
+        return float(v)
+    m = re.fullmatch(r"\s*(\d+(?:\.\d+)?)\s*(B|KiB|MiB|GiB)/s\s*", str(v))
+    if not m:
+        raise ConfigError(f"invalid rate spec: {v!r}")
+    return float(m.group(1)) * _SIZE_UNITS[m.group(2)] / _SIZE_UNITS["MiB"]
+
+
+# A field whose yaml value is a unit spec string. kind: duration|storage|rate
+def spec(kind: str, default, mutable: bool = False):
+    return field(default=default,
+                 metadata={"spec": kind, "mutable": mutable})
+
+
+def mut(default):
+    return field(default=default, metadata={"mutable": True})
+
+
+@dataclass
+class Config:
+    """Typed catalog of node settings. Field names follow
+    conf/cassandra.yaml; durations are SECONDS, sizes BYTES, rates MiB/s
+    after parsing. Fields marked mutable may change at runtime."""
+
+    # identity / topology (cassandra.yaml:10-25)
+    cluster_name: str = "Test Cluster"
+    num_tokens: int = 16
+    partitioner: str = "Murmur3Partitioner"
+    endpoint_snitch: str = "SimpleSnitch"
+    dc: str = "dc1"
+    rack: str = "rack1"
+
+    # storage locations (cassandra.yaml:73-120)
+    data_file_directories: list = field(default_factory=list)
+    commitlog_directory: str = ""
+    saved_caches_directory: str = ""
+    hints_directory: str = ""
+
+    # commitlog (cassandra.yaml:419-480)
+    commitlog_sync: str = "periodic"            # periodic | batch
+    commitlog_sync_period: float = spec("duration", 10.0)
+    commitlog_segment_size: int = spec("storage", 32 * 1024 * 1024)
+    commitlog_compression: str = ""             # codec name or ""
+    cdc_enabled: bool = False
+
+    # memtable / flush (cassandra.yaml:903-916)
+    memtable_flush_writers: int = 2
+    memtable_cleanup_threshold: float = 0.25
+    memtable_heap_space: int = spec("storage", 256 * 1024 * 1024)
+
+    # compaction (cassandra.yaml:1217-1250)
+    concurrent_compactors: int = mut(1)
+    compaction_throughput: float = spec("rate", 64.0, mutable=True)
+    sstable_preemptive_open_interval: int = spec("storage",
+                                                 50 * 1024 * 1024)
+
+    # streaming / hints (cassandra.yaml / hints section)
+    stream_throughput_outbound: float = spec("rate", 24.0, mutable=True)
+    inter_dc_stream_throughput_outbound: float = spec("rate", 24.0,
+                                                      mutable=True)
+    hinted_handoff_enabled: bool = mut(True)
+    max_hint_window: float = spec("duration", 3 * 3600.0, mutable=True)
+    hints_flush_period: float = spec("duration", 10.0)
+
+    # request timeouts (cassandra.yaml:1320-1360), mutable like
+    # DatabaseDescriptor.setReadRpcTimeout etc.
+    read_request_timeout: float = spec("duration", 5.0, mutable=True)
+    range_request_timeout: float = spec("duration", 10.0, mutable=True)
+    write_request_timeout: float = spec("duration", 2.0, mutable=True)
+    counter_write_request_timeout: float = spec("duration", 5.0,
+                                                mutable=True)
+    cas_contention_timeout: float = spec("duration", 1.0, mutable=True)
+    truncate_request_timeout: float = spec("duration", 60.0, mutable=True)
+    request_timeout: float = spec("duration", 10.0, mutable=True)
+
+    # failure detection / gossip
+    phi_convict_threshold: float = mut(8.0)
+    gossip_interval: float = spec("duration", 1.0)
+
+    # native transport
+    native_transport_port: int = 9042
+    native_transport_max_frame_size: int = spec("storage",
+                                                16 * 1024 * 1024)
+    native_transport_max_concurrent_connections: int = mut(-1)
+
+    # internode
+    storage_port: int = 7000
+    internode_compression: str = "none"         # none | all | dc
+
+    # caches (cassandra.yaml key/row/counter cache section)
+    key_cache_size: int = spec("storage", 50 * 1024 * 1024, mutable=True)
+    row_cache_size: int = spec("storage", 0, mutable=True)
+    counter_cache_size: int = spec("storage", 25 * 1024 * 1024,
+                                   mutable=True)
+    cache_save_period: float = spec("duration", 14400.0, mutable=True)
+
+    # security
+    authenticator: str = "AllowAllAuthenticator"
+    authorizer: str = "AllowAllAuthorizer"
+    network_authorizer: str = "AllowAllNetworkAuthorizer"
+    cidr_authorizer: str = "AllowAllCIDRAuthorizer"
+    auth_cache_validity: float = spec("duration", 2.0, mutable=True)
+
+    # misc operations
+    incremental_backups: bool = mut(False)
+    auto_snapshot: bool = True
+    snapshot_before_compaction: bool = False
+    batch_size_warn_threshold: int = spec("storage", 5 * 1024,
+                                          mutable=True)
+    batch_size_fail_threshold: int = spec("storage", 50 * 1024,
+                                          mutable=True)
+    tombstone_warn_threshold: int = mut(1000)
+    tombstone_failure_threshold: int = mut(100_000)
+    column_index_size: int = spec("storage", 64 * 1024)
+    trace_probability: float = mut(0.0)
+    slow_query_log_timeout: float = spec("duration", 0.5, mutable=True)
+
+    # guardrail overrides (db/guardrails/GuardrailsOptions.java) — passed
+    # through to storage/guardrails.py field-for-field
+    guardrails: dict = field(default_factory=dict)
+
+    # free-form transparent data encryption block (storage/encryption.py)
+    transparent_data_encryption: dict = field(default_factory=dict)
+
+    # ------------------------------------------------------------- load --
+
+    @classmethod
+    def load(cls, raw: dict) -> "Config":
+        """Validate + coerce a raw dict (parsed yaml/json). Unknown keys
+        and mis-typed values raise ConfigError (startup must fail loudly,
+        DatabaseDescriptor.applyAll behavior)."""
+        fields = {f.name: f for f in dataclasses.fields(cls)}
+        out = {}
+        for k, v in raw.items():
+            f = fields.get(k)
+            if f is None:
+                raise ConfigError(f"unknown config key: {k!r}")
+            out[k] = cls._coerce(f, v)
+        return cls(**out)
+
+    @staticmethod
+    def _coerce(f: dataclasses.Field, v: Any):
+        kind = f.metadata.get("spec")
+        try:
+            if kind == "duration":
+                return parse_duration(v)
+            if kind == "storage":
+                return parse_storage(v)
+            if kind == "rate":
+                return parse_rate(v)
+            if f.type in ("int", int):
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    raise ConfigError(f"{f.name}: expected int, got {v!r}")
+                return int(v)
+            if f.type in ("float", float):
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    raise ConfigError(
+                        f"{f.name}: expected number, got {v!r}")
+                return float(v)
+            if f.type in ("bool", bool):
+                if not isinstance(v, bool):
+                    raise ConfigError(f"{f.name}: expected bool, got {v!r}")
+                return v
+            if f.type in ("str", str):
+                if not isinstance(v, str):
+                    raise ConfigError(f"{f.name}: expected str, got {v!r}")
+                return v
+            if f.type in ("list", list):
+                if not isinstance(v, list):
+                    raise ConfigError(f"{f.name}: expected list, got {v!r}")
+                return list(v)
+            if f.type in ("dict", dict):
+                if not isinstance(v, dict):
+                    raise ConfigError(f"{f.name}: expected dict, got {v!r}")
+                return dict(v)
+        except ConfigError:
+            raise
+        except Exception as e:
+            raise ConfigError(f"{f.name}: {e}") from e
+        return v
+
+    def mutable_fields(self) -> set:
+        return {f.name for f in dataclasses.fields(self)
+                if f.metadata.get("mutable")}
+
+
+class Settings:
+    """Runtime settings surface over a Config: typed get/set with change
+    listeners. The reference exposes these via JMX/nodetool (e.g.
+    `nodetool setcompactionthroughput`) and the system_views.settings
+    virtual table; both route through here."""
+
+    def __init__(self, config: Config | None = None):
+        self.config = config or Config()
+        self._mutable = self.config.mutable_fields()
+        self._fields = {f.name: f for f in dataclasses.fields(Config)}
+        self._listeners: dict[str, list[Callable]] = {}
+        self._lock = threading.Lock()
+
+    def get(self, name: str):
+        if name not in self._fields:
+            raise ConfigError(f"unknown setting: {name!r}")
+        return getattr(self.config, name)
+
+    def set(self, name: str, value) -> None:
+        """Hot-set a mutable setting (validated/coerced like load)."""
+        f = self._fields.get(name)
+        if f is None:
+            raise ConfigError(f"unknown setting: {name!r}")
+        if name not in self._mutable:
+            raise ConfigError(f"setting {name!r} is not mutable at runtime")
+        coerced = Config._coerce(f, value)
+        with self._lock:
+            setattr(self.config, name, coerced)
+            listeners = list(self._listeners.get(name, []))
+        for cb in listeners:
+            cb(coerced)
+
+    def on_change(self, name: str, cb: Callable) -> None:
+        if name not in self._fields:
+            raise ConfigError(f"unknown setting: {name!r}")
+        with self._lock:
+            self._listeners.setdefault(name, []).append(cb)
+
+    def remove_listener(self, name: str, cb: Callable) -> None:
+        """Unregister (engine/proxy close paths — a Settings may outlive
+        one engine instance across in-process restarts)."""
+        with self._lock:
+            subs = self._listeners.get(name, [])
+            if cb in subs:
+                subs.remove(cb)
+
+    def all(self) -> list[tuple[str, str, bool]]:
+        """(name, rendered value, mutable) rows — the settings vtable."""
+        rows = []
+        for name in sorted(self._fields):
+            v = getattr(self.config, name)
+            rows.append((name, repr(v) if isinstance(v, (dict, list))
+                         else str(v), name in self._mutable))
+        return rows

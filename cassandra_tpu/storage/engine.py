@@ -25,11 +25,16 @@ class StorageEngine:
                  audit_log_path: str | None = None,
                  keystore_dir: str | None = None,
                  commitlog_archive_dir: str | None = None,
-                 encrypt_commitlog: bool = False):
+                 encrypt_commitlog: bool = False,
+                 settings=None):
         """keystore_dir enables TDE: an EncryptionContext is installed
         node-wide (tables opt in via WITH encryption = {'enabled': true};
         encrypt_commitlog covers the WAL). commitlog_archive_dir turns on
-        the segment archiver for point-in-time restore."""
+        the segment archiver for point-in-time restore. settings: a
+        config.Settings (DatabaseDescriptor role); defaults apply when
+        omitted."""
+        from ..config import Settings
+        self.settings = settings or Settings()
         self.data_dir = data_dir
         self.schema = schema or Schema()
         self.durable = durable_writes
@@ -67,7 +72,17 @@ class StorageEngine:
         # the store; daemons turn the worker on via enable_auto(), tests
         # drain explicitly with run_pending()
         from ..compaction.manager import CompactionManager
-        self.compactions = CompactionManager(auto=False)
+        # NOTE the default is the REFERENCE default (64 MiB/s,
+        # cassandra.yaml:1243) — out-of-the-box nodes are throttled like
+        # the reference; bench.py drives CompactionTask directly and is
+        # unaffected. `compaction_throughput: 0` disables.
+        self.compactions = CompactionManager(
+            throughput_mib_s=self.settings.get("compaction_throughput"),
+            auto=False)
+        # hot-reload: `nodetool setcompactionthroughput` / settings table
+        self._throttle_listener = self.compactions.set_throughput
+        self.settings.on_change("compaction_throughput",
+                                self._throttle_listener)
         self._load_schema()
         self._schema_listener = lambda s: self._save_schema()
         self.schema.listeners.append(self._schema_listener)
@@ -92,7 +107,8 @@ class StorageEngine:
         from ..service.auth import AuthService
         self.auth = AuthService(data_dir, enabled=auth_enabled)
         from .guardrails import Guardrails
-        self.guardrails = Guardrails()
+        self.guardrails = Guardrails.from_config(
+            self.settings.config.guardrails)
         from ..service.monitoring import QueryMonitor
         self.monitor = QueryMonitor()
 
@@ -262,6 +278,8 @@ class StorageEngine:
             self.schema.listeners.remove(self._schema_listener)
         except ValueError:
             pass
+        self.settings.remove_listener("compaction_throughput",
+                                      self._throttle_listener)
         self.compactions.close()
         if self.commitlog:
             self.commitlog.close()

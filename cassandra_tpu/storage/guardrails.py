@@ -25,6 +25,28 @@ class Guardrails:
     in_select_cartesian_fail: int = 100
     warnings: list = field(default_factory=list)
 
+    @classmethod
+    def from_config(cls, overrides: dict | None) -> "Guardrails":
+        """Build from the config `guardrails:` block; unknown keys AND
+        mis-typed values fail startup (GuardrailsOptions validation)."""
+        import dataclasses as _dc
+
+        from ..config import ConfigError
+        overrides = overrides or {}
+        fields = {f.name: f for f in _dc.fields(cls) if f.name != "warnings"}
+        bad = set(overrides) - set(fields)
+        if bad:
+            raise ConfigError(f"unknown guardrail keys: {sorted(bad)}")
+        coerced = {}
+        for k, v in overrides.items():
+            want = fields[k].type
+            if want in ("int", int):
+                if isinstance(v, bool) or not isinstance(v, int):
+                    raise ConfigError(f"guardrail {k}: expected int, "
+                                      f"got {v!r}")
+            coerced[k] = v
+        return cls(**coerced)
+
     def _warn(self, msg: str) -> None:
         self.warnings.append(msg)
         if len(self.warnings) > 100:

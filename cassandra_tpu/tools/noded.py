@@ -101,8 +101,12 @@ def build_node(cfg: dict):
 
 def _engine_opts(cfg: dict) -> dict:
     """TDE + commitlog archiver knobs (cassandra.yaml
-    transparent_data_encryption_options / commitlog_archiving role)."""
-    out = {}
+    transparent_data_encryption_options / commitlog_archiving role), plus
+    the typed `config:` block (config.Config — the cassandra.yaml
+    equivalent, validated with unit-spec parsing; unknown keys fail
+    startup). Runtime-mutable settings flow through engine.settings."""
+    from ..config import Config, Settings
+    out = {"settings": Settings(Config.load(cfg.get("config", {})))}
     if cfg.get("keystore_dir"):
         out["keystore_dir"] = cfg["keystore_dir"]
     if cfg.get("commitlog_archive_dir"):

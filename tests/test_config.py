@@ -1,0 +1,159 @@
+"""Typed config system (config.py — the DatabaseDescriptor role):
+unit-spec parsing, validated loading, runtime-mutable settings with
+listeners, and wiring into the engine's compaction throttle/guardrails."""
+import pytest
+
+from cassandra_tpu.config import (Config, ConfigError, Settings,
+                                  parse_duration, parse_rate, parse_storage)
+
+
+def test_duration_spec():
+    assert parse_duration("10s") == 10.0
+    assert parse_duration("200ms") == 0.2
+    assert parse_duration("2h") == 7200.0
+    assert parse_duration("3d") == 3 * 86400.0
+    assert parse_duration(500) == 0.5          # bare number: default ms
+    with pytest.raises(ConfigError):
+        parse_duration("10 parsecs")
+
+
+def test_storage_spec():
+    assert parse_storage("16KiB") == 16 * 1024
+    assert parse_storage("32MiB") == 32 * 1024 ** 2
+    assert parse_storage("1GiB") == 1024 ** 3
+    assert parse_storage(512) == 512
+    with pytest.raises(ConfigError):
+        parse_storage("16KB")   # reference rejects non-binary units too
+
+
+def test_rate_spec():
+    assert parse_rate("64MiB/s") == 64.0
+    assert parse_rate("512KiB/s") == 0.5
+    assert parse_rate(24) == 24.0
+    with pytest.raises(ConfigError):
+        parse_rate("64MiB")
+
+
+def test_load_defaults_match_reference():
+    c = Config()
+    assert c.compaction_throughput == 64.0          # cassandra.yaml:1243
+    assert c.commitlog_sync == "periodic"
+    assert c.num_tokens == 16
+    assert c.stream_throughput_outbound == 24.0
+    assert c.read_request_timeout == 5.0
+    assert c.write_request_timeout == 2.0
+
+
+def test_load_parses_and_validates():
+    c = Config.load({"compaction_throughput": "128MiB/s",
+                     "commitlog_sync_period": "5s",
+                     "commitlog_segment_size": "16MiB",
+                     "phi_convict_threshold": 10,
+                     "hinted_handoff_enabled": False})
+    assert c.compaction_throughput == 128.0
+    assert c.commitlog_sync_period == 5.0
+    assert c.commitlog_segment_size == 16 * 1024 ** 2
+    assert c.phi_convict_threshold == 10.0
+    assert c.hinted_handoff_enabled is False
+
+
+def test_load_rejects_unknown_and_mistyped():
+    with pytest.raises(ConfigError, match="unknown config key"):
+        Config.load({"compaction_thruput": "64MiB/s"})
+    with pytest.raises(ConfigError):
+        Config.load({"num_tokens": "sixteen"})
+    with pytest.raises(ConfigError):
+        Config.load({"cluster_name": 7})
+    with pytest.raises(ConfigError):
+        Config.load({"hinted_handoff_enabled": "yes"})
+
+
+def test_settings_mutability_and_listeners():
+    s = Settings()
+    seen = []
+    s.on_change("compaction_throughput", seen.append)
+    s.set("compaction_throughput", "16MiB/s")
+    assert s.get("compaction_throughput") == 16.0
+    assert seen == [16.0]
+    with pytest.raises(ConfigError, match="not mutable"):
+        s.set("cluster_name", "nope")
+    with pytest.raises(ConfigError, match="unknown setting"):
+        s.set("no_such", 1)
+    rows = dict((n, (v, m)) for n, v, m in s.all())
+    assert rows["compaction_throughput"] == ("16.0", True)
+    assert rows["cluster_name"][1] is False
+
+
+def test_engine_wiring(tmp_path):
+    from cassandra_tpu.storage.engine import StorageEngine
+
+    s = Settings(Config.load({"compaction_throughput": "32MiB/s",
+                              "guardrails": {"tables_fail_threshold": 7}}))
+    eng = StorageEngine(str(tmp_path), durable_writes=False, settings=s)
+    assert eng.compactions.limiter.rate == 32 * 2 ** 20
+    assert eng.guardrails.tables_fail_threshold == 7
+    # hot reload reaches the running limiter
+    s.set("compaction_throughput", "8MiB/s")
+    assert eng.compactions.limiter.rate == 8 * 2 ** 20
+    # 0 = unthrottled
+    s.set("compaction_throughput", 0)
+    assert eng.compactions.limiter.rate == 0
+
+
+def test_guardrails_from_config_rejects_unknown(tmp_path):
+    from cassandra_tpu.storage.engine import StorageEngine
+
+    s = Settings(Config.load({"guardrails": {"tables_warn_treshold": 1}}))
+    with pytest.raises(ConfigError, match="unknown guardrail"):
+        StorageEngine(str(tmp_path), durable_writes=False, settings=s)
+
+
+def test_guardrails_value_types_fail_startup():
+    from cassandra_tpu.storage.guardrails import Guardrails
+
+    with pytest.raises(ConfigError, match="expected int"):
+        Guardrails.from_config({"tombstones_warn_per_read": "1000"})
+    with pytest.raises(ConfigError, match="expected int"):
+        Guardrails.from_config({"tables_fail_threshold": True})
+
+
+def test_bool_rejected_by_specs():
+    with pytest.raises(ConfigError):
+        parse_duration(True)
+    with pytest.raises(ConfigError):
+        parse_storage(True)
+    with pytest.raises(ConfigError):
+        parse_rate(False)
+    with pytest.raises(ConfigError):
+        Config.load({"read_request_timeout": True})
+
+
+def test_listener_removal():
+    s = Settings()
+    seen = []
+    s.on_change("compaction_throughput", seen.append)
+    s.remove_listener("compaction_throughput", seen.append)
+    s.set("compaction_throughput", 1)
+    assert seen == []
+
+
+def test_per_operation_timeouts_wired(tmp_path):
+    """Coordinator takes read/write/range timeouts from config and tracks
+    hot updates; the blanket `timeout` alias sets all three."""
+    from cassandra_tpu.cluster.node import LocalCluster
+
+    c = LocalCluster(1, str(tmp_path), rf=1)
+    try:
+        node = c.nodes[0]
+        s = node.engine.settings
+        s.set("read_request_timeout", "700ms")
+        s.set("write_request_timeout", "300ms")
+        s.set("range_request_timeout", "9s")
+        assert node.proxy.read_timeout == pytest.approx(0.7)
+        assert node.proxy.write_timeout == pytest.approx(0.3)
+        assert node.proxy.range_timeout == pytest.approx(9.0)
+        node.proxy.timeout = 1.5
+        assert (node.proxy.read_timeout, node.proxy.write_timeout,
+                node.proxy.range_timeout) == (1.5, 1.5, 1.5)
+    finally:
+        c.shutdown()
