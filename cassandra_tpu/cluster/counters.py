@@ -75,6 +75,12 @@ class CounterService:
             for k in [k for k in self._cache if k[0] == table_id]:
                 del self._cache[k]
 
+    def invalidate_cache(self) -> None:
+        """nodetool invalidatecountercache: drop every cached shard."""
+        with self._cache_lock:
+            self._cache_epoch += 1
+            self._cache.clear()
+
     # ------------------------------------------------------------ leader --
 
     def apply_as_leader(self, keyspace: str, mutation: Mutation,

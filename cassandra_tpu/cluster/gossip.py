@@ -174,6 +174,10 @@ class Gossiper:
             st = self.states.get(ep)
             return bool(st and st.alive)
 
+    def is_running(self) -> bool:
+        return not self._stop.is_set() and self._thread is not None \
+            and self._thread.is_alive()
+
     def force_convict(self, ep: Endpoint, generation: int | None = None,
                       version: int | None = None) -> None:
         """Operator-asserted death (nodetool assassinate / the replace

@@ -22,11 +22,22 @@ class HintsService:
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.Lock()
         self.metrics = {"written": 0, "replayed": 0}
+        # nodetool disablehandoff: new hints are dropped (the reference's
+        # StorageProxy.shouldHint gate)
+        self.enabled = True
 
     def _path(self, target: Endpoint) -> str:
         return os.path.join(self.directory, f"hints-{target.name}.db")
 
-    def store(self, target: Endpoint, mutation: Mutation) -> None:
+    def store(self, target: Endpoint, mutation: Mutation,
+              redelivery: bool = False) -> None:
+        """redelivery=True marks a hint being RE-stored after a failed
+        dispatch send — those bypass the disablehandoff gate (the gate
+        stops NEW hints only; already-persisted hints must never be
+        silently dropped mid-replay; `nodetool truncatehints` is the
+        explicit delete)."""
+        if not self.enabled and not redelivery:
+            return
         payload = mutation.serialize()
         frame = struct.pack("<II", len(payload), zlib.crc32(payload)) + payload
         with self._lock:

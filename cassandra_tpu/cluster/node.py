@@ -196,7 +196,8 @@ class Node:
             self.messaging.send_with_callback(
                 Verb.HINT_REQ, m.serialize(), ep,
                 on_response=lambda rsp: None,
-                on_failure=lambda mid, mm=m: self.hints.store(ep, mm),
+                on_failure=lambda mid, mm=m: self.hints.store(
+                    ep, mm, redelivery=True),
                 timeout=self.proxy.timeout)
 
         self.hints.dispatch(ep, send)

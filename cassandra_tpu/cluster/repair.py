@@ -75,6 +75,11 @@ class RepairService:
 
     def __init__(self, node):
         self.node = node
+        # completed session records (system_views.repairs / nodetool
+        # repair history; repair/RepairRunnable session state role) —
+        # bounded: old sessions age out at constant memory
+        from collections import deque
+        self.history: "deque[dict]" = deque(maxlen=256)
         node.messaging.register_handler(Verb.REPAIR_VALIDATION_REQ,
                                         self._handle_validation)
         node.messaging.register_handler(Verb.REPAIR_SYNC_REQ,
@@ -312,6 +317,9 @@ class RepairService:
                     f"anticompaction acks {len(done)}/{len(live)}")
             stats["anticompacted"] = sum(done.values())
             stats["repaired_at"] = repaired_at
+        self.history.append({"keyspace": keyspace, "table": table_name,
+                             "incremental": incremental,
+                             "replicas": len(live), **stats})
         return stats
 
     def _fetch_range(self, ep, keyspace, table_name, lo, hi, timeout):

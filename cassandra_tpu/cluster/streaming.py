@@ -41,6 +41,11 @@ def _filter_token_range(batch, lo: int, hi: int):
 class StreamService:
     def __init__(self, node):
         self.node = node
+        # completed/failed session records (system_views.streaming /
+        # nodetool netstats; streaming/StreamManager.java state role) —
+        # bounded: old sessions age out at constant memory
+        from collections import deque
+        self.sessions: "deque[dict]" = deque(maxlen=256)
         node.messaging.register_handler(Verb.STREAM_REQ,
                                         self._handle_req)
 
@@ -111,11 +116,22 @@ class StreamService:
             Verb.STREAM_REQ, (keyspace, table_name, lo, hi), owner,
             on_response=on_rsp, timeout=timeout)
         if not ev.wait(timeout):
+            self.sessions.append(
+                {"peer": owner.name, "direction": "in",
+                 "keyspace": keyspace, "table": table_name,
+                 "status": "failed", "files": 0, "bytes": 0})
             raise TimeoutError(
                 f"stream of {keyspace}.{table_name} ({lo}, {hi}] from "
                 f"{owner.name} timed out")
         files, leftover_b = holder["p"]
-        return files, cb_deserialize(leftover_b)
+        leftover = cb_deserialize(leftover_b)
+        self.sessions.append(
+            {"peer": owner.name, "direction": "in",
+             "keyspace": keyspace, "table": table_name,
+             "status": "complete", "files": len(files),
+             "bytes": sum(len(d) for c in files for d in c.values())
+             + len(leftover_b), "leftover_cells": len(leftover)})
+        return files, leftover
 
     def land_sstable(self, cfs, comps: dict) -> int:
         """Write a shipped sstable's components under a fresh local

@@ -53,6 +53,9 @@ class CompactionManager:
     def __init__(self, throughput_mib_s: float = 0.0, auto: bool = False):
         self.limiter = RateLimiter(throughput_mib_s)
         self.auto = auto
+        # nodetool disableautocompaction: queued stores stay queued,
+        # nothing new runs until re-enabled
+        self.paused = False
         self._queue: queue.Queue = queue.Queue()
         self._pending_cfs: set = set()
         self._lock = threading.Lock()
@@ -149,6 +152,9 @@ class CompactionManager:
 
     def _run_loop(self) -> None:
         while not self._stop.is_set():
+            if self.paused:
+                self._stop.wait(0.2)
+                continue
             try:
                 cfs = self._queue.get(timeout=0.5)
             except queue.Empty:

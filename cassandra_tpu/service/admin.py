@@ -1,0 +1,99 @@
+"""Admin protocol: remote nodetool transport.
+
+Reference counterpart: the JMX endpoint (port 7199) that
+tools/nodetool/NodeProbe.java drives. Here: newline-delimited JSON over
+TCP — request {"cmd": name, "args": {...}}, response {"ok": true,
+"result": ...} | {"ok": false, "error": "..."}. Every command in
+tools/nodetool.py's COMMANDS registry is remotely invokable, so a real
+deployment is operated without shelling into the daemon process.
+
+SECURITY: the protocol itself carries no credentials (like default
+unauthenticated JMX). The listener therefore binds LOOPBACK ONLY unless
+the operator explicitly sets `admin_host` — reaching it from another
+machine means the operator has shell access to the box, which is the
+JMX-local trust model. Do not bind it wide without a network filter.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+
+
+class AdminServer:
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 0):
+        self.node = node
+        self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listen.bind((host, port))
+        self._listen.listen(16)
+        self.port = self._listen.getsockname()[1]
+        self._closed = False
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name=f"admin-{self.port}").start()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listen.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        import time
+        while not self._closed:
+            try:
+                sock, addr = self._listen.accept()
+            except OSError:
+                if self._closed:
+                    return
+                # transient (EMFILE under a connection burst): keep the
+                # admin endpoint alive, retry after a beat
+                time.sleep(0.1)
+                continue
+            threading.Thread(target=self._serve, args=(sock, addr),
+                             daemon=True).start()
+
+    def _serve(self, sock: socket.socket, addr) -> None:
+        from ..tools import nodetool
+        try:
+            f = sock.makefile("rwb")
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    req = json.loads(line)
+                    result = nodetool.run_command(
+                        req["cmd"], node=self.node,
+                        **(req.get("args") or {}))
+                    rsp = {"ok": True, "result": result}
+                except Exception as e:
+                    rsp = {"ok": False,
+                           "error": f"{type(e).__name__}: {e}"}
+                f.write(json.dumps(rsp, default=str).encode() + b"\n")
+                f.flush()
+        except OSError:
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+def admin_call(host: str, port: int, cmd: str, args: dict | None = None,
+               timeout: float = 30.0):
+    """One-shot client call (nodetool --host/--port mode)."""
+    with socket.create_connection((host, port), timeout=timeout) as sock:
+        f = sock.makefile("rwb")
+        f.write(json.dumps({"cmd": cmd, "args": args or {}}).encode()
+                + b"\n")
+        f.flush()
+        line = f.readline()
+        if not line:
+            raise ConnectionError("admin server closed the connection")
+        rsp = json.loads(line)
+    if not rsp.get("ok"):
+        raise RuntimeError(rsp.get("error", "admin call failed"))
+    return rsp.get("result")

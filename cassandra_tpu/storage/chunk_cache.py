@@ -79,5 +79,12 @@ class ChunkCache:
                     "capacity": self.capacity, "hits": self.hits,
                     "misses": self.misses}
 
+    def clear(self) -> None:
+        """nodetool invalidatechunkcache."""
+        with self._lock:
+            self._lru.clear()
+            self._sizes.clear()
+            self._bytes = 0
+
 
 GLOBAL = ChunkCache()

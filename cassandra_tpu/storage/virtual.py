@@ -110,6 +110,217 @@ def build_engine_virtuals(engine) -> VirtualSchema:
                    "duration_ms": e["duration_ms"], "at": e["at"]}
     vs.register(VirtualTable(t_slow, slow_rows))
 
+    # --- settings (db/virtual/SettingsTable.java): the typed config,
+    # live values, with mutability flag
+    t_settings = make_table("system_views", "settings", pk=["name"],
+                           cols={"name": "text", "value": "text",
+                                 "mutable": "boolean"})
+    vs.register(VirtualTable(t_settings, lambda: (
+        {"name": n, "value": v, "mutable": m}
+        for n, v, m in engine.settings.all())))
+
+    # --- caches (db/virtual/CachesTable.java): chunk + per-table row
+    def cache_rows():
+        from . import chunk_cache
+        s = chunk_cache.GLOBAL.stats()
+        yield {"name": "chunks", "entries": s.get("entries", 0),
+               "size_bytes": s.get("bytes", 0),
+               "capacity_bytes": s.get("capacity", 0),
+               "hits": s.get("hits", 0), "misses": s.get("misses", 0)}
+        row_hits = row_miss = rows_cached = 0
+        for cfs in engine.stores.values():
+            rc = cfs.row_cache
+            if rc is not None:
+                row_hits += rc.hits
+                row_miss += rc.misses
+                rows_cached += len(rc)
+        yield {"name": "rows", "entries": rows_cached, "size_bytes": 0,
+               "capacity_bytes": 0, "hits": row_hits, "misses": row_miss}
+
+    t_caches = make_table("system_views", "caches", pk=["name"],
+                          cols={"name": "text", "entries": "bigint",
+                                "size_bytes": "bigint",
+                                "capacity_bytes": "bigint",
+                                "hits": "bigint", "misses": "bigint"})
+    vs.register(VirtualTable(t_caches, cache_rows))
+
+    # --- disk_usage (db/virtual/DisksTable role, per-table granularity)
+    t_disk = make_table("system_views", "disk_usage", pk=["keyspace_name"],
+                        ck=["table_name"],
+                        cols={"keyspace_name": "text",
+                              "table_name": "text", "mebibytes": "double",
+                              "sstables": "int"})
+
+    def disk_rows():
+        for cfs in engine.stores.values():
+            live = cfs.live_sstables()
+            yield {"keyspace_name": cfs.table.keyspace,
+                   "table_name": cfs.table.name,
+                   "mebibytes": round(sum(s.size_bytes for s in live)
+                                      / 2**20, 3),
+                   "sstables": len(live)}
+    vs.register(VirtualTable(t_disk, disk_rows))
+
+    # --- memtables
+    t_mem = make_table("system_views", "memtables", pk=["keyspace_name"],
+                       ck=["table_name"],
+                       cols={"keyspace_name": "text", "table_name": "text",
+                             "cells": "bigint", "payload_bytes": "bigint"})
+
+    def mem_rows():
+        for cfs in engine.stores.values():
+            m = cfs.memtable
+            yield {"keyspace_name": cfs.table.keyspace,
+                   "table_name": cfs.table.name, "cells": len(m),
+                   "payload_bytes": getattr(m, "live_bytes", 0)}
+    vs.register(VirtualTable(t_mem, mem_rows))
+
+    # --- thread_pools (db/virtual/ThreadPoolsTable): the executors that
+    # exist in this runtime — compaction worker + per-writer syncers
+    t_tp = make_table("system_views", "thread_pools", pk=["name"],
+                      cols={"name": "text", "active": "int",
+                            "pending": "int", "completed": "bigint"})
+
+    def tp_rows():
+        from ..tools.nodetool import tpstats
+        for p in tpstats(engine):   # single source for nodetool + vtable
+            yield {"name": p["pool"], "active": p["active"],
+                   "pending": p["pending"], "completed": p["completed"]}
+    vs.register(VirtualTable(t_tp, tp_rows))
+
+    # --- indexes (SAI/SASI registry)
+    t_idx = make_table("system_views", "indexes", pk=["keyspace_name"],
+                       ck=["table_name", "index_name"],
+                       cols={"keyspace_name": "text", "table_name": "text",
+                             "index_name": "text", "column_name": "text",
+                             "kind": "text"})
+
+    def index_rows():
+        im = getattr(engine, "indexes", None)
+        if im is None:
+            return
+        for (ks, tbl, name), key in sorted(im.by_name.items()):
+            meta = im.meta.get(key, {})
+            yield {"keyspace_name": ks, "table_name": tbl,
+                   "index_name": name, "column_name": key[2],
+                   "kind": meta.get("custom_class") or "SAI"}
+    vs.register(VirtualTable(t_idx, index_rows))
+
+    # --- triggers
+    t_trig = make_table("system_views", "triggers", pk=["keyspace_name"],
+                        ck=["table_name", "trigger_name"],
+                        cols={"keyspace_name": "text",
+                              "table_name": "text", "trigger_name": "text",
+                              "source": "text"})
+
+    def trigger_rows():
+        tm = getattr(engine, "triggers", None)
+        if tm is None:
+            return
+        for (ks, tbl), by_name in sorted(tm.triggers.items()):
+            for name, source in sorted(by_name.items()):
+                yield {"keyspace_name": ks, "table_name": tbl,
+                       "trigger_name": name, "source": source[:200]}
+    vs.register(VirtualTable(t_trig, trigger_rows))
+
+    # --- snapshots (db/virtual/SnapshotsTable)
+    t_snap = make_table("system_views", "snapshots", pk=["tag"],
+                        ck=["keyspace_name", "table_name"],
+                        cols={"tag": "text", "keyspace_name": "text",
+                              "table_name": "text", "files": "int",
+                              "created_at": "text"})
+
+    def snap_rows():
+        from .snapshot import list_snapshots
+        for cfs in engine.stores.values():
+            for s in list_snapshots(cfs):
+                yield {"tag": s["tag"],
+                       "keyspace_name": cfs.table.keyspace,
+                       "table_name": cfs.table.name,
+                       "files": len(s.get("files", [])),
+                       "created_at": str(s.get("created_at", ""))}
+    vs.register(VirtualTable(t_snap, snap_rows))
+
+    # --- guardrail thresholds + recent warnings
+    t_guard = make_table("system_views", "guardrails", pk=["name"],
+                         cols={"name": "text", "value": "bigint"})
+
+    def guard_rows():
+        import dataclasses as _dc
+        g = engine.guardrails
+        for f in _dc.fields(g):
+            if f.name == "warnings":
+                continue
+            yield {"name": f.name, "value": int(getattr(g, f.name))}
+    vs.register(VirtualTable(t_guard, guard_rows))
+
+    t_gwarn = make_table("system_views", "guardrail_warnings", pk=["id"],
+                         cols={"id": "int", "message": "text"})
+    vs.register(VirtualTable(t_gwarn, lambda: (
+        {"id": i, "message": w}
+        for i, w in enumerate(engine.guardrails.warnings))))
+
+    # --- commitlog segments
+    t_cl = make_table("system_views", "commitlog", pk=["name"],
+                      cols={"name": "text", "size_bytes": "bigint"})
+
+    def cl_rows():
+        import os as _os
+        cl = engine.commitlog
+        if cl is None:
+            return
+        d = cl.directory
+        for fn in sorted(_os.listdir(d)):
+            p = _os.path.join(d, fn)
+            if _os.path.isfile(p):
+                yield {"name": fn, "size_bytes": _os.path.getsize(p)}
+    vs.register(VirtualTable(t_cl, cl_rows))
+
+    # --- batches on disk (batchlog backlog)
+    t_bl = make_table("system_views", "batch_metrics", pk=["name"],
+                      cols={"name": "text", "value": "bigint"})
+
+    def bl_rows():
+        import os as _os
+        bl = getattr(engine, "batchlog", None)
+        n = 0
+        if bl is not None and _os.path.isdir(bl.directory):
+            n = len([f for f in _os.listdir(bl.directory)
+                     if f.startswith("batch-")])
+        yield {"name": "pending_batches", "value": n}
+    vs.register(VirtualTable(t_bl, bl_rows))
+
+    # --- system_properties (db/virtual/SystemPropertiesTable): the
+    # environment the node actually runs with
+    t_props = make_table("system_views", "system_properties", pk=["name"],
+                         cols={"name": "text", "value": "text"})
+
+    def prop_rows():
+        import os as _os
+        import sys as _sys
+        yield {"name": "python_version", "value": _sys.version.split()[0]}
+        yield {"name": "platform", "value": _sys.platform}
+        yield {"name": "data_dir", "value": engine.data_dir}
+        for k in sorted(_os.environ):
+            if k.startswith(("JAX_", "XLA_", "CTPU_")):
+                yield {"name": k, "value": _os.environ[k][:200]}
+    vs.register(VirtualTable(t_props, prop_rows))
+
+    # --- cql latency percentiles (db/virtual/QueriesTable +
+    # ClientRequestMetrics): served from the global latency histogram
+    t_cqlm = make_table("system_views", "cql_metrics", pk=["name"],
+                        cols={"name": "text", "p50_us": "double",
+                              "p99_us": "double", "max_us": "double",
+                              "count": "bigint"})
+
+    def cqlm_rows():
+        from ..service.metrics import GLOBAL
+        h = GLOBAL.hist("cql.request")
+        yield {"name": "cql.request", "p50_us": h.percentile(0.5),
+               "p99_us": h.percentile(0.99), "max_us": h.percentile(1.0),
+               "count": h.count}
+    vs.register(VirtualTable(t_cqlm, cqlm_rows))
+
     return vs
 
 
@@ -129,4 +340,122 @@ def build_node_virtuals(node) -> VirtualSchema:
             yield {"peer": ep.name, "data_center": ep.dc, "rack": ep.rack,
                    "alive": node.is_alive(ep), "tokens": len(toks)}
     vs.register(VirtualTable(t_peers, peer_rows))
+
+    # --- gossip_info (db/virtual/GossipInfoTable): per-endpoint state +
+    # phi from the accrual detector
+    t_gossip = make_table("system_views", "gossip_info", pk=["endpoint"],
+                          cols={"endpoint": "text", "generation": "bigint",
+                                "heartbeat": "bigint", "alive": "boolean",
+                                "phi": "double"})
+
+    def gossip_rows():
+        g = node.gossiper
+        now = g.clock()
+        with g._lock:
+            states = dict(g.states)
+        for ep, st in states.items():
+            phi = 0.0 if ep == g.ep else g.detector.phi(st, now)
+            yield {"endpoint": ep.name, "generation": st.generation,
+                   "heartbeat": st.version,
+                   "alive": ep == g.ep or node.is_alive(ep),
+                   "phi": round(float(phi), 3)}
+    vs.register(VirtualTable(t_gossip, gossip_rows))
+
+    # --- internode messaging counters (InternodeInbound/OutboundTable)
+    t_msg = make_table("system_views", "internode_metrics", pk=["name"],
+                       cols={"name": "text", "value": "bigint"})
+    vs.register(VirtualTable(t_msg, lambda: (
+        {"name": k, "value": int(v)}
+        for k, v in sorted(node.messaging.metrics.items()))))
+
+    # --- pending hints per target (PendingHintsTable)
+    t_hints = make_table("system_views", "pending_hints", pk=["target"],
+                         cols={"target": "text", "bytes_on_disk": "bigint",
+                               "written": "bigint", "replayed": "bigint"})
+
+    def hint_rows():
+        import os as _os
+        h = node.hints
+        d = h.directory
+        if _os.path.isdir(d):
+            for fn in sorted(_os.listdir(d)):
+                if fn.startswith("hints-"):
+                    yield {"target": fn[len("hints-"):-3],
+                           "bytes_on_disk": _os.path.getsize(
+                               _os.path.join(d, fn)),
+                           "written": h.metrics["written"],
+                           "replayed": h.metrics["replayed"]}
+    vs.register(VirtualTable(t_hints, hint_rows))
+
+    # --- streaming sessions (StreamingVirtualTable)
+    t_stream = make_table("system_views", "streaming", pk=["id"],
+                          cols={"id": "int", "peer": "text",
+                                "direction": "text", "keyspace_name": "text",
+                                "table_name": "text", "status": "text",
+                                "files": "int", "bytes": "bigint"})
+
+    def stream_rows():
+        svc = getattr(node, "streams", None)
+        for i, s in enumerate(svc.sessions if svc else []):
+            yield {"id": i, "peer": s["peer"], "direction": s["direction"],
+                   "keyspace_name": s["keyspace"],
+                   "table_name": s["table"], "status": s["status"],
+                   "files": s["files"], "bytes": s["bytes"]}
+    vs.register(VirtualTable(t_stream, stream_rows))
+
+    # --- repair sessions
+    t_rep = make_table("system_views", "repairs", pk=["id"],
+                       cols={"id": "int", "keyspace_name": "text",
+                             "table_name": "text", "incremental": "boolean",
+                             "replicas": "int", "ranges_synced": "int"})
+
+    def repair_rows():
+        svc = getattr(node, "repair", None)
+        for i, s in enumerate(svc.history if svc else []):
+            yield {"id": i, "keyspace_name": s["keyspace"],
+                   "table_name": s["table"],
+                   "incremental": s["incremental"],
+                   "replicas": s["replicas"],
+                   "ranges_synced": int(s.get("ranges_synced", 0))}
+    vs.register(VirtualTable(t_rep, repair_rows))
+
+    # --- connected native-protocol clients (ClientsTable)
+    t_cli = make_table("system_views", "clients", pk=["id"],
+                       cols={"id": "int", "address": "text",
+                             "username": "text", "keyspace_name": "text",
+                             "protocol_version": "int",
+                             "requests": "bigint"})
+
+    def client_rows():
+        from ..tools.nodetool import clientstats
+        for c in clientstats(node):   # single source: nodetool + vtable
+            yield {"id": c["id"], "address": c["address"],
+                   "username": c["user"], "keyspace_name": c["keyspace"],
+                   "protocol_version": c["version"],
+                   "requests": c["requests"]}
+    vs.register(VirtualTable(t_cli, client_rows))
+
+    # --- token ownership (TokensTable / nodetool ring backing)
+    t_tok = make_table("system_views", "tokens", pk=["endpoint"],
+                       ck=["token"],
+                       cols={"endpoint": "text", "token": "bigint"})
+
+    def token_rows():
+        for ep, toks in node.ring.endpoints.items():
+            for t in sorted(toks):
+                yield {"endpoint": ep.name, "token": int(t)}
+    vs.register(VirtualTable(t_tok, token_rows))
+
+    # --- coordinator latencies (CoordinatorReadLatency metrics): the
+    # dynamic-snitch EWMA per peer
+    t_lat = make_table("system_views", "coordinator_read_latency",
+                       pk=["endpoint"],
+                       cols={"endpoint": "text", "ewma_ms": "double"})
+
+    def lat_rows():
+        with node.proxy._lat_lock:
+            snap = dict(node.proxy._latency)
+        for ep, s in sorted(snap.items(), key=lambda kv: kv[0].name):
+            yield {"endpoint": ep.name, "ewma_ms": round(s * 1000.0, 3)}
+    vs.register(VirtualTable(t_lat, lat_rows))
     return vs

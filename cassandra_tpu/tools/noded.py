@@ -234,12 +234,23 @@ def main(argv=None) -> int:
         native = CQLServer(node, cfg.get("host", "127.0.0.1"),
                            int(cfg["native_port"]),
                            tls=TLSConfig.from_dict(cfg.get("native_tls")))
+    admin = None
+    if cfg.get("admin_port") is not None:
+        # remote nodetool endpoint (the JMX port 7199 role); the protocol
+        # is unauthenticated, so it binds loopback unless admin_host is
+        # explicitly widened
+        from ..service.admin import AdminServer
+        admin = AdminServer(node, cfg.get("admin_host", "127.0.0.1"),
+                            int(cfg["admin_port"]))
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     print(f"READY {transport.bound_port}"
-          + (f" NATIVE {native.port}" if native else ""), flush=True)
+          + (f" NATIVE {native.port}" if native else "")
+          + (f" ADMIN {admin.port}" if admin else ""), flush=True)
     stop.wait()
+    if admin is not None:
+        admin.close()
     if native is not None:
         native.close()
     node.engine.close()

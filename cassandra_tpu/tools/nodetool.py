@@ -234,10 +234,10 @@ def describecluster(node) -> dict:
 
 
 def setcompactionthroughput(engine, mib_s: int) -> dict:
-    """nodetool setcompactionthroughput (0 = unthrottled). Applies to
-    the engine's background CompactionManager (wired at engine init;
-    daemons run its worker via enable_auto)."""
-    engine.compactions.limiter.rate = mib_s * 2**20
+    """nodetool setcompactionthroughput (0 = unthrottled). Routed through
+    the mutable settings surface so the settings vtable, listeners and
+    the limiter stay consistent."""
+    engine.settings.set("compaction_throughput", float(mib_s))
     return {"compaction_throughput_mib": mib_s}
 
 
@@ -423,29 +423,398 @@ def garbagecollect(engine, keyspace: str | None = None,
     return out
 
 
+# ------------------------------------------------- round-3 command set --
+
+def netstats(node) -> dict:
+    """nodetool netstats: streaming sessions + internode counters."""
+    return {"streaming": list(getattr(node.streams, "sessions", [])),
+            "messaging": dict(node.messaging.metrics)}
+
+
+def tpstats(engine) -> list[dict]:
+    """nodetool tpstats (thread_pools vtable data)."""
+    cm = engine.compactions
+    return [{"pool": "CompactionExecutor",
+             "active": 1 if cm.auto and cm._worker
+             and cm._worker.is_alive() else 0,
+             "pending": cm._queue.qsize(), "completed": len(cm.completed)},
+            {"pool": "MemtableFlushWriter", "active": 0, "pending": 0,
+             "completed": sum(cfs.metrics.get("flushes", 0)
+                              for cfs in engine.stores.values())}]
+
+
+def proxyhistograms(node) -> dict:
+    """nodetool proxyhistograms: coordinator-side latency percentiles."""
+    from ..service.metrics import GLOBAL
+    h = GLOBAL.hist("cql.request")
+    with node.proxy._lat_lock:
+        lat = dict(node.proxy._latency)
+    return {"request": {"p50_us": h.percentile(0.5),
+                        "p95_us": h.percentile(0.95),
+                        "p99_us": h.percentile(0.99),
+                        "count": h.count},
+            "replica_ewma_ms": {ep.name: round(s * 1000, 3)
+                                for ep, s in lat.items()}}
+
+
+def compactionhistory(engine) -> list[dict]:
+    """nodetool compactionhistory."""
+    out = []
+    for cfs in engine.stores.values():
+        for st in cfs.compaction_history:
+            out.append({"table": cfs.table.full_name(), **st})
+    return out
+
+
+def clientstats(node) -> list[dict]:
+    """nodetool clientstats: connected native-protocol clients."""
+    out = []
+    for srv in getattr(node, "cql_servers", []):
+        for info in list(srv.clients.values()):
+            conn = info["conn"]
+            out.append({"id": info["id"], "address": info["address"],
+                        "user": conn.user or "anonymous",
+                        "keyspace": conn.keyspace or "",
+                        "version": conn.version or 0,
+                        "requests": info["requests"]})
+    return out
+
+
+def gettimeout(node, timeout_type: str = "read") -> dict:
+    """nodetool gettimeout <read|write|range>."""
+    attr = {"read": "read_timeout", "write": "write_timeout",
+            "range": "range_timeout"}[timeout_type]
+    return {timeout_type: getattr(node.proxy, attr) * 1000.0}
+
+
+def settimeout(node, timeout_type: str, ms: float) -> dict:
+    """nodetool settimeout <read|write|range> <ms> (through settings)."""
+    name = {"read": "read_request_timeout",
+            "write": "write_request_timeout",
+            "range": "range_request_timeout"}[timeout_type]
+    node.engine.settings.set(name, f"{int(ms)}ms")
+    return gettimeout(node, timeout_type)
+
+
+def getstreamthroughput(engine) -> dict:
+    return {"stream_throughput_mib":
+            engine.settings.get("stream_throughput_outbound")}
+
+
+def setstreamthroughput(engine, mib_s: float) -> dict:
+    engine.settings.set("stream_throughput_outbound", float(mib_s))
+    return getstreamthroughput(engine)
+
+
+def getconcurrentcompactors(engine) -> dict:
+    return {"concurrent_compactors":
+            engine.settings.get("concurrent_compactors")}
+
+
+def setconcurrentcompactors(engine, n: int) -> dict:
+    engine.settings.set("concurrent_compactors", int(n))
+    return getconcurrentcompactors(engine)
+
+
+def gettraceprobability(engine) -> dict:
+    return {"trace_probability": engine.settings.get("trace_probability")}
+
+
+def settraceprobability(engine, p: float) -> dict:
+    """nodetool settraceprobability: sample rate for background request
+    tracing (service/tracing.py consults it)."""
+    engine.settings.set("trace_probability", float(p))
+    return gettraceprobability(engine)
+
+
+def disableautocompaction(engine) -> dict:
+    """nodetool disableautocompaction (pauses the background worker's
+    submissions; running tasks finish)."""
+    engine.compactions.paused = True
+    return {"auto_compaction": "disabled"}
+
+
+def enableautocompaction(engine) -> dict:
+    engine.compactions.paused = False
+    return {"auto_compaction": "enabled"}
+
+
+def statusautocompaction(engine) -> dict:
+    return {"running": not getattr(engine.compactions, "paused", False)}
+
+
+def disablehandoff(node) -> dict:
+    """nodetool disablehandoff: stop storing new hints."""
+    node.hints.enabled = False
+    return {"handoff": "disabled"}
+
+
+def enablehandoff(node) -> dict:
+    node.hints.enabled = True
+    return {"handoff": "enabled"}
+
+
+def statushandoff(node) -> dict:
+    return {"handoff": "running"
+            if getattr(node.hints, "enabled", True) else "disabled"}
+
+
+def truncatehints(node, endpoint: str | None = None) -> dict:
+    """nodetool truncatehints [endpoint]."""
+    import os as _os
+    n = 0
+    d = node.hints.directory
+    for fn in list(_os.listdir(d)):
+        if not fn.startswith("hints-"):
+            continue
+        if endpoint and fn != f"hints-{endpoint}.db":
+            continue
+        _os.remove(_os.path.join(d, fn))
+        n += 1
+    return {"truncated_files": n}
+
+
+def statusgossip(node) -> dict:
+    return {"gossip": "running" if node.gossiper.is_running()
+            else "not running"}
+
+
+def statusbinary(node) -> dict:
+    return {"native_transport": "running"
+            if getattr(node, "cql_servers", []) else "not running"}
+
+
+def drain(node) -> dict:
+    """nodetool drain: flush everything, stop accepting new compactions;
+    the commitlog is synced so restart replays nothing."""
+    node.engine.compactions.paused = True
+    node.engine.flush_all()
+    if node.engine.commitlog is not None:
+        node.engine.commitlog.sync()
+    return {"drained": True}
+
+
+def refresh(engine, keyspace: str, table: str) -> dict:
+    """nodetool refresh: pick up sstables dropped into the data dir
+    out-of-band (bulk load path)."""
+    cfs = engine.store(keyspace, table)
+    before = len(cfs.live_sstables())
+    cfs.reload_sstables()
+    return {"sstables_before": before,
+            "sstables_after": len(cfs.live_sstables())}
+
+
+def invalidaterowcache(engine) -> dict:
+    n = 0
+    for cfs in engine.stores.values():
+        if cfs.row_cache is not None:
+            cfs.row_cache.clear()
+            n += 1
+    return {"invalidated_tables": n}
+
+
+def invalidatechunkcache(engine) -> dict:
+    from ..storage import chunk_cache
+    chunk_cache.GLOBAL.clear()
+    return {"invalidated": True}
+
+
+def invalidatecountercache(node) -> dict:
+    node.counters.invalidate_cache()
+    return {"invalidated": True}
+
+
+def getsstables(engine, keyspace: str, table: str, key: str) -> list[str]:
+    """nodetool getsstables: which sstables hold a partition key."""
+    from .copyutil import _parse_value
+    t = engine.store(keyspace, table).table
+    cols = t.partition_key_columns
+    parts = key.split(":") if len(cols) > 1 else [key]
+    vals = [_parse_value(p, c.cql_type) for p, c in zip(parts, cols)]
+    pk = t.serialize_partition_key(vals)
+    cfs = engine.store(keyspace, table)
+    out = []
+    for sst in cfs.live_sstables():
+        if sst.might_contain(pk):
+            out.append(f"{sst.desc.version}-{sst.desc.generation}")
+    return out
+
+
+def verify(engine, keyspace: str | None = None,
+           table: str | None = None) -> list[dict]:
+    """nodetool verify: recheck each sstable's digest against its data."""
+    out = []
+    for cfs in list(engine.stores.values()):
+        t = cfs.table
+        if keyspace and t.keyspace != keyspace:
+            continue
+        if table and t.name != table:
+            continue
+        for sst in cfs.live_sstables():
+            try:
+                ok = sst.verify_digest()
+            except Exception as e:
+                ok = False
+                out.append({"sstable": sst.desc.generation,
+                            "table": t.full_name(), "ok": False,
+                            "error": str(e)})
+                continue
+            out.append({"sstable": sst.desc.generation,
+                        "table": t.full_name(), "ok": bool(ok)})
+    return out
+
+
+def assassinate(node, endpoint: str) -> dict:
+    """nodetool assassinate: force-convict an endpoint without waiting
+    for phi (Gossiper.assassinateEndpoint role)."""
+    for ep in node.ring.endpoints:
+        if ep.name == endpoint:
+            node.gossiper.force_convict(ep)
+            return {"assassinated": endpoint}
+    raise ValueError(f"unknown endpoint {endpoint!r}")
+
+
+def listpendinghints(node) -> list[dict]:
+    import os as _os
+    out = []
+    d = node.hints.directory
+    for fn in sorted(_os.listdir(d)):
+        if fn.startswith("hints-"):
+            out.append({"target": fn[len("hints-"):-3],
+                        "bytes": _os.path.getsize(_os.path.join(d, fn))})
+    return out
+
+
+def getlogginglevels() -> dict:
+    import logging
+    return {name: logging.getLevelName(logging.getLogger(name).level)
+            for name in sorted(logging.root.manager.loggerDict)
+            if name.startswith("cassandra_tpu")} or \
+        {"root": logging.getLevelName(logging.root.level)}
+
+
+def setlogginglevel(logger: str = "root", level: str = "INFO") -> dict:
+    import logging
+    lg = logging.root if logger == "root" else logging.getLogger(logger)
+    lg.setLevel(level.upper())
+    return {logger: level.upper()}
+
+
+def decommission(node) -> dict:
+    """nodetool decommission (streams ranges away, leaves the ring)."""
+    node.decommission()
+    return {"decommissioned": node.endpoint.name}
+
+
+def move(node, new_token: int) -> dict:
+    """nodetool move <token> (TCM Move sequence)."""
+    node.move_tokens([int(new_token)])
+    return {"moved_to": int(new_token)}
+
+
+# Registry: name -> (target kind, callable). Target "node" needs the full
+# cluster Node; "engine" works on a bare StorageEngine (offline --data
+# mode supports only those); "none" needs neither.
+COMMANDS: dict = {}
+for _name, _target in [
+        ("status", "node"), ("info", "engine"), ("ring", "node"),
+        ("flush", "engine"), ("compact", "engine"),
+        ("compactionstats", "engine"), ("tablestats", "engine"),
+        ("repair", "node"), ("cleanup", "node"),
+        ("getendpoints", "node"), ("gossipinfo", "node"),
+        ("version", "none"), ("describecluster", "node"),
+        ("setcompactionthroughput", "engine"),
+        ("getcompactionthroughput", "engine"),
+        ("setslowquerythreshold", "engine"),
+        ("upgradesstables", "engine"), ("sstablesplit", "engine"),
+        ("snapshot", "engine"), ("listsnapshots", "engine"),
+        ("clearsnapshot", "engine"), ("scrub", "engine"),
+        ("garbagecollect", "engine"),
+        ("netstats", "node"), ("tpstats", "engine"),
+        ("proxyhistograms", "node"), ("compactionhistory", "engine"),
+        ("clientstats", "node"), ("gettimeout", "node"),
+        ("settimeout", "node"), ("getstreamthroughput", "engine"),
+        ("setstreamthroughput", "engine"),
+        ("getconcurrentcompactors", "engine"),
+        ("setconcurrentcompactors", "engine"),
+        ("gettraceprobability", "engine"),
+        ("settraceprobability", "engine"),
+        ("disableautocompaction", "engine"),
+        ("enableautocompaction", "engine"),
+        ("statusautocompaction", "engine"),
+        ("disablehandoff", "node"), ("enablehandoff", "node"),
+        ("statushandoff", "node"), ("truncatehints", "node"),
+        ("statusgossip", "node"), ("statusbinary", "node"),
+        ("drain", "node"), ("refresh", "engine"),
+        ("invalidaterowcache", "engine"),
+        ("invalidatechunkcache", "engine"),
+        ("invalidatecountercache", "node"),
+        ("getsstables", "engine"), ("verify", "engine"),
+        ("assassinate", "node"), ("listpendinghints", "node"),
+        ("getlogginglevels", "none"), ("setlogginglevel", "none"),
+        ("decommission", "node"), ("move", "node")]:
+    COMMANDS[_name] = (_target, globals()[_name])
+
+
+def run_command(name: str, node=None, engine=None, **kwargs):
+    """Dispatch one command against whatever backend is available —
+    shared by the CLI local mode and the admin server."""
+    if name not in COMMANDS:
+        raise ValueError(f"unknown command {name!r}")
+    target, fn = COMMANDS[name]
+    if target == "node":
+        if node is None:
+            raise ValueError(f"{name} needs a running node "
+                             "(use --host/--port admin mode)")
+        return fn(node, **kwargs)
+    if target == "engine":
+        eng = engine if engine is not None \
+            else (node.engine if node is not None else None)
+        if eng is None:
+            raise ValueError(f"{name} needs an engine")
+        return fn(eng, **kwargs)
+    return fn(**kwargs)
+
+
 def main(argv=None):
-    p = argparse.ArgumentParser(prog="nodetool")
-    p.add_argument("command", choices=["info", "flush", "compact",
-                                       "compactionstats", "tablestats",
-                                       "garbagecollect", "scrub"])
-    p.add_argument("--data", required=True, help="data directory")
-    p.add_argument("--keyspace")
-    p.add_argument("--table")
+    p = argparse.ArgumentParser(
+        prog="nodetool",
+        description="Operator commands. --host/--port drives a running "
+                    "daemon over the admin protocol (JMX role); --data "
+                    "opens a local data directory offline.")
+    p.add_argument("command", choices=sorted(COMMANDS))
+    p.add_argument("args", nargs="*", help="key=value command arguments")
+    p.add_argument("--data", help="offline mode: data directory")
+    p.add_argument("--host", help="admin mode: daemon host")
+    p.add_argument("--port", type=int, help="admin mode: admin port")
     args = p.parse_args(argv)
 
+    kwargs = {}
+    for kv in args.args:
+        if "=" not in kv:
+            p.error(f"arguments are key=value, got {kv!r}")
+        k, v = kv.split("=", 1)
+        try:
+            kwargs[k] = json.loads(v)
+        except json.JSONDecodeError:
+            kwargs[k] = v
+
+    if args.host and args.port:
+        from ..service.admin import admin_call
+        out = admin_call(args.host, args.port, args.command, kwargs)
+        print(json.dumps(out, indent=2, default=str))
+        return
+    if not args.data:
+        p.error("need --data DIR (offline) or --host/--port (admin mode)")
     from ..schema import Schema
     from ..storage.engine import StorageEngine
     engine = StorageEngine(args.data, Schema())
-    fn = globals()[args.command]
-    import inspect
-    kwargs = {}
-    sig = inspect.signature(fn)
-    if "keyspace" in sig.parameters:
-        kwargs["keyspace"] = args.keyspace
-    if "table" in sig.parameters:
-        kwargs["table"] = args.table
-    print(json.dumps(fn(engine, **kwargs), indent=2, default=str))
-    engine.close()
+    try:
+        print(json.dumps(run_command(args.command, engine=engine,
+                                     **kwargs),
+                         indent=2, default=str))
+    finally:
+        engine.close()
 
 
 if __name__ == "__main__":

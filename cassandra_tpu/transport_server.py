@@ -283,6 +283,17 @@ class CQLServer:
         self._closed = False
         self._event_conns: set[_Conn] = set()
         self._conn_lock = threading.Lock()
+        # live connection registry (system_views.clients / `nodetool
+        # clientstats`; transport/ConnectedClient role). The server links
+        # itself onto the backend so virtual tables can enumerate.
+        self.clients: dict[int, dict] = {}
+        self._client_ids = 0
+        try:
+            if not hasattr(backend, "cql_servers"):
+                backend.cql_servers = []
+            backend.cql_servers.append(self)
+        except Exception:
+            pass
         # server-push events: a cluster Node surfaces liveness/topology/
         # schema transitions through add_event_listener. Pushes run on a
         # DEDICATED thread with a bounded per-send deadline — the
@@ -370,6 +381,9 @@ class CQLServer:
 
     def close(self) -> None:
         self._closed = True
+        servers = getattr(self.backend, "cql_servers", None)
+        if servers is not None and self in servers:
+            servers.remove(self)
         remove = getattr(self.backend, "remove_event_listener", None)
         if remove is not None:
             remove(self._on_node_event)
@@ -419,11 +433,21 @@ class CQLServer:
         conn = _Conn(sock)
         auth = getattr(self.backend, "auth", None)
         need_auth = auth is not None and auth.enabled
+        with self._conn_lock:
+            self._client_ids += 1
+            cid = self._client_ids
+        try:
+            peer = "%s:%d" % sock.getpeername()[:2]
+        except OSError:
+            peer = "?"
+        info = {"id": cid, "address": peer, "requests": 0, "conn": conn}
+        self.clients[cid] = info
         try:
             while not self._closed:
                 env = self._next_envelope(conn)
                 if env is None:
                     return
+                info["requests"] += 1
                 ver, flags, stream, opcode, body = env
                 if ver not in SUPPORTED_VERSIONS:
                     # reject cleanly (spec: respond with a PROTOCOL error
@@ -463,6 +487,7 @@ class CQLServer:
         except (OSError, ValueError):
             pass
         finally:
+            self.clients.pop(cid, None)
             with self._conn_lock:
                 self._event_conns.discard(conn)
             try:
