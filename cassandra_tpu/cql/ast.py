@@ -240,8 +240,20 @@ class RoleStatement:
     action: str          # create | drop | alter
     name: str
     password: str | None = None
-    superuser: bool = False
+    superuser: bool | None = False
     if_not_exists: bool = False
+    # CEP-33 access options: None = leave unchanged, [] = unrestricted
+    datacenters: list | None = None
+    cidr_groups: list | None = None
+
+
+@dataclass
+class IdentityStatement:
+    """ADD/DROP IDENTITY — mTLS certificate identity to role mapping
+    (auth/MutualTlsAuthenticator, identity_to_role)."""
+    action: str          # add | drop
+    identity: str
+    role: str | None
 
 
 @dataclass

@@ -286,7 +286,8 @@ class Executor:
         if m is None:
             raise InvalidRequest(f"cannot execute {name}")
         if name in ("RoleStatement", "GrantStatement",
-                    "ListRolesStatement", "BatchStatement"):
+                    "ListRolesStatement", "BatchStatement",
+                    "IdentityStatement"):
             return m(stmt, params, keyspace, now_micros, user)
         if name == "SelectStatement":
             return m(stmt, params, keyspace, now_micros,
@@ -331,15 +332,48 @@ class Executor:
         auth.require_superuser(user)
         if s.action == "create":
             try:
-                auth.create_role(s.name, s.password, s.superuser)
+                auth.create_role(s.name, s.password, bool(s.superuser))
             except ValueError:
                 if not s.if_not_exists:
                     raise InvalidRequest(f"role {s.name} exists")
+                # IF NOT EXISTS on an existing role is a FULL no-op —
+                # applying the access options would silently rewrite the
+                # live role's restrictions
+                return ResultSet([], [])
         elif s.action == "drop":
             try:
                 auth.drop_role(s.name, if_exists=s.if_not_exists)
             except ValueError as e:
                 raise InvalidRequest(str(e))
+        elif s.action == "alter":
+            r = auth.roles.get(s.name)
+            if r is None:
+                raise InvalidRequest(f"unknown role {s.name}")
+            if s.password is not None or s.superuser is not None:
+                auth.alter_role(s.name, password=s.password,
+                                superuser=s.superuser)
+        if s.action in ("create", "alter") and \
+                (s.datacenters is not None or s.cidr_groups is not None):
+            try:
+                auth.alter_role_access(s.name, cidr_groups=s.cidr_groups,
+                                       datacenters=s.datacenters)
+            except ValueError as e:
+                raise InvalidRequest(str(e))
+        return ResultSet([], [])
+
+    def _exec_IdentityStatement(self, s, params, keyspace, now,
+                                user=None):
+        auth = getattr(self.backend, "auth", None)
+        if auth is None:
+            raise InvalidRequest("no auth service on this backend")
+        auth.require_superuser(user)
+        try:
+            if s.action == "add":
+                auth.add_identity(s.identity, s.role)
+            else:
+                auth.drop_identity(s.identity)
+        except ValueError as e:
+            raise InvalidRequest(str(e))
         return ResultSet([], [])
 
     def _exec_GrantStatement(self, s, params, keyspace, now, user=None):

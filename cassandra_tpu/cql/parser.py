@@ -176,7 +176,7 @@ class Parser:
             "drop": self.drop, "alter": self.alter,
             "truncate": self.truncate, "use": self.use,
             "grant": self.grant, "revoke": self.grant,
-            "list": self.list_stmt,
+            "list": self.list_stmt, "add": self.add_identity,
         }.get(kw)
         if fn is None:
             raise ParseError(f"unsupported statement {kw.upper()}")
@@ -573,13 +573,48 @@ class Parser:
                                             stype, finalfunc, initcond,
                                             or_replace)
 
-    def _create_role(self):
-        ine = self._if_not_exists()
-        name = self.ident()
+    def _set_literal(self) -> list:
+        """{'a', 'b'} — the set form used by ACCESS TO DATACENTERS /
+        ACCESS FROM CIDRS role options."""
+        self.expect_op("{")
+        out: list = []
+        if self.accept_op("}"):
+            return out
+        while True:
+            t = self.next()
+            if t.kind not in ("STRING", "IDENT"):
+                raise ParseError(f"expected set element, got {t}")
+            out.append(str(t.value))
+            if self.accept_op("}"):
+                return out
+            self.expect_op(",")
+
+    def _role_options(self):
+        """WITH password = '..' AND superuser = true AND
+        ACCESS TO DATACENTERS {'dc1'} AND ACCESS FROM CIDRS {'office'}
+        (auth/CassandraRoleManager role options + CEP-33 access)."""
         password = None
-        superuser = False
-        if self.accept_kw("with"):
-            while True:
+        superuser = None
+        datacenters = None
+        cidr_groups = None
+        while True:
+            if self.accept_ident("access"):
+                if self.accept_kw("from"):
+                    if not self.accept_ident("cidrs"):
+                        raise ParseError("expected CIDRS after ACCESS FROM")
+                    cidr_groups = self._set_literal()
+                else:
+                    if not (self.accept_kw("to") or self.accept_ident("to")):
+                        raise ParseError("expected TO or FROM after ACCESS")
+                    if self.accept_kw("all") or self.accept_ident("all"):
+                        if not self.accept_ident("datacenters"):
+                            raise ParseError("expected DATACENTERS")
+                        datacenters = []   # clear the restriction
+                    else:
+                        if not self.accept_ident("datacenters"):
+                            raise ParseError("expected DATACENTERS")
+                        datacenters = self._set_literal()
+            else:
                 opt = self.ident()
                 self.expect_op("=")
                 v = self._option_value()
@@ -587,9 +622,48 @@ class Parser:
                     password = str(v)
                 elif opt == "superuser":
                     superuser = bool(v)
-                if not self.accept_kw("and"):
-                    break
-        return ast.RoleStatement("create", name, password, superuser, ine)
+            if not self.accept_kw("and"):
+                break
+        return password, superuser, datacenters, cidr_groups
+
+    def _create_role(self):
+        ine = self._if_not_exists()
+        name = self.ident()
+        password = None
+        superuser = False
+        datacenters = cidr_groups = None
+        if self.accept_kw("with"):
+            password, superuser, datacenters, cidr_groups = \
+                self._role_options()
+            superuser = bool(superuser)
+        return ast.RoleStatement("create", name, password, superuser, ine,
+                                 datacenters=datacenters,
+                                 cidr_groups=cidr_groups)
+
+    def _alter_role(self):
+        name = self.ident()
+        self.expect_kw("with")
+        password, superuser, datacenters, cidr_groups = \
+            self._role_options()
+        return ast.RoleStatement("alter", name, password, superuser,
+                                 datacenters=datacenters,
+                                 cidr_groups=cidr_groups)
+
+    def add_identity(self):
+        """ADD IDENTITY '<identity>' TO ROLE 'r' (mTLS, CEP-34)."""
+        self.expect_kw("add")
+        if not self.accept_ident("identity"):
+            raise ParseError("expected IDENTITY after ADD")
+        t = self.next()
+        if t.kind != "STRING":
+            raise ParseError("expected identity string")
+        if not (self.accept_kw("to") or self.accept_ident("to")):
+            raise ParseError("expected TO ROLE")
+        self.expect_kw("role")
+        r = self.next()
+        if r.kind not in ("STRING", "IDENT"):
+            raise ParseError("expected role name")
+        return ast.IdentityStatement("add", str(t.value), str(r.value))
 
     def grant(self):
         revoke = bool(self.accept_kw("revoke"))
@@ -876,6 +950,11 @@ class Parser:
     # DROP / ALTER / TRUNCATE / USE
     def drop(self):
         self.expect_kw("drop")
+        if self.accept_ident("identity"):
+            t = self.next()
+            if t.kind != "STRING":
+                raise ParseError("expected identity string")
+            return ast.IdentityStatement("drop", str(t.value), None)
         what = self.next().value
         if what in ("role", "user"):
             ife = False
@@ -910,6 +989,8 @@ class Parser:
 
     def alter(self):
         self.expect_kw("alter")
+        if self.accept_kw("role") or self.accept_kw("user"):
+            return self._alter_role()
         self.expect_kw("table")
         ks, name = self.qualified_name()
         if self.accept_kw("add"):

@@ -10,6 +10,18 @@ from __future__ import annotations
 from ..schema import TableMetadata, make_table
 
 
+def _snapshot(seq):
+    """Copy a concurrently-appended deque/list for safe iteration; an
+    append racing the copy raises RuntimeError — retry once, then serve
+    what a best-effort copy yields."""
+    for _ in range(3):
+        try:
+            return list(seq)
+        except RuntimeError:
+            continue
+    return []
+
+
 class VirtualTable:
     def __init__(self, table: TableMetadata, rows_fn):
         self.table = table
@@ -374,17 +386,11 @@ def build_node_virtuals(node) -> VirtualSchema:
                                "written": "bigint", "replayed": "bigint"})
 
     def hint_rows():
-        import os as _os
-        h = node.hints
-        d = h.directory
-        if _os.path.isdir(d):
-            for fn in sorted(_os.listdir(d)):
-                if fn.startswith("hints-"):
-                    yield {"target": fn[len("hints-"):-3],
-                           "bytes_on_disk": _os.path.getsize(
-                               _os.path.join(d, fn)),
-                           "written": h.metrics["written"],
-                           "replayed": h.metrics["replayed"]}
+        from ..tools.nodetool import listpendinghints
+        m = node.hints.metrics
+        for h in listpendinghints(node):   # single source: nodetool+vtable
+            yield {"target": h["target"], "bytes_on_disk": h["bytes"],
+                   "written": m["written"], "replayed": m["replayed"]}
     vs.register(VirtualTable(t_hints, hint_rows))
 
     # --- streaming sessions (StreamingVirtualTable)
@@ -396,7 +402,7 @@ def build_node_virtuals(node) -> VirtualSchema:
 
     def stream_rows():
         svc = getattr(node, "streams", None)
-        for i, s in enumerate(svc.sessions if svc else []):
+        for i, s in enumerate(_snapshot(svc.sessions) if svc else []):
             yield {"id": i, "peer": s["peer"], "direction": s["direction"],
                    "keyspace_name": s["keyspace"],
                    "table_name": s["table"], "status": s["status"],
@@ -411,7 +417,7 @@ def build_node_virtuals(node) -> VirtualSchema:
 
     def repair_rows():
         svc = getattr(node, "repair", None)
-        for i, s in enumerate(svc.history if svc else []):
+        for i, s in enumerate(_snapshot(svc.history) if svc else []):
             yield {"id": i, "keyspace_name": s["keyspace"],
                    "table_name": s["table"],
                    "incremental": s["incremental"],

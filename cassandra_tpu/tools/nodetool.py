@@ -427,7 +427,8 @@ def garbagecollect(engine, keyspace: str | None = None,
 
 def netstats(node) -> dict:
     """nodetool netstats: streaming sessions + internode counters."""
-    return {"streaming": list(getattr(node.streams, "sessions", [])),
+    from ..storage.virtual import _snapshot
+    return {"streaming": _snapshot(getattr(node.streams, "sessions", [])),
             "messaging": dict(node.messaging.metrics)}
 
 
@@ -700,6 +701,31 @@ def setlogginglevel(logger: str = "root", level: str = "INFO") -> dict:
     return {logger: level.upper()}
 
 
+def updatecidrgroup(engine, name: str, cidrs) -> dict:
+    """nodetool updatecidrgroup <name> <cidrs> — define/replace a named
+    CIDR group (auth/CIDRPermissionsManager)."""
+    if isinstance(cidrs, str):
+        cidrs = [c.strip() for c in cidrs.split(",") if c.strip()]
+    engine.auth.set_cidr_group(name, cidrs)
+    return {name: cidrs}
+
+
+def dropcidrgroup(engine, name: str) -> dict:
+    engine.auth.drop_cidr_group(name)
+    return {"dropped": name}
+
+
+def listcidrgroups(engine) -> dict:
+    return dict(engine.auth.cidr_groups)
+
+
+def invalidatecredentialscache(engine) -> dict:
+    """nodetool invalidatecredentialscache / invalidatepermissionscache:
+    drop all AuthCache verdicts."""
+    engine.auth.cache.invalidate_all()
+    return {"invalidated": True}
+
+
 def decommission(node) -> dict:
     """nodetool decommission (streams ranges away, leaves the ring)."""
     node.decommission()
@@ -752,6 +778,9 @@ for _name, _target in [
         ("getsstables", "engine"), ("verify", "engine"),
         ("assassinate", "node"), ("listpendinghints", "node"),
         ("getlogginglevels", "none"), ("setlogginglevel", "none"),
+        ("updatecidrgroup", "engine"), ("dropcidrgroup", "engine"),
+        ("listcidrgroups", "engine"),
+        ("invalidatecredentialscache", "engine"),
         ("decommission", "node"), ("move", "node")]:
     COMMANDS[_name] = (_target, globals()[_name])
 

@@ -225,6 +225,8 @@ class _Conn:
         self.keyspace: str | None = None
         self.user: str | None = None
         self.authed = False
+        self.peer_ip: str | None = None
+        self.tls_identity: str | None = None   # verified client-cert id
         self.registrations: set[str] = set()
         self.buf = bytearray()         # modern-framing reassembly
         self.wlock = threading.Lock()  # event pushes race responses
@@ -257,6 +259,29 @@ def _inet(host: str, port: int) -> bytes:
     import ipaddress
     addr = ipaddress.ip_address(host).packed
     return bytes([len(addr)]) + addr + struct.pack(">i", port)
+
+
+def _cert_identity(sock) -> str | None:
+    """The VERIFIED client certificate's identity: SAN URI (SPIFFE
+    style) preferred, else subject CN (MutualTlsAuthenticator's
+    identity extraction). None for plaintext / cert-less TLS."""
+    import ssl
+    if not isinstance(sock, ssl.SSLSocket):
+        return None
+    try:
+        cert = sock.getpeercert()
+    except ssl.SSLError:
+        return None
+    if not cert:
+        return None
+    for typ, val in cert.get("subjectAltName", ()):
+        if typ == "URI":
+            return val
+    for rdn in cert.get("subject", ()):
+        for k, v in rdn:
+            if k == "commonName":
+                return v
+    return None
 
 
 class CQLServer:
@@ -437,9 +462,12 @@ class CQLServer:
             self._client_ids += 1
             cid = self._client_ids
         try:
-            peer = "%s:%d" % sock.getpeername()[:2]
+            peername = sock.getpeername()[:2]
+            peer = "%s:%d" % peername
+            conn.peer_ip = peername[0]
         except OSError:
             peer = "?"
+        conn.tls_identity = _cert_identity(sock)
         info = {"id": cid, "address": peer, "requests": 0, "conn": conn}
         self.clients[cid] = info
         try:
@@ -538,6 +566,15 @@ class CQLServer:
 
     # ------------------------------------------------------------- opcodes
 
+    def _post_auth_checks(self, auth, conn: "_Conn", user: str) -> None:
+        """CIDR + network (datacenter) authorization at connect time
+        (auth/CIDRPermissionsManager, CassandraNetworkAuthorizer)."""
+        if conn.peer_ip:
+            auth.check_cidr(user, conn.peer_ip)
+        ep = getattr(self.backend, "endpoint", None)
+        if ep is not None:
+            auth.check_datacenter(user, ep.dc)
+
     def _dispatch(self, processor, conn: _Conn, need_auth, auth, opcode,
                   body):
         if opcode == OP_OPTIONS:
@@ -548,6 +585,23 @@ class CQLServer:
                 _string("4/v4") + _string("5/v5")
         if opcode == OP_STARTUP:
             if need_auth:
+                # mutual-TLS path (MutualTlsAuthenticator): a VERIFIED
+                # client certificate authenticates by identity mapping
+                # without a password exchange
+                ident = conn.tls_identity
+                if ident is not None and ident in auth.identities:
+                    # mapped identity: cert authenticates; an UNMAPPED
+                    # cert falls through to the password exchange
+                    # (optional-mTLS upgrade path)
+                    try:
+                        user = auth.authenticate_identity(ident)
+                        self._post_auth_checks(auth, conn, user)
+                    except Exception as e:
+                        return OP_ERROR, struct.pack(
+                            ">i", ERR_BAD_CREDENTIALS) + _string(str(e))
+                    conn.user = user
+                    conn.authed = True
+                    return OP_READY, b""
                 return OP_AUTHENTICATE, _string(
                     "org.apache.cassandra.auth.PasswordAuthenticator")
             conn.authed = True
@@ -559,6 +613,7 @@ class CQLServer:
                 user, pw = parts[1].decode(), parts[2].decode()
                 try:
                     auth.authenticate(user, pw)
+                    self._post_auth_checks(auth, conn, user)
                 except Exception:
                     return OP_ERROR, struct.pack(
                         ">i", ERR_BAD_CREDENTIALS) + _string(
