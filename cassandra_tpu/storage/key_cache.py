@@ -1,0 +1,58 @@
+"""Key cache: partition-key -> partition location, shared by readers.
+
+Reference counterpart: cache/KeyCacheKey.java + the key cache in
+CacheService.java:108 — avoids the partition-index walk on repeat point
+reads. Matters most for summary-mode sstables (large partition
+directories kept downsampled in memory, storage/sstable/reader.py):
+a hit skips the on-disk directory bracket scan entirely.
+
+Entries key on (directory, generation, pk) — generation-scoped like the
+chunk cache, so stale entries can never serve a new sstable. Persisted
+across restarts by storage/saved_caches.py (AutoSavingCache role).
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+
+class KeyCache:
+    def __init__(self, capacity: int = 100_000):
+        self.capacity = capacity
+        self._lru: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: tuple):
+        with self._lock:
+            v = self._lru.get(key)
+            if v is None:
+                self.misses += 1
+                return None
+            self._lru.move_to_end(key)
+            self.hits += 1
+            return v
+
+    def put(self, key: tuple, value: tuple) -> None:
+        with self._lock:
+            self._lru[key] = value
+            self._lru.move_to_end(key)
+            while len(self._lru) > self.capacity:
+                self._lru.popitem(last=False)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._lru.clear()
+
+    def keys(self) -> list[tuple]:
+        with self._lock:
+            return list(self._lru)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._lru), "capacity": self.capacity,
+                    "hits": self.hits, "misses": self.misses}
+
+
+GLOBAL = KeyCache()

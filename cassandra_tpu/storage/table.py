@@ -71,6 +71,11 @@ class RowCache:
         with self._lock:
             return len(self._d)
 
+    def keys(self) -> list[bytes]:
+        """LRU-ordered pks (oldest first) — AutoSavingCache snapshot."""
+        with self._lock:
+            return list(self._d)
+
     def get(self, pk: bytes):
         with self._lock:
             batch = self._d.get(pk)
