@@ -26,6 +26,10 @@ from .messaging import Verb
 
 MIN_TOKEN = -(1 << 63)
 
+# reserved key in a shipped component dict carrying the sender's sstable
+# format version (bytes); never a real component filename
+VERSION_KEY = "__format_version__"
+
 
 def _filter_token_range(batch, lo: int, hi: int):
     import numpy as np
@@ -73,7 +77,11 @@ class StreamService:
         files = []
         for sst in whole:
             prefix = f"{sst.desc.version}-{sst.desc.generation}-"
-            comps = {}
+            # the FORMAT VERSION must travel with the bytes: since "cc"
+            # the version gates the lane-plane unshuffle on read, so a
+            # receiver stamping its own version onto shipped components
+            # would silently transpose-garble the lane matrix
+            comps = {VERSION_KEY: sst.desc.version.encode()}
             for fn in os.listdir(cfs.directory):
                 if fn.startswith(prefix):
                     with open(os.path.join(cfs.directory, fn), "rb") as f:
@@ -136,15 +144,21 @@ class StreamService:
     def land_sstable(self, cfs, comps: dict) -> int:
         """Write a shipped sstable's components under a fresh local
         generation; TOC last = commit point (the receiver-side
-        CassandraStreamReceiver contract)."""
+        CassandraStreamReceiver contract). The sstable lands under the
+        SENDER's format version (shipped in VERSION_KEY) — the version
+        byte gates layout decode (lane unshuffle since "cc"), so
+        re-stamping would corrupt silently."""
         from ..storage.sstable.format import Component
-        version = None
-        for sst in cfs.live_sstables():
-            version = sst.desc.version
-            break
-        if version is None:
-            from ..storage.sstable import Descriptor
-            version = Descriptor(cfs.directory, 1).version
+        comps = dict(comps)
+        version_b = comps.pop(VERSION_KEY, None)
+        if version_b is not None:
+            version = version_b.decode()
+        else:
+            # legacy sender without a version marker: such a sender is by
+            # definition running pre-"cc" code (the marker shipped with
+            # "cc"), so its lanes are row-major — land as "cb", never as
+            # the current version
+            version = "cb"
         from ..storage.sstable.writer import SSTableWriter
         gen = cfs.next_generation()
         toc = comps.get(Component.TOC)

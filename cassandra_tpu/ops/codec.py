@@ -7,7 +7,9 @@ recommendedUses), schema/CompressionParams.java:45 (per-table configuration,
 Five codecs, matching the reference set:
   LZ4Compressor      C++ (ops/native/codec.cpp), LZ4 block format
   SnappyCompressor   C++ (ops/native/codec.cpp), snappy raw format
-  ZstdCompressor     python `zstandard` (bindings over libzstd)
+  ZstdCompressor     system libzstd dlopen'd by the C++ layer (the
+                     reference's zstd-jni role); python `zstandard`
+                     fallback when the library is absent
   DeflateCompressor  zlib stdlib
   NoopCompressor     identity
 
@@ -73,6 +75,10 @@ class _NativeCompressor(Compressor):
     """ctypes front-end over the C++ batch codecs."""
     _prefix = "?"
 
+    def _prepare(self) -> None:
+        """Hook run (on the calling thread) before each FFI entry —
+        codecs with per-instance state (zstd level) sync it here."""
+
     def __init__(self):
         self._lib = native_build.load()
         self._compress = getattr(self._lib, f"{self._prefix}_compress")
@@ -85,6 +91,7 @@ class _NativeCompressor(Compressor):
         self._max = getattr(self._lib, f"{self._prefix}_max_compressed")
 
     def compress(self, data: bytes) -> bytes:
+        self._prepare()
         cap = self._max(len(data))
         dst = ctypes.create_string_buffer(cap)
         src = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
@@ -95,6 +102,7 @@ class _NativeCompressor(Compressor):
         return dst.raw[:n]
 
     def uncompress(self, data: bytes, uncompressed_length: int) -> bytes:
+        self._prepare()
         dst = ctypes.create_string_buffer(uncompressed_length or 1)
         src = (ctypes.c_uint8 * max(len(data), 1)).from_buffer_copy(data or b"\x00")
         n = self._decompress(src, len(data),
@@ -107,6 +115,7 @@ class _NativeCompressor(Compressor):
     def compress_batch(self, chunks: list[bytes]) -> list[bytes]:
         if not chunks:
             return []
+        self._prepare()
         src = b"".join(chunks)
         src_offs = np.zeros(len(chunks) + 1, dtype=np.int64)
         np.cumsum([len(c) for c in chunks], out=src_offs[1:])
@@ -130,6 +139,7 @@ class _NativeCompressor(Compressor):
                          lengths: list[int]) -> list[bytes]:
         if not chunks:
             return []
+        self._prepare()
         src = b"".join(chunks)
         src_offs = np.zeros(len(chunks) + 1, dtype=np.int64)
         np.cumsum([len(c) for c in chunks], out=src_offs[1:])
@@ -167,6 +177,7 @@ class _NativeCompressor(Compressor):
         if n == 0:
             return np.zeros(0, np.uint8), np.zeros(0, np.int64), \
                 np.zeros(0, np.int64)
+        self._prepare()
         arrs = [self._as_u8(f) for f in frames]
         lens = np.array([a.nbytes for a in arrs], dtype=np.int64)
         dst_offs = np.zeros(n + 1, dtype=np.int64)
@@ -188,6 +199,7 @@ class _NativeCompressor(Compressor):
         n = len(dsts)
         if n == 0:
             return
+        self._prepare()
         src = np.ascontiguousarray(src.view(np.uint8).reshape(-1))
         src_offs = np.ascontiguousarray(src_offs, dtype=np.int64)
         src_lens = np.ascontiguousarray(src_lens, dtype=np.int64)
@@ -232,7 +244,26 @@ class DeflateCompressor(Compressor):
         return out
 
 
-class ZstdCompressor(Compressor):
+class ZstdNativeCompressor(_NativeCompressor):
+    """Zstd over the system libzstd, dlopen'd by the C++ layer (the
+    reference's zstd-jni role). Raises at construction when libzstd is
+    absent — the registry falls back to the Python binding."""
+    name = "ZstdCompressor"
+    _prefix = "zstd"
+
+    def __init__(self, level: int = 3):
+        super().__init__()
+        if not self._lib.zstd_available():
+            raise RuntimeError("libzstd unavailable")
+        self.level = level
+
+    def _prepare(self) -> None:
+        # the native level is THREAD-LOCAL; syncing it before every FFI
+        # entry keeps instances with different levels independent
+        self._lib.zstd_set_level(self.level)
+
+
+class ZstdPythonCompressor(Compressor):
     name = "ZstdCompressor"
 
     def __init__(self, level: int = 3):
@@ -250,6 +281,14 @@ class ZstdCompressor(Compressor):
         if len(out) != uncompressed_length:
             raise ValueError("ZstdCompressor: corrupt chunk")
         return out
+
+
+def ZstdCompressor(level: int = 3) -> Compressor:
+    """Factory: native libzstd when present, else the Python binding."""
+    try:
+        return ZstdNativeCompressor(level)
+    except Exception:
+        return ZstdPythonCompressor(level)
 
 
 class NoopCompressor(Compressor):
@@ -271,6 +310,102 @@ _REGISTRY = {
     "ZstdCompressor": ZstdCompressor,
     "NoopCompressor": NoopCompressor,
 }
+
+
+class SegmentPacker:
+    """Front-end over the fused native write path (segment_pack): one
+    GIL-released call does lane delta + order check + compress-or-raw +
+    CRC32 + sequential placement. Returns None from `create` when the
+    codec has no native id (Deflate) or the library is unavailable —
+    callers fall back to the per-block Python chain."""
+
+    _CODEC_IDS = {"NoopCompressor": 0, "LZ4Compressor": 1,
+                  "SnappyCompressor": 2, "ZstdCompressor": 3}
+
+    @classmethod
+    def create(cls, compressor: Compressor) -> "SegmentPacker | None":
+        cid = cls._CODEC_IDS.get(compressor.name)
+        if cid is None:
+            return None
+        if cid == 3 and not isinstance(compressor, ZstdNativeCompressor):
+            return None
+        try:
+            lib = native_build.load()
+        except Exception:
+            return None
+        return cls(lib, cid, getattr(compressor, "level", 0))
+
+    def __init__(self, lib, codec_id: int, zstd_level: int = 0):
+        self._lib = lib
+        self._cid = codec_id
+        self._zstd_level = zstd_level
+        self._u8p = ctypes.POINTER(ctypes.c_uint8)
+        self._i64p = ctypes.POINTER(ctypes.c_int64)
+        self._u32p = ctypes.POINTER(ctypes.c_uint32)
+        self._scratch = np.zeros(0, dtype=np.uint8)
+
+    def pack(self, blocks: list[np.ndarray], attempt: list[bool],
+             max_compressed_length: int, shuffle_block: int,
+             lane_width: int, out: np.ndarray):
+        """Pack `blocks` into `out`. Returns (total, sizes, rawflags,
+        crcs); raises ValueError on an order violation in the shuffled
+        block."""
+        n = len(blocks)
+        arrs = [np.ascontiguousarray(b.reshape(-1).view(np.uint8))
+                for b in blocks]
+        lens = np.array([a.nbytes for a in arrs], dtype=np.int64)
+        if shuffle_block >= 0 and \
+                self._scratch.nbytes < int(lens[shuffle_block]):
+            self._scratch = np.empty(int(lens[shuffle_block]),
+                                     dtype=np.uint8)
+        sizes = np.zeros(n, dtype=np.int64)
+        raws = np.zeros(n, dtype=np.uint8)
+        crcs = np.zeros(n, dtype=np.uint32)
+        att = np.array([1 if a else 0 for a in attempt], dtype=np.uint8)
+        ptrs = (self._u8p * n)(*[a.ctypes.data_as(self._u8p)
+                                 for a in arrs])
+        if self._cid == 3:
+            self._lib.zstd_set_level(self._zstd_level)
+        total = self._lib.segment_pack(
+            self._cid, ptrs, lens.ctypes.data_as(self._i64p), n,
+            att.ctypes.data_as(self._u8p), max_compressed_length,
+            shuffle_block, lane_width,
+            self._scratch.ctypes.data_as(self._u8p),
+            out.ctypes.data_as(self._u8p), out.nbytes,
+            sizes.ctypes.data_as(self._i64p),
+            raws.ctypes.data_as(self._u8p),
+            crcs.ctypes.data_as(self._u32p))
+        if total == -3:
+            raise ValueError("appended cells out of order")
+        if total < 0:
+            raise RuntimeError("segment_pack failed")
+        return int(total), sizes, raws, crcs
+
+
+def lanes_unshuffle(planes: np.ndarray, lanes_out: np.ndarray) -> None:
+    """Byte planes -> [n, K] u32 rows (reader side of the segment_pack
+    shuffle transform)."""
+    n, k = lanes_out.shape
+    if n == 0:
+        return
+    try:
+        lib = native_build.load()
+        lib.lanes_unshuffle(
+            planes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            lanes_out.view(np.uint8).ctypes.data_as(
+                ctypes.POINTER(ctypes.c_uint8)), n, k)
+    except Exception:
+        lanes_out.view(np.uint8).reshape(n, 4 * k)[:] = \
+            planes.reshape(4 * k, n).T
+
+
+def lanes_shuffle(lanes: np.ndarray) -> np.ndarray:
+    """[n, K] u32 rows -> byte planes (numpy path — used by writers that
+    cannot take the fused native call, e.g. encrypted tables)."""
+    n, k = lanes.shape
+    return np.ascontiguousarray(
+        lanes.astype(np.uint32, copy=False).view(np.uint8)
+        .reshape(n, 4 * k).T).ravel()
 _instances: dict[str, Compressor] = {}
 
 

@@ -36,7 +36,7 @@ def _src_hash() -> str:
 def _build(h: str) -> None:
     tmp = f"{_SO}.tmp.{os.getpid()}"  # unique per process: no build races
     cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-           "-o", tmp] + _SRCS
+           "-o", tmp] + _SRCS + ["-lz", "-ldl"]
     subprocess.run(cmd, check=True, capture_output=True)
     os.replace(tmp, _SO)
     with open(_STAMP + f".{os.getpid()}", "w") as f:
@@ -76,28 +76,47 @@ def load() -> ctypes.CDLL:
         u8p = ctypes.POINTER(ctypes.c_uint8)
         i64p = ctypes.POINTER(ctypes.c_int64)
         for fn in ("lz4_compress", "lz4_decompress",
-                   "snappy_compress", "snappy_decompress"):
+                   "snappy_compress", "snappy_decompress",
+                   "zstd_compress", "zstd_decompress"):
             f = getattr(lib, fn)
             f.restype = i64
             f.argtypes = [u8p, i64, u8p, i64]
-        for fn in ("lz4_max_compressed", "snappy_max_compressed"):
+        for fn in ("lz4_max_compressed", "snappy_max_compressed",
+                   "zstd_max_compressed"):
             f = getattr(lib, fn)
             f.restype = i64
             f.argtypes = [i64]
+        lib.zstd_available.restype = i64
+        lib.zstd_available.argtypes = []
+        lib.zstd_set_level.restype = None
+        lib.zstd_set_level.argtypes = [ctypes.c_int]
         for fn in ("lz4_compress_batch", "lz4_decompress_batch",
-                   "snappy_compress_batch", "snappy_decompress_batch"):
+                   "snappy_compress_batch", "snappy_decompress_batch",
+                   "zstd_compress_batch", "zstd_decompress_batch"):
             f = getattr(lib, fn)
             f.restype = i64
             f.argtypes = [u8p, i64p, u8p, i64p, i64p, i64]
         u8pp = ctypes.POINTER(u8p)
-        for fn in ("lz4_compress_iov", "snappy_compress_iov"):
+        for fn in ("lz4_compress_iov", "snappy_compress_iov",
+                   "zstd_compress_iov"):
             f = getattr(lib, fn)
             f.restype = i64
             f.argtypes = [u8pp, i64p, u8p, i64p, i64p, i64]
-        for fn in ("lz4_decompress_iov", "snappy_decompress_iov"):
+        for fn in ("lz4_decompress_iov", "snappy_decompress_iov",
+                   "zstd_decompress_iov"):
             f = getattr(lib, fn)
             f.restype = i64
             f.argtypes = [u8p, i64p, i64p, u8pp, i64p, i64]
+        u32p_ = ctypes.POINTER(ctypes.c_uint32)
+        lib.segment_pack.restype = i64
+        lib.segment_pack.argtypes = [
+            i64, u8pp, i64p, i64,            # codec, blocks, lens, nblocks
+            u8p, i64,                        # attempt, maxCompressedLen
+            i64, i64, u8p,                   # delta_block, lane_width, scratch
+            u8p, i64,                        # out, outCap
+            i64p, u8p, u32p_]                # outSizes, outRaw, outCrcs
+        lib.lanes_unshuffle.restype = None
+        lib.lanes_unshuffle.argtypes = [u8p, u8p, i64, i64]
         lib.gather_frames.restype = i64
         lib.gather_frames.argtypes = [u8p, i64p, i64p, i64, i64p, u8p]
         u32p = ctypes.POINTER(ctypes.c_uint32)

@@ -15,6 +15,10 @@
 #include <cstring>
 #include <cstddef>
 
+#include <dlfcn.h>
+#include <pthread.h>
+#include <zlib.h>
+
 extern "C" {
 
 // ---------------------------------------------------------------- LZ4 -----
@@ -488,6 +492,231 @@ int64_t snappy_decompress_iov(const uint8_t* src, const int64_t* srcOffs,
         if (r != dstLens[i]) return -1;
     }
     return 0;
+}
+
+// ---------------------------------------------------------------- zstd ----
+// Zstd rides the system libzstd (dlopen'd lazily — the reference links
+// zstd-jni the same way: a thin binding over the real library). The
+// symbols used are the stable simple API only.
+
+typedef size_t (*ZSTD_compress_t)(void*, size_t, const void*, size_t, int);
+typedef size_t (*ZSTD_decompress_t)(void*, size_t, const void*, size_t);
+typedef size_t (*ZSTD_compressBound_t)(size_t);
+typedef unsigned (*ZSTD_isError_t)(size_t);
+
+static ZSTD_compress_t p_zstd_compress = nullptr;
+static ZSTD_decompress_t p_zstd_decompress = nullptr;
+static ZSTD_compressBound_t p_zstd_bound = nullptr;
+static ZSTD_isError_t p_zstd_iserr = nullptr;
+static int zstd_state = 0;  // 0 unresolved, 1 ok, -1 unavailable
+
+// first zstd call can come concurrently from a flush writer and a
+// compaction reader — the one-time dlopen/dlsym must not race
+static pthread_once_t zstd_once = PTHREAD_ONCE_INIT;
+
+static void zstd_resolve_once() {
+    void* h = dlopen("libzstd.so.1", RTLD_NOW | RTLD_GLOBAL);
+    if (!h) h = dlopen("libzstd.so", RTLD_NOW | RTLD_GLOBAL);
+    if (h) {
+        p_zstd_compress = (ZSTD_compress_t)dlsym(h, "ZSTD_compress");
+        p_zstd_decompress = (ZSTD_decompress_t)dlsym(h, "ZSTD_decompress");
+        p_zstd_bound = (ZSTD_compressBound_t)dlsym(h, "ZSTD_compressBound");
+        p_zstd_iserr = (ZSTD_isError_t)dlsym(h, "ZSTD_isError");
+    }
+    zstd_state = (p_zstd_compress && p_zstd_decompress && p_zstd_bound &&
+                  p_zstd_iserr) ? 1 : -1;
+}
+
+static int zstd_resolve() {
+    pthread_once(&zstd_once, zstd_resolve_once);
+    return zstd_state;
+}
+
+int64_t zstd_available() { return zstd_resolve() == 1 ? 1 : 0; }
+
+int64_t zstd_max_compressed(int64_t n) {
+    if (zstd_resolve() != 1) return -1;
+    return (int64_t)p_zstd_bound((size_t)n);
+}
+
+// THREAD-LOCAL: each caller sets its level immediately before its codec
+// calls (same thread), so instances with different levels never clobber
+// each other and there is no cross-thread race on the level
+static thread_local int g_zstd_level = 3;
+void zstd_set_level(int level) { g_zstd_level = level; }
+
+int64_t zstd_compress(const uint8_t* src, int64_t srcLen,
+                      uint8_t* dst, int64_t dstCap) {
+    if (zstd_resolve() != 1) return -1;
+    size_t r = p_zstd_compress(dst, (size_t)dstCap, src, (size_t)srcLen,
+                               g_zstd_level);
+    if (p_zstd_iserr(r)) return -1;
+    return (int64_t)r;
+}
+
+int64_t zstd_decompress(const uint8_t* src, int64_t srcLen,
+                        uint8_t* dst, int64_t dstCap) {
+    if (zstd_resolve() != 1) return -1;
+    size_t r = p_zstd_decompress(dst, (size_t)dstCap, src, (size_t)srcLen);
+    if (p_zstd_iserr(r)) return -1;
+    return (int64_t)r;
+}
+
+int64_t zstd_compress_batch(const uint8_t* src, const int64_t* srcOffs,
+                            uint8_t* dst, const int64_t* dstOffs,
+                            int64_t* outSizes, int64_t n) {
+    return run_batch(zstd_compress, src, srcOffs, dst, dstOffs, outSizes, n);
+}
+
+int64_t zstd_decompress_batch(const uint8_t* src, const int64_t* srcOffs,
+                              uint8_t* dst, const int64_t* dstOffs,
+                              int64_t* outSizes, int64_t n) {
+    return run_batch(zstd_decompress, src, srcOffs, dst, dstOffs, outSizes,
+                     n);
+}
+
+int64_t zstd_compress_iov(const uint8_t** srcs, const int64_t* srcLens,
+                          uint8_t* dst, const int64_t* dstOffs,
+                          int64_t* outSizes, int64_t n) {
+    return run_iov(zstd_compress, srcs, srcLens, dst, dstOffs, outSizes, n);
+}
+
+int64_t zstd_decompress_iov(const uint8_t* src, const int64_t* srcOffs,
+                            const int64_t* srcLens, uint8_t** dsts,
+                            const int64_t* dstLens, int64_t n) {
+    for (int64_t i = 0; i < n; i++) {
+        int64_t r = zstd_decompress(src + srcOffs[i], srcLens[i],
+                                    dsts[i], dstLens[i]);
+        if (r != dstLens[i]) return -1;
+    }
+    return 0;
+}
+
+// -------------------------------------------------------- segment pack ----
+// The fused write-path entry point: one GIL-released FFI call per segment
+// does (optional) lane delta-transform + order check, per-block
+// compress-or-store-raw, CRC32, and a sequential copy into `out` — the
+// role of the reference's CompressedSequentialWriter.flushData chain
+// (io/compress/CompressedSequentialWriter.java:140-205) without
+// re-entering Python per block.
+//
+//   codec: 0 noop, 1 lz4, 2 snappy, 3 zstd
+//   blocks/lens: nblocks source buffers
+//   attempt[i]: 0 => store raw without trying (caller's skip heuristic)
+//   maxCompressedLen: min_compress_ratio fallback bound
+//   shuffle_block: index of the block to byte-plane-shuffle as
+//                  u32[lane_width] rows (-1 = none); scratch must hold
+//                  that block. Measured on real lane data: the plane
+//                  layout compresses better AND 1.2-3x faster than
+//                  row-major for lz4 and zstd both (blosc's shuffle
+//                  filter, applied to the identity-lane matrix). Rows
+//                  are also lex order-checked (u32 numeric per column)
+//                  while shuffling — the writer's out-of-order guard.
+//   out/outCap: destination; blocks land back to back
+//   outSizes/outRaw/outCrcs: per-block stored size, raw?, crc32
+// Returns total bytes placed in out; -1 codec/capacity error; -3 order
+// violation inside the shuffled block.
+
+int64_t segment_pack(int64_t codec, const uint8_t** blocks,
+                     const int64_t* lens, int64_t nblocks,
+                     const uint8_t* attempt, int64_t maxCompressedLen,
+                     int64_t shuffle_block, int64_t lane_width,
+                     uint8_t* scratch, uint8_t* out, int64_t outCap,
+                     int64_t* outSizes, uint8_t* outRaw,
+                     uint32_t* outCrcs) {
+    codec_fn fn = nullptr;
+    if (codec == 1) fn = lz4_compress;
+    else if (codec == 2) fn = snappy_compress;
+    else if (codec == 3) { if (zstd_resolve() != 1) return -1;
+                           fn = zstd_compress; }
+    int64_t pos = 0;
+    for (int64_t i = 0; i < nblocks; i++) {
+        const uint8_t* srcp = blocks[i];
+        int64_t srcLen = lens[i];
+        if (i == shuffle_block && lane_width > 0) {
+            int64_t W = 4 * lane_width;          // row bytes
+            int64_t nrows = srcLen / W;
+            // row-tiled transpose: plane starts sit 64KiB-multiples
+            // apart (power-of-two segment sizes), so a row-at-a-time
+            // scatter puts W concurrent write streams in the SAME cache
+            // set and thrashes; per tile only one plane's 4-line window
+            // is hot at a time
+            const int64_t TR = 256;
+            for (int64_t r0 = 0; r0 < nrows; r0 += TR) {
+                int64_t r1 = r0 + TR < nrows ? r0 + TR : nrows;
+                for (int64_t p = 0; p < W; p++) {
+                    uint8_t* d = scratch + p * nrows + r0;
+                    const uint8_t* s = srcp + r0 * W + p;
+                    for (int64_t r = r0; r < r1; r++) {
+                        *d++ = *s;
+                        s += W;
+                    }
+                }
+            }
+            // lexicographic order check (u32 numeric per column)
+            const uint32_t* rows = (const uint32_t*)srcp;
+            for (int64_t r = 1; r < nrows; r++) {
+                const uint32_t* prev = rows + (r - 1) * lane_width;
+                const uint32_t* cur = rows + r * lane_width;
+                for (int64_t c = 0; c < lane_width; c++) {
+                    if (cur[c] != prev[c]) {
+                        if (cur[c] < prev[c]) return -3;
+                        break;
+                    }
+                }
+            }
+            srcp = scratch;
+        }
+        int64_t stored;
+        int raw = 1;
+        if (fn && attempt[i]) {
+            // compress straight into out; cap at the raw length (worse
+            // than raw => store raw) and the min_compress_ratio bound
+            int64_t cap = srcLen < maxCompressedLen ? srcLen
+                                                    : maxCompressedLen;
+            if (cap > outCap - pos) cap = outCap - pos;
+            int64_t r = fn(srcp, srcLen, out + pos, cap);
+            if (r >= 0 && r < srcLen && r < maxCompressedLen) {
+                stored = r;
+                raw = 0;
+            } else {
+                stored = srcLen;
+            }
+        } else {
+            stored = srcLen;
+        }
+        if (raw) {
+            if (srcLen > outCap - pos) return -1;
+            memcpy(out + pos, srcp, srcLen);
+            stored = srcLen;
+        }
+        outSizes[i] = stored;
+        outRaw[i] = (uint8_t)raw;
+        outCrcs[i] = (uint32_t)crc32(0, out + pos, (uInt)stored);
+        pos += stored;
+    }
+    return pos;
+}
+
+// Reader side of segment_pack's shuffle: byte planes -> row-major.
+// planes holds W*nrows bytes (W = 4*lane_width); rows receives the
+// [nrows, lane_width] u32 matrix. W sequential read streams, one
+// sequential write stream.
+void lanes_unshuffle(const uint8_t* planes, uint8_t* rows, int64_t nrows,
+                     int64_t lane_width) {
+    int64_t W = 4 * lane_width;
+    const int64_t TR = 256;   // row-tiled (see shuffle_block note)
+    for (int64_t r0 = 0; r0 < nrows; r0 += TR) {
+        int64_t r1 = r0 + TR < nrows ? r0 + TR : nrows;
+        for (int64_t p = 0; p < W; p++) {
+            const uint8_t* s = planes + p * nrows + r0;
+            uint8_t* d = rows + r0 * W + p;
+            for (int64_t r = r0; r < r1; r++) {
+                *d = *s++;
+                d += W;
+            }
+        }
+    }
 }
 
 // ------------------------------------------------------------ gather -----

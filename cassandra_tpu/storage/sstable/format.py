@@ -27,8 +27,11 @@ import re
 
 SEGMENT_CELLS = 65536  # cells per segment (device batch granularity)
 # bumped on layout changes; "cb": Digest.crc32 holds crc32 over the
-# per-block crc words instead of the raw Data.db byte stream
-FORMAT_VERSION = "cb"
+# per-block crc words instead of the raw Data.db byte stream; "cc": the
+# LANES block is stored byte-plane SHUFFLED (blosc-style filter over the
+# u32 lane matrix — measured better ratio AND 1.2-3x faster codec passes
+# on lz4 and zstd both; readers transpose back)
+FORMAT_VERSION = "cc"
 
 
 class Component:

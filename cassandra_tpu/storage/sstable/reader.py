@@ -36,6 +36,8 @@ class SSTableReader:
         # decoded here carry it as ck_comp when the table is known
         self._table = table
         self.desc = descriptor
+        # "cc"+ stores the LANES block byte-plane shuffled (format.py)
+        self._shuffled_lanes = descriptor.version >= "cc"
         with open(descriptor.path(Component.STATS)) as f:
             self.stats = json.load(f)
         self.K = int(self.stats["n_lanes"])
@@ -232,7 +234,19 @@ class SSTableReader:
         meta = np.empty(uls[0], dtype=np.uint8)
         lanes = np.empty((n, self.K), dtype=np.uint32)
         payload = np.empty(uls[2], dtype=np.uint8)
-        dsts = [meta, lanes, payload]
+        if uls[1] != 4 * n * self.K:
+            # the native unshuffle (and the row view) trust this length;
+            # never let a corrupt/crafted index walk past the allocation
+            raise CorruptSSTableError(
+                f"{self.desc}: segment {i} lanes length {uls[1]} != "
+                f"{4 * n * self.K}")
+        if self._shuffled_lanes:
+            # stored lanes are byte planes; decode lands in scratch and
+            # is unshuffled into the row-major array afterwards
+            lanes_store: np.ndarray = np.empty(uls[1], dtype=np.uint8)
+        else:
+            lanes_store = lanes
+        dsts = [meta, lanes_store, payload]
         iovs = []
         compressed: list[tuple[int, np.ndarray]] = []
         for b in range(3):
@@ -273,6 +287,9 @@ class SSTableReader:
         for b, scratch in compressed:
             self.compressor.decompress_iov(scratch, [0], [cls[b]],
                                            [dsts[b]])
+        if self._shuffled_lanes:
+            from ...ops.codec import lanes_unshuffle
+            lanes_unshuffle(lanes_store, lanes)
 
         ts = meta[:8 * n].view("<i8")
         o = 8 * n
