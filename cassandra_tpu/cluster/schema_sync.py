@@ -1,38 +1,35 @@
-"""Distributed schema agreement — the TCM-lite epoch log.
+"""Distributed schema agreement — the Paxos-backed epoch log (TCM).
 
 Reference counterpart: tcm/ClusterMetadata.java:81 + the log-based
 transformation model (every metadata change is an ordered log entry;
-replicas converge by applying the same entries in the same order).
-Scaled to this framework: the replicated unit is the DDL STATEMENT
-TEXT, ordered by a per-cluster epoch counter.
+replicas converge by applying the same entries in the same order),
+committed through a Paxos-backed processor on a CMS replica group
+(tcm/PaxosBackedProcessor.java:57, tcm/Commit.java). Scaled to this
+framework: the replicated unit is the DDL STATEMENT TEXT (or a
+#topology transformation), ordered by a per-cluster epoch counter.
 
-Serialization model (the TCM analogue of "all transformations commit
-through the CMS leader"): every DDL is COORDINATED BY ONE DESIGNATED
-NODE — the lowest-named live endpoint. A node receiving DDL while not
-designated forwards it (SCHEMA_FORWARD) and applies the resulting entry
-from the ack, so the statement is visible locally when execute()
-returns. With a single coordinator there are no same-epoch collisions
-in steady state; the only race window is a designation handover (the
-old designated node dies mid-broadcast), which the deterministic
-winner rule below repairs.
+Commit model (cluster/cms.py): every epoch slot is decided by
+single-decree Paxos over the CMS replica set (the min(3) lowest-named
+endpoints). A CMS member coordinates directly; any other node forwards
+(SCHEMA_FORWARD) to a live CMS member and applies the acked entry, so
+the statement is visible locally when execute() returns. A minority
+partition CANNOT commit (MetadataUnavailable) — no fork is possible;
+a proposer that loses a slot to a concurrent commit applies the winner
+and retries its own statement at the next slot.
 
-  - Designated node: epoch = local+1, apply locally, append to the
-    durable log, broadcast SCHEMA_PUSH(epoch, ddl) to every peer.
-  - Receiving node: expected epoch -> apply + append; future epoch ->
-    SCHEMA_PULL the gap from the sender (async — the response callback
-    runs on this same dispatch thread later; nothing here may block on
-    a response); stale -> ignore unless it is a same-epoch conflict.
-  - Same-epoch conflict (handover window only): the entry whose
-    coordinator has the HIGHER name owns the epoch everywhere; a node
-    holding the losing entry applies + re-logs the winner, then
-    re-coordinates its displaced statement at a fresh epoch from a
-    separate thread (never from the dispatch thread), carrying the
-    original object ids so every node converges on them.
+  - Learn paths: CMS members apply at Paxos-commit time; all peers get
+    SCHEMA_PUSH(epoch, entry); a node seeing a future epoch pulls the
+    gap (SCHEMA_PULL, async — the response callback runs on the same
+    dispatch thread later; nothing here may block on a response).
   - A (re)starting node replays its persisted log, then pulls anything
     newer from the first live peer.
+  - Same-epoch conflicts cannot be produced by CMS commits; the
+    deterministic winner rule below survives only as tolerance for
+    logs predating the CMS (and screams into stderr if it ever fires).
 
-Enabled for per-process schemas (TCP deployments); LocalCluster shares
-one Schema object in-process and needs no sync.
+Enabled for per-process schemas (TCP deployments and per-node-schema
+test rigs); LocalCluster shares one Schema object in-process and needs
+no sync.
 """
 from __future__ import annotations
 
@@ -143,6 +140,13 @@ class SchemaSync:
         self.epoch = 0
         self._lock = threading.RLock()
         self._load()
+        # statements THIS node already executed locally and is currently
+        # committing through the CMS — learn() must log, not re-apply,
+        # them (the Paxos COMMIT self-delivery arrives before the
+        # coordination path's own learn call)
+        self._inflight_local: set = set()
+        from .cms import CMSService
+        self.cms = CMSService(node, self, directory)
         ms = node.messaging
         ms.register_handler(Verb.SCHEMA_PUSH, self._handle_push)
         ms.register_handler(Verb.SCHEMA_PULL, self._handle_pull)
@@ -193,6 +197,31 @@ class SchemaSync:
         """Last (i.e. winning) record logged at `epoch`, or None."""
         return self._entries.get(epoch)
 
+    def entry_at(self, epoch: int):
+        """Thread-safe committed-entry lookup (CMS prepare fast path)."""
+        with self._lock:
+            return self._entries.get(epoch)
+
+    def learn(self, slot: int, ddict: dict,
+              skip_apply: bool = False) -> None:
+        """Apply a Paxos-DECIDED entry if it is next in sequence.
+        skip_apply: the entry is OUR OWN statement, already executed
+        locally by the coordination path — log it without re-applying.
+        A stale slot is a no-op; a gap is left for push/pull catch-up
+        (the decided value will arrive again there)."""
+        with self._lock:
+            if slot != self.epoch + 1:
+                return
+            q, k, x, c = ddict["q"], ddict["k"], ddict.get("x") or {}, \
+                ddict.get("c")
+            if c == self.node.endpoint.name and q in self._inflight_local:
+                skip_apply = True
+            if skip_apply:
+                self.epoch = slot
+                self._append(slot, q, k, x, coord=c)
+            else:
+                self._apply_entry(slot, q, k, x, coord=c)
+
     # ------------------------------------------------------- application --
 
     def _apply_local(self, query: str, keyspace, extra: dict) -> None:
@@ -235,78 +264,105 @@ class SchemaSync:
 
     # ----------------------------------------------------- coordination --
 
-    def _designated(self):
-        """The one node that serializes DDL: lowest-named live endpoint
-        (the CMS-leader role; re-evaluated per statement so designation
-        fails over with liveness)."""
-        live = [ep for ep in self.node.ring.endpoints
-                if ep == self.node.endpoint or self.node.is_alive(ep)]
-        return min(live, key=lambda e: e.name) if live \
-            else self.node.endpoint
-
     def coordinate(self, query: str, keyspace, stmt, local_exec,
                    extra_override: dict | None = None):
         """Entry point from the CQL processor. Runs on a client/session
         thread (never the messaging dispatch thread), so it MAY block
-        on responses. If this node is not designated, forward and apply
-        the acked entry; fall back to coordinating locally only when
-        the designated node is unreachable."""
-        des = self._designated()
-        if des != self.node.endpoint:
-            pre_epoch = self.epoch
+        on responses. A CMS member commits through Paxos directly; any
+        other node forwards to a live CMS member and applies the acked
+        entry. NO local-commit fallback exists: if no CMS quorum is
+        reachable the statement FAILS (MetadataUnavailable) — a
+        minority partition must not fork the log."""
+        from .cms import MetadataUnavailable
+        members = self.cms.members()
+        if self.node.endpoint in members:
+            return self._coordinate_cms(query, keyspace, stmt,
+                                        local_exec, extra_override)
+        pre_epoch = self.epoch
+        targets = [m for m in members if self.node.is_alive(m)]
+        if not targets:
+            raise MetadataUnavailable(
+                f"no CMS member reachable "
+                f"({[m.name for m in members]} all down)")
+        ambiguous = False
+        for des in targets:
             ack = self._forward(des, query, keyspace, extra_override)
             if ack is None:
-                # AMBIGUOUS: the designated node may have committed the
-                # statement and only the ack was lost. Re-coordinating
-                # a committed CREATE would fork its table id across the
-                # cluster — pull first and, if our exact statement now
-                # appears in the log, it committed: done.
+                ambiguous = True
+                continue     # this member unreachable: try the next
+            epoch, extra = ack
+            with self._lock:
+                behind = epoch > self.epoch + 1
+            if behind:
+                # missed entries: the CMS member has them all (it just
+                # committed `epoch`). Pull OUTSIDE the lock: the
+                # response is processed on the dispatch thread, and
+                # _on_pull_response needs this same lock — a pull
+                # under the lock would deadlock-till-timeout and stall
+                # every message on the node.
                 self.pull_from_peers(timeout=self.FORWARD_TIMEOUT,
                                      prefer=des)
-                if any(rec[1] == query
-                       for rec in self.entries_after(pre_epoch)):
-                    from ..cql.execution import ResultSet
-                    return ResultSet([], [])
-            if ack is not None:
-                epoch, extra = ack
-                with self._lock:
-                    behind = epoch > self.epoch + 1
-                if behind:
-                    # missed entries: the designated node has them all
-                    # (it just appended `epoch`). Pull OUTSIDE the lock:
-                    # the response is processed on the dispatch thread,
-                    # and _on_pull_response needs this same lock — a
-                    # pull under the lock would deadlock-till-timeout
-                    # and stall every message on the node.
-                    self.pull_from_peers(timeout=self.FORWARD_TIMEOUT,
-                                         prefer=des)
-                with self._lock:
-                    if epoch == self.epoch + 1:
-                        self._apply_entry(epoch, query, keyspace,
-                                          extra or {}, coord=des.name)
-                    if self.epoch < epoch:
-                        # committed cluster-wide, but this node could
-                        # not catch up (peers unreachable mid-pull) —
-                        # surface that rather than return success for a
-                        # table this node does not have yet
-                        raise SchemaForwardError(
-                            f"DDL committed at epoch {epoch} but local "
-                            f"catch-up failed (local epoch "
-                            f"{self.epoch}); retry")
+            with self._lock:
+                if epoch == self.epoch + 1:
+                    self._apply_entry(epoch, query, keyspace,
+                                      extra or {}, coord=des.name)
+                if self.epoch < epoch:
+                    # committed cluster-wide, but this node could not
+                    # catch up (peers unreachable mid-pull) — surface
+                    # that rather than return success for a table this
+                    # node does not have yet
+                    raise SchemaForwardError(
+                        f"DDL committed at epoch {epoch} but local "
+                        f"catch-up failed (local epoch "
+                        f"{self.epoch}); retry")
+            from ..cql.execution import ResultSet
+            return ResultSet([], [])   # DDL result shape
+        if ambiguous:
+            # a forward may have committed with only the ack lost.
+            # Re-issuing a committed CREATE would fork its table id —
+            # pull first; if our exact statement now appears, it
+            # committed: done.
+            self.pull_from_peers(timeout=self.FORWARD_TIMEOUT)
+            if any(rec[1] == query
+                   for rec in self.entries_after(pre_epoch)):
                 from ..cql.execution import ResultSet
-                return ResultSet([], [])   # DDL result shape
-            # designated unreachable: coordinate locally (handover)
+                return ResultSet([], [])
+        raise MetadataUnavailable(
+            f"no CMS member answered the DDL forward "
+            f"({[m.name for m in members]})")
+
+    def _coordinate_cms(self, query: str, keyspace, stmt, local_exec,
+                        extra_override: dict | None):
+        """CMS-member commit: execute locally (validation + object-id
+        assignment), then decide the epoch via Paxos. The local
+        execution happens FIRST so semantic errors (bad DDL) surface to
+        the client without touching the log; the Paxos decision then
+        makes the entry durable cluster-wide or fails the statement.
+        A liveness quorum check fails fast BEFORE the local execution,
+        so a minority-side statement normally leaves no local residue
+        (a member dying mid-round can still strand a locally-applied
+        statement — the client sees the error and retries)."""
+        from .cms import MetadataUnavailable
+        members = self.cms.members()
+        need = len(members) // 2 + 1
+        live = [m for m in members
+                if m == self.node.endpoint or self.node.is_alive(m)]
+        if len(live) < need:
+            raise MetadataUnavailable(
+                f"metadata commit needs {need}/{len(members)} CMS "
+                f"members ({[m.name for m in members]}), "
+                f"{len(live)} reachable")
         result = local_exec()
+        extra = extra_override if extra_override is not None \
+            else self._extra_for(stmt, keyspace)
         with self._lock:
-            extra = extra_override if extra_override is not None \
-                else self._extra_for(stmt, keyspace)
-            self.epoch += 1
-            self._append(self.epoch, query, keyspace, extra)
-            epoch = self.epoch
-        for ep in list(self.node.ring.endpoints):
-            if ep != self.node.endpoint:
-                self.node.messaging.send_one_way(
-                    Verb.SCHEMA_PUSH, (epoch, query, keyspace, extra), ep)
+            self._inflight_local.add(query)
+        try:
+            self.cms.commit_entry(query, keyspace, extra,
+                                  already_applied=True)
+        finally:
+            with self._lock:
+                self._inflight_local.discard(query)
         return result
 
     def _forward(self, des, query: str, keyspace, extra_override):
@@ -339,30 +395,45 @@ class SchemaSync:
     # ---------------------------------------------------------- handlers --
 
     def _handle_forward(self, msg):
-        """Designated-coordinator side of a forwarded DDL. Runs on the
-        dispatch thread: applies + logs + broadcasts, all non-blocking,
-        then acks (epoch, extra) to the origin."""
+        """CMS-member side of a forwarded DDL. The Paxos commit BLOCKS
+        on quorum responses, so the work runs on a worker thread and
+        the ack is sent asynchronously (messaging.respond) — the
+        dispatch thread must stay free to process the very promise/
+        accept responses the commit is waiting for."""
         query, keyspace, fwd_extra = msg.payload
-        from ..cql.parser import parse
-        with self._lock:
+
+        def run():
+            from ..cql.parser import parse
             try:
+                if not self.cms.is_member():
+                    raise SchemaForwardError(
+                        f"{self.node.endpoint.name} is not a CMS "
+                        f"member")
                 extra = fwd_extra or {}
-                if query.startswith(TOPOLOGY_PREFIX):
-                    self._apply_local(query, keyspace, extra)
-                else:
-                    stmt = parse(query)
-                    self._apply_local(query, keyspace, extra)
-                    extra = extra or self._extra_for(stmt, keyspace)
+                with self._lock:
+                    if query.startswith(TOPOLOGY_PREFIX):
+                        self._apply_local(query, keyspace, extra)
+                    else:
+                        stmt = parse(query)
+                        self._apply_local(query, keyspace, extra)
+                        extra = extra or self._extra_for(stmt, keyspace)
+                    self._inflight_local.add(query)
+                try:
+                    epoch = self.cms.commit_entry(
+                        query, keyspace, extra, already_applied=True)
+                finally:
+                    with self._lock:
+                        self._inflight_local.discard(query)
             except Exception as e:
-                return Verb.SCHEMA_FORWARD, ("err", repr(e), None)
-            self.epoch += 1
-            self._append(self.epoch, query, keyspace, extra)
-            epoch = self.epoch
-        for ep in list(self.node.ring.endpoints):
-            if ep != self.node.endpoint and ep != msg.sender:
-                self.node.messaging.send_one_way(
-                    Verb.SCHEMA_PUSH, (epoch, query, keyspace, extra), ep)
-        return Verb.SCHEMA_FORWARD, ("ok", epoch, extra)
+                self.node.messaging.respond(
+                    msg, Verb.SCHEMA_FORWARD, ("err", repr(e), None))
+                return
+            self.node.messaging.respond(
+                msg, Verb.SCHEMA_FORWARD, ("ok", epoch, extra))
+
+        threading.Thread(target=run, daemon=True,
+                         name="schema-forward").start()
+        return None
 
     def _handle_push(self, msg):
         epoch, query, keyspace, extra = msg.payload
@@ -391,15 +462,23 @@ class SchemaSync:
 
     def _adopt_winner_locked(self, epoch, query, keyspace, extra,
                              coord: str):
-        """Same-epoch conflict (designation-handover window only): the
-        entry whose coordinator has the HIGHER name owns the epoch,
-        deterministically at every node. Adopts the winner and returns
-        our displaced entry (for re-coordination), or None if the
-        incoming entry is stale/identical/losing. Caller holds _lock."""
+        """Same-epoch conflict resolution. With the CMS (cluster/cms.py)
+        every epoch is Paxos-decided, so two nodes holding DIFFERENT
+        entries at one epoch is impossible for CMS-committed logs —
+        this path survives only as tolerance for logs predating the CMS
+        and is LOUD when it fires (it would indicate log corruption or
+        a mixed-version cluster). The entry whose coordinator has the
+        HIGHER name wins deterministically; returns our displaced entry
+        (for re-coordination) or None. Caller holds _lock."""
         mine = self._entry_at(epoch)
         if mine is None or mine[1] == query \
                 or (coord or "") <= (mine[4] or ""):
             return None
+        print(f"[schema-sync] {self.node.endpoint.name}: SAME-EPOCH "
+              f"CONFLICT at {epoch} ({mine[1]!r} vs {query!r}) — "
+              f"impossible for CMS-committed logs; adopting "
+              f"deterministic winner. Investigate log integrity.",
+              file=sys.stderr)
         self._apply_entry(epoch, query, keyspace, extra or {},
                           coord=coord)
         return mine

@@ -271,15 +271,14 @@ def test_ddl_replicates_across_processes(tmp_path):
                     p.kill()
 
 
-# -------------------------------------------- designated DDL coordinator --
+# ------------------------------------------------ CMS-committed DDL --
 
-def test_ddl_forwarded_to_designated_coordinator(tmp_path):
-    """Schema sync serializes DDL through ONE node (lowest live name,
-    the CMS-leader role): a statement issued on another node is
-    forwarded, the entry is applied locally from the ack (visible the
-    moment execute() returns), and the log on every node records the
-    DESIGNATED node as coordinator — the name the same-epoch conflict
-    rule compares against."""
+def test_ddl_commits_through_cms(tmp_path):
+    """Every DDL epoch is decided by Paxos over the CMS replica set
+    (min(3) lowest-named nodes — cluster/cms.py). A NON-member issues a
+    statement: it is forwarded to a CMS member, Paxos-committed, applied
+    locally from the ack (visible the moment execute() returns), and
+    every node's log records the committing CMS member as coordinator."""
     import time as _t
 
     from cassandra_tpu.cluster.messaging import LocalTransport
@@ -288,9 +287,9 @@ def test_ddl_forwarded_to_designated_coordinator(tmp_path):
     from cassandra_tpu.cluster.schema_sync import SchemaSync
     from cassandra_tpu.schema import Schema
 
-    eps = [Endpoint(n, host="127.0.0.1", port=0)
-           for n in ("node1", "node2")]
-    tokens = even_tokens(2, vnodes=4)
+    names = ("node1", "node2", "node3", "node4")
+    eps = [Endpoint(n, host="127.0.0.1", port=0) for n in names]
+    tokens = even_tokens(4, vnodes=4)
     transport = LocalTransport()
     ring = Ring()
     for ep, toks in zip(eps, tokens):
@@ -304,35 +303,45 @@ def test_ddl_forwarded_to_designated_coordinator(tmp_path):
             n.schema_sync = SchemaSync(n, str(tmp_path / ep.name))
             n.gossiper.start()
             nodes.append(n)
+        cms_names = {m.name
+                     for m in nodes[0].schema_sync.cms.members()}
+        assert cms_names == {"node1", "node2", "node3"}
         deadline = _t.time() + 10
         while _t.time() < deadline:
-            if nodes[1].is_alive(eps[0]) and nodes[0].is_alive(eps[1]):
+            if all(nodes[3].is_alive(e) for e in eps[:3]):
                 break
             _t.sleep(0.05)
 
-        s = nodes[1].session()   # NOT the designated node
+        s = nodes[3].session()   # NOT a CMS member: must forward
         s.execute("CREATE KEYSPACE ks WITH replication = "
                   "{'class': 'SimpleStrategy', 'replication_factor': 2}")
         s.execute("CREATE TABLE ks.kv (k int PRIMARY KEY, v text)")
 
-        # synchronously visible on the issuing node, and on the
-        # designated node which coordinated it
-        t_origin = nodes[1].schema.get_table("ks", "kv")
-        t_des = nodes[0].schema.get_table("ks", "kv")
-        assert t_origin.id == t_des.id      # coordinator-assigned id
-        assert nodes[0].schema_sync.epoch == 2
-        assert nodes[1].schema_sync.epoch == 2
-        # both logs name the designated node as the epoch's coordinator
-        for n in nodes:
-            assert n.schema_sync._entry_at(2)[4] == "node1"
+        # synchronously visible on the issuing node with the
+        # coordinator-assigned table id
+        t_origin = nodes[3].schema.get_table("ks", "kv")
+        assert nodes[3].schema_sync.epoch == 2
+        # the committing CMS member applied it too and both logs agree
+        # on the coordinator (a CMS member, never the issuer)
+        deadline = _t.time() + 10
+        while _t.time() < deadline:
+            try:
+                if all(n.schema_sync.epoch >= 2 for n in nodes[:3]):
+                    break
+            except Exception:
+                pass
+            _t.sleep(0.05)
+        for n in nodes[:3]:
+            assert n.schema.get_table("ks", "kv").id == t_origin.id
+        coords = {n.schema_sync._entry_at(2)[4] for n in nodes}
+        assert len(coords) == 1 and coords < cms_names | {None}, coords
 
         # prepared DDL coordinates identically (no local-only bypass)
         qid = s.prepare("CREATE TABLE ks.kv2 (k int PRIMARY KEY)")
         s.execute_prepared(qid)
-        assert nodes[0].schema.get_table("ks", "kv2").id \
-            == nodes[1].schema.get_table("ks", "kv2").id
-        assert nodes[0].schema_sync.epoch == 3
-        assert nodes[1].schema_sync.epoch == 3
+        assert nodes[3].schema.get_table("ks", "kv2").id \
+            == nodes[0].schema.get_table("ks", "kv2").id
+        assert nodes[3].schema_sync.epoch == 3
     finally:
         for n in nodes:
             n.engine.close()
