@@ -22,11 +22,18 @@ The replica-side promise/accept/commit state reuses the LWT machinery
 as the partition key, in its own durable log directory (cms_paxos/) —
 the system.paxos-for-TCM role of tcm/log/.
 
-CMS membership: the min(3) lowest-named endpoints of the (log-derived)
-ring — deterministic at every node that has applied the same log prefix.
-Membership therefore moves only when one of those nodes joins/leaves,
-itself a logged (i.e. Paxos-committed) transformation, mirroring how the
-reference reconfigures the CMS through the log it guards.
+CMS membership: the min(3) lowest-named FULLY-JOINED endpoints of the
+log-materialized ring (SchemaSync.cms_members) — deterministic at every
+node that has applied the same log prefix, and captured ATOMICALLY with
+the slot number for each proposal (SchemaSync.snapshot_for_commit), so
+two proposers of the same slot always use the same member set and their
+quorums intersect. Pending joiners are excluded until their finish_join
+entry commits: membership moves only at a committed log entry, and the
+OLD set decides the slot that admits the newcomer — mirroring how the
+reference reconfigures the CMS explicitly through the log it guards
+(tcm/membership/, tcm/ClusterMetadataService.java). Commit-then-apply:
+nothing executes locally before the Paxos decision; the proposer applies
+its own entry through the same COMMIT/learn path as every replica.
 """
 from __future__ import annotations
 
@@ -81,13 +88,15 @@ class CMSService:
     # ----------------------------------------------------------- members --
 
     def members(self) -> list:
-        """The CMS replica set: min(3) lowest-named ring endpoints —
-        deterministic for every node at the same log prefix. A node with
-        an empty ring (bootstrap) is its own CMS."""
-        eps = sorted(self.node.ring.endpoints, key=lambda e: e.name)
-        if not eps:
-            return [self.node.endpoint]
-        return eps[:CMS_SIZE]
+        """The CMS replica set as-of THIS node's applied log prefix —
+        log-DERIVED, not live-ring-derived (SchemaSync.cms_members):
+        pending joiners are not eligible until their finish_join entry
+        commits, so the set moves only at a committed log entry and
+        the OLD set decides the slot that admits a newcomer. Proposals
+        capture (slot, members) atomically via
+        SchemaSync.snapshot_for_commit so two proposers of one slot
+        always share a member set (intersecting quorums)."""
+        return self.sync.cms_members()
 
     def is_member(self) -> bool:
         return self.node.endpoint in self.members()
@@ -220,11 +229,13 @@ class CMSService:
             CMSService._last_ballot_ts = ts
         return Ballot(ts, self.node.endpoint.name)
 
-    def _paxos_slot(self, slot: int, value: bytes) -> bytes:
-        """Decide slot: returns the DECIDED value bytes (ours, or the
-        winner we must apply instead). Raises MetadataUnavailable when a
-        quorum cannot be reached."""
-        members = self.members()
+    def _paxos_slot(self, slot: int, value: bytes,
+                    members: list) -> bytes:
+        """Decide slot among `members` (the set the caller captured
+        atomically with the slot number — see snapshot_for_commit):
+        returns the DECIDED value bytes (ours, or the winner we must
+        apply instead). Raises MetadataUnavailable when a quorum
+        cannot be reached."""
         need = len(members) // 2 + 1
         last_err = None
         for attempt in range(self.MAX_BALLOT_ATTEMPTS):
@@ -277,38 +288,51 @@ class CMSService:
             f"CMS slot {slot}: ballot contention exhausted")
 
     def commit_entry(self, query: str, keyspace, extra: dict,
-                     already_applied: bool = True) -> int:
+                     revalidate=None) -> int:
         """Commit (query, keyspace, extra) at the next free epoch.
+        COMMIT-THEN-APPLY: the caller must NOT have executed the
+        statement — the decided entry applies via the COMMIT
+        self-delivery (sync.learn), the same path every replica takes.
         Losing a slot to a concurrent commit applies the winner and
-        retries at the next slot. Returns the epoch ours landed at.
-        `already_applied`: the caller executed the statement locally
-        (validation + object-id assignment) — skip re-applying OUR
-        entry, only log it."""
-        me = self.node.endpoint.name
+        retries at the next slot (with a re-snapshotted member set —
+        the lost slot may have changed CMS membership). `revalidate`
+        (no-arg callable raising on semantic error) re-checks the
+        statement against the just-applied winner before each retry:
+        without it, losing CREATE TABLE t to a concurrent CREATE
+        TABLE t would commit a permanently-doomed duplicate entry that
+        every node (and every future replay) fails to apply. Returns
+        the epoch ours landed at."""
         # normalize through JSON so equality with a decided value is
         # type-faithful (tuples become lists etc.)
         value_dict = json.loads(json.dumps(
-            {"q": query, "k": keyspace, "x": extra or {}, "c": me},
-            sort_keys=True))
+            {"q": query, "k": keyspace, "x": extra or {},
+             "c": self.node.endpoint.name}, sort_keys=True))
         value = json.dumps(value_dict, sort_keys=True).encode()
         for _ in range(self.MAX_SLOT_ATTEMPTS):
-            slot = self.sync.epoch + 1
-            decided = self._paxos_slot(slot, value)
+            slot, members = self.sync.snapshot_for_commit()
+            decided = self._paxos_slot(slot, value, members)
             ddict = json.loads(decided)
-            mine = ddict == value_dict
-            self.sync.learn(slot, ddict,
-                            skip_apply=mine and already_applied)
+            self.sync.learn(slot, ddict)
             self._push_entry(slot, ddict)
-            if mine:
+            if ddict == value_dict:
                 return slot
-            # lost the slot: the winner is applied; ours retries next
+            # lost the slot: the winner is applied; ours retries next —
+            # unless the winner invalidated it (raises to the client)
+            if revalidate is not None:
+                revalidate()
         raise MetadataUnavailable(
             f"lost {self.MAX_SLOT_ATTEMPTS} consecutive metadata slots")
 
     def _push_entry(self, slot: int, ddict: dict) -> None:
-        """Broadcast the committed entry to every peer (non-CMS nodes
-        learn from this push; stragglers pull)."""
-        for ep in list(self.node.ring.endpoints):
+        """Broadcast the committed entry to every peer — including
+        PENDING joiners and replacements (a mid-join node must track
+        the log it is about to become part of; reference
+        tcm/log/LocalLog replication reaches registered-but-not-joined
+        nodes). Non-CMS nodes learn from this push; stragglers pull."""
+        ring = self.node.ring
+        targets = set(ring.endpoints) | set(ring.pending) \
+            | set(ring.replacing)
+        for ep in targets:
             if ep != self.node.endpoint:
                 self.node.messaging.send_one_way(
                     Verb.SCHEMA_PUSH,

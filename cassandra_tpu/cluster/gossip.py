@@ -87,6 +87,10 @@ class Gossiper:
         self._thread: threading.Thread | None = None
         self.on_alive = None    # callbacks for hint replay etc.
         self.on_dead = None
+        # called with (ep, app_states) when a peer's versioned state
+        # advances — schema-epoch anti-entropy etc. Invoked OUTSIDE the
+        # gossip lock; must not block (dispatch thread).
+        self.on_app_state = None
         messaging.register_handler(Verb.GOSSIP_SYN, self._handle_syn)
         messaging.register_handler(Verb.GOSSIP_ACK, self._handle_ack_msg)
 
@@ -102,24 +106,32 @@ class Gossiper:
 
     def _merge(self, digest: dict) -> None:
         now = self.clock()
+        advanced = []
         with self._lock:
             for name, (ep, gen, ver, apps) in digest.items():
+                if ep == self.ep:
+                    continue
                 st = self.states.get(ep)
                 if st is None:
                     st = EndpointState(generation=gen, version=ver,
                                        app_states=apps)
                     self.states[ep] = st
                     self.detector.report(ep, st, now)
+                    advanced.append((ep, dict(st.app_states)))
                 elif (gen, ver) > (st.generation, st.version):
                     gen_advance = gen > st.generation
                     st.generation, st.version = gen, ver
                     st.app_states.update(apps)
                     self.detector.report(ep, st, now)
+                    advanced.append((ep, dict(st.app_states)))
                     if not st.alive and (not st.forced_down or gen_advance):
                         st.alive = True
                         st.forced_down = False
                         if self.on_alive:
                             self.on_alive(ep)
+        if self.on_app_state:
+            for ep, apps in advanced:
+                self.on_app_state(ep, apps)
 
     def _handle_syn(self, msg):
         self._merge(msg.payload)

@@ -37,6 +37,7 @@ import json
 import os
 import sys
 import threading
+import time
 
 from .messaging import Verb
 
@@ -140,17 +141,27 @@ class SchemaSync:
         self.epoch = 0
         self._lock = threading.RLock()
         self._load()
-        # statements THIS node already executed locally and is currently
-        # committing through the CMS — learn() must log, not re-apply,
-        # them (the Paxos COMMIT self-delivery arrives before the
-        # coordination path's own learn call)
-        self._inflight_local: set = set()
+        # epoch -> exception raised applying that entry locally; the
+        # coordinator pops its own slot to surface the error to the
+        # client (commit-then-apply: application happens after the
+        # Paxos decision, so errors can no longer surface from a
+        # pre-commit local execution). Bounded — see _apply_entry.
+        self._apply_errors: dict[int, Exception] = {}
         from .cms import CMSService
         self.cms = CMSService(node, self, directory)
         ms = node.messaging
         ms.register_handler(Verb.SCHEMA_PUSH, self._handle_push)
         ms.register_handler(Verb.SCHEMA_PULL, self._handle_pull)
         ms.register_handler(Verb.SCHEMA_FORWARD, self._handle_forward)
+        # epoch anti-entropy (tcm PeerLogFetcher role): the epoch rides
+        # gossip app-state; a node seeing a peer ahead pulls the gap —
+        # so a straggler that missed a push AND had its one gap-pull
+        # time out still converges within a gossip round
+        self._pulling = False
+        g = getattr(node, "gossiper", None)
+        if g is not None:
+            g.on_app_state = self._on_peer_app_state
+            self._publish_epoch()
 
     # ------------------------------------------------------------- log --
 
@@ -185,6 +196,33 @@ class SchemaSync:
         self._entries[epoch] = (epoch, query, keyspace, extra or {},
                                 coord)
 
+    def _publish_epoch(self) -> None:
+        """Advertise the applied epoch in gossip app-state (catch-up
+        signal for _on_peer_app_state on peers)."""
+        g = getattr(self.node, "gossiper", None)
+        if g is None:
+            return
+        with g._lock:
+            g.states[g.ep].app_states["schema_epoch"] = self.epoch
+
+    def _on_peer_app_state(self, ep, apps: dict) -> None:
+        """Gossip says `ep` has applied a newer epoch than ours: pull
+        the gap on a worker thread (this callback runs on the dispatch
+        thread and must not block). One pull in flight at a time."""
+        pe = apps.get("schema_epoch")
+        if pe is None or int(pe) <= self.epoch or self._pulling:
+            return
+        self._pulling = True
+
+        def run():
+            try:
+                self.pull_from_peers(timeout=5.0, prefer=ep)
+            finally:
+                self._pulling = False
+
+        threading.Thread(target=run, daemon=True,
+                         name="schema-antientropy").start()
+
     def entries_after(self, epoch: int) -> list[tuple]:
         """Entries newer than `epoch`, ONE record per epoch: an epoch
         rewritten by conflict resolution keeps only its LAST (winning)
@@ -202,25 +240,52 @@ class SchemaSync:
         with self._lock:
             return self._entries.get(epoch)
 
-    def learn(self, slot: int, ddict: dict,
-              skip_apply: bool = False) -> None:
+    def learn(self, slot: int, ddict: dict) -> None:
         """Apply a Paxos-DECIDED entry if it is next in sequence.
-        skip_apply: the entry is OUR OWN statement, already executed
-        locally by the coordination path — log it without re-applying.
-        A stale slot is a no-op; a gap is left for push/pull catch-up
-        (the decided value will arrive again there)."""
+        COMMIT-THEN-APPLY: this is the ONLY place CMS-committed entries
+        execute, for the proposer and replicas alike — nothing runs
+        locally before the decision (reference
+        tcm/ClusterMetadataService.java commit-then-apply). A stale
+        slot is a no-op; a gap is left for push/pull catch-up (the
+        decided value will arrive again there)."""
         with self._lock:
             if slot != self.epoch + 1:
                 return
-            q, k, x, c = ddict["q"], ddict["k"], ddict.get("x") or {}, \
-                ddict.get("c")
-            if c == self.node.endpoint.name and q in self._inflight_local:
-                skip_apply = True
-            if skip_apply:
-                self.epoch = slot
-                self._append(slot, q, k, x, coord=c)
-            else:
-                self._apply_entry(slot, q, k, x, coord=c)
+            self._apply_entry(slot, ddict["q"], ddict["k"],
+                              ddict.get("x") or {},
+                              coord=ddict.get("c"))
+
+    # ------------------------------------------------- CMS membership --
+
+    def cms_members(self) -> list:
+        """CMS replica set as-of THIS node's applied log prefix."""
+        with self._lock:
+            return self._cms_members_locked()
+
+    def _cms_members_locked(self) -> list:
+        """The min(CMS_SIZE) lowest-named FULLY-JOINED endpoints of the
+        log-materialized ring. Pending joiners/replacements are NOT
+        eligible until their finish_join/finish_replace entry commits,
+        so the set changes only at a committed log entry and the OLD
+        set decides the slot that admits the newcomer — the reference's
+        explicit logged CMS reconfiguration (tcm/membership/, the old
+        set votes the handover). Caller holds _lock (ring mutations
+        happen under it, via _apply_entry)."""
+        from .cms import CMS_SIZE
+        eps = sorted(self.node.ring.endpoints, key=lambda e: e.name)
+        if not eps:
+            return [self.node.endpoint]
+        return eps[:CMS_SIZE]
+
+    def snapshot_for_commit(self) -> tuple:
+        """(next slot, CMS member set) captured atomically under the
+        log lock: slot N is ALWAYS decided by the member set the log
+        prefix N-1 materializes. Two proposers of the same slot hold
+        the same prefix, hence the same set — their quorums intersect
+        even across a membership change (the non-intersecting-quorum
+        hazard of reading the live ring mid-flight)."""
+        with self._lock:
+            return self.epoch + 1, self._cms_members_locked()
 
     # ------------------------------------------------------- application --
 
@@ -247,24 +312,100 @@ class SchemaSync:
         # any distributed fan-out path
         Executor(self.node.engine).execute(stmt, keyspace=keyspace)
 
-    def _extra_for(self, stmt, keyspace) -> dict:
-        """After the coordinator applied the DDL: the ids peers must
-        reuse."""
+    def _preassign_extra(self, stmt, keyspace) -> dict:
+        """Object ids assigned BEFORE the Paxos commit, so the decided
+        entry carries them and every node — including the coordinator,
+        which applies only after the decision — creates the object with
+        the same id (mutations route by table id). Reference: tcm
+        transformations carry the ids they assign."""
         if stmt is None:
             return {}
         name = type(stmt).__name__
+        if name not in ("CreateTableStatement", "CreateViewStatement"):
+            return {}
+        ks = stmt.keyspace or keyspace
         try:
-            if name in ("CreateTableStatement", "CreateViewStatement"):
-                ks = stmt.keyspace or keyspace
-                return {"table_id":
-                        str(self.node.schema.get_table(ks, stmt.name).id)}
-        except KeyError:
+            # IF NOT EXISTS over an existing object keeps its id
+            return {"table_id":
+                    str(self.node.schema.get_table(ks, stmt.name).id)}
+        except Exception:
             pass
-        return {}
+        if name == "CreateTableStatement" and "id" in (stmt.options or {}):
+            return {"table_id": str(stmt.options["id"])}
+        import uuid
+        return {"table_id": str(uuid.uuid4())}
+
+    def _validate_ddl(self, stmt, keyspace) -> None:
+        """Semantic pre-checks run BEFORE the Paxos commit. Under
+        commit-then-apply nothing executes locally until the slot is
+        decided, so errors the old flow surfaced from its pre-commit
+        local execution must be caught here or they would pollute the
+        committed log. Mirrors the _exec_* guard prefixes
+        (cql/execution.py) for the common cases; anything subtler
+        surfaces from the post-commit application — deterministically,
+        on every node — via _apply_errors."""
+        if stmt is None:
+            return
+        from ..cql.execution import InvalidRequest
+        schema = self.node.schema
+        name = type(stmt).__name__
+        if name == "CreateKeyspaceStatement":
+            if stmt.name in schema.keyspaces and not stmt.if_not_exists:
+                raise InvalidRequest(f"keyspace {stmt.name} exists")
+        elif name == "CreateTableStatement":
+            ks = stmt.keyspace or keyspace
+            if ks is None:
+                raise InvalidRequest("no keyspace for CREATE TABLE")
+            if ks not in schema.keyspaces:
+                raise InvalidRequest(f"unknown keyspace {ks}")
+            if stmt.name in schema.keyspaces[ks].tables \
+                    and not stmt.if_not_exists:
+                raise InvalidRequest(f"table {ks}.{stmt.name} exists")
+            if not stmt.partition_key:
+                raise InvalidRequest("missing PRIMARY KEY")
+        elif name == "CreateViewStatement":
+            ks = stmt.keyspace or keyspace
+            bks = stmt.base_keyspace or keyspace
+            if ks is None or bks is None:
+                raise InvalidRequest(
+                    "no keyspace for CREATE MATERIALIZED VIEW")
+            if (ks, stmt.name) in getattr(schema, "views", {}) \
+                    and not stmt.if_not_exists:
+                raise InvalidRequest(f"view {ks}.{stmt.name} exists")
+            try:
+                schema.get_table(bks, stmt.base_table)
+            except KeyError as e:
+                raise InvalidRequest(str(e))
+        elif name == "AlterTableStatement":
+            ks = stmt.keyspace or keyspace
+            if ks is None:
+                raise InvalidRequest("no keyspace specified")
+            try:
+                schema.get_table(ks, stmt.name)
+            except KeyError as e:
+                raise InvalidRequest(str(e))
+        elif name == "CreateIndexStatement":
+            ks = stmt.keyspace or keyspace
+            if ks is None:
+                raise InvalidRequest("no keyspace specified")
+            try:
+                schema.get_table(ks, stmt.table)
+            except KeyError as e:
+                raise InvalidRequest(str(e))
+        elif name == "DropStatement" and not stmt.if_exists:
+            ks = stmt.keyspace or keyspace
+            if stmt.what == "keyspace":
+                if stmt.name not in schema.keyspaces:
+                    raise InvalidRequest(f"unknown keyspace {stmt.name}")
+            elif stmt.what == "table" and ks is not None:
+                try:
+                    schema.get_table(ks, stmt.name)
+                except KeyError as e:
+                    raise InvalidRequest(str(e))
 
     # ----------------------------------------------------- coordination --
 
-    def coordinate(self, query: str, keyspace, stmt, local_exec,
+    def coordinate(self, query: str, keyspace, stmt,
                    extra_override: dict | None = None):
         """Entry point from the CQL processor. Runs on a client/session
         thread (never the messaging dispatch thread), so it MAY block
@@ -277,7 +418,7 @@ class SchemaSync:
         members = self.cms.members()
         if self.node.endpoint in members:
             return self._coordinate_cms(query, keyspace, stmt,
-                                        local_exec, extra_override)
+                                        extra_override)
         pre_epoch = self.epoch
         targets = [m for m in members if self.node.is_alive(m)]
         if not targets:
@@ -331,19 +472,20 @@ class SchemaSync:
             f"no CMS member answered the DDL forward "
             f"({[m.name for m in members]})")
 
-    def _coordinate_cms(self, query: str, keyspace, stmt, local_exec,
+    def _coordinate_cms(self, query: str, keyspace, stmt,
                         extra_override: dict | None):
-        """CMS-member commit: execute locally (validation + object-id
-        assignment), then decide the epoch via Paxos. The local
-        execution happens FIRST so semantic errors (bad DDL) surface to
-        the client without touching the log; the Paxos decision then
-        makes the entry durable cluster-wide or fails the statement.
-        A liveness quorum check fails fast BEFORE the local execution,
-        so a minority-side statement normally leaves no local residue
-        (a member dying mid-round can still strand a locally-applied
-        statement — the client sees the error and retries)."""
+        """CMS-member commit — COMMIT-THEN-APPLY (reference
+        tcm/ClusterMetadataService.java: transformations apply only
+        after the log commit). The statement is validated and its
+        object ids assigned up front, but NOTHING executes locally
+        until the Paxos decision: local application happens as this
+        node's own COMMIT self-delivery inside commit_entry
+        (cms._handle_commit -> learn). A member dying mid-round
+        therefore strands no locally-applied residue. A liveness
+        quorum check fails fast so a minority-side statement is
+        refused before any Paxos traffic."""
         from .cms import MetadataUnavailable
-        members = self.cms.members()
+        _slot, members = self.snapshot_for_commit()
         need = len(members) // 2 + 1
         live = [m for m in members
                 if m == self.node.endpoint or self.node.is_alive(m)]
@@ -352,18 +494,19 @@ class SchemaSync:
                 f"metadata commit needs {need}/{len(members)} CMS "
                 f"members ({[m.name for m in members]}), "
                 f"{len(live)} reachable")
-        result = local_exec()
+        self._validate_ddl(stmt, keyspace)
         extra = extra_override if extra_override is not None \
-            else self._extra_for(stmt, keyspace)
+            else self._preassign_extra(stmt, keyspace)
+        epoch = self.cms.commit_entry(
+            query, keyspace, extra,
+            revalidate=(None if stmt is None
+                        else lambda: self._validate_ddl(stmt, keyspace)))
         with self._lock:
-            self._inflight_local.add(query)
-        try:
-            self.cms.commit_entry(query, keyspace, extra,
-                                  already_applied=True)
-        finally:
-            with self._lock:
-                self._inflight_local.discard(query)
-        return result
+            err = self._apply_errors.pop(epoch, None)
+        if err is not None:
+            raise err
+        from ..cql.execution import ResultSet
+        return ResultSet([], [])   # DDL result shape
 
     def _forward(self, des, query: str, keyspace, extra_override):
         """Send the DDL to the designated node; block for its ack.
@@ -410,20 +553,23 @@ class SchemaSync:
                         f"{self.node.endpoint.name} is not a CMS "
                         f"member")
                 extra = fwd_extra or {}
+                # commit-then-apply, same as _coordinate_cms: validate
+                # + pre-assign ids, commit via Paxos, let the COMMIT
+                # self-delivery apply — no pre-decision local residue
+                revalidate = None
+                if not query.startswith(TOPOLOGY_PREFIX):
+                    stmt = parse(query)
+                    self._validate_ddl(stmt, keyspace)
+                    if not extra:
+                        extra = self._preassign_extra(stmt, keyspace)
+                    revalidate = \
+                        lambda: self._validate_ddl(stmt, keyspace)
+                epoch = self.cms.commit_entry(query, keyspace, extra,
+                                              revalidate=revalidate)
                 with self._lock:
-                    if query.startswith(TOPOLOGY_PREFIX):
-                        self._apply_local(query, keyspace, extra)
-                    else:
-                        stmt = parse(query)
-                        self._apply_local(query, keyspace, extra)
-                        extra = extra or self._extra_for(stmt, keyspace)
-                    self._inflight_local.add(query)
-                try:
-                    epoch = self.cms.commit_entry(
-                        query, keyspace, extra, already_applied=True)
-                finally:
-                    with self._lock:
-                        self._inflight_local.discard(query)
+                    err = self._apply_errors.pop(epoch, None)
+                if err is not None:
+                    raise err
             except Exception as e:
                 self.node.messaging.respond(
                     msg, Verb.SCHEMA_FORWARD, ("err", repr(e), None))
@@ -492,8 +638,7 @@ class SchemaSync:
 
         def run():
             try:
-                self.coordinate(q, k, None, lambda: None,
-                                extra_override=x)
+                self.coordinate(q, k, None, extra_override=x)
             except Exception as e:
                 # the statement's local side effects exist but it lost
                 # its epoch and could not be re-committed — tell the
@@ -543,12 +688,25 @@ class SchemaSync:
             # still advances the epoch — convergence over strictness,
             # matching pre-TCM schema-merge behaviour. But NOT silently:
             # e.g. CREATE TRIGGER fails on a node missing the trigger
-            # file, and the operator must learn this node diverged
+            # file, and the operator must learn this node diverged. The
+            # coordinator additionally pops its own slot's error to
+            # surface it to the client (commit-then-apply).
             print(f"[schema-sync] {self.node.endpoint.name}: replicated "
                   f"DDL failed locally at epoch {epoch} ({query!r}): "
                   f"{e!r}", file=sys.stderr)
-        self.epoch = max(self.epoch, epoch)
+            # bounded, OLDEST-first: a blanket clear() could wipe an
+            # in-flight coordinator's error before its pop, acking a
+            # failed DDL as success
+            while len(self._apply_errors) > 64:
+                del self._apply_errors[min(self._apply_errors)]
+            self._apply_errors[epoch] = e
+        # entry durable + readable BEFORE the epoch advances: any
+        # reader observing epoch >= N is guaranteed entries 1..N are
+        # present (the fsync can take milliseconds under load — an
+        # epoch-first order lets epoch polls race past a missing entry)
         self._append(epoch, query, keyspace, extra, coord=coord)
+        self.epoch = max(self.epoch, epoch)
+        self._publish_epoch()
 
     def commit_topology(self, extra: dict) -> None:
         """Commit a topology transformation as an epoch-log entry —
@@ -557,13 +715,7 @@ class SchemaSync:
         all changed through one log). The entry text embeds the op so
         the same-epoch conflict rule dedups identical retries."""
         query = TOPOLOGY_PREFIX + json.dumps(extra, sort_keys=True)
-
-        def local_apply():
-            apply_topology_to_ring(self.node.ring, extra)
-            emit_topology_event(self.node, extra)
-
-        self.coordinate(query, None, None, local_apply,
-                        extra_override=extra)
+        self.coordinate(query, None, None, extra_override=extra)
 
     def replay_all(self) -> None:
         """Re-apply every logged entry in epoch order (daemon restart).
@@ -580,33 +732,53 @@ class SchemaSync:
 
     def pull_from_peers(self, timeout: float = 5.0, prefer=None,
                         peers=None) -> bool:
-        """Catch-up: ask a live peer (preferring `prefer`) for newer
-        entries. Blocks on the response — callers must be off the
-        dispatch thread (startup threads, session threads). `peers`
-        overrides discovery — a FRESH node joining has an empty ring and
-        only knows its configured seed addresses (tcm/Discovery role).
-        Returns True if any peer answered (callers that REQUIRE the
-        cluster's log — auto-join discovery — must treat False as
-        fatal, not as 'I am the first node')."""
-        if peers is None:
-            peers = [ep for ep in self.node.ring.endpoints
-                     if ep != self.node.endpoint and self.node.is_alive(ep)]
-        else:
-            peers = [ep for ep in peers if ep != self.node.endpoint]
-        if prefer is not None and prefer in peers:
-            peers.remove(prefer)
-            peers.insert(0, prefer)
-        for ep in peers:
-            done = threading.Event()
+        """Catch-up: ask a peer (preferring `prefer`) for newer
+        entries, RETRYING within `timeout` until one answers — a node
+        that just healed from a partition must converge on its own,
+        not wait for external help. Liveness is re-read every attempt,
+        and if gossip still convicts every peer (heartbeats lag a heal
+        by up to a gossip round) the convicted peers are contacted
+        optimistically — a dead one simply doesn't answer. Blocks on
+        responses — callers must be off the dispatch thread (startup
+        threads, session threads). `peers` overrides discovery — a
+        FRESH node joining has an empty ring and only knows its
+        configured seed addresses (tcm/Discovery role). Returns True
+        if any peer answered (callers that REQUIRE the cluster's log —
+        auto-join discovery — must treat False as fatal, not as 'I am
+        the first node')."""
+        deadline = time.monotonic() + timeout
+        while True:
+            if peers is not None:
+                cand = [ep for ep in peers if ep != self.node.endpoint]
+            else:
+                ring_eps = [ep for ep in self.node.ring.endpoints
+                            if ep != self.node.endpoint]
+                live = [ep for ep in ring_eps
+                        if self.node.is_alive(ep)]
+                cand = live or ring_eps
+            if prefer is not None and prefer in cand:
+                cand.remove(prefer)
+                cand.insert(0, prefer)
+            for ep in cand:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                # bound each attempt so one silent peer can't eat the
+                # whole deadline when others might answer
+                per_try = remaining if len(cand) == 1 \
+                    else min(remaining, max(1.0, timeout / len(cand)))
+                done = threading.Event()
 
-            def on_rsp(msg):
-                self._on_pull_response(msg)
-                done.set()
+                def on_rsp(msg, _done=done):
+                    self._on_pull_response(msg)
+                    _done.set()
 
-            self.node.messaging.send_with_callback(
-                Verb.SCHEMA_PULL,
-                max(0, self.epoch - self.PULL_OVERLAP), ep,
-                on_response=on_rsp, timeout=timeout)
-            if done.wait(timeout):
-                return True
-        return False
+                self.node.messaging.send_with_callback(
+                    Verb.SCHEMA_PULL,
+                    max(0, self.epoch - self.PULL_OVERLAP), ep,
+                    on_response=on_rsp, timeout=per_try)
+                if done.wait(per_try):
+                    return True
+            if deadline - time.monotonic() <= 0.05:
+                return False
+            time.sleep(0.05)

@@ -54,12 +54,11 @@ class QueryProcessor:
         if sync is not None:
             # prepared DDL replicates exactly like direct DDL — a
             # bypass here would apply locally only, with no epoch
+            self._check_ddl_auth(prep.statement, keyspace, user)
             from ..service.metrics import GLOBAL
             with GLOBAL.timer("cql.request"):
-                return sync.coordinate(
-                    prep.query, keyspace, prep.statement,
-                    lambda: self.executor.execute(
-                        prep.statement, params, keyspace, user=user))
+                return sync.coordinate(prep.query, keyspace,
+                                       prep.statement)
         return self.executor.execute(prep.statement, params, keyspace,
                                      user=user, page_size=page_size,
                                      paging_state=paging_state)
@@ -72,6 +71,20 @@ class QueryProcessor:
             return None
         from ..cluster.schema_sync import DDL_STATEMENTS
         return sync if type(stmt).__name__ in DDL_STATEMENTS else None
+
+    def _check_ddl_auth(self, stmt, keyspace, user) -> None:
+        """Permission check for log-replicated DDL. Under
+        commit-then-apply the coordinator no longer executes the
+        statement through Executor.execute (whose auth gate covers the
+        non-replicated path), so the same check runs here BEFORE the
+        statement reaches the metadata log."""
+        auth = getattr(self.executor.backend, "auth", None)
+        if auth is None or not auth.enabled:
+            return
+        perm = Executor.PERMISSION_OF.get(type(stmt).__name__)
+        if perm is not None:
+            ks = getattr(stmt, "keyspace", None) or keyspace
+            auth.check(user, perm, ks)
 
     def process(self, query: str, params=(),
                 keyspace: str | None = None,
@@ -91,11 +104,9 @@ class QueryProcessor:
         try:
             sync = self._ddl_sync_for(stmt)
             if sync is not None:
+                self._check_ddl_auth(stmt, keyspace, user)
                 with GLOBAL.timer("cql.request"):
-                    return sync.coordinate(
-                        query, keyspace, stmt,
-                        lambda: self.executor.execute(
-                            stmt, params, keyspace, user=user))
+                    return sync.coordinate(query, keyspace, stmt)
             with GLOBAL.timer("cql.request"):
                 return self.executor.execute(stmt, params, keyspace,
                                              user=user,
