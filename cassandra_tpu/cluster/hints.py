@@ -73,3 +73,20 @@ class HintsService:
             os.remove(p)
             self.metrics["replayed"] += n
             return n
+
+    def truncate(self, endpoint_name: str | None = None) -> int:
+        """Delete persisted hint files (all, or one target's) under the
+        service lock — `nodetool truncatehints` must not race a
+        concurrent store()/dispatch() holding a file open (reference
+        HintsService.deleteAllHints serializes through the catalog).
+        Returns the number of files removed."""
+        n = 0
+        with self._lock:
+            for fn in list(os.listdir(self.directory)):
+                if not fn.startswith("hints-") or not fn.endswith(".db"):
+                    continue
+                if endpoint_name and fn != f"hints-{endpoint_name}.db":
+                    continue
+                os.remove(os.path.join(self.directory, fn))
+                n += 1
+        return n

@@ -7,21 +7,41 @@ TCP — request {"cmd": name, "args": {...}}, response {"ok": true,
 tools/nodetool.py's COMMANDS registry is remotely invokable, so a real
 deployment is operated without shelling into the daemon process.
 
-SECURITY: the protocol itself carries no credentials (like default
-unauthenticated JMX). The listener therefore binds LOOPBACK ONLY unless
-the operator explicitly sets `admin_host` — reaching it from another
-machine means the operator has shell access to the box, which is the
-JMX-local trust model. Do not bind it wide without a network filter.
+SECURITY: loopback binds run in the JMX-local trust model (shell access
+to the box implies admin rights). Binding a NON-loopback address
+REQUIRES a shared `secret`: the server refuses to start wide-open
+(reference: JMX remote requires authentication by default,
+jmx.remote.x.password.file), and every request must then carry
+{"auth": secret}, compared constant-time. Transport encryption is the
+operator's network layer (or front the port with the mTLS internode
+listener); the secret gates command execution.
 """
 from __future__ import annotations
 
+import hmac
 import json
 import socket
 import threading
 
 
+def _is_loopback(host: str) -> bool:
+    try:
+        import ipaddress
+        return ipaddress.ip_address(host).is_loopback
+    except ValueError:
+        return host in ("localhost",)
+
+
 class AdminServer:
-    def __init__(self, node, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 0,
+                 secret: str | None = None):
+        if not _is_loopback(host) and not secret:
+            raise ValueError(
+                f"refusing to bind admin endpoint on non-loopback "
+                f"{host!r} without a shared secret (set admin_secret); "
+                f"unauthenticated remote admin is full remote control "
+                f"of the node")
+        self.secret = secret
         self.node = node
         self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -64,6 +84,14 @@ class AdminServer:
                     continue
                 try:
                     req = json.loads(line)
+                    if self.secret is not None and not \
+                            hmac.compare_digest(
+                                str(req.get("auth") or ""), self.secret):
+                        f.write(b'{"ok": false, "error": '
+                                b'"AuthenticationError: bad or missing '
+                                b'admin secret"}\n')
+                        f.flush()
+                        continue
                     result = nodetool.run_command(
                         req["cmd"], node=self.node,
                         **(req.get("args") or {}))
@@ -83,12 +111,14 @@ class AdminServer:
 
 
 def admin_call(host: str, port: int, cmd: str, args: dict | None = None,
-               timeout: float = 30.0):
+               timeout: float = 30.0, secret: str | None = None):
     """One-shot client call (nodetool --host/--port mode)."""
+    req = {"cmd": cmd, "args": args or {}}
+    if secret is not None:
+        req["auth"] = secret
     with socket.create_connection((host, port), timeout=timeout) as sock:
         f = sock.makefile("rwb")
-        f.write(json.dumps({"cmd": cmd, "args": args or {}}).encode()
-                + b"\n")
+        f.write(json.dumps(req).encode() + b"\n")
         f.flush()
         line = f.readline()
         if not line:

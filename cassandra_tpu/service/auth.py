@@ -53,6 +53,12 @@ class AuthCache:
         self.validity = validity
         self._entries: dict = {}
         self._lock = threading.Lock()
+        # bumped by invalidate_all(): a verdict computed under an older
+        # generation must NOT be inserted after the flush — without this
+        # an in-flight get() could re-cache a stale verdict (e.g. a
+        # password verified just before the role's hash changed) for a
+        # full validity window after the invalidation
+        self._gen = 0
 
     def get(self, key, loader):
         now = time.monotonic()
@@ -60,16 +66,19 @@ class AuthCache:
             hit = self._entries.get(key)
             if hit is not None and now - hit[0] < self.validity:
                 return hit[1]
+            gen = self._gen
         value = loader()
         with self._lock:
-            self._entries[key] = (now, value)
-            if len(self._entries) > 10_000:
-                self._entries.clear()   # crude bound; verdicts re-load
+            if self._gen == gen:
+                self._entries[key] = (now, value)
+                if len(self._entries) > 10_000:
+                    self._entries.clear()  # crude bound; verdicts re-load
         return value
 
     def invalidate_all(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._gen += 1
 
 
 class AuthService:

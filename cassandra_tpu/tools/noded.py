@@ -236,12 +236,16 @@ def main(argv=None) -> int:
                            tls=TLSConfig.from_dict(cfg.get("native_tls")))
     admin = None
     if cfg.get("admin_port") is not None:
-        # remote nodetool endpoint (the JMX port 7199 role); the protocol
-        # is unauthenticated, so it binds loopback unless admin_host is
-        # explicitly widened
+        # remote nodetool endpoint (the JMX port 7199 role); loopback
+        # binds run in the shell-access trust model, non-loopback binds
+        # REQUIRE admin_secret (AdminServer refuses otherwise)
         from ..service.admin import AdminServer
+        secret = cfg.get("admin_secret")
+        if secret is None and cfg.get("admin_secret_file"):
+            with open(cfg["admin_secret_file"]) as sf:
+                secret = sf.read().strip()
         admin = AdminServer(node, cfg.get("admin_host", "127.0.0.1"),
-                            int(cfg["admin_port"]))
+                            int(cfg["admin_port"]), secret=secret)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())

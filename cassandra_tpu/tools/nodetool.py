@@ -561,18 +561,10 @@ def statushandoff(node) -> dict:
 
 
 def truncatehints(node, endpoint: str | None = None) -> dict:
-    """nodetool truncatehints [endpoint]."""
-    import os as _os
-    n = 0
-    d = node.hints.directory
-    for fn in list(_os.listdir(d)):
-        if not fn.startswith("hints-"):
-            continue
-        if endpoint and fn != f"hints-{endpoint}.db":
-            continue
-        _os.remove(_os.path.join(d, fn))
-        n += 1
-    return {"truncated_files": n}
+    """nodetool truncatehints [endpoint] — delegates to
+    HintsService.truncate, which holds the service lock so a concurrent
+    store()/dispatch() can't race the deletes."""
+    return {"truncated_files": node.hints.truncate(endpoint)}
 
 
 def statusgossip(node) -> dict:
@@ -816,6 +808,8 @@ def main(argv=None):
     p.add_argument("--data", help="offline mode: data directory")
     p.add_argument("--host", help="admin mode: daemon host")
     p.add_argument("--port", type=int, help="admin mode: admin port")
+    p.add_argument("--secret", help="admin mode: shared secret "
+                   "(or env CTPU_ADMIN_SECRET)")
     args = p.parse_args(argv)
 
     kwargs = {}
@@ -829,8 +823,12 @@ def main(argv=None):
             kwargs[k] = v
 
     if args.host and args.port:
+        import os as _os
+
         from ..service.admin import admin_call
-        out = admin_call(args.host, args.port, args.command, kwargs)
+        out = admin_call(args.host, args.port, args.command, kwargs,
+                         secret=args.secret
+                         or _os.environ.get("CTPU_ADMIN_SECRET"))
         print(json.dumps(out, indent=2, default=str))
         return
     if not args.data:

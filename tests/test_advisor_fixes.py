@@ -1,0 +1,109 @@
+"""Round-3 advisor findings, closed with a test each:
+
+(a) AdminServer refuses non-loopback binds without a shared secret, and
+    a configured secret gates every command (service/admin.py).
+(b) AuthCache.get cannot re-insert a verdict computed before
+    invalidate_all() — generation counter (service/auth.py).
+(c) nodetool truncatehints deletes hint files under the HintsService
+    lock (cluster/hints.py truncate, tools/nodetool.py).
+"""
+import threading
+
+import pytest
+
+from cassandra_tpu.service.auth import AuthCache
+
+
+# ---------------------------------------------------------- (a) admin --
+
+def test_admin_refuses_wide_bind_without_secret():
+    from cassandra_tpu.service.admin import AdminServer
+    with pytest.raises(ValueError, match="secret"):
+        AdminServer(node=None, host="0.0.0.0", port=0)
+
+
+def test_admin_secret_gates_commands(tmp_path):
+    from cassandra_tpu.cluster.node import LocalCluster
+    from cassandra_tpu.service.admin import AdminServer, admin_call
+    c = LocalCluster(1, str(tmp_path), rf=1)
+    srv = AdminServer(c.nodes[0], secret="s3kr1t")
+    try:
+        with pytest.raises(RuntimeError, match="admin secret"):
+            admin_call("127.0.0.1", srv.port, "version")
+        with pytest.raises(RuntimeError, match="admin secret"):
+            admin_call("127.0.0.1", srv.port, "version", secret="wrong")
+        out = admin_call("127.0.0.1", srv.port, "version",
+                         secret="s3kr1t")
+        assert out["release"].startswith("cassandra-tpu")
+    finally:
+        srv.close()
+        c.shutdown()
+
+
+# ------------------------------------------------------ (b) auth cache --
+
+def test_authcache_invalidate_beats_inflight_load():
+    cache = AuthCache(validity=60.0)
+    loaded = threading.Event()
+    release = threading.Event()
+    result = {}
+
+    def slow_loader():
+        loaded.set()
+        release.wait(5.0)
+        return "STALE-VERDICT"
+
+    t = threading.Thread(
+        target=lambda: result.setdefault(
+            "v", cache.get("k", slow_loader)))
+    t.start()
+    assert loaded.wait(5.0)
+    # role/grant mutation lands while the verdict is mid-computation
+    cache.invalidate_all()
+    release.set()
+    t.join(5.0)
+    assert result["v"] == "STALE-VERDICT"   # caller still gets its value
+    # ...but the stale verdict must NOT have been cached: a fresh get
+    # re-loads instead of serving the pre-invalidation verdict
+    assert cache.get("k", lambda: "FRESH") == "FRESH"
+
+
+def test_authcache_normal_hit_still_caches():
+    cache = AuthCache(validity=60.0)
+    assert cache.get("k", lambda: "v1") == "v1"
+    assert cache.get("k", lambda: "v2") == "v1"   # served from cache
+
+
+# --------------------------------------------------- (c) truncatehints --
+
+def test_truncatehints_under_service_lock(tmp_path):
+    from cassandra_tpu.cluster.hints import HintsService
+    from cassandra_tpu.cluster.ring import Endpoint
+    from cassandra_tpu.storage.mutation import Mutation
+    from cassandra_tpu.tools import nodetool
+
+    svc = HintsService(str(tmp_path))
+    a, b = Endpoint("nodeA"), Endpoint("nodeB")
+    import uuid
+    m = Mutation(uuid.uuid4(), b"pk")
+    m.add(b"", 0, b"", b"v", ts=1)
+    svc.store(a, m)
+    svc.store(b, m)
+    assert svc.has_hints(a) and svc.has_hints(b)
+
+    class FakeNode:
+        hints = svc
+
+    out = nodetool.truncatehints(FakeNode(), endpoint="nodeA")
+    assert out == {"truncated_files": 1}
+    assert not svc.has_hints(a) and svc.has_hints(b)
+    # holding the service lock blocks the truncate until released —
+    # i.e. it cannot race a store()/dispatch() critical section
+    done = threading.Event()
+    with svc._lock:
+        t = threading.Thread(target=lambda: (svc.truncate(), done.set()))
+        t.start()
+        assert not done.wait(0.2)
+    t.join(5.0)
+    assert done.is_set()
+    assert not svc.has_hints(b)

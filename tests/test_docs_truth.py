@@ -1,0 +1,131 @@
+"""docs/ARCHITECTURE.md "Known gaps" enforcement: the list is checked
+against the CODEBASE, not against itself, so it cannot rot.
+
+Two directions:
+  1. Every `gap:` token listed in the doc has a probe here that checks
+     whether the feature actually shipped (file/symbol presence). A
+     listed gap whose probe finds the feature fails the suite — the
+     doc must be updated in the same change that ships the feature.
+  2. A curated set of SHIPPED features (things past rounds delivered)
+     is asserted absent from the gaps section — the failure mode of
+     rounds 2–4, where shipped features stayed listed as gaps.
+
+Adding a new gap bullet without a probe also fails: unprobed claims
+are exactly the rot this test exists to stop.
+"""
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "cassandra_tpu")
+DOC = os.path.join(REPO, "docs", "ARCHITECTURE.md")
+
+
+def _read(*rel):
+    p = os.path.join(*rel)
+    if not os.path.exists(p):
+        return ""
+    with open(p, encoding="utf-8") as f:
+        return f.read()
+
+
+def _gaps_section() -> str:
+    text = _read(DOC)
+    m = re.search(r"## Known gaps\n(.*)", text, re.S)
+    assert m, "ARCHITECTURE.md lost its Known gaps section"
+    return m.group(1)
+
+
+# Each probe returns True when the feature EXISTS in the codebase
+# (meaning the gap is closed and must leave the doc). Probes look at
+# artifacts — files and load-bearing symbols — never at docs.
+GAP_PROBES = {
+    "gap:preview-repair": lambda: (
+        "preview" in _read(PKG, "cluster", "repair.py")
+        and "class RepairSessionStore" in _read(PKG, "cluster",
+                                                "repair.py")),
+    "gap:partitioner-breadth": lambda: (
+        "ByteOrderedPartitioner" in _read(PKG, "utils",
+                                          "partitioners.py")),
+    "gap:snitch-breadth": lambda: (
+        "GossipingPropertyFileSnitch" in _read(PKG, "cluster",
+                                               "snitch.py")),
+    "gap:big-bti-interop": lambda: (
+        os.path.exists(os.path.join(PKG, "storage", "sstable",
+                                    "big_format.py"))),
+    "gap:nodetool-breadth": lambda: (
+        # closed when the remote command registry crosses 120
+        len(re.findall(r'^\s+\("[a-z]+", "(?:node|engine|none)"\),?',
+                       _read(PKG, "tools", "nodetool.py"), re.M)) > 120
+        or _read(PKG, "tools", "nodetool.py").count('("') > 240),
+    "gap:datalimits-pushdown": lambda: (
+        "class DataLimits" in _read(PKG, "cluster", "coordinator.py")
+        or "short_read" in _read(PKG, "cluster", "coordinator.py")),
+    "gap:deterministic-sim": lambda: (
+        os.path.exists(os.path.join(PKG, "sim", "scheduler.py"))),
+    "gap:ucs-vector": lambda: (
+        "scaling_vector" in _read(PKG, "compaction", "strategies.py")),
+    "gap:sstableloader": lambda: (
+        os.path.exists(os.path.join(PKG, "tools", "sstableloader.py"))),
+    "gap:harry-ttl": lambda: (
+        "ttl" in _read(PKG, "tools", "harry.py").lower()
+        and "no TTLs here" not in _read(PKG, "tools", "harry.py")),
+    "gap:guardrails-breadth": lambda: (
+        _read(PKG, "storage", "guardrails.py").count("def check_") >= 12
+        or _read(PKG, "storage", "guardrails.py").count("Guardrail(")
+        >= 15),
+    "gap:compressed-commitlog": lambda: (
+        "compress" in _read(PKG, "storage", "commitlog.py")),
+}
+
+# Features that SHIPPED (with their proving artifact) — none of these
+# phrases may appear inside the Known-gaps section. This is the exact
+# list rounds 2–4 kept mis-reporting.
+SHIPPED = {
+    "encryption at rest": os.path.join(PKG, "storage", "encryption.py"),
+    "entire-sstable": os.path.join(PKG, "cluster", "streaming.py"),
+    "SASI": os.path.join(PKG, "index", "manager.py"),
+    "AutoSavingCache": os.path.join(PKG, "storage", "saved_caches.py"),
+    "gossip/ring-driven": None,   # topology is epoch-logged now
+    "epoch log covers DDL only": None,
+}
+
+
+def test_every_listed_gap_is_probed_and_still_open():
+    gaps = _gaps_section()
+    listed = set(re.findall(r"gap:[a-z-]+", gaps))
+    assert listed, "Known gaps section lists no gap: tokens"
+    unprobed = listed - set(GAP_PROBES)
+    assert not unprobed, (
+        f"gap tokens without probes (add one here): {sorted(unprobed)}")
+    shipped_but_listed = [t for t in sorted(listed) if GAP_PROBES[t]()]
+    assert not shipped_but_listed, (
+        f"these gaps appear to be SHIPPED but are still listed in "
+        f"docs/ARCHITECTURE.md Known gaps — update the doc: "
+        f"{shipped_but_listed}")
+
+
+def test_no_shipped_feature_listed_as_gap():
+    gaps = _gaps_section().lower()
+    for phrase, artifact in SHIPPED.items():
+        if artifact is not None:
+            assert os.path.exists(artifact), (
+                f"SHIPPED registry stale: {artifact} vanished")
+        assert phrase.lower() not in gaps, (
+            f"shipped feature {phrase!r} is listed under Known gaps")
+
+
+def test_closed_gaps_left_the_doc():
+    """The inverse direction: any probe that fires must not have its
+    token in the doc (covered above), AND tokens removed from the doc
+    must correspond to a firing probe OR be absent from GAP_PROBES —
+    i.e. you cannot 'close' a gap by deleting the bullet while the
+    probe still reports it missing."""
+    gaps = _gaps_section()
+    listed = set(re.findall(r"gap:[a-z-]+", gaps))
+    for token, probe in GAP_PROBES.items():
+        if token not in listed:
+            assert probe(), (
+                f"{token} was removed from Known gaps but its probe "
+                f"says the feature is still missing — restore the "
+                f"bullet or ship the feature")
