@@ -10,6 +10,7 @@ resolution (service/reads/DigestResolver) and blocking read repair
 from __future__ import annotations
 
 import threading
+import time
 
 from ..storage import cellbatch as cb
 from ..storage.mutation import Mutation
@@ -227,13 +228,27 @@ class StorageProxy:
 
     _digest = staticmethod(cb.content_digest)
 
+    # short-read protection: doubling rounds before falling back to an
+    # unlimited fetch (correctness over boundedness)
+    SHORT_READ_MAX_ROUNDS = 8
+
     def read_partition(self, keyspace: str, table_name: str, pk: bytes,
-                       cl: str = ConsistencyLevel.ONE) -> cb.CellBatch:
+                       cl: str = ConsistencyLevel.ONE,
+                       limits: cb.DataLimits | None = None) -> cb.CellBatch:
         """Single-partition read: full data from ONE replica, digest-only
         responses from the rest of the blockFor set — the digest round
         ships 16 bytes per replica, not the partition. A mismatch triggers
         a full-data round to every target plus blocking read repair
-        (AbstractReadExecutor + DigestResolver + DataResolver)."""
+        (AbstractReadExecutor + DigestResolver + DataResolver).
+
+        `limits` pushes the row limit to every replica (DataLimits.java
+        role) so responses are bounded by the LIMIT, not the partition.
+        Because each replica truncates on its OWN view, the merged result
+        can come up short when one replica's tombstones shadow another's
+        contributions: short-read protection re-queries with doubled
+        limits until the merged live-row count reaches the target or no
+        replica was truncated
+        (service/reads/ShortReadPartitionsProtection.java:40)."""
         if cl == ConsistencyLevel.EACH_QUORUM:
             raise ValueError(
                 "EACH_QUORUM ConsistencyLevel is only supported for writes")
@@ -255,53 +270,95 @@ class StorageProxy:
                                       self._latency_of(r)))
         targets = countable[:block_for]
         spares = countable[block_for:]
+        target_rows = limits.target() if limits is not None else None
+        effective = limits
+        rounds = self.SHORT_READ_MAX_ROUNDS if target_rows is not None \
+            else 0
+        for rnd in range(rounds + 1):
+            if rnd == rounds:
+                effective = None        # final round: no truncation
+            merged, results = self._read_round(
+                keyspace, table_name, pk, targets, spares, block_for,
+                effective)
+            if effective is None or target_rows is None:
+                return merged
+            truncated = [b for _, b, more in results if more]
+            if not truncated:
+                # every source shipped its complete view: merged IS the
+                # partition's truth
+                return merged
+            # a truncated source vouches only for rows up to its LAST
+            # shipped row; merged rows beyond the earliest such frontier
+            # may be shadowed by tombstones that source never shipped —
+            # count (and serve) only the covered prefix
+            frontiers = [cb.row_frontier(b) for b in truncated]
+            if all(f is not None for f in frontiers):
+                fmin = min(frontiers)
+                covered = merged.slice_range(
+                    0, cb.covered_prefix(merged, fmin))
+                if cb.live_row_count(covered) >= target_rows:
+                    return covered
+            # covered shortfall: the truncated tails may hold the rows
+            # (or the tombstones) the merge needs — re-query doubled
+            from ..service.metrics import GLOBAL
+            GLOBAL.incr("reads.short_read_retries")
+            effective = effective.doubled()
+        return merged
+
+    def _read_round(self, keyspace, table_name, pk, targets, spares,
+                    block_for, limits):
+        """One digest-checked read round at the given limits. Returns
+        (merged, results) with results = [(ep, batch, more)]."""
         results, digests = self._fetch(keyspace, table_name, pk,
                                        targets[:1], targets[1:],
-                                       spares=spares)
+                                       spares=spares, limits=limits)
         if len(results) + len(digests) < block_for:
             raise TimeoutException(
                 f"{len(results) + len(digests)}/{block_for} read responses")
-        want = {self._digest(b) for _, b in results} | \
+        want = {self._digest(b) for _, b, _ in results} | \
             {d for _, d in digests}
         if len(want) > 1:
             # digest mismatch: full-data second round from every target
-            results, _ = self._fetch(keyspace, table_name, pk, targets, [])
+            results, _ = self._fetch(keyspace, table_name, pk, targets,
+                                     [], limits=limits)
             if len(results) < block_for:
                 raise TimeoutException(
                     f"{len(results)}/{block_for} data responses")
-            self._read_repair(keyspace, table_name, results)
-        merged = cb.merge_sorted([b for _, b in results])
-        return merged
+            self._read_repair(keyspace, table_name,
+                              [(ep, b) for ep, b, _ in results])
+        merged = cb.merge_sorted([b for _, b, _ in results])
+        return merged, results
 
     def _fetch(self, keyspace, table_name, pk, data_targets,
-               digest_targets, spares=()):
+               digest_targets, spares=(), limits=None):
         """One round: full READ_REQ to data_targets, digest-only READ_REQ
         to digest_targets. If the round is still short of blockFor after
         the speculative delay, ONE spare replica gets a redundant
         full-data request (speculative retry —
         service/reads/AbstractReadExecutor). Returns
-        ([(ep, batch)], [(ep, digest)])."""
-        import time as _time
-
+        ([(ep, batch, more)], [(ep, digest)]) — `more` is the replica's
+        truncated-by-limits flag (short-read protection input)."""
         ck_comp = self.node.schema.get_table(
             keyspace, table_name).clustering_comp
         handler = _Await(len(data_targets) + len(digest_targets))
         results: list = []
         digests: list = []
         lock = threading.Lock()
-        t0 = _time.monotonic()
+        t0 = time.monotonic()
+        wire_limits = limits.to_wire() if limits is not None else None
 
         def send_to(target, digest_only):
-            sent = _time.monotonic()
+            sent = time.monotonic()
             if target == self.node.endpoint:
                 batch = self.node.engine.store(
                     keyspace, table_name).read_partition(pk)
+                batch, more = cb.truncate_live_rows(batch, limits)
                 with lock:
                     if digest_only:
                         digests.append((target, cb.content_digest(batch)))
                     else:
-                        results.append((target, batch))
-                self._record_latency(target, _time.monotonic() - sent)
+                        results.append((target, batch, more))
+                self._record_latency(target, time.monotonic() - sent)
                 handler.ack()
             else:
                 def on_rsp(m, t=target, dg=digest_only, ts=sent):
@@ -309,10 +366,11 @@ class StorageProxy:
                         if dg:
                             digests.append((t, m.payload))
                         else:
-                            b = cb_deserialize(m.payload)
+                            payload, more = m.payload
+                            b = cb_deserialize(payload)
                             b.ck_comp = ck_comp
-                            results.append((t, b))
-                    self._record_latency(t, _time.monotonic() - ts)
+                            results.append((t, b, bool(more)))
+                    self._record_latency(t, time.monotonic() - ts)
                     handler.ack()
 
                 def on_fail(mid, t=target):
@@ -322,7 +380,8 @@ class StorageProxy:
                     handler.fail()
                 self.messaging.send_with_callback(
                     Verb.READ_REQ,
-                    (keyspace, table_name, pk, digest_only), target,
+                    (keyspace, table_name, pk, digest_only, wire_limits),
+                    target,
                     on_response=on_rsp, on_failure=on_fail,
                     timeout=self.read_timeout)
 
@@ -336,7 +395,7 @@ class StorageProxy:
             # a straggling digest (ack tallies are read-resolver inputs)
             send_to(spares[0], False)
         # the read budget is self.read_timeout TOTAL, not per wait
-        handler.await_(max(self.read_timeout - (_time.monotonic() - t0), 0.0))
+        handler.await_(max(self.read_timeout - (time.monotonic() - t0), 0.0))
         with lock:
             return list(results), list(digests)
 

@@ -93,11 +93,19 @@ class Node:
     def _handle_read(self, msg):
         keyspace, table_name, pk, *rest = msg.payload
         digest_only = bool(rest[0]) if rest else False
+        limits = cbmod.DataLimits.from_wire(rest[1]) \
+            if len(rest) > 1 else None
         batch = self.engine.store(keyspace, table_name).read_partition(pk)
+        # DataLimits pushdown: truncate at the source so LIMIT 1 on a
+        # huge partition ships bytes proportional to the limit, not the
+        # partition (db/filter/DataLimits.java:44); `more` feeds the
+        # coordinator's short-read protection
+        batch, more = cbmod.truncate_live_rows(batch, limits)
         if digest_only:
-            # digest read: 16 bytes back instead of the partition
+            # digest read: 16 bytes back instead of the partition —
+            # computed over the SAME limited view every replica produces
             return Verb.READ_RSP, cbmod.content_digest(batch)
-        return Verb.READ_RSP, cb_serialize(batch)
+        return Verb.READ_RSP, (cb_serialize(batch), more)
 
     def _handle_range(self, msg):
         keyspace, table_name, *window = msg.payload
@@ -176,15 +184,20 @@ class Node:
 
     def _hint_loop(self):
         while not self._stop_hints.wait(0.5):
-            # self included: a failed local apply (e.g. as a pending
-            # replica) leaves a self-hint that replays through the
-            # transport loopback
-            for ep in list(self.ring.endpoints) + [self.endpoint]:
-                if self.hints.has_hints(ep) and self.is_alive(ep):
-                    try:
-                        self._dispatch_hints(ep)
-                    except Exception:
-                        pass
+            self.hint_round()
+
+    def hint_round(self) -> None:
+        """One hint-dispatch pass (extracted so the deterministic
+        simulator can drive it as a timer instead of a thread). Self
+        included: a failed local apply (e.g. as a pending replica)
+        leaves a self-hint that replays through the transport
+        loopback."""
+        for ep in list(self.ring.endpoints) + [self.endpoint]:
+            if self.hints.has_hints(ep) and self.is_alive(ep):
+                try:
+                    self._dispatch_hints(ep)
+                except Exception:
+                    pass
 
     def _dispatch_hints(self, ep: Endpoint):
         """Replay hints with acks: un-acked mutations are re-stored so a
@@ -525,9 +538,10 @@ class _DistributedStore:
         self.keyspace = keyspace
         self.name = name
 
-    def read_partition(self, pk: bytes, now=None):
+    def read_partition(self, pk: bytes, now=None, limits=None):
         return self.node.proxy.read_partition(self.keyspace, self.name, pk,
-                                              self.node.default_cl)
+                                              self.node.default_cl,
+                                              limits=limits)
 
     def scan_all(self, now=None):
         return self.node.proxy.scan_all(self.keyspace, self.name,

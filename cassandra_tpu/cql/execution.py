@@ -28,6 +28,19 @@ class InvalidRequest(ValueError):
     pass
 
 
+def _check_ttl(ttl: int) -> None:
+    """TTL bounds check (cql3/Attributes.java MAX_TTL = 20 years): the
+    expiry cap (utils/timeutil.expiration_time) handles the int32
+    horizon, this rejects requests the reference would refuse."""
+    from ..utils.timeutil import MAX_TTL
+    if ttl < 0:
+        raise InvalidRequest(f"A TTL must be greater than or equal to 0, "
+                             f"but was {ttl}")
+    if ttl > MAX_TTL:
+        raise InvalidRequest(f"ttl is too large. requested ({ttl}) "
+                             f"maximum ({MAX_TTL})")
+
+
 class ResultSet:
     paging_state: bytes | None = None   # set when a page cut a scan short
 
@@ -1134,6 +1147,7 @@ class Executor:
             else int(bind_term(s.timestamp, None, params))
         ttl = 0 if s.ttl is None else int(bind_term(s.ttl, None, params))
         ttl = ttl or t.params.default_ttl
+        _check_ttl(ttl)
         values = {}
         for cname, term in zip(s.columns, s.values):
             col = t.columns.get(cname)
@@ -1185,7 +1199,8 @@ class Executor:
 
     def _add_liveness(self, m, ck, ts, ttl, now_s):
         if ttl:
-            m.add(ck, COL_ROW_LIVENESS, b"", b"", ts, now_s + ttl, ttl,
+            m.add(ck, COL_ROW_LIVENESS, b"", b"", ts,
+                  timeutil.expiration_time(now_s, ttl), ttl,
                   cb.FLAG_ROW_LIVENESS | cb.FLAG_EXPIRING)
         else:
             m.add(ck, COL_ROW_LIVENESS, b"", b"", ts,
@@ -1196,7 +1211,8 @@ class Executor:
         cid = col.column_id
         typ = col.cql_type
         flags = cb.FLAG_EXPIRING if ttl else 0
-        ldt = now_s + ttl if ttl else timeutil.NO_DELETION_TIME
+        ldt = timeutil.expiration_time(now_s, ttl) if ttl \
+            else timeutil.NO_DELETION_TIME
         if v is None:
             m.add(ck, cid, b"", b"", ts, now_s, 0, cb.FLAG_TOMBSTONE)
             return
@@ -1212,7 +1228,7 @@ class Executor:
     def _add_collection_cells(self, m, t, col, ck, v, ts, ttl, now_s, flags):
         typ = col.cql_type
         cid = col.column_id
-        ldt = now_s + ttl if ttl else 0x7FFFFFFF
+        ldt = timeutil.expiration_time(now_s, ttl) if ttl else 0x7FFFFFFF
         if isinstance(typ, MapType):
             for k, val in v.items():
                 m.add(ck, cid, typ.key.serialize(k), typ.val.serialize(val),
@@ -1229,6 +1245,18 @@ class Executor:
         else:
             raise InvalidRequest(f"bad collection assignment to {col.name}")
 
+
+    def _static_only_ck(self, t, ck_rel, column_names):
+        """ck frame for a write: b"" when every touched column is
+        static and no clustering is given (reference
+        ModificationStatement.appliesOnlyToStaticColumns waives the
+        full-clustering restriction), else the full-equality frame."""
+        static_names = {c.name for c in t.static_columns}
+        if t.clustering_columns and not ck_rel and column_names and \
+                all(n in static_names for n in column_names):
+            return b""
+        return self._full_ck(t, ck_rel) if t.clustering_columns else b""
+
     def _exec_UpdateStatement(self, s, params, keyspace, now):
         t = self._table(s, keyspace)
         self._reject_view_write(t)
@@ -1237,11 +1265,13 @@ class Executor:
             else int(bind_term(s.timestamp, None, params))
         ttl = 0 if s.ttl is None else int(bind_term(s.ttl, None, params))
         ttl = ttl or t.params.default_ttl
+        _check_ttl(ttl)
         pk_vals, ck_rel, filters = self._split_where(t, s.where, params)
         if filters:
             raise InvalidRequest("non-primary-key columns in UPDATE WHERE")
         pks = self._pk_bytes_list(t, pk_vals)
-        ck = self._full_ck(t, ck_rel) if t.clustering_columns else b""
+        ck = self._static_only_ck(t, ck_rel,
+                                  [op.column for op in s.ops])
         now_s = timeutil.now_seconds()
         conditional = s.if_exists or s.conditions
         if conditional and len(pks) > 1:
@@ -1328,7 +1358,8 @@ class Executor:
             else:
                 m.add(target_ck, col.column_id, typ.key.serialize(k),
                       typ.val.serialize(v), ts,
-                      now_s + ttl if ttl else 0x7FFFFFFF, ttl,
+                      timeutil.expiration_time(now_s, ttl)
+                      if ttl else 0x7FFFFFFF, ttl,
                       cb.FLAG_EXPIRING if ttl else 0)
         elif op.op == "prepend":
             v = bind_term(op.value, typ, params)
@@ -1366,7 +1397,10 @@ class Executor:
                     return self._not_applied(t, existing)
             m = Mutation(t.id, pk)
             if s.columns:
-                ck = self._full_ck(t, ck_rel) if t.clustering_columns else b""
+                ck = self._static_only_ck(
+                    t, ck_rel,
+                    [item[0] if isinstance(item, tuple) else item
+                     for item in s.columns])
                 for item in s.columns:
                     if isinstance(item, tuple):
                         cname, key_term = item
@@ -1555,7 +1589,8 @@ class Executor:
             statics_by_pk = {}
             batches = []
         elif pk_vals:
-            batches = [(pk, cfs.read_partition(pk))
+            push = self._pushdown_limits(t, s, params, ck_rel, filters)
+            batches = [(pk, cfs.read_partition(pk, limits=push))
                        for pk in self._pk_bytes_list(t, pk_vals)]
         else:
             # full scan: paged, windowed, bounded memory (QueryPagers)
@@ -1636,6 +1671,35 @@ class Executor:
         if limit is not None and post:
             rs = ResultSet(rs.column_names, rs.rows[:limit])
         return rs
+
+    def _pushdown_limits(self, t, s, params, ck_rel, filters):
+        """DataLimits for a single-partition read, or None when pushdown
+        is unsafe. Safe only when every fetched row is a result row:
+        no clustering restrictions or column filters (applied POST-fetch
+        here — a pushed limit would count rows they later drop), no
+        ORDER BY re-sort, no aggregation/GROUP BY/DISTINCT. Static
+        columns pad the limit by one: the static pseudo-row occupies
+        the partition's first row slot at the replica."""
+        if ck_rel or filters or s.order_by or \
+                self._limit_after_projection(s, t):
+            return None
+        lim = int(bind_term(s.limit, None, params)) \
+            if s.limit is not None else None
+        ppl = int(bind_term(s.per_partition_limit, None, params)) \
+            if s.per_partition_limit is not None else None
+        if lim is None and ppl is None:
+            return None
+        if (lim is not None and lim <= 0) or \
+                (ppl is not None and ppl <= 0):
+            # a non-positive limit would make every replica return an
+            # empty truncated batch forever — the retry loop could
+            # never converge, so don't push
+            return None
+        from ..storage.cellbatch import DataLimits
+        pad = 1 if t.static_columns else 0
+        return DataLimits(
+            row_limit=None if lim is None else lim + pad,
+            per_partition=None if ppl is None else ppl + pad)
 
     def _limit_after_projection(self, s, t=None) -> bool:
         if getattr(s, "group_by", None) or getattr(s, "distinct", False):

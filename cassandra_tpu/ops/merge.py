@@ -920,6 +920,10 @@ def host_tiebreak(cat: CellBatch, perm_real: np.ndarray, keep: np.ndarray,
     n = len(perm_real)
     flags_sorted = cat.flags[perm_real]
     death_orig = (flags_sorted & DEATH_FLAGS) != 0
+    # rank-grade tombstone: STATIC isTombstone (death, no ttl) so the
+    # rank survives expired->tombstone conversion (CASSANDRA-14592);
+    # must mirror CellBatch._pure_death_lane and merge.cpp beats()
+    pure_death = death_orig & ((flags_sorted & FLAG_EXPIRING) == 0)
     eot = death_orig | ((flags_sorted & FLAG_EXPIRING) != 0)
     death_eff = death_orig | expired
     ldt_sorted = cat.ldt[perm_real]
@@ -948,11 +952,11 @@ def host_tiebreak(cat: CellBatch, perm_real: np.ndarray, keep: np.ndarray,
         if order_by_ts:
             best = max(range(lo, hi + 1),
                        key=lambda i: (int(ts_sorted[i]), bool(eot[i]),
-                                      bool(death_orig[i]),
+                                      bool(pure_death[i]),
                                       int(ldt_sorted[i]), orig_value(i)))
         else:
             best = max(range(lo, hi + 1),
-                       key=lambda i: (bool(eot[i]), bool(death_orig[i]),
+                       key=lambda i: (bool(eot[i]), bool(pure_death[i]),
                                       int(ldt_sorted[i]), orig_value(i)))
         keep[lo:hi + 1] = False
         purgeable = pts_sorted is None or ts_sorted[best] < pts_sorted[best]

@@ -66,14 +66,18 @@ static inline bool stream_less(const View& v, int64_t a, int64_t b) {
 // winner ranking within a cell run — Cells.resolveRegular
 // (db/rows/Cells.java:79, CASSANDRA-14592): newest ts, then
 // expiring-or-tombstone over live, pure tombstone over expiring, larger
-// localDeletionTime, larger value bytes, then first-seen.
+// localDeletionTime, larger value bytes, then first-seen. "Pure
+// tombstone" is the STATIC isTombstone property (death flag, NO ttl):
+// an expired cell converted to a tombstone keeps F_EXPIRING, so its
+// rank is identical before and after conversion — clock-independent.
 static inline bool beats(const View& v, int64_t a, int64_t b) {
     if (v.ts[a] != v.ts[b]) return v.ts[a] > v.ts[b];
     uint8_t fa = v.flags[a], fb = v.flags[b];
     bool ea = (fa & (F_DEATH | F_EXPIRING)) != 0;
     bool eb = (fb & (F_DEATH | F_EXPIRING)) != 0;
     if (ea != eb) return ea;
-    bool da = (fa & F_DEATH) != 0, db = (fb & F_DEATH) != 0;
+    bool da = (fa & F_DEATH) != 0 && (fa & F_EXPIRING) == 0;
+    bool db = (fb & F_DEATH) != 0 && (fb & F_EXPIRING) == 0;
     if (da != db) return da;
     if (v.ldt[a] != v.ldt[b]) return v.ldt[a] > v.ldt[b];
     int64_t la = v.off[a + 1] - v.val_start[a];

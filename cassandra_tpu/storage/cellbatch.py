@@ -47,7 +47,8 @@ import numpy as np
 from ..schema import (COL_PARTITION_DEL, COL_REGULAR_BASE, COL_ROW_DEL,
                       COL_ROW_LIVENESS, TableMetadata)
 from ..utils import murmur3
-from ..utils.timeutil import NO_DELETION_TIME, NO_TIMESTAMP
+from ..utils.timeutil import (NO_DELETION_TIME, NO_TIMESTAMP,
+                              expiration_time as timeutil_expiration)
 from ..utils import varint as vi
 
 # flags
@@ -152,6 +153,138 @@ def content_digest(batch: "CellBatch") -> bytes:
     return h.digest()
 
 
+@dataclass(frozen=True)
+class DataLimits:
+    """Per-replica row limits shipped WITH the read command so replicas
+    truncate at the source instead of the coordinator post-merge
+    (db/filter/DataLimits.java:44 CQLLimits). `row_limit` bounds live
+    rows across the response; `per_partition` bounds live rows within
+    each partition. None = unlimited on that axis."""
+    row_limit: int | None = None
+    per_partition: int | None = None
+
+    def target(self) -> int | None:
+        """The merged-result live-row count that satisfies this limit
+        for a single partition (short-read stop condition)."""
+        vals = [v for v in (self.row_limit, self.per_partition)
+                if v is not None]
+        return min(vals) if vals else None
+
+    def doubled(self) -> "DataLimits":
+        """Short-read protection growth step: each re-query fetches
+        geometrically more so convergence needs O(log n) rounds
+        (ShortReadRowsProtection multiplies its fetch size too)."""
+        return DataLimits(
+            None if self.row_limit is None else self.row_limit * 2,
+            None if self.per_partition is None
+            else self.per_partition * 2)
+
+    def to_wire(self) -> tuple:
+        return (self.row_limit, self.per_partition)
+
+    @staticmethod
+    def from_wire(t) -> "DataLimits | None":
+        return None if t is None else DataLimits(t[0], t[1])
+
+
+def live_row_count(batch: "CellBatch") -> int:
+    """Number of LIVE rows (>= 1 non-death cell) in a sorted+reconciled
+    batch — the unit DataLimits counts."""
+    if len(batch) == 0:
+        return 0
+    _, row_new, _ = batch.boundaries()
+    row_id = np.cumsum(row_new) - 1
+    live_cell = (batch.flags & DEATH_FLAGS) == 0
+    if not live_cell.any():
+        return 0
+    return len(np.unique(row_id[live_cell]))
+
+
+def row_frontier(batch: "CellBatch") -> bytes | None:
+    """Identity-lane key (big-endian bytes, ordered like the sort) of
+    the LAST row in a sorted batch — the position up to which a
+    truncated response VOUCHES for its replica's view. Rows beyond a
+    truncated replica's frontier may be shadowed by tombstones it never
+    shipped, so the coordinator must not serve them from this round
+    (short-read protection's per-source exhaustion check)."""
+    if len(batch) == 0:
+        return None
+    C = batch.n_lanes - 9
+    return batch.lanes[-1, :6 + C].astype(">u4").tobytes()
+
+
+def covered_prefix(batch: "CellBatch", frontier: bytes) -> int:
+    """Number of leading cells whose row identity is <= `frontier`
+    (from row_frontier) — binary search over the sorted identity
+    lanes."""
+    n = len(batch)
+    if n == 0:
+        return 0
+    C = batch.n_lanes - 9
+
+    def key(i: int) -> bytes:
+        return batch.lanes[i, :6 + C].astype(">u4").tobytes()
+
+    lo, hi = 0, n       # first index with key > frontier
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if key(mid) <= frontier:
+            lo = mid + 1
+        else:
+            hi = mid
+    return lo
+
+
+def truncate_live_rows(batch: "CellBatch",
+                       limits: "DataLimits | None"
+                       ) -> tuple["CellBatch", bool]:
+    """DataLimits enforcement on a sorted+reconciled batch: keep cells
+    up to the row_limit-th LIVE row overall and the per_partition-th
+    live row within each partition; everything after is dropped, the
+    way the reference's counting iterator stops consuming its source
+    (db/filter/DataLimits.java:44). Dead rows (tombstone-only) BEFORE
+    the cutoff ship with the response — the coordinator merge needs
+    them to shadow other replicas' stale rows. Returns
+    (batch, truncated): truncated=True means this replica may hold
+    more rows past the cut (short-read protection input)."""
+    n = len(batch)
+    if n == 0 or limits is None or \
+            (limits.row_limit is None and limits.per_partition is None):
+        return batch, False
+    part_new, row_new, _ = batch.boundaries()
+    row_id = np.cumsum(row_new) - 1                     # per cell
+    nrows = int(row_id[-1]) + 1
+    live_cell = (batch.flags & DEATH_FLAGS) == 0
+    row_live = np.zeros(nrows, dtype=bool)
+    row_live[row_id[live_cell]] = True
+    # global live rank: at a live row, how many live rows up to and
+    # including it; at a dead row, how many live rows precede it
+    glr = np.cumsum(row_live)
+    keep_row = np.ones(nrows, dtype=bool)
+    if limits.row_limit is not None:
+        L = limits.row_limit
+        keep_row &= np.where(row_live, glr <= L, glr < L)
+    if limits.per_partition is not None:
+        P = limits.per_partition
+        first_cell_of_row = np.flatnonzero(row_new)
+        row_part_new = part_new[first_cell_of_row]
+        part_of_row = np.cumsum(row_part_new) - 1
+        before = glr - row_live                 # live rows strictly before
+        part_base = before[np.flatnonzero(row_part_new)]
+        pplr = glr - part_base[part_of_row]
+        keep_row &= np.where(row_live, pplr <= P, pplr < P)
+    if keep_row.all():
+        return batch, False
+    keep_cell = keep_row[row_id]
+    # a pure global limit keeps a prefix: zero-copy slice
+    nkeep = int(keep_cell.sum())
+    if keep_cell[:nkeep].all():
+        return batch.slice_range(0, nkeep), True
+    out = batch.apply_permutation(np.flatnonzero(keep_cell))
+    out.sorted = True
+    return out, True
+
+
 def lanes_for_table(table: TableMetadata) -> int:
     return 9 + table.clustering_lanes
 
@@ -242,7 +375,7 @@ class CellBatch:
         # np.lexsort: LAST key is the primary -> least-significant first
         keys = [_U32 - self._value_prefix_lane(),            # value desc
                 np.int64(NO_DELETION_TIME) - self.ldt,       # ldt desc
-                np.uint8(1) - self._death_lane(),            # tombstone 1st
+                np.uint8(1) - self._pure_death_lane(),       # tombstone 1st
                 np.uint8(1) - self._eot_lane()]              # eot first
         with np.errstate(over="ignore"):
             # two's-complement reinterpret + sign-bit flip = biased unsigned
@@ -254,6 +387,17 @@ class CellBatch:
 
     def _death_lane(self) -> np.ndarray:
         return ((self.flags & DEATH_FLAGS) != 0).astype(np.uint8)
+
+    def _pure_death_lane(self) -> np.ndarray:
+        """RANK-grade tombstone bit (Cells.resolveRegular isTombstone —
+        a STATIC property: has a deletion time and NO ttl). An expired
+        expiring cell that compaction converted to a tombstone keeps
+        FLAG_EXPIRING, so its rank is identical before and after the
+        conversion — replicas compacting at different times still
+        reconcile identically (CASSANDRA-14592). Shadowing/purge use
+        death_eff (death | expired), which is separately clock-correct."""
+        return (((self.flags & DEATH_FLAGS) != 0)
+                & ((self.flags & FLAG_EXPIRING) == 0)).astype(np.uint8)
 
     def _eot_lane(self) -> np.ndarray:
         """Expiring-or-tombstone: has a localDeletionTime (static property,
@@ -452,7 +596,7 @@ class CellBatch:
         # (Cells.resolveRegular compares whole values last). Host fix-up,
         # rare.
         vp = self._value_prefix_lane()
-        death = self._death_lane()
+        death = self._pure_death_lane()   # must mirror the sort keys
         eot = self._eot_lane()
         tie = np.zeros(n, dtype=bool)
         if n > 1:
@@ -682,7 +826,7 @@ class CellBatchBuilder:
                  ts: int, ttl: int = 0, now: int = 0, path: bytes = b"") -> None:
         if ttl > 0:
             self.append_raw(pk, ck, column_id, path, value, ts,
-                            ldt=now + ttl, ttl=ttl, flags=FLAG_EXPIRING)
+                            ldt=timeutil_expiration(now, ttl), ttl=ttl, flags=FLAG_EXPIRING)
         else:
             self.append_raw(pk, ck, column_id, path, value, ts)
 
@@ -695,7 +839,7 @@ class CellBatchBuilder:
                          ttl: int = 0, now: int = 0) -> None:
         if ttl > 0:
             self.append_raw(pk, ck, COL_ROW_LIVENESS, b"", b"", ts,
-                            ldt=now + ttl, ttl=ttl,
+                            ldt=timeutil_expiration(now, ttl), ttl=ttl,
                             flags=FLAG_ROW_LIVENESS | FLAG_EXPIRING)
         else:
             self.append_raw(pk, ck, COL_ROW_LIVENESS, b"", b"", ts,

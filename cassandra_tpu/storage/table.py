@@ -14,7 +14,8 @@ import numpy as np
 
 from ..schema import TableMetadata
 from ..utils import timeutil
-from .cellbatch import CellBatch, merge_sorted
+from .cellbatch import (CellBatch, merge_sorted,
+                        truncate_live_rows)
 from .memtable import Memtable
 from .mutation import Mutation
 from .sstable import Descriptor, SSTableReader, SSTableWriter
@@ -223,9 +224,14 @@ class ColumnFamilyStore:
 
     # -------------------------------------------------------------- read --
 
-    def read_partition(self, pk: bytes, now: int | None = None) -> CellBatch:
+    def read_partition(self, pk: bytes, now: int | None = None,
+                       limits=None) -> CellBatch:
         """Merged view of one partition across memtable + sstables
-        (SinglePartitionReadCommand.queryMemtableAndDisk role)."""
+        (SinglePartitionReadCommand.queryMemtableAndDisk role).
+        `limits` (cellbatch.DataLimits) truncates the RETURNED view at
+        the limit-th live row — the full merge still happens (and still
+        feeds the row cache); truncation spares downstream assembly and,
+        replica-side, the wire."""
         self.metrics["reads"] += 1
         from ..service.tracing import active, trace
         now = now if now is not None else timeutil.now_seconds()
@@ -235,6 +241,8 @@ class ColumnFamilyStore:
             if cached is not None:
                 if active() is not None:
                     trace("Row cache hit")
+                if limits is not None:
+                    cached, _ = truncate_live_rows(cached, limits)
                 return cached
             # captured BEFORE the source snapshot (see RowCache.put)
             read_gen = self.row_cache.generation
@@ -257,6 +265,8 @@ class ColumnFamilyStore:
             merged = merge_sorted(sources, now=now)
         if self.row_cache is not None:
             self.row_cache.put(pk, merged, read_gen)
+        if limits is not None:
+            merged, _ = truncate_live_rows(merged, limits)
         return merged
 
     def scan_all(self, now: int | None = None) -> CellBatch:

@@ -13,6 +13,21 @@ NO_TIMESTAMP = -(1 << 63)          # LivenessInfo.NO_TIMESTAMP
 NO_TTL = 0
 NO_DELETION_TIME = 0x7FFFFFFF      # int max: "not deleted / never expires"
 LIVE_DELETION = (NO_TIMESTAMP, NO_DELETION_TIME)
+# largest TTL CQL accepts: 20 years (cql3/Attributes.java MAX_TTL)
+MAX_TTL = 20 * 365 * 24 * 3600
+
+# patchable wall clock (seconds, float). Tests install a virtual clock
+# here to make TTL expiry deterministic; production leaves time.time.
+CLOCK = time.time
+
+
+def expiration_time(now_s: int, ttl: int) -> int:
+    """localDeletionTime of an expiring cell, CAPPED at the int32
+    horizon instead of overflowing (the 2038 problem —
+    db/ExpirationDateOverflowHandling.java policy CAP: a write whose
+    expiry exceeds the representable maximum lives until the cap, it
+    does not wrap into the past and vanish)."""
+    return min(now_s + ttl, NO_DELETION_TIME - 1)
 
 _last_micros = 0
 _micros_lock = threading.Lock()
@@ -31,4 +46,4 @@ def now_micros() -> int:
 
 
 def now_seconds() -> int:
-    return int(time.time())
+    return int(CLOCK())

@@ -1,6 +1,13 @@
 """Seeded fuzzing against the model checker (the harry role —
 test/harry/.../QuiescentChecker.java). Any failure prints the seed and
-op index that reproduce it; set CTPU_FUZZ_SEED to replay."""
+op index that reproduce it; set CTPU_FUZZ_SEED to replay.
+
+TTL expiry runs against a VIRTUAL clock (utils/timeutil.CLOCK) moved by
+the generator's `advance` ops, so expiring cells die mid-stream at
+deterministic points and every run is replayable from its seed —
+including the interaction of expiry with flush/compaction timing, which
+is exactly where the three merge engines could silently diverge
+(CASSANDRA-14592 ranking)."""
 import os
 import time
 
@@ -9,20 +16,33 @@ import pytest
 from cassandra_tpu.cluster.node import LocalCluster
 from cassandra_tpu.cluster.replication import ConsistencyLevel
 from cassandra_tpu.tools.harry import Model, OpGenerator, check_partition
+from cassandra_tpu.utils import timeutil
 
 SEED = int(os.environ.get("CTPU_FUZZ_SEED", "20260729"))
 N_OPS = int(os.environ.get("CTPU_FUZZ_OPS", "10000"))
 
-DDL = ("CREATE TABLE t (k int, c int, v text, w int, "
-       "PRIMARY KEY (k, c))")
+DDL = ("CREATE TABLE t (k int, c int, v text, w int, st text static, "
+       "m map<text,int>, PRIMARY KEY (k, c))")
 
 
-def _compact(node):
+@pytest.fixture
+def vclock(monkeypatch):
+    """Deterministic virtual clock for TTL expiry: the engine reads it
+    through timeutil.CLOCK, the model gets it passed explicitly."""
+    state = {"now": int(time.time())}
+    monkeypatch.setattr(timeutil, "CLOCK", lambda: state["now"])
+    return state
+
+
+def _compact(node, engine=None):
     from cassandra_tpu.compaction.task import CompactionTask
     cfs = node.engine.store("fz", "t")
     inputs = list(cfs.live_sstables())
     if len(inputs) >= 2:
-        CompactionTask(cfs, inputs).execute()
+        if engine is None:
+            CompactionTask(cfs, inputs).execute()
+        else:
+            CompactionTask(cfs, inputs, engine=engine).execute()
 
 
 def _mk_cluster(tmp_path, n, rf):
@@ -37,11 +57,25 @@ def _mk_cluster(tmp_path, n, rf):
     return c, s
 
 
-def test_fuzz_single_node(tmp_path):
-    """10k seeded ops on one node with interleaved flush/compaction;
-    every partition checked against the model every 500 ops and at the
-    end. This certifies the write path + merge/reconcile + tombstone
-    algebra end-to-end through CQL."""
+def _drive(op, s, node, vclock, model, engine=None):
+    """Apply one op to the engine and the model under the shared clock."""
+    if op.kind == "advance":
+        vclock["now"] += op.seconds
+    elif op.kind == "flush":
+        node.engine.store("fz", "t").flush()
+    elif op.kind == "compact":
+        _compact(node, engine)
+    else:
+        s.execute(op.cql("t"))
+    model.apply(op, now_s=vclock["now"])
+
+
+def test_fuzz_single_node(tmp_path, vclock):
+    """10k seeded ops — TTLs, collections, statics, tombstone algebra —
+    on one node with interleaved flush/compaction and virtual-clock
+    advances; every partition checked against the model every 500 ops
+    and at the end. This certifies the write path + merge/reconcile +
+    expiry + deletion algebra end-to-end through CQL."""
     cluster, s = _mk_cluster(tmp_path, 1, 1)
     node = cluster.node(1)
     node.default_cl = ConsistencyLevel.ONE
@@ -51,25 +85,27 @@ def test_fuzz_single_node(tmp_path):
         for op in gen:
             if op.index >= N_OPS:
                 break
-            if op.kind == "flush":
-                node.engine.store("fz", "t").flush()
-            elif op.kind == "compact":
-                _compact(node)
-            else:
-                s.execute(op.cql("t"))
-            model.apply(op)
+            _drive(op, s, node, vclock, model)
             if (op.index + 1) % 500 == 0:
                 for pk in range(gen.n_pks):
-                    check_partition(s, model, "t", pk, SEED, op.index)
+                    check_partition(s, model, "t", pk, SEED, op.index,
+                                    now=vclock["now"])
         node.engine.store("fz", "t").flush()
         _compact(node)
         for pk in range(gen.n_pks):
-            check_partition(s, model, "t", pk, SEED, N_OPS)
+            check_partition(s, model, "t", pk, SEED, N_OPS,
+                            now=vclock["now"])
+        # fast-forward past every short TTL: survivors must be exactly
+        # the non-expiring + capped-overflow cells
+        vclock["now"] += 200_000
+        for pk in range(gen.n_pks):
+            check_partition(s, model, "t", pk, SEED, N_OPS,
+                            now=vclock["now"])
     finally:
         cluster.shutdown()
 
 
-def test_fuzz_cluster_with_drops(tmp_path):
+def test_fuzz_cluster_with_drops(tmp_path, vclock):
     """Seeded ops against a 3-node RF=3 cluster while one replica's
     MUTATION stream is periodically dropped; after hints replay, every
     replica-quorum read must match the model (quiescent checking with
@@ -93,13 +129,7 @@ def test_fuzz_cluster_with_drops(tmp_path):
             if op.index % 400 == 399 and dropping is not None:
                 dropping["remaining"] = 0
                 dropping = None
-            if op.kind == "flush":
-                node.engine.store("fz", "t").flush()
-            elif op.kind == "compact":
-                _compact(node)
-            else:
-                s.execute(op.cql("t"))
-            model.apply(op)
+            _drive(op, s, node, vclock, model)
         if dropping is not None:
             dropping["remaining"] = 0
         # quiesce: hints must drain to every node
@@ -112,7 +142,8 @@ def test_fuzz_cluster_with_drops(tmp_path):
             time.sleep(0.2)
         node.default_cl = ConsistencyLevel.ALL
         for pk in range(gen.n_pks):
-            check_partition(s, model, "t", pk, SEED + 1, n_ops)
+            check_partition(s, model, "t", pk, SEED + 1, n_ops,
+                            now=vclock["now"])
         # and each node's LOCAL data alone serves the model: ONE with a
         # self-first replica ordering reads node i's own copy, so a
         # replica that hint-replay failed to converge is caught here
@@ -121,16 +152,18 @@ def test_fuzz_cluster_with_drops(tmp_path):
             si.keyspace = "fz"
             cluster.node(i).default_cl = ConsistencyLevel.ONE
             for pk in range(0, gen.n_pks, 3):
-                check_partition(si, model, "t", pk, SEED + 1, n_ops)
+                check_partition(si, model, "t", pk, SEED + 1, n_ops,
+                                now=vclock["now"])
     finally:
         cluster.shutdown()
 
 
-def test_fuzz_device_engine_agrees(tmp_path):
-    """The same seeded stream, compacted with the numpy spec engine vs
-    recompacted state must serve identical reads (cheap cross-engine
-    agreement on fuzz-shaped data; the bit-identity tests in
-    test_merge_device.py do the exhaustive version)."""
+def test_fuzz_engines_agree_with_ttls(tmp_path, vclock):
+    """The same seeded TTL+collection stream compacted with the numpy
+    spec engine must serve identical reads — AND the numpy/native
+    engines must produce bit-identical sstable content on the final
+    fuzz-shaped state (expiry conversions included). The bit-identity
+    micro tests in test_merge_device.py do the exhaustive version."""
     cluster, s = _mk_cluster(tmp_path, 1, 1)
     node = cluster.node(1)
     node.default_cl = ConsistencyLevel.ONE
@@ -140,19 +173,91 @@ def test_fuzz_device_engine_agrees(tmp_path):
         for op in gen:
             if op.index >= 1500:
                 break
-            if op.kind == "flush":
-                node.engine.store("fz", "t").flush()
-            elif op.kind == "compact":
-                from cassandra_tpu.compaction.task import CompactionTask
-                cfs = node.engine.store("fz", "t")
-                inputs = list(cfs.live_sstables())
-                if len(inputs) >= 2:
-                    CompactionTask(cfs, inputs, engine="numpy").execute()
-            else:
-                s.execute(op.cql("t"))
-            model.apply(op)
+            _drive(op, s, node, vclock, model, engine="numpy")
         node.engine.store("fz", "t").flush()
         for pk in range(gen.n_pks):
-            check_partition(s, model, "t", pk, SEED + 2, 1500)
+            check_partition(s, model, "t", pk, SEED + 2, 1500,
+                            now=vclock["now"])
+        # cross-engine bit-identity on the accumulated fuzz state
+        from cassandra_tpu.storage import cellbatch as cb
+        cfs = node.engine.store("fz", "t")
+        sources = []
+        for sst in cfs.tracker.view():
+            segs = list(sst.scanner())
+            if segs:
+                cat = cb.CellBatch.concat(segs)
+                cat.sorted = True
+                sources.append(cat)
+        if len(sources) >= 2:
+            a = cb.merge_sorted(sources, now=vclock["now"])
+            from cassandra_tpu.ops.host_merge import merge_sorted_native
+            b = merge_sorted_native(sources, now=vclock["now"])
+            assert cb.content_digest(a) == cb.content_digest(b), (
+                f"numpy vs native merge diverged on fuzz state "
+                f"(seed {SEED + 2})")
     finally:
         cluster.shutdown()
+
+
+def test_expiration_overflow_boundary(tmp_path, vclock):
+    """TTL at MAX_TTL pushes now+ttl past the int32 horizon: the expiry
+    must CAP (cell stays live), not wrap into the past and vanish
+    (db/ExpirationDateOverflowHandling.java policy CAP); TTLs beyond
+    MAX_TTL are rejected at validation."""
+    from cassandra_tpu.cql.execution import InvalidRequest
+    from cassandra_tpu.utils.timeutil import MAX_TTL, NO_DELETION_TIME
+    cluster, s = _mk_cluster(tmp_path, 1, 1)
+    node = cluster.node(1)
+    node.default_cl = ConsistencyLevel.ONE
+    try:
+        s.execute(f"INSERT INTO t (k, c, v) VALUES (1, 1, 'cap') "
+                  f"USING TTL {MAX_TTL}")
+        rows = s.execute("SELECT c, v FROM t WHERE k = 1").rows
+        assert rows == [(1, "cap")]
+        batch = node.engine.store("fz", "t").read_partition(
+            node.schema.get_table("fz", "t").partition_key_columns[0]
+            .cql_type.serialize(1))
+        assert int(batch.ldt.max()) == NO_DELETION_TIME - 1, (
+            "expiry must cap at the int32 horizon, not overflow")
+        with pytest.raises(InvalidRequest, match="too large"):
+            s.execute(f"INSERT INTO t (k, c, v) VALUES (1, 2, 'x') "
+                      f"USING TTL {MAX_TTL + 1}")
+    finally:
+        cluster.shutdown()
+
+
+def test_expiry_rank_is_clock_independent(tmp_path):
+    """CASSANDRA-14592 core property: two expiring writes to the same
+    cell at the SAME timestamp with different expiries must reconcile
+    identically whether the shorter-lived one was compacted (and so
+    converted to a tombstone) before the merge or not."""
+    import numpy as np
+
+    from cassandra_tpu.schema import COL_REGULAR_BASE, make_table
+    from cassandra_tpu.storage import cellbatch as cb
+    t = make_table("ks", "t", pk=["k"], ck=["c"],
+                   cols={"k": "int", "c": "int", "v": "text"})
+    pk = t.columns["k"].cql_type.serialize(1)
+    ck = t.serialize_clustering([1])
+
+    def expiring(value, ldt):
+        b = cb.CellBatchBuilder(t)
+        b.append_raw(pk, ck, COL_REGULAR_BASE, b"", value, 5,
+                     ldt=ldt, ttl=ldt - 1, flags=cb.FLAG_EXPIRING)
+        return b.seal()
+
+    x, z = expiring(b"short", 10), expiring(b"long", 30)
+    now = 20   # x expired, z still alive
+    # path A: merged together at now
+    a = cb.merge_sorted([x, z], now=now)
+    # path B: x compacted ALONE first (expired -> tombstone conversion
+    # persists), then merged with z
+    x_conv = cb.merge_sorted([expiring(b"short", 10)], now=now)
+    assert bool(x_conv.flags[0] & cb.FLAG_TOMBSTONE)
+    b_ = cb.merge_sorted([x_conv, z], now=now)
+    assert cb.content_digest(a) == cb.content_digest(b_), (
+        "merge outcome depends on when compaction ran relative to "
+        "expiry — the equal-ts rank must be clock-independent")
+    # and the long-lived value is the winner in both
+    assert (a.flags[0] & cb.FLAG_TOMBSTONE) == 0
+    assert a.cell_value(0) == b"long"

@@ -1,11 +1,12 @@
 """Divergent-replica read protections.
 
-Short reads: the reference needs ShortReadPartitionsProtection
-(service/reads/ShortReadPartitionsProtection.java:40) because replicas
-truncate at the query LIMIT before merging; here replicas never truncate
-(LIMIT applies post-merge at the coordinator), so correctness under
-divergence is structural — the first test pins that property with the
-reference's canonical failure scenario.
+Short reads: replicas truncate at the pushed DataLimits before merging
+(storage/cellbatch.py truncate_live_rows), so the coordinator carries
+short-read protection — re-query with doubled limits on post-merge
+shortfall (cluster/coordinator.py read_partition;
+service/reads/ShortReadPartitionsProtection.java:40). The first test
+pins the reference's canonical failure scenario end-to-end; deeper
+SRP coverage lives in tests/test_datalimits.py.
 
 Filtered reads: ReplicaFilteringProtection.java:66 — index candidates
 are unioned over blockFor replicas per range and every candidate is
