@@ -85,6 +85,10 @@ class Gossiper:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # per-instance RNG for peer selection: the deterministic
+        # simulator seeds it per node; sharing the module RNG would let
+        # any other thread's draws perturb a simulation's replay
+        self.rng = random.Random()
         self.on_alive = None    # callbacks for hint replay etc.
         self.on_dead = None
         # called with (ep, app_states) when a peer's versioned state
@@ -151,9 +155,9 @@ class Gossiper:
             peers = [e for e in self.states if e != self.ep]
         targets = []
         if peers:
-            targets.append(random.choice(peers))
-        if self.seeds and (not targets or random.random() < 0.3):
-            targets.append(random.choice(self.seeds))
+            targets.append(self.rng.choice(peers))
+        if self.seeds and (not targets or self.rng.random() < 0.3):
+            targets.append(self.rng.choice(self.seeds))
         for t in set(targets):
             self.messaging.send_with_callback(
                 Verb.GOSSIP_SYN, digest, t,

@@ -142,12 +142,19 @@ class MessagingService:
         self._queue: queue.Queue = queue.Queue()
         self.closed = False
         self.metrics = {"sent": 0, "received": 0, "dropped_timeout": 0}
+        # deterministic-simulation mode: a SimTransport (sim/scheduler.py)
+        # carries a scheduler; deliveries and callback timeouts become
+        # virtual-time events processed inline on the pumping thread, so
+        # NO worker/reaper threads exist and every interleaving replays
+        # from the scheduler's seed
+        self._sim = getattr(transport, "scheduler", None)
         transport.register(ep, self)
-        self._worker = threading.Thread(target=self._run, daemon=True,
-                                        name=f"msg-{ep.name}")
-        self._worker.start()
-        self._reaper = threading.Thread(target=self._reap, daemon=True)
-        self._reaper.start()
+        if self._sim is None:
+            self._worker = threading.Thread(target=self._run, daemon=True,
+                                            name=f"msg-{ep.name}")
+            self._worker.start()
+            self._reaper = threading.Thread(target=self._reap, daemon=True)
+            self._reaper.start()
 
     # ------------------------------------------------------------- sending
 
@@ -168,6 +175,9 @@ class MessagingService:
             self._callbacks[msg.id] = (on_response, on_failure,
                                        time.monotonic() + timeout)
         self.metrics["sent"] += 1
+        if self._sim is not None:
+            self._sim.after(timeout, lambda: self._expire_one(msg.id),
+                            f"timeout {self.ep.name}#{msg.id}")
         self.transport.deliver(msg)
         return msg.id
 
@@ -199,37 +209,43 @@ class MessagingService:
                 msg = self._queue.get(timeout=0.2)
             except queue.Empty:
                 continue
-            self.metrics["received"] += 1
-            if msg.reply_to:
-                with self._cb_lock:
-                    cb = self._callbacks.pop(msg.reply_to, None)
-                if cb is not None:
-                    on_response, on_failure, _ = cb
-                    # a FAILURE_RSP (remote handler raised) is a failure,
-                    # never an ack (write/hint acks must mean applied)
-                    fn = on_failure if msg.verb == Verb.FAILURE_RSP \
-                        else on_response
-                    if fn is not None:
-                        try:
-                            # both callbacks receive the Message, so a
-                            # failure handler can inspect the remote
-                            # error payload (callbacks reaped on timeout
-                            # get the bare id instead — see _reap)
-                            fn(msg)
-                        except Exception:
-                            pass
-                continue
-            handler = self.handlers.get(msg.verb)
-            if handler is None:
-                continue
-            try:
-                result = handler(msg)
-            except Exception as e:
-                self.respond_failure(msg, e)
-                continue
-            if result is not None:
-                rsp_verb, payload = result
-                self.respond(msg, rsp_verb, payload)
+            self._process(msg)
+
+    def _process(self, msg: Message) -> None:
+        """Handle one inbound message: response-callback dispatch or
+        verb-handler execution (the _run loop body; the deterministic
+        simulator calls this directly as a scheduled event)."""
+        self.metrics["received"] += 1
+        if msg.reply_to:
+            with self._cb_lock:
+                cb = self._callbacks.pop(msg.reply_to, None)
+            if cb is not None:
+                on_response, on_failure, _ = cb
+                # a FAILURE_RSP (remote handler raised) is a failure,
+                # never an ack (write/hint acks must mean applied)
+                fn = on_failure if msg.verb == Verb.FAILURE_RSP \
+                    else on_response
+                if fn is not None:
+                    try:
+                        # both callbacks receive the Message, so a
+                        # failure handler can inspect the remote
+                        # error payload (callbacks reaped on timeout
+                        # get the bare id instead — see _reap)
+                        fn(msg)
+                    except Exception:
+                        pass
+            return
+        handler = self.handlers.get(msg.verb)
+        if handler is None:
+            return
+        try:
+            result = handler(msg)
+        except Exception as e:
+            self.respond_failure(msg, e)
+            return
+        if result is not None:
+            rsp_verb, payload = result
+            self.respond(msg, rsp_verb, payload)
 
     def _reap(self) -> None:
         """Expire callbacks whose responses never arrived."""
@@ -249,6 +265,21 @@ class MessagingService:
                         fail(mid)
                     except Exception:
                         pass
+
+    def _expire_one(self, mid: int) -> None:
+        """Sim-mode callback expiry (the _reap role as a scheduled
+        event): same contract — the failure callback gets the bare id."""
+        with self._cb_lock:
+            cb = self._callbacks.pop(mid, None)
+        if cb is None:
+            return
+        _ok, fail, _deadline = cb
+        self.metrics["dropped_timeout"] += 1
+        if fail is not None:
+            try:
+                fail(mid)
+            except Exception:
+                pass
 
     def close(self) -> None:
         self.closed = True

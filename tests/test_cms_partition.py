@@ -123,7 +123,7 @@ def test_minority_cannot_commit_majority_can_no_fork(tmp_path):
         assert len(ids) == 1
     finally:
         for n in nodes:
-            n.engine.close()
+            n.shutdown()
 
 
 def test_concurrent_commits_serialize_without_displacement(tmp_path):
@@ -175,4 +175,4 @@ def test_concurrent_commits_serialize_without_displacement(tmp_path):
             assert len(ids) == 1, (name, ids)
     finally:
         for n in nodes:
-            n.engine.close()
+            n.shutdown()

@@ -140,4 +140,4 @@ def test_ddl_during_join_of_lowest_named_node(tmp_path):
             assert len(ids) == 1, (name, ids)
     finally:
         for n in nodes + ([joiner] if joiner else []):
-            n.engine.close()
+            n.shutdown()

@@ -344,4 +344,4 @@ def test_ddl_commits_through_cms(tmp_path):
         assert nodes[3].schema_sync.epoch == 3
     finally:
         for n in nodes:
-            n.engine.close()
+            n.shutdown()
