@@ -188,7 +188,11 @@ class CompactionTask:
     # link transfers overlap host decode/gather/write), and sized so the
     # padded program shape is almost always exactly 2^18 — one compiled
     # program, warm after the first round.
-    ROUND_CELLS_DEVICE = (1 << 18) - (1 << 14)
+    # ~2 rounds per 1M-cell compaction: through a tunneled link the
+    # per-round trip latency (~67 ms measured) dominates, so fewer,
+    # larger rounds win as long as >= 2 keep the decode/write pipeline
+    # overlapped (scripts/device_accounting.py sweeps this)
+    ROUND_CELLS_DEVICE = (1 << 19) - (1 << 15)
     PIPELINE_DEPTH = 3
     # the host engines want SMALL rounds: per-round cost is near zero and
     # many rounds let the pipelined writer thread overlap compression +

@@ -117,6 +117,8 @@ def load() -> ctypes.CDLL:
             i64p, u8p, u32p_]                # outSizes, outRaw, outCrcs
         lib.lanes_unshuffle.restype = None
         lib.lanes_unshuffle.argtypes = [u8p, u8p, i64, i64]
+        lib.part_boundaries.restype = i64
+        lib.part_boundaries.argtypes = [u32p_, i64, i64, i64p]
         lib.gather_frames.restype = i64
         lib.gather_frames.argtypes = [u8p, i64p, i64p, i64, i64p, u8p]
         u32p = ctypes.POINTER(ctypes.c_uint32)

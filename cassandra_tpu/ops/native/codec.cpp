@@ -60,9 +60,10 @@ int64_t lz4_max_compressed(int64_t n) {
     return n + n / 255 + 16;
 }
 
-// returns compressed size, or -1 if dst too small
-int64_t lz4_compress(const uint8_t* src, int64_t srcLen,
-                     uint8_t* dst, int64_t dstCap) {
+// first-party implementation (fallback when the system liblz4 is
+// absent) — returns compressed size, or -1 if dst too small
+static int64_t lz4_compress_fb(const uint8_t* src, int64_t srcLen,
+                               uint8_t* dst, int64_t dstCap) {
     if (srcLen == 0) {
         if (dstCap < 1) return -1;
         dst[0] = 0;  // token: 0 literals, no match
@@ -160,9 +161,10 @@ int64_t lz4_compress(const uint8_t* src, int64_t srcLen,
     return op - dst;
 }
 
-// returns decompressed size, or -1 on malformed input / overflow
-int64_t lz4_decompress(const uint8_t* src, int64_t srcLen,
-                       uint8_t* dst, int64_t dstCap) {
+// first-party fallback — returns decompressed size, or -1 on
+// malformed input / overflow
+static int64_t lz4_decompress_fb(const uint8_t* src, int64_t srcLen,
+                                 uint8_t* dst, int64_t dstCap) {
     const uint8_t* ip = src;
     const uint8_t* iend = src + srcLen;
     uint8_t* op = dst;
@@ -220,7 +222,7 @@ int64_t snappy_max_compressed(int64_t n) {
     return 32 + n + n / 6;
 }
 
-int64_t snappy_compress(const uint8_t* src, int64_t srcLen,
+static int64_t snappy_compress_fb(const uint8_t* src, int64_t srcLen,
                         uint8_t* dst, int64_t dstCap) {
     uint8_t* op = dst;
     uint8_t* oend = dst + dstCap;
@@ -330,7 +332,7 @@ int64_t snappy_compress(const uint8_t* src, int64_t srcLen,
 }
 
 // returns decompressed length or -1
-int64_t snappy_decompress(const uint8_t* src, int64_t srcLen,
+static int64_t snappy_decompress_fb(const uint8_t* src, int64_t srcLen,
                           uint8_t* dst, int64_t dstCap) {
     const uint8_t* ip = src;
     const uint8_t* iend = src + srcLen;
@@ -398,6 +400,158 @@ int64_t snappy_decompress(const uint8_t* src, int64_t srcLen,
 // in outSizes; returns 0 or -1 (first failure aborts).
 
 typedef int64_t (*codec_fn)(const uint8_t*, int64_t, uint8_t*, int64_t);
+
+
+// --------------------------------------------------- byte transpose ------
+// R x C byte-matrix transpose (dst[c*R + r] = src[r*C + c]) used by the
+// lane byte-plane shuffle (write path) and unshuffle (read path). SSE2
+// 16x16 kernel: four unpack stages leave rows in 4-bit bit-reversed
+// order (self-inverse), so each vector stores to row BITREV4 of its
+// index. ~5x the scalar tiled loop on this host.
+#if defined(__SSE2__)
+#include <emmintrin.h>
+static const int TR16_PERM[16] =
+    {0, 8, 4, 12, 2, 10, 6, 14, 1, 9, 5, 13, 3, 11, 7, 15};
+static inline void tr16x16(__m128i x[16]) {
+    __m128i t[16], u[16];
+    for (int i = 0; i < 8; ++i) {
+        t[i]   = _mm_unpacklo_epi8(x[2*i], x[2*i+1]);
+        t[i+8] = _mm_unpackhi_epi8(x[2*i], x[2*i+1]);
+    }
+    for (int i = 0; i < 8; ++i) {
+        u[i]   = _mm_unpacklo_epi16(t[2*i], t[2*i+1]);
+        u[i+8] = _mm_unpackhi_epi16(t[2*i], t[2*i+1]);
+    }
+    for (int i = 0; i < 8; ++i) {
+        t[i]   = _mm_unpacklo_epi32(u[2*i], u[2*i+1]);
+        t[i+8] = _mm_unpackhi_epi32(u[2*i], u[2*i+1]);
+    }
+    for (int i = 0; i < 8; ++i) {
+        x[i]   = _mm_unpacklo_epi64(t[2*i], t[2*i+1]);
+        x[i+8] = _mm_unpackhi_epi64(t[2*i], t[2*i+1]);
+    }
+}
+#endif
+
+static void byte_transpose(const uint8_t* src, int64_t R, int64_t C,
+                           uint8_t* dst) {
+#if defined(__SSE2__)
+    int64_t r0 = 0;
+    for (; r0 + 16 <= R; r0 += 16) {
+        int64_t c0 = 0;
+        for (; c0 + 16 <= C; c0 += 16) {
+            __m128i x[16];
+            for (int i = 0; i < 16; i++)
+                x[i] = _mm_loadu_si128(
+                    (const __m128i*)(src + (r0 + i) * C + c0));
+            tr16x16(x);
+            for (int i = 0; i < 16; i++)
+                _mm_storeu_si128(
+                    (__m128i*)(dst + (c0 + TR16_PERM[i]) * R + r0), x[i]);
+        }
+        for (; c0 < C; c0++) {
+            uint8_t* d = dst + c0 * R + r0;
+            const uint8_t* s = src + r0 * C + c0;
+            for (int i = 0; i < 16; i++) { d[i] = *s; s += C; }
+        }
+    }
+    for (; r0 < R; r0++)
+        for (int64_t c = 0; c < C; c++)
+            dst[c * R + r0] = src[r0 * C + c];
+#else
+    const int64_t TR = 256;       // cache-tiled scalar fallback
+    for (int64_t t0 = 0; t0 < R; t0 += TR) {
+        int64_t t1 = t0 + TR < R ? t0 + TR : R;
+        for (int64_t c = 0; c < C; c++) {
+            uint8_t* d = dst + c * R + t0;
+            const uint8_t* s = src + t0 * C + c;
+            for (int64_t r = t0; r < t1; r++) { *d++ = *s; s += C; }
+        }
+    }
+#endif
+}
+
+// ---- system-library fast paths ------------------------------------
+// LZ4/Snappy block formats are fixed public formats, so the system
+// libraries (lz4 1.9 SIMD-tuned, snappy-c) produce bit-compatible
+// blocks 1.4-3.4x faster than the first-party loops on this host.
+// dlopen'd lazily like zstd; the first-party code stays as the
+// fallback so the build has no hard dependency.
+static void* p_lz4_c = nullptr;    // LZ4_compress_default
+static void* p_lz4_d = nullptr;    // LZ4_decompress_safe
+static pthread_once_t lz4_once = PTHREAD_ONCE_INIT;
+static void lz4_resolve_once() {
+    void* h = dlopen("liblz4.so.1", RTLD_NOW | RTLD_GLOBAL);
+    if (!h) h = dlopen("liblz4.so", RTLD_NOW | RTLD_GLOBAL);
+    if (!h) return;
+    p_lz4_c = dlsym(h, "LZ4_compress_default");
+    p_lz4_d = dlsym(h, "LZ4_decompress_safe");
+    if (!p_lz4_c || !p_lz4_d) { p_lz4_c = p_lz4_d = nullptr; }
+}
+typedef int (*lz4_c_fn)(const char*, char*, int, int);
+typedef int (*lz4_d_fn)(const char*, char*, int, int);
+
+int64_t lz4_compress(const uint8_t* src, int64_t srcLen,
+                     uint8_t* dst, int64_t dstCap) {
+    pthread_once(&lz4_once, lz4_resolve_once);
+    if (p_lz4_c && srcLen > 0 && srcLen < (1 << 30)
+        && dstCap < (1 << 30)) {
+        int r = ((lz4_c_fn)p_lz4_c)((const char*)src, (char*)dst,
+                                    (int)srcLen, (int)dstCap);
+        return r > 0 ? (int64_t)r : -1;
+    }
+    return lz4_compress_fb(src, srcLen, dst, dstCap);
+}
+
+int64_t lz4_decompress(const uint8_t* src, int64_t srcLen,
+                       uint8_t* dst, int64_t dstCap) {
+    pthread_once(&lz4_once, lz4_resolve_once);
+    if (p_lz4_d && srcLen > 0 && srcLen < (1 << 30)
+        && dstCap < (1 << 30)) {
+        int r = ((lz4_d_fn)p_lz4_d)((const char*)src, (char*)dst,
+                                    (int)srcLen, (int)dstCap);
+        return r >= 0 ? (int64_t)r : -1;
+    }
+    return lz4_decompress_fb(src, srcLen, dst, dstCap);
+}
+
+static void* p_snp_c = nullptr;    // snappy_compress (snappy-c API)
+static void* p_snp_d = nullptr;    // snappy_uncompress
+static pthread_once_t snp_once = PTHREAD_ONCE_INIT;
+static void snp_resolve_once() {
+    void* h = dlopen("libsnappy.so.1", RTLD_NOW | RTLD_GLOBAL);
+    if (!h) h = dlopen("libsnappy.so", RTLD_NOW | RTLD_GLOBAL);
+    if (!h) return;
+    p_snp_c = dlsym(h, "snappy_compress");
+    p_snp_d = dlsym(h, "snappy_uncompress");
+    if (!p_snp_c || !p_snp_d) { p_snp_c = p_snp_d = nullptr; }
+}
+typedef int (*snp_fn)(const char*, size_t, char*, size_t*);
+
+int64_t snappy_compress(const uint8_t* src, int64_t srcLen,
+                        uint8_t* dst, int64_t dstCap) {
+    pthread_once(&snp_once, snp_resolve_once);
+    if (p_snp_c) {
+        size_t outLen = (size_t)dstCap;
+        int s = ((snp_fn)p_snp_c)((const char*)src, (size_t)srcLen,
+                                  (char*)dst, &outLen);
+        return s == 0 ? (int64_t)outLen : -1;
+    }
+    return snappy_compress_fb(src, srcLen, dst, dstCap);
+}
+
+int64_t snappy_decompress(const uint8_t* src, int64_t srcLen,
+                          uint8_t* dst, int64_t dstCap) {
+    pthread_once(&snp_once, snp_resolve_once);
+    if (p_snp_d) {
+        size_t outLen = (size_t)dstCap;
+        int s = ((snp_fn)p_snp_d)((const char*)src, (size_t)srcLen,
+                                  (char*)dst, &outLen);
+        return s == 0 ? (int64_t)outLen : -1;
+    }
+    return snappy_decompress_fb(src, srcLen, dst, dstCap);
+}
+
 
 static int64_t run_batch(codec_fn fn, const uint8_t* src,
                          const int64_t* srcOffs, uint8_t* dst,
@@ -636,23 +790,7 @@ int64_t segment_pack(int64_t codec, const uint8_t** blocks,
         if (i == shuffle_block && lane_width > 0) {
             int64_t W = 4 * lane_width;          // row bytes
             int64_t nrows = srcLen / W;
-            // row-tiled transpose: plane starts sit 64KiB-multiples
-            // apart (power-of-two segment sizes), so a row-at-a-time
-            // scatter puts W concurrent write streams in the SAME cache
-            // set and thrashes; per tile only one plane's 4-line window
-            // is hot at a time
-            const int64_t TR = 256;
-            for (int64_t r0 = 0; r0 < nrows; r0 += TR) {
-                int64_t r1 = r0 + TR < nrows ? r0 + TR : nrows;
-                for (int64_t p = 0; p < W; p++) {
-                    uint8_t* d = scratch + p * nrows + r0;
-                    const uint8_t* s = srcp + r0 * W + p;
-                    for (int64_t r = r0; r < r1; r++) {
-                        *d++ = *s;
-                        s += W;
-                    }
-                }
-            }
+            byte_transpose(srcp, nrows, W, scratch);
             // lexicographic order check (u32 numeric per column)
             const uint32_t* rows = (const uint32_t*)srcp;
             for (int64_t r = 1; r < nrows; r++) {
@@ -704,19 +842,28 @@ int64_t segment_pack(int64_t codec, const uint8_t** blocks,
 // sequential write stream.
 void lanes_unshuffle(const uint8_t* planes, uint8_t* rows, int64_t nrows,
                      int64_t lane_width) {
-    int64_t W = 4 * lane_width;
-    const int64_t TR = 256;   // row-tiled (see shuffle_block note)
-    for (int64_t r0 = 0; r0 < nrows; r0 += TR) {
-        int64_t r1 = r0 + TR < nrows ? r0 + TR : nrows;
-        for (int64_t p = 0; p < W; p++) {
-            const uint8_t* s = planes + p * nrows + r0;
-            uint8_t* d = rows + r0 * W + p;
-            for (int64_t r = r0; r < r1; r++) {
-                *d = *s++;
-                d += W;
-            }
-        }
+    byte_transpose(planes, 4 * lane_width, nrows, rows);
+}
+
+
+// Partition boundaries: indices where the first 4 identity lanes (the
+// partition key lanes) change. One cache-friendly pass replacing the
+// writer's strided numpy slice-copy + row compare. Returns the count.
+int64_t part_boundaries(const uint32_t* lanes, int64_t nrows,
+                        int64_t lane_width, int64_t* out_idx) {
+    if (nrows == 0) return 0;
+    int64_t n = 0;
+    out_idx[n++] = 0;
+    const uint32_t* prev = lanes;
+    const uint32_t* cur = lanes + lane_width;
+    for (int64_t r = 1; r < nrows; r++) {
+        if (cur[0] != prev[0] || cur[1] != prev[1] ||
+            cur[2] != prev[2] || cur[3] != prev[3])
+            out_idx[n++] = r;
+        prev = cur;
+        cur += lane_width;
     }
+    return n;
 }
 
 // ------------------------------------------------------------ gather -----

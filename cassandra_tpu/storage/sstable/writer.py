@@ -27,6 +27,25 @@ from ..cellbatch import CellBatch
 from .format import SEGMENT_CELLS, Component, Descriptor
 
 
+def _part_starts(lanes_c: "np.ndarray", n: int) -> "np.ndarray":
+    """Row indices where the partition (first 4 lanes) changes — native
+    single pass with a numpy fallback."""
+    try:
+        from ...ops.native import build as native_build
+        lib = native_build.load()
+        out = np.empty(n, dtype=np.int64)
+        import ctypes
+        cnt = lib.part_boundaries(
+            lanes_c.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            n, lanes_c.shape[1],
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return out[:cnt]
+    except Exception:
+        part_new = np.ones(n, dtype=bool)
+        part_new[1:] = (lanes_c[1:, :4] != lanes_c[:-1, :4]).any(axis=1)
+        return np.flatnonzero(part_new)
+
+
 class SSTableWriter:
     # trickle fsync (conf trickle_fsync role), used by the BUFFERED
     # fallback path only: push dirty pages to disk WHILE later segments
@@ -390,14 +409,15 @@ class SSTableWriter:
                 if ((a[rows, fi] > b[rows, fi]) & anyneq).any():
                     raise ValueError("appended cells out of order")
 
-        # --- partition directory + bloom
-        lane4 = np.ascontiguousarray(seg.lanes[:, :4])
-        part_new = np.ones(n, dtype=bool)
-        part_new[1:] = (lane4[1:] != lane4[:-1]).any(axis=1)
-        starts = np.flatnonzero(part_new)
+        # --- partition directory + bloom: one native pass over the
+        # lanes finds the rows where the 4 pk lanes change (the numpy
+        # strided slice-copy + row-compare this replaces was a measured
+        # write-leg hotspot)
+        lanes_c = np.ascontiguousarray(seg.lanes)
+        starts = _part_starts(lanes_c, n)
         new_keys = []
         for s in starts:
-            l4 = lane4[s].astype(">u4").tobytes()
+            l4 = lanes_c[s, :4].astype(">u4").tobytes()
             if l4 == self._last_lane4:
                 continue  # partition continues from previous segment
             pk = seg.pk_map.get(l4)
@@ -481,8 +501,7 @@ class SSTableWriter:
         if self._packer is not None:
             # fused native path: delta + order check + compress-or-raw +
             # CRC + sequential placement, one GIL-released call
-            lanes_b = np.ascontiguousarray(
-                seg.lanes.astype(np.uint32, copy=False))
+            lanes_b = lanes_c
             blocks = [meta, lanes_b, payload_b]
             need = sum(b.nbytes for b in blocks)
             if self._pack_out is None or self._pack_out.nbytes < need:
