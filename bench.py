@@ -59,10 +59,15 @@ CONFIGS = {
     "twcs": {"desc": "TWCS time-series, TTL purge, LZ4 16KiB",
              "compressor": ("LZ4Compressor", 16 * 1024),
              "runs": [262_144] * 4, "values": "points", "ttl": True},
-    "ucs": {"desc": "UCS mixed-density, Zstd 64KiB",
+    "ucs": {"desc": "UCS mixed-density (Ws T4,T2,L4), Zstd 64KiB",
             "compressor": ("ZstdCompressor", 64 * 1024),
             "runs": [524_288, 262_144, 131_072, 65_536, 65_536],
-            "values": "blob"},
+            "values": "blob",
+            # per-level scaling vector recorded on the table: densities
+            # in this workload span 3 levels of the mixed geometry
+            "compaction": {"class": "UnifiedCompactionStrategy",
+                           "scaling_parameters": "T4, T2, L4",
+                           "base_shard_count": 4}},
 }
 
 
@@ -161,12 +166,15 @@ def main():
     cfg = CONFIGS[cfg_name]
     comp, chunk = cfg["compressor"]
     gc_grace = 0 if cfg.get("ttl") else 864000
+    params = TableParams(
+        compression=CompressionParams(comp, chunk_length=chunk),
+        gc_grace_seconds=gc_grace)
+    if cfg.get("compaction"):
+        params.compaction = dict(cfg["compaction"])
     table = make_table(
         "bench", "stress", pk=["id"], ck=["c"],
         cols={"id": "int", "c": "int", "v": "blob"},
-        params=TableParams(
-            compression=CompressionParams(comp, chunk_length=chunk),
-            gc_grace_seconds=gc_grace))
+        params=params)
 
     engine = os.environ.get("CTPU_BENCH_ENGINE", "native")
     base = tempfile.mkdtemp(prefix="ctpu-bench-")

@@ -214,51 +214,140 @@ class TimeWindowCompactionStrategy(AbstractCompactionStrategy):
 
 
 class UnifiedCompactionStrategy(AbstractCompactionStrategy):
-    """Unified strategy (reference UnifiedCompactionStrategy.java:66 and
-    UnifiedCompactionStrategy.md, simplified): sstables bucket into
-    density levels with fanout F = 2 + |w|; a positive scaling parameter w
-    behaves tiered (merge when F sstables share a level), negative behaves
-    leveled (merge eagerly at 2), and outputs are sharded into
-    `base_shard_count` token ranges — the knob that parallelises one
-    logical compaction across cores/chips (ShardManager.java:33; the mesh
-    path in parallel/mesh.py consumes exactly these shards)."""
+    """Unified strategy (reference UnifiedCompactionStrategy.java:66,
+    unified/Controller.java:154, UnifiedCompactionStrategy.md):
+
+    * `scaling_parameters` is a PER-LEVEL VECTOR ("T4, T8, N, L4"):
+      level i uses W = vector[min(i, len-1)]. Positive W behaves tiered
+      (fanout 2+W, threshold 2+W), negative behaves leveled (fanout
+      2-W, threshold 2), N is the middle (fanout 2, threshold 2) —
+      UnifiedCompactionStrategy.fanoutFromScalingParameter /
+      thresholdFromScalingParameter.
+    * SSTables form DENSITY levels: boundaries start at
+      min_sstable_size x fanout(0) and each level's ceiling multiplies
+      by ITS OWN fanout (Controller.getMaxLevelDensity) — so a mixed
+      vector changes the level geometry, not just thresholds.
+    * Outputs are sharded density-aware (Controller.getNumShards): a
+      power-of-two multiple of `base_shard_count` chosen so each shard
+      lands near `target_sstable_size` x density^sstable_growth, with
+      the min-size clamp below the base count. The shard count is the
+      knob that parallelises one logical compaction across cores/chips
+      (ShardManager.java:33; parallel/mesh.py consumes these shards).
+    """
+
+    MAX_SHARD_SHIFT = 20
 
     def __init__(self, cfs, options=None, repaired=None):
         super().__init__(cfs, options, repaired)
-        # e.g. scaling_parameters: "T4" (w=2), "L4" (w=-2), "N" (w=0)
         spec = str(self.options.get("scaling_parameters", "T4"))
-        self.w = self._parse_w(spec)
-        self.fanout = 2 + abs(self.w)
+        # the per-level W vector; levels beyond the end repeat the last
+        self.scaling_vector = self.parse_scaling_vector(spec)
         self.base_shard_count = int(self.options.get("base_shard_count", 4))
         self.min_sstable_size = int(self.options.get(
             "min_sstable_size", 2 * 1024 * 1024))
+        self.target_sstable_size = int(self.options.get(
+            "target_sstable_size", 1 << 30))
+        self.sstable_growth = float(self.options.get("sstable_growth",
+                                                     0.333))
+
+    # ------------------------------------------------ scaling vector --
 
     @staticmethod
-    def _parse_w(spec: str) -> int:
-        spec = spec.strip().upper()
-        if spec.startswith("T"):
-            return max(int(spec[1:] or 4) - 2, 0)
-        if spec.startswith("L"):
-            return -max(int(spec[1:] or 4) - 2, 0)
-        return 0
+    def parse_scaling_vector(spec: str) -> list:
+        out = []
+        for part in str(spec).split(","):
+            part = part.strip().upper()
+            if not part:
+                continue
+            if part == "N":
+                out.append(0)
+            elif part.startswith("T"):
+                out.append(max(int(part[1:] or 4) - 2, 0))
+            elif part.startswith("L"):
+                out.append(-max(int(part[1:] or 4) - 2, 0))
+            else:
+                out.append(int(part))
+        return out or [2]
 
-    def _level_of(self, sst: SSTableReader) -> int:
+    def scaling_w(self, level: int) -> int:
+        v = self.scaling_vector
+        return v[level] if level < len(v) else v[-1]
+
+    def fanout(self, level: int) -> int:
+        w = self.scaling_w(level)
+        return 2 - w if w < 0 else 2 + w
+
+    def threshold(self, level: int) -> int:
+        w = self.scaling_w(level)
+        return 2 if w <= 0 else 2 + w
+
+    # ------------------------------------------------- density levels --
+
+    def level_of(self, density: float) -> int:
+        """The density level an sstable of `density` bytes falls in:
+        level ceilings grow by each level's OWN fanout
+        (Controller.getMaxLevelDensity iterated)."""
+        ceiling = float(self.min_sstable_size) * self.fanout(0)
+        lvl = 0
+        while density >= ceiling and lvl < 64:
+            lvl += 1
+            ceiling *= self.fanout(lvl)
+        return lvl
+
+    def form_levels(self, sstables) -> dict:
+        levels: dict[int, list] = {}
+        for s in sstables:
+            levels.setdefault(self.level_of(float(s.data_size)),
+                              []).append(s)
+        return levels
+
+    # ------------------------------------------------ shard geometry --
+
+    def num_shards(self, density: float) -> int:
+        """Controller.getNumShards: power-of-two multiple of the base
+        count targeting target_sstable_size x growth correction, with
+        the min-size clamp below the base."""
         import math
-        density = max(sst.data_size / self.min_sstable_size, 1.0)
-        return int(math.log(density, self.fanout)) if density > 1 else 0
+
+        if self.min_sstable_size > 0:
+            count = density / self.min_sstable_size
+            if not count >= self.base_shard_count:
+                # below the base: power-of-two DIVISOR of the base so
+                # boundaries still align with higher levels
+                low_bit = self.base_shard_count & -self.base_shard_count
+                return min(1 << max(int(count) | 1, 1).bit_length() - 1,
+                           low_bit)
+        g = self.sstable_growth
+        if g >= 1:
+            return self.base_shard_count
+        if g <= 0:
+            count = density / (self.target_sstable_size * math.sqrt(0.5)
+                               * self.base_shard_count)
+            count = min(count, float(1 << self.MAX_SHARD_SHIFT))
+            return self.base_shard_count *                 (1 << max(int(count) | 1, 1).bit_length() - 1)
+        # partial growth: exponent of the density/target ratio scaled by
+        # (1 - growth), rounded to the nearest power of two
+        count = density / (self.target_sstable_size
+                           * self.base_shard_count)
+        if count <= 0:
+            return self.base_shard_count
+        exponent = int(max(0, min(
+            math.floor(math.log2(count) * (1 - g) + 0.5),
+            self.MAX_SHARD_SHIFT)))
+        return self.base_shard_count * (1 << exponent)
+
+    # -------------------------------------------------- task selection --
 
     def next_background_task(self):
         from .task import CompactionTask
-        levels: dict[int, list[SSTableReader]] = {}
-        for s in self.candidates():
-            levels.setdefault(self._level_of(s), []).append(s)
-        threshold = self.fanout if self.w >= 0 else 2
+        levels = self.form_levels(self.candidates())
         for lvl in sorted(levels):
             group = levels[lvl]
-            if len(group) >= threshold:
+            if len(group) >= self.threshold(lvl):
                 inputs = group[: self.max_threshold]
-                total = sum(s.data_size for s in inputs)
-                shard_bytes = max(total // self.base_shard_count,
+                total = float(sum(s.data_size for s in inputs))
+                shards = self.num_shards(total)
+                shard_bytes = max(int(total // shards),
                                   self.min_sstable_size)
                 return CompactionTask(self.cfs, inputs,
                                       max_output_bytes=shard_bytes,

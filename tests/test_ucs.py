@@ -1,0 +1,109 @@
+"""UCS reference-shape semantics: per-level scaling vector, density
+level geometry, and density-aware shard counts.
+
+Reference: db/compaction/unified/Controller.java:154 (scaling vector,
+getNumShards), UnifiedCompactionStrategy.java:106
+(fanout/thresholdFromScalingParameter), getMaxLevelDensity level
+geometry.
+"""
+import pytest
+
+from cassandra_tpu.compaction.strategies import UnifiedCompactionStrategy
+
+
+def _ucs(**options):
+    class _CFS:
+        def live_sstables(self):
+            return []
+    o = {"min_sstable_size": 1024, "base_shard_count": 4,
+         "target_sstable_size": 1 << 20, "sstable_growth": 0.0}
+    o.update(options)
+    return UnifiedCompactionStrategy(_CFS(), o)
+
+
+def test_scaling_vector_parsing_and_repeat():
+    u = _ucs(scaling_parameters="T4, T8, N, L4")
+    assert u.scaling_vector == [2, 6, 0, -2]
+    # per-level lookup; beyond the end repeats the LAST entry
+    assert [u.scaling_w(i) for i in range(6)] == [2, 6, 0, -2, -2, -2]
+    # raw integers are accepted too (reference pattern allows [+-]?d+)
+    assert _ucs(scaling_parameters="2, -2, 0").scaling_vector == [2, -2, 0]
+
+
+def test_fanout_and_threshold_per_level():
+    u = _ucs(scaling_parameters="T4, N, L4")
+    # T4: w=2 -> tiered: fanout 4, threshold 4
+    assert (u.fanout(0), u.threshold(0)) == (4, 4)
+    # N: w=0 -> fanout 2, threshold 2
+    assert (u.fanout(1), u.threshold(1)) == (2, 2)
+    # L4: w=-2 -> leveled: fanout 4, threshold 2 (eager)
+    assert (u.fanout(2), u.threshold(2)) == (4, 2)
+
+
+def test_density_level_geometry_mixed_vector():
+    """Level ceilings multiply by each level's OWN fanout
+    (getMaxLevelDensity iterated): min=1024, vector T4,N,L8 gives
+    ceilings 1024*4=4096, *2=8192, *8=65536, *8=..."""
+    u = _ucs(scaling_parameters="T4, N, L8")
+    assert u.level_of(1023) == 0
+    assert u.level_of(4095) == 0
+    assert u.level_of(4096) == 1
+    assert u.level_of(8191) == 1
+    assert u.level_of(8192) == 2
+    assert u.level_of(65535) == 2
+    assert u.level_of(65536) == 3
+    # uniform-vector sanity: T4 everywhere -> pure log base 4
+    v = _ucs(scaling_parameters="T4")
+    assert v.level_of(1024 * 4 - 1) == 0
+    assert v.level_of(1024 * 4) == 1
+    assert v.level_of(1024 * 16) == 2
+
+
+def test_num_shards_growth_modes():
+    u0 = _ucs(sstable_growth=0.0)
+    # fixed mode: growth 1 always yields the base count
+    u1 = _ucs(sstable_growth=1.0)
+    assert u1.num_shards(1 << 30) == 4
+    # growth 0: power-of-two multiple of base targeting ~target size
+    # density = 64 MiB, target 1 MiB, base 4 -> ~64 shards
+    s = u0.num_shards(64 << 20)
+    assert s % 4 == 0 and s & (s - 1) == 0 or s % 4 == 0
+    assert 32 <= s <= 128
+    # shard count never shrinks as density grows
+    prev = 0
+    for d in (1 << 20, 8 << 20, 64 << 20, 512 << 20):
+        n = u0.num_shards(d)
+        assert n >= prev
+        prev = n
+    # intermediate growth: between fixed and full splitting
+    uh = _ucs(sstable_growth=0.5)
+    assert u1.num_shards(64 << 20) <= uh.num_shards(64 << 20) \
+        <= u0.num_shards(64 << 20)
+
+
+def test_num_shards_min_size_clamp():
+    """Densities below base_shard_count x min size split only to
+    power-of-two DIVISORS of the base so boundaries align upward."""
+    u = _ucs(min_sstable_size=1 << 20, base_shard_count=4)
+    assert u.num_shards(512 << 10) == 1       # half a min-size sstable
+    assert u.num_shards(2 << 20) <= 4
+
+
+def test_selection_uses_per_level_threshold(tmp_path):
+    """Level 0 (T4) needs 4 sstables; level 1 (L4) compacts at 2 — the
+    vector changes WHICH group fires, not just how big it is."""
+    class FakeSST:
+        def __init__(self, size):
+            self.data_size = size
+            self.is_repaired = False
+
+    u = _ucs(scaling_parameters="T4, L4", min_sstable_size=1024)
+    # three small (level 0, threshold 4: not enough), two big (level 1,
+    # threshold 2: fires)
+    small = [FakeSST(1000) for _ in range(3)]
+    big = [FakeSST(5000) for _ in range(2)]
+    levels = u.form_levels(small + big)
+    assert set(levels) == {0, 1}
+    assert len(levels[0]) == 3 and len(levels[1]) == 2
+    assert len(levels[0]) < u.threshold(0)
+    assert len(levels[1]) >= u.threshold(1)
