@@ -99,7 +99,7 @@ def tablestats(engine, keyspace: str | None = None) -> dict:
 
 
 def repair(node, keyspace: str, table: str | None = None,
-           full: bool = False) -> list[dict]:
+           full: bool = False, preview: bool = False) -> list[dict]:
     """nodetool repair — incremental by default: validation still covers
     the FULL data set (unrepaired-only trees diverge once repaired
     status differs across replicas), but afterwards the validated
@@ -110,7 +110,8 @@ def repair(node, keyspace: str, table: str | None = None,
     for name in ([table] if table else list(ks.tables)):
         out.append({"table": f"{keyspace}.{name}",
                     **node.repair.repair_table(keyspace, name,
-                                               incremental=not full)})
+                                               incremental=not full,
+                                               preview=preview)})
     return out
 
 
@@ -733,6 +734,88 @@ def move(node, new_token: int) -> dict:
 # Registry: name -> (target kind, callable). Target "node" needs the full
 # cluster Node; "engine" works on a bare StorageEngine (offline --data
 # mode supports only those); "none" needs neither.
+def repair_admin(node, list_all: bool = False) -> list[dict]:
+    """nodetool repair_admin — durable repair-session records
+    (repair/consistent/LocalSessions role): by default the sessions
+    still IN_PROGRESS (including ones orphaned by a coordinator crash,
+    read back from the journal after restart); --list_all for the full
+    history."""
+    store = node.repair.sessions
+    return store.sessions() if list_all else store.in_flight()
+
+
+def bulkload(node, directory: str, keyspace: str, table: str) -> dict:
+    """nodetool bulkload — ring-aware streaming of externally-written
+    sstables into the cluster (tools/BulkLoader.java role; see
+    tools/sstableloader.py for the standalone CLI)."""
+    from .sstableloader import load
+    return load(directory, node, keyspace, table)
+
+
+def rebuild(node, keyspace: str | None = None) -> dict:
+    """nodetool rebuild — re-stream every range this node replicates
+    from a surviving replica (tools/nodetool/Rebuild.java): entire
+    in-range sstables land as component files, boundary-straddling data
+    as merged batches. Used after disk loss or to fill a node that
+    joined without bootstrap."""
+    from ..cluster.replication import ReplicationStrategy
+    from ..storage import cellbatch as cbmod
+    MIN, MAX = -(1 << 63), (1 << 63) - 1
+    total_files = 0
+    total_cells = 0
+    ranges_done = 0
+    for ks in list(node.schema.keyspaces.values()):
+        if keyspace and ks.name != keyspace:
+            continue
+        if not ks.tables:
+            continue
+        strat = ReplicationStrategy.create(ks.params.replication)
+        for lo, hi in node.ring.all_ranges():
+            replicas = strat.replicas(node.ring, hi)
+            if node.endpoint not in replicas:
+                continue
+            sources = [e for e in replicas
+                       if e != node.endpoint and node.is_alive(e)]
+            if not sources:
+                # RF=1 ranges have no other replica; skip silently only
+                # when we are the SOLE replica, else surface the outage
+                if len(replicas) > 1:
+                    raise RuntimeError(
+                        f"rebuild: no live source for range ({lo}, {hi}] "
+                        f"of {ks.name} (replicas {replicas})")
+                continue
+            ranges_done += 1
+            for tname, table in ks.tables.items():
+                cfs = node.engine.store(ks.name, tname)
+                arcs = [(MIN, hi), (lo, MAX)] if lo > hi else [(lo, hi)]
+                batches = []
+                landed = []
+                for alo, ahi in arcs:
+                    files, leftover = node.streams.fetch_range(
+                        sources[0], ks.name, tname, alo, ahi,
+                        node.proxy.timeout)
+                    for comps in files:
+                        landed.append(
+                            node.streams.land_sstable(cfs, comps))
+                        total_files += 1
+                    if len(leftover):
+                        batches.append(leftover)
+                if batches:
+                    batch = cbmod.merge_sorted(batches)
+                    from ..storage.sstable import Descriptor, SSTableWriter
+                    gen = cfs.next_generation()
+                    w = SSTableWriter(Descriptor(cfs.directory, gen),
+                                      table)
+                    w.append(batch)
+                    w.finish()
+                    total_cells += len(batch)
+                if landed or batches:
+                    cfs.reload_sstables()
+    return {"ranges": ranges_done, "files_streamed": total_files,
+            "cells_streamed": total_cells}
+
+
+
 COMMANDS: dict = {}
 for _name, _target in [
         ("status", "node"), ("info", "engine"), ("ring", "node"),
@@ -773,7 +856,9 @@ for _name, _target in [
         ("updatecidrgroup", "engine"), ("dropcidrgroup", "engine"),
         ("listcidrgroups", "engine"),
         ("invalidatecredentialscache", "engine"),
-        ("decommission", "node"), ("move", "node")]:
+        ("decommission", "node"), ("move", "node"),
+        ("bulkload", "node"), ("rebuild", "node"),
+        ("repair_admin", "node")]:
     COMMANDS[_name] = (_target, globals()[_name])
 
 
