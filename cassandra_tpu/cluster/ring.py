@@ -135,7 +135,8 @@ class Ring:
         return next(self.successors(token))
 
     def token_of(self, key: bytes) -> int:
-        return murmur3.token_of(key)
+        from ..utils import partitioners
+        return partitioners.token_of(key)
 
     def ranges_of(self, ep: Endpoint) -> list[tuple[int, int]]:
         """(start, end] ranges owned primarily by ep."""

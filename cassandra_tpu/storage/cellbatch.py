@@ -290,8 +290,10 @@ def lanes_for_table(table: TableMetadata) -> int:
 
 
 def pk_lanes(pk: bytes) -> tuple[int, int, int, int]:
-    """The four partition lanes of a key: biased token + murmur h2."""
-    token = murmur3.token_of(pk)
+    """The four partition lanes of a key: biased token (from the
+    CLUSTER partitioner — utils/partitioners) + murmur h2 identity."""
+    from ..utils import partitioners
+    token = partitioners.token_of(pk)
     _, h2 = murmur3.hash128(pk)
     t = token + _BIAS
     return (t >> 32, t & _U32, h2 >> 32, h2 & _U32)

@@ -76,14 +76,14 @@ def paged_rows(store, table, now: int | None = None,
         comp = table.clustering_comp
         skip_key = (state.token, pk_lane_key(state.pk),
                     comp(state.ck) if state.ck else b"")
-    from ..utils import murmur3
+    from ..utils import murmur3, partitioners
     for batch in store.iter_scan(now=now, after=after,
                                  window_parts=window_parts):
         if on_batch is not None:
             on_batch(batch)
         for row in rows_from_batch(table, batch):
             if skip_key is not None:
-                tok = murmur3.token_of(row.pk)
+                tok = partitioners.token_of(row.pk)
                 pos = (tok, pk_lane_key(row.pk),
                        table.clustering_comp(row.ck_frame)
                        if row.ck_frame else b"")
@@ -96,6 +96,7 @@ def paged_rows(store, table, now: int | None = None,
 def position_of(table, row, remaining: int = -1,
                 ppl_seen: int = 0) -> PagingState:
     """PagingState pointing AT this row (resume returns rows after it)."""
-    from ..utils import murmur3
-    return PagingState(murmur3.token_of(row.pk), row.pk, row.ck_frame,
+    from ..utils import murmur3, partitioners
+    return PagingState(partitioners.token_of(row.pk), row.pk,
+                       row.ck_frame,
                        remaining, ppl_seen)

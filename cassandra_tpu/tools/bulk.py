@@ -67,11 +67,19 @@ def build_int_batch(table: TableMetadata, pk_ints: np.ndarray,
     # token + pk hash lanes (pad to 32-byte width for the hasher)
     padded = np.zeros((n, 32), dtype=np.uint8)
     padded[:, :4] = pk_mat
-    h1, h2 = murmur3.hash128_mat(padded, np.full(n, 4, dtype=np.int64))
-    with np.errstate(over="ignore"):
+    lens4 = np.full(n, 4, dtype=np.int64)
+    h1, h2 = murmur3.hash128_mat(padded, lens4)
+    from ..utils import partitioners
+    part = partitioners.current()
+    if isinstance(part, partitioners.Murmur3Partitioner):
+        # identity hash already computed h1: derive the token from it
+        # instead of hashing every key a second time
         tok = h1.astype(np.int64)
         tok = np.where(tok == np.iinfo(np.int64).min,
                        np.iinfo(np.int64).max, tok)
+    else:
+        tok = part.tokens_mat(padded, lens4)
+    with np.errstate(over="ignore"):
         ut = tok.astype(np.uint64) ^ np.uint64(_BIAS)
 
     frame5, comp, comp_len = _ck_frame_and_comp(ck_ints)

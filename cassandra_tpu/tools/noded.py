@@ -44,9 +44,22 @@ def build_node(cfg: dict):
     from ..schema import Schema
 
     from ..cluster.tls import TLSConfig
-    me = Endpoint(cfg["name"], cfg.get("dc", "dc1"),
-                  cfg.get("rack", "rack1"), cfg.get("host", "127.0.0.1"),
-                  int(cfg["port"]))
+    if cfg.get("partitioner"):
+        # cluster-wide key->token mapping; must install before any
+        # write bakes tokens into lanes (cassandra.yaml `partitioner`)
+        from ..utils import partitioners
+        partitioners.set_current(cfg["partitioner"])
+    dc, rack = cfg.get("dc"), cfg.get("rack")
+    if cfg.get("snitch") and (dc is None or rack is None):
+        # snitch-resolved placement (locator/ SPI): explicit dc/rack in
+        # the config win; otherwise the snitch supplies them
+        from ..cluster import snitch as snitch_mod
+        sdc, srack = snitch_mod.create(
+            cfg["snitch"]).local_dc_rack(cfg["name"])
+        dc = dc or sdc
+        rack = rack or srack
+    me = Endpoint(cfg["name"], dc or "dc1", rack or "rack1",
+                  cfg.get("host", "127.0.0.1"), int(cfg["port"]))
     if cfg.get("auto_join"):
         return _build_tcm_node(cfg, me)
     ring = Ring()
