@@ -74,6 +74,12 @@ class Node:
         replicated applies (BatchlogManager role)."""
         return self.engine.batchlog
 
+    @property
+    def guardrails(self):
+        """The executor reads guardrails off its backend; a Node backend
+        delegates to the engine's instance (one catalog per node)."""
+        return self.engine.guardrails
+
     # ------------------------------------------------------------- verbs --
 
     def _register_verbs(self):

@@ -537,6 +537,14 @@ class Executor:
     # ----------------------------------------------------------------- DDL
 
     def _exec_CreateKeyspaceStatement(self, s, params, ks, now):
+        gr = getattr(self.backend, "guardrails", None)
+        if gr is not None:
+            gr.check_keyspace_count(1 + len(self.schema.keyspaces))
+            rep = s.replication or {}
+            rfs = [int(v) for k, v in rep.items()
+                   if k not in ("class",) and str(v).isdigit()]
+            for rf in rfs:
+                gr.check_replication_factor(rf, s.name)
         self.schema.create_keyspace(
             s.name, KeyspaceParams(replication=s.replication,
                                    durable_writes=s.durable_writes),
@@ -559,6 +567,8 @@ class Executor:
         if gr is not None:
             gr.check_table_count(1 + sum(len(k.tables) for k in
                                          self.schema.keyspaces.values()))
+            gr.check_columns_per_table(len(s.columns),
+                                       f"{ks}.{s.name}")
         udts = self.schema.keyspaces[ks].user_types
         cols = {n: t for n, t, _ in s.columns}
         statics = {n for n, _, st in s.columns if st}
@@ -571,6 +581,21 @@ class Executor:
                  if n not in s.partition_key and n not in s.clustering
                  and not st]
         stat = [(n, parse_type(cols[n], udts)) for n in statics]
+        if gr is not None:
+            # PARSED types, so frozen<vector<...>> and friends are seen
+            from ..types.marshal import VectorType
+
+            def _vec_dims(typ):
+                if isinstance(typ, VectorType):
+                    yield typ.dimension
+                for sub_t in ("elem", "key", "val"):
+                    inner = getattr(typ, sub_t, None)
+                    if inner is not None and hasattr(inner, "serialize"):
+                        yield from _vec_dims(inner)
+            for n_, typ in pkc + [(n, t) for n, t, _ in ckc] \
+                    + other + stat:
+                for dims in _vec_dims(typ):
+                    gr.check_vector_dimensions(dims, n_)
         tid = None
         if "id" in s.options:
             # CREATE TABLE ... WITH id = <uuid>: explicit table id —
@@ -596,6 +621,12 @@ class Executor:
         bks = s.base_keyspace or keyspace
         if ks is None or bks is None:
             raise InvalidRequest("no keyspace for CREATE MATERIALIZED VIEW")
+        gr = getattr(self.backend, "guardrails", None)
+        if gr is not None:
+            have = sum(1 for v in self.schema.views.values()
+                       if v.get("base") == (bks, s.base_table))
+            gr.check_materialized_views(have + 1,
+                                        f"{bks}.{s.base_table}")
         if (ks, s.name) in self.schema.views:
             if s.if_not_exists:
                 return ResultSet([], [])
@@ -892,6 +923,9 @@ class Executor:
         return p
 
     def _exec_CreateTypeStatement(self, s, params, keyspace, now):
+        gr = getattr(self.backend, "guardrails", None)
+        if gr is not None:
+            gr.check_fields_per_udt(len(s.fields), s.name)
         ks = s.keyspace or keyspace
         ksm = self.schema.keyspaces.get(ks)
         if ksm is None:
@@ -911,6 +945,12 @@ class Executor:
         t = self._table(s, keyspace)
         if s.column not in t.columns:
             raise InvalidRequest(f"unknown column {s.column}")
+        gr = getattr(self.backend, "guardrails", None)
+        registry0 = getattr(self.backend, "indexes", None)
+        if gr is not None and registry0 is not None:
+            have = sum(1 for (ks0, tb0, _c) in registry0.indexes
+                       if ks0 == t.keyspace and tb0 == t.name)
+            gr.check_secondary_indexes(have + 1, t.full_name())
         # index definitions are per-node structures: register on EVERY
         # node of an in-process cluster (TCP clusters replicate the DDL
         # itself through the schema log, so each process runs this)
@@ -965,6 +1005,10 @@ class Executor:
         return ResultSet([], [])
 
     def _exec_DropStatement(self, s, params, keyspace, now):
+        if s.what in ("table", "keyspace"):
+            gr = getattr(self.backend, "guardrails", None)
+            if gr is not None:
+                gr.check_drop_truncate(f"DROP {s.what.upper()}")
         ks = s.keyspace or keyspace
         try:
             if s.what == "keyspace":
@@ -1083,6 +1127,9 @@ class Executor:
         return ResultSet([], [])
 
     def _exec_TruncateStatement(self, s, params, keyspace, now):
+        gr = getattr(self.backend, "guardrails", None)
+        if gr is not None:
+            gr.check_drop_truncate("TRUNCATE")
         t = self._table(s, keyspace)
         self.backend.store(t.keyspace, t.name).truncate()
         return ResultSet([], [])
@@ -1223,11 +1270,18 @@ class Executor:
             self._add_collection_cells(m, t, col, ck, v, ts, ttl, now_s,
                                        flags)
             return
-        m.add(ck, cid, b"", typ.serialize(v), ts, ldt, ttl, flags)
+        ser = typ.serialize(v)
+        gr = getattr(self.backend, "guardrails", None)
+        if gr is not None:
+            gr.check_column_value_size(len(ser), col.name)
+        m.add(ck, cid, b"", ser, ts, ldt, ttl, flags)
 
     def _add_collection_cells(self, m, t, col, ck, v, ts, ttl, now_s, flags):
         typ = col.cql_type
         cid = col.column_id
+        gr = getattr(self.backend, "guardrails", None)
+        if gr is not None and hasattr(v, "__len__"):
+            gr.check_items_per_collection(len(v), col.name)
         ldt = timeutil.expiration_time(now_s, ttl) if ttl else 0x7FFFFFFF
         if isinstance(typ, MapType):
             for k, val in v.items():
@@ -1566,6 +1620,10 @@ class Executor:
                 rs = _jsonify_resultset(rs)
             return rs
 
+        if s.allow_filtering:
+            gr = getattr(self.backend, "guardrails", None)
+            if gr is not None:
+                gr.check_allow_filtering()
         index_rows = None
         if filters and not s.allow_filtering:
             index_rows = self._indexed_lookup(t, cfs, filters, params)
@@ -1727,6 +1785,10 @@ class Executor:
 
         state = paging_mod.PagingState.deserialize(paging_state) \
             if paging_state else None
+        if page_size:
+            gr = getattr(self.backend, "guardrails", None)
+            if gr is not None:
+                gr.check_page_size(page_size)
         post_agg = self._limit_after_projection(s, t) or bool(s.order_by)
         if post_agg:
             # aggregates / GROUP BY / DISTINCT / sorted scans consume all
@@ -2089,7 +2151,8 @@ class Executor:
                     from ..utils import murmur3
                     pkb = t.serialize_partition_key(
                         [r[c.name] for c in t.partition_key_columns])
-                    row.append(murmur3.token_of(pkb))
+                    from ..utils import partitioners
+                    row.append(partitioners.token_of(pkb))
                 elif f in ("writetime", "ttl"):
                     meta = r.get("__meta__", {}).get(cname)
                     # a deleted column has null writetime/ttl (the meta of

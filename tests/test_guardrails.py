@@ -1,0 +1,117 @@
+"""Guardrails catalog (db/guardrails/Guardrails.java): warn/fail
+thresholds wired through the CQL paths that trip them."""
+import pytest
+
+from cassandra_tpu.storage.guardrails import (GuardrailViolation,
+                                              Guardrails)
+
+
+def test_threshold_ladder_and_disabled_sides():
+    g = Guardrails(columns_per_table_warn=2, columns_per_table_fail=4)
+    g.check_columns_per_table(2, "t")      # at warn: ok
+    g.check_columns_per_table(3, "t")      # above warn: warns
+    assert any("columns in t" in w for w in g.warnings)
+    with pytest.raises(GuardrailViolation):
+        g.check_columns_per_table(5, "t")
+    # 0 disables a side
+    g2 = Guardrails(page_size_warn=0, page_size_fail=0)
+    g2.check_page_size(10 ** 9)
+
+
+def test_catalog_breadth():
+    g = Guardrails()
+    checks = [m for m in dir(g) if m.startswith("check_")]
+    assert len(checks) >= 15, checks
+
+
+@pytest.fixture
+def node(tmp_path):
+    from cassandra_tpu.cluster.node import LocalCluster
+    c = LocalCluster(1, str(tmp_path), rf=1)
+    s = c.session(1)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    yield c.nodes[0], s
+    c.shutdown()
+
+
+def test_ddl_guardrails_fire_through_cql(node):
+    n, s = node
+    gr = n.engine.guardrails
+    gr.columns_per_table_fail = 3
+    with pytest.raises(Exception, match="columns"):
+        s.execute("CREATE TABLE wide (k int PRIMARY KEY, a int, b int, "
+                  "c int, d int)")
+    gr.columns_per_table_fail = 500
+    gr.fields_per_udt_fail = 2
+    with pytest.raises(Exception, match="UDT"):
+        s.execute("CREATE TYPE big (f1 int, f2 int, f3 int)")
+    gr.minimum_replication_factor_fail = 2
+    with pytest.raises(Exception, match="replication factor"):
+        s.execute("CREATE KEYSPACE low WITH replication = "
+                  "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    gr.minimum_replication_factor_fail = 0
+
+
+def test_drop_truncate_and_filtering_gates(node):
+    n, s = node
+    s.execute("CREATE TABLE t (k int PRIMARY KEY, v int)")
+    s.execute("INSERT INTO t (k, v) VALUES (1, 2)")
+    gr = n.engine.guardrails
+    gr.drop_truncate_table_enabled = False
+    with pytest.raises(Exception, match="TRUNCATE"):
+        s.execute("TRUNCATE t")
+    with pytest.raises(Exception, match="DROP"):
+        s.execute("DROP TABLE t")
+    gr.drop_truncate_table_enabled = True
+    gr.allow_filtering_enabled = False
+    with pytest.raises(Exception, match="ALLOW FILTERING"):
+        s.execute("SELECT * FROM t WHERE v = 2 ALLOW FILTERING")
+    gr.allow_filtering_enabled = True
+
+
+def test_collection_item_guardrail_fires(node):
+    n, s = node
+    s.execute("CREATE TABLE cm (k int PRIMARY KEY, m map<text,int>)")
+    n.engine.guardrails.items_per_collection_fail = 2
+    with pytest.raises(Exception, match="items in collection"):
+        s.execute("UPDATE cm SET m = {'a': 1, 'b': 2, 'c': 3} "
+                  "WHERE k = 1")
+    n.engine.guardrails.items_per_collection_fail = 0
+
+
+def test_index_and_view_counts_fire(node):
+    """The 2i / MV counters must see EXISTING objects (regression:
+    both once counted 0 and could never trip)."""
+    n, s = node
+    gr = n.engine.guardrails
+    s.execute("CREATE TABLE gx (k int PRIMARY KEY, a int, b int, c int)")
+    gr.secondary_indexes_per_table_fail = 2
+    s.execute("CREATE INDEX ON gx (a)")
+    s.execute("CREATE INDEX ON gx (b)")
+    with pytest.raises(Exception, match="secondary indexes"):
+        s.execute("CREATE INDEX ON gx (c)")
+    gr.secondary_indexes_per_table_fail = 10
+    s.execute("CREATE TABLE gb (k int, c int, v int, "
+              "PRIMARY KEY (k, c))")
+    gr.materialized_views_per_table_fail = 1
+    s.execute("CREATE MATERIALIZED VIEW mv1 AS SELECT * FROM gb "
+              "WHERE k IS NOT NULL AND c IS NOT NULL "
+              "PRIMARY KEY (c, k)")
+    with pytest.raises(Exception, match="materialized views"):
+        s.execute("CREATE MATERIALIZED VIEW mv2 AS SELECT * FROM gb "
+                  "WHERE k IS NOT NULL AND c IS NOT NULL "
+                  "PRIMARY KEY (c, k)")
+    gr.materialized_views_per_table_fail = 10
+
+
+def test_vector_dimension_guardrail_sees_parsed_types(node):
+    n, s = node
+    n.engine.guardrails.vector_dimensions_fail = 16
+    with pytest.raises(Exception, match="vector dimensions"):
+        s.execute("CREATE TABLE vec (k int PRIMARY KEY, "
+                  "e vector<float, 32>)")
+    n.engine.guardrails.vector_dimensions_fail = 8192
+    s.execute("CREATE TABLE vec (k int PRIMARY KEY, "
+              "e vector<float, 8>)")
