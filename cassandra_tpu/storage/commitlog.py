@@ -39,12 +39,20 @@ class CommitLogPosition(tuple):
 
 _ENC_MAGIC = b"CTPUCLE1"   # encrypted segment: magic + u32 key id + nonce16
 _ENC_HDR = len(_ENC_MAGIC) + 4 + 16
+# compressed segment (db/commitlog/CompressedSegment.java role): magic +
+# u8 codec-name length + codec name. Records in such a segment use the
+# 12-byte frame [u32 stored_len][u32 crc][u32 raw_len]; raw_len ==
+# stored_len marks an incompressible record stored raw. Composes with
+# encryption as compress-then-encrypt (the reference's EncryptedSegment
+# also compresses before encrypting); the CRC covers the stored bytes.
+_COMP_MAGIC = b"CTPUCLC1"
 
 
 class CommitLog:
     def __init__(self, directory: str, segment_size: int = 32 * 1024 * 1024,
                  sync_mode: str = "periodic", sync_period_ms: int = 1000,
-                 archive_dir: str | None = None, encrypt: bool = False):
+                 archive_dir: str | None = None, encrypt: bool = False,
+                 compression: str | None = None):
         """archive_dir: finished segments are copied there on rotation
         and at close (CommitLogArchiver role — the restore half is
         replay_archived / StorageEngine.restore_point_in_time).
@@ -57,6 +65,11 @@ class CommitLog:
         self.sync_period_ms = sync_period_ms
         self.archive_dir = archive_dir
         self.encrypt = encrypt
+        self.compression = compression or None
+        self._compressor = None
+        if self.compression:
+            from ..ops.codec import get_compressor
+            self._compressor = get_compressor(self.compression)
         if archive_dir:
             os.makedirs(archive_dir, exist_ok=True)
         os.makedirs(directory, exist_ok=True)
@@ -114,6 +127,7 @@ class CommitLog:
             self._file.close()
             prev = self._seg_id - 1
         self._file = open(self._seg_path(self._seg_id), "ab")
+        self._seg_comp = None
         if prev is not None and self.archive_dir:
             # async: the rotated segment is immutable; the worker copies
             # it off the write path (deletion waits for the archive)
@@ -142,6 +156,13 @@ class CommitLog:
                         "rotate before enabling encryption")
                 self._seg_enc = (int.from_bytes(hdr[8:12], "little"),
                                  hdr[12:28])
+        if self._compressor is not None:
+            if self._file.tell() == 0 or (
+                    self.encrypt and self._file.tell() == _ENC_HDR):
+                name = self.compression.encode()
+                self._file.write(_COMP_MAGIC + bytes([len(name)]) + name)
+                self._file.flush()
+            self._seg_comp = self._compressor
         # reserve the whole segment's blocks up front (KEEP_SIZE: st_size
         # stays at the append point so replay's EOF/torn-tail detection is
         # unaffected). The reference pre-creates fixed-size segments for
@@ -158,17 +179,27 @@ class CommitLog:
         the record is durable when this returns (CommitLog.add:300)."""
         payload = mutation.serialize()
         with self._lock:
-            if self._file.tell() + len(payload) + 8 > self.segment_size:
+            if self._file.tell() + len(payload) + 12 > self.segment_size:
                 self._seg_id += 1
                 self._open_segment()
             pos = CommitLogPosition(self._seg_id, self._file.tell())
+            raw_len = len(payload)
+            if self._seg_comp is not None:
+                c = self._seg_comp.compress(payload)
+                if len(c) < raw_len:
+                    payload = c
             if self._seg_enc is not None:
                 from . import encryption as enc_mod
                 kid, nonce = self._seg_enc
+                hdr = 12 if self._seg_comp is not None else 8
                 payload = enc_mod.get_context().xor_at(
-                    kid, nonce, pos.offset + 8, payload)
-            frame = struct.pack("<II", len(payload),
-                                zlib.crc32(payload)) + payload
+                    kid, nonce, pos.offset + hdr, payload)
+            if self._seg_comp is not None:
+                frame = struct.pack("<III", len(payload),
+                                    zlib.crc32(payload), raw_len) + payload
+            else:
+                frame = struct.pack("<II", len(payload),
+                                    zlib.crc32(payload)) + payload
             self._file.write(frame)
             self._dirty.setdefault(self._seg_id, set()).add(mutation.table_id)
             if self.sync_mode == "batch":
@@ -203,6 +234,7 @@ class CommitLog:
             data = f.read()
         pos = 0
         enc = None
+        comp = None
         if data.startswith(_ENC_MAGIC):
             from . import encryption as enc_mod
             ctx = enc_mod.get_context()
@@ -213,19 +245,34 @@ class CommitLog:
             enc = (ctx, int.from_bytes(data[8:12], "little"),
                    data[12:_ENC_HDR])
             pos = _ENC_HDR
-        while pos + 8 <= len(data):
-            length, crc = struct.unpack_from("<II", data, pos)
-            if length == 0 or pos + 8 + length > len(data):
+        if data[pos:pos + len(_COMP_MAGIC)] == _COMP_MAGIC:
+            from ..ops.codec import get_compressor
+            nlen = data[pos + len(_COMP_MAGIC)]
+            name = data[pos + len(_COMP_MAGIC) + 1:
+                        pos + len(_COMP_MAGIC) + 1 + nlen].decode()
+            comp = get_compressor(name)
+            pos += len(_COMP_MAGIC) + 1 + nlen
+        hdr = 12 if comp is not None else 8
+        while pos + hdr <= len(data):
+            if comp is not None:
+                length, crc, raw_len = struct.unpack_from("<III", data,
+                                                          pos)
+            else:
+                length, crc = struct.unpack_from("<II", data, pos)
+                raw_len = length
+            if length == 0 or pos + hdr + length > len(data):
                 break  # torn tail
-            payload = data[pos + 8: pos + 8 + length]
+            payload = data[pos + hdr: pos + hdr + length]
             if zlib.crc32(payload) != crc:
                 break  # corrupt tail
             if enc is not None:
                 ctx, kid, nonce = enc
-                payload = ctx.xor_at(kid, nonce, pos + 8, payload)
+                payload = ctx.xor_at(kid, nonce, pos + hdr, payload)
+            if comp is not None and length < raw_len:
+                payload = comp.uncompress(bytes(payload), raw_len)
             yield CommitLogPosition(seg_id, pos), \
-                Mutation.deserialize(payload)
-            pos += 8 + length
+                Mutation.deserialize(bytes(payload))
+            pos += hdr + length
 
     # ------------------------------------------------------------ archive
 

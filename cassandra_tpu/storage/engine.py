@@ -26,6 +26,7 @@ class StorageEngine:
                  keystore_dir: str | None = None,
                  commitlog_archive_dir: str | None = None,
                  encrypt_commitlog: bool = False,
+                 commitlog_compression: str | None = None,
                  settings=None):
         """keystore_dir enables TDE: an EncryptionContext is installed
         node-wide (tables opt in via WITH encryption = {'enabled': true};
@@ -61,10 +62,13 @@ class StorageEngine:
             self.encryption_ctx = enc_mod.get_context()
         from .cdc import CDCLog
         self.cdc = CDCLog(os.path.join(data_dir, "cdc_raw"))
-        self.commitlog = CommitLog(os.path.join(data_dir, "commitlog"),
-                                   sync_mode=commitlog_sync,
-                                   archive_dir=commitlog_archive_dir,
-                                   encrypt=encrypt_commitlog) \
+        self.commitlog = CommitLog(
+            os.path.join(data_dir, "commitlog"),
+            sync_mode=commitlog_sync,
+            archive_dir=commitlog_archive_dir,
+            encrypt=encrypt_commitlog,
+            compression=commitlog_compression
+            or (self.settings.get("commitlog_compression") or None)) \
             if durable_writes else None
         self.stores: dict = {}  # table_id -> ColumnFamilyStore
         self._lock = threading.RLock()

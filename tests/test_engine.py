@@ -256,3 +256,45 @@ def test_alter_and_index_persist_across_restart(tmp_path):
     rs = s2.execute("SELECT k FROM t WHERE zz = 'zval'")
     assert rs.rows == [(1,)]
     eng2.close()
+
+
+def test_compressed_commitlog_roundtrip(tmp_path):
+    """Compressed segments (db/commitlog/CompressedSegment.java role):
+    records written through an LZ4 commitlog replay bit-identically,
+    torn tails still terminate cleanly, and the on-disk segment is
+    smaller than the raw payload volume for compressible writes."""
+    import os
+    import uuid
+
+    from cassandra_tpu.storage.commitlog import CommitLog
+    from cassandra_tpu.storage.mutation import Mutation
+
+    d = str(tmp_path / "cl")
+    cl = CommitLog(d, sync_mode="batch", compression="LZ4Compressor")
+    tid = uuid.uuid4()
+    written = []
+    for i in range(200):
+        m = Mutation(tid, f"pk{i % 8}".encode())
+        m.add(b"", 8, b"", (b"value-%d" % i) * 40, ts=i)
+        cl.add(m)
+        written.append(m)
+    cl.sync()
+    replayed = list(cl.replay())
+    assert len(replayed) == 200
+    for (pos, got), want in zip(replayed, written):
+        assert got.serialize() == want.serialize()
+    # compressible payloads: stored bytes well under raw volume
+    raw = sum(len(m.serialize()) + 12 for m in written)
+    stored = sum(os.path.getsize(os.path.join(d, fn))
+                 for fn in os.listdir(d) if fn.endswith(".log"))
+    # preallocation keeps st_size at the append point, so this compares
+    # actual written extents
+    assert stored < raw * 0.6, (stored, raw)
+    # torn tail: truncate mid-record, replay stops cleanly
+    seg = os.path.join(d, f"commitlog-{cl.segment_ids()[-1]}.log")
+    sz = os.path.getsize(seg)
+    with open(seg, "r+b") as f:
+        f.truncate(sz - 7)
+    n = len(list(cl.replay()))
+    assert n == 199
+    cl.close()
