@@ -70,6 +70,62 @@ def build_validation_tree(table, batch: cb.CellBatch,
     return tree
 
 
+class RepairSessionStore:
+    """Durable repair-session records (repair/consistent/
+    LocalSessions.java role): every coordinated session is journaled to
+    repair_sessions.jsonl BEFORE it runs and finalized after, so a
+    coordinator restart can report in-flight sessions (state
+    IN_PROGRESS with no FINALIZED record) instead of forgetting them —
+    the operator sees exactly which sessions died mid-flight
+    (`nodetool repair_admin`)."""
+
+    def __init__(self, directory: str):
+        import os
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, "repair_sessions.jsonl")
+        self._lock = threading.Lock()
+
+    def _append(self, rec: dict) -> None:
+        import json
+        import os
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+
+    def begin(self, session_id: str, **info) -> None:
+        self._append({"id": session_id, "state": "IN_PROGRESS", **info})
+
+    def finish(self, session_id: str, state: str, **info) -> None:
+        self._append({"id": session_id, "state": state, **info})
+
+    def sessions(self) -> list[dict]:
+        """Latest state per session id, oldest first — survives
+        restarts (read back from the journal)."""
+        import json
+        import os
+        out: dict[str, dict] = {}
+        if not os.path.exists(self.path):
+            return []
+        with self._lock:
+            with open(self.path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue   # torn tail after a crash
+                    out[rec["id"]] = {**out.get(rec["id"], {}), **rec}
+        return list(out.values())
+
+    def in_flight(self) -> list[dict]:
+        return [s for s in self.sessions()
+                if s.get("state") == "IN_PROGRESS"]
+
+
 class RepairService:
     """Per-node repair endpoint + coordinator entry point."""
 
@@ -80,6 +136,7 @@ class RepairService:
         # bounded: old sessions age out at constant memory
         from collections import deque
         self.history: "deque[dict]" = deque(maxlen=256)
+        self.sessions = RepairSessionStore(node.engine.data_dir)
         node.messaging.register_handler(Verb.REPAIR_VALIDATION_REQ,
                                         self._handle_validation)
         node.messaging.register_handler(Verb.REPAIR_SYNC_REQ,
@@ -186,13 +243,38 @@ class RepairService:
 
     def repair_table(self, keyspace: str, table_name: str,
                      depth: int = 10, timeout: float = 10.0,
-                     incremental: bool = False) -> dict:
+                     incremental: bool = False,
+                     preview: bool = False) -> dict:
         """Full-range repair of one table across its replica set
         (RepairJob). incremental=True validates/syncs only data that was
         never repaired, then ANTICOMPACTS on every replica: synced
         ranges split out of unrepaired sstables and are stamped
         repairedAt, so future repairs skip them and compaction never
-        mixes across the boundary (repair/consistent/). Returns stats."""
+        mixes across the boundary (repair/consistent/).
+
+        preview=True runs VALIDATION ONLY (repair --preview,
+        PreviewKind role): merkle trees are built and diffed but
+        nothing streams and nothing is stamped — the stats report how
+        much WOULD sync. Sessions journal durably through
+        RepairSessionStore either way. Returns stats."""
+        import uuid as _uuid
+        session_id = str(_uuid.uuid4())
+        self.sessions.begin(session_id, keyspace=keyspace,
+                            table=table_name, incremental=incremental,
+                            preview=preview,
+                            coordinator=self.node.endpoint.name)
+        try:
+            stats = self._repair_table(keyspace, table_name, depth,
+                                       timeout, incremental, preview)
+        except Exception as e:
+            self.sessions.finish(session_id, "FAILED", error=repr(e))
+            raise
+        self.sessions.finish(session_id, "COMPLETED", **{
+            k: v for k, v in stats.items() if isinstance(v, (int, bool))})
+        return stats
+
+    def _repair_table(self, keyspace, table_name, depth, timeout,
+                      incremental, preview) -> dict:
         node = self.node
         ks = node.schema.keyspaces[keyspace]
         strat = ReplicationStrategy.create(ks.params.replication)
@@ -251,6 +333,9 @@ class RepairService:
 
         stats = {"replicas": len(live), "ranges_synced": 0,
                  "cells_streamed": 0}
+        if preview:
+            stats["preview"] = True
+            stats["ranges_mismatched"] = 0
         # diff LEAF-WISE among that leaf range's replica set only — with
         # RF < cluster size, comparing full trees across non-replicas
         # would stream data to nodes that don't own it (placement
@@ -275,12 +360,16 @@ class RepairService:
                         if key in synced:
                             continue
                         synced.add(key)
+                        if preview:
+                            # validate-only: report, never stream
+                            stats["ranges_mismatched"] += 1
+                            continue
                         n = self._sync_range(keyspace, table_name, a, b,
                                              lo, hi, timeout)
                         stats["ranges_synced"] += 1
                         stats["cells_streamed"] += n
 
-        if incremental:
+        if incremental and not preview:
             # the whole token space is now consistent across the replica
             # set: anticompact everywhere so repaired data crosses the
             # boundary and future incremental repairs skip it

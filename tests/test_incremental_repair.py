@@ -156,3 +156,61 @@ def test_incremental_repair_refuses_down_replica(tmp_path):
                                           timeout=5.0)
     finally:
         c.shutdown()
+
+
+def test_preview_repair_reports_without_streaming(tmp_path):
+    """repair --preview (PreviewKind role): diverged replicas are
+    REPORTED but nothing streams and nothing is stamped; a followup
+    real repair fixes what preview saw."""
+    from cassandra_tpu.cluster.messaging import Verb
+    from cassandra_tpu.cluster.node import LocalCluster
+    from cassandra_tpu.cluster.replication import ConsistencyLevel
+    c = LocalCluster(2, str(tmp_path), rf=2)
+    try:
+        s = c.session(1)
+        s.execute("CREATE KEYSPACE ks WITH replication = "
+                  "{'class': 'SimpleStrategy', 'replication_factor': 2}")
+        s.execute("CREATE TABLE ks.t (k int PRIMARY KEY, v int)")
+        n1 = c.node(1)
+        n1.default_cl = ConsistencyLevel.ALL
+        for i in range(10):
+            s.execute(f"INSERT INTO ks.t (k, v) VALUES ({i}, {i})")
+        rule = c.filters.drop(verb=Verb.MUTATION_REQ,
+                              to=c.nodes[1].endpoint)
+        n1.default_cl = ConsistencyLevel.ONE
+        s.execute("INSERT INTO ks.t (k, v) VALUES (99, 99)")
+        rule["remaining"] = 0
+        before2 = len(c.node(2).engine.store("ks", "t").scan_all())
+        stats = n1.repair.repair_table("ks", "t", preview=True)
+        assert stats["preview"] and stats["ranges_mismatched"] > 0
+        assert stats["cells_streamed"] == 0
+        # nothing moved
+        assert len(c.node(2).engine.store("ks", "t").scan_all()) == before2
+        # the session journal recorded it durably
+        sessions = n1.repair.sessions.sessions()
+        assert sessions and sessions[-1]["state"] == "COMPLETED"
+        assert sessions[-1]["preview"] is True
+        # a real repair then converges the replicas
+        stats2 = n1.repair.repair_table("ks", "t")
+        assert stats2["cells_streamed"] > 0
+    finally:
+        c.shutdown()
+
+
+def test_repair_sessions_survive_restart(tmp_path):
+    """An IN_PROGRESS record with no FINALIZED pair survives a
+    coordinator restart and shows in repair_admin (LocalSessions
+    persistence role)."""
+    from cassandra_tpu.cluster.repair import RepairSessionStore
+    store = RepairSessionStore(str(tmp_path))
+    store.begin("s1", keyspace="ks", table="t", incremental=True,
+                preview=False, coordinator="node1")
+    store.begin("s2", keyspace="ks", table="u", incremental=False,
+                preview=False, coordinator="node1")
+    store.finish("s2", "COMPLETED")
+    # "restart": a fresh store over the same directory
+    store2 = RepairSessionStore(str(tmp_path))
+    inflight = store2.in_flight()
+    assert [s["id"] for s in inflight] == ["s1"]
+    states = {s["id"]: s["state"] for s in store2.sessions()}
+    assert states == {"s1": "IN_PROGRESS", "s2": "COMPLETED"}
