@@ -155,6 +155,11 @@ def run_compaction(base_dir, table, seed, cfg):
 def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import jax
+    if os.environ.get("CTPU_BENCH_ENGINE", "native") != "device":
+        # the host engines never touch the accelerator: pin the CPU
+        # backend so a wedged/absent device tunnel cannot hang a
+        # native-engine bench at backend initialization
+        jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_compilation_cache_dir",
                       os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                    ".jax_cache"))

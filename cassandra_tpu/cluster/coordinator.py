@@ -179,9 +179,10 @@ class StorageProxy:
                     f"EACH_QUORUM: quorum unreachable in {bad}")
         handler = _Await(block_for)
         for target in dead:
-            self.node.hints.store(target, mutation)
-            if cl == ConsistencyLevel.ANY:
-                handler.ack()
+            if self.node.should_hint(target):
+                self.node.hints.store(target, mutation)
+                if cl == ConsistencyLevel.ANY:
+                    handler.ack()
         for target in live:
             counts = self._counts_toward(cl, target, local_dc)
             if target == self.node.endpoint:

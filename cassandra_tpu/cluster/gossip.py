@@ -215,6 +215,8 @@ class Gossiper:
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> None:
+        # restartable: nodetool enablegossip after disablegossip
+        self._stop.clear()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"gossip-{self.ep.name}")
         self._thread.start()

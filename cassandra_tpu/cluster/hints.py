@@ -25,6 +25,8 @@ class HintsService:
         # nodetool disablehandoff: new hints are dropped (the reference's
         # StorageProxy.shouldHint gate)
         self.enabled = True
+        # nodetool disablehintsfordc: DCs whose targets get no new hints
+        self.disabled_dcs: set[str] = set()
 
     def _path(self, target: Endpoint) -> str:
         return os.path.join(self.directory, f"hints-{target.name}.db")

@@ -74,11 +74,42 @@ class Node:
         replicated applies (BatchlogManager role)."""
         return self.engine.batchlog
 
+    # reference default: 3h (cassandra.yaml max_hint_window)
+    max_hint_window_ms = 3 * 3600 * 1000
+
+    def should_hint(self, target) -> bool:
+        """StorageProxy.shouldHint: no new hints for targets in a
+        hint-disabled DC, or dead longer than the hint window (their
+        backlog would only grow unboundedly — the node needs repair,
+        not hints, when it returns)."""
+        if not self.hints.enabled:
+            # disablehandoff: without this gate a CL.ANY write to dead
+            # replicas would ack on a hint store() silently dropped
+            return False
+        if target.dc in self.hints.disabled_dcs:
+            return False
+        st = self.gossiper.states.get(target)
+        if st is not None and not st.alive:
+            dead_s = self.gossiper.clock() - st.last_heartbeat
+            if dead_s * 1000.0 > self.max_hint_window_ms:
+                return False
+        return True
+
     @property
     def guardrails(self):
         """The executor reads guardrails off its backend; a Node backend
         delegates to the engine's instance (one catalog per node)."""
         return self.engine.guardrails
+
+    @property
+    def audit_log(self):
+        """Processor reads the audit/FQL streams off its backend —
+        delegate so Node-backed sessions audit like engine-backed."""
+        return self.engine.audit_log
+
+    @property
+    def fql_log(self):
+        return self.engine.fql_log
 
     # ------------------------------------------------------------- verbs --
 

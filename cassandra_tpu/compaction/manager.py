@@ -61,6 +61,9 @@ class CompactionManager:
         self._lock = threading.Lock()
         self._cfs_locks: dict = {}   # table_id -> rewrite mutex
         self._stop = threading.Event()
+        # nodetool stop: in-flight tasks poll this each round and abort
+        # (their lifecycle txn rolls back); cleared before the next task
+        self.abort_event = threading.Event()
         self._worker: threading.Thread | None = None
         self.completed: list[dict] = []
         if auto:
@@ -77,6 +80,7 @@ class CompactionManager:
         """Hook the CFS flush notification (Tracker -> strategy manager
         notification path in the reference)."""
         cfs.compaction_listener = self.submit_background
+        cfs.compaction_abort = self.abort_event
 
     def enable_auto(self) -> None:
         """Start the background worker (daemon deployments; tests keep
@@ -146,6 +150,9 @@ class CompactionManager:
             task = get_strategy(cfs).major_task()
             if task is None:
                 return None
+            # `nodetool stop` aborts IN-FLIGHT tasks: the request is
+            # consumed when the next task begins
+            self.abort_event.clear()
             stats = task.execute()
         self.completed.append(stats)
         return stats
@@ -162,6 +169,9 @@ class CompactionManager:
             with self._lock:
                 self._pending_cfs.discard(cfs)
             try:
+                # a standing `nodetool stop` request only covers tasks
+                # already running when it was issued
+                self.abort_event.clear()
                 self._maybe_compact(cfs)
             except Exception:   # background task failure must not kill loop
                 import traceback

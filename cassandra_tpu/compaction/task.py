@@ -330,6 +330,13 @@ class CompactionTask:
             while True:
                 if werr:       # writer died: fail fast, don't keep merging
                     break
+                abort = getattr(cfs, "compaction_abort", None)
+                if abort is not None and abort.is_set():
+                    # nodetool stop: cooperative cancel between rounds;
+                    # the lifecycle txn below never commits, so the
+                    # partial output rolls back on the crash-safe path
+                    raise RuntimeError(
+                        "compaction stopped by operator request")
                 active = [c for c in cursors if c.has_data]
                 if not active:
                     break

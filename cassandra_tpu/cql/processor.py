@@ -50,6 +50,10 @@ class QueryProcessor:
         if audit is not None:
             audit.log(type(prep.statement).__name__, prep.query, user,
                       keyspace, params=params)
+        fql = getattr(self.executor.backend, "fql_log", None)
+        if fql is not None:
+            fql.log(type(prep.statement).__name__, prep.query, user,
+                    keyspace, params=params)
         sync = self._ddl_sync_for(prep.statement)
         if sync is not None:
             # prepared DDL replicates exactly like direct DDL — a
@@ -100,6 +104,10 @@ class QueryProcessor:
         if audit is not None:
             audit.log(type(stmt).__name__, query, user, keyspace,
                       params=params)
+        fql = getattr(self.executor.backend, "fql_log", None)
+        if fql is not None:
+            fql.log(type(stmt).__name__, query, user, keyspace,
+                    params=params)
         t0 = time_mod.perf_counter()
         try:
             sync = self._ddl_sync_for(stmt)

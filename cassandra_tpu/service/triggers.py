@@ -106,7 +106,13 @@ class TriggerManager:
             return []
         out: list[Mutation] = []
         for name in named:
-            fn = self._fns[(t.keyspace, t.name, name)]
+            fkey = (t.keyspace, t.name, name)
+            fn = self._fns.get(fkey)
+            if fn is None:
+                # compiled-fn cache cleared (nodetool reloadtriggers):
+                # re-import the trigger file lazily
+                fn = self._load_fn(named[name])
+                self._fns[fkey] = fn
             try:
                 extra = fn(t, mutation, backend)
             except Exception as e:

@@ -70,6 +70,13 @@ class StorageEngine:
             compression=commitlog_compression
             or (self.settings.get("commitlog_compression") or None)) \
             if durable_writes else None
+        # nodetool enablebackup: flushed sstables hardlink into
+        # <table>/backups/ (incremental_backups role). Set BEFORE any
+        # store opens — replay at startup creates stores that read it.
+        self.incremental_backup = False
+        # full-query log (fql/FullQueryLogger role): a second audit
+        # stream capturing EVERY statement when enabled
+        self.fql_log = None
         self.stores: dict = {}  # table_id -> ColumnFamilyStore
         self._lock = threading.RLock()
         # background compaction (CompactionManager role): flushes enqueue
@@ -173,6 +180,7 @@ class StorageEngine:
     def _open_store(self, t: TableMetadata) -> ColumnFamilyStore:
         cfs = ColumnFamilyStore(t, self.data_dir, self.commitlog,
                                 flush_threshold=self.flush_threshold)
+        cfs.backup_enabled = lambda: self.incremental_backup
         self.compactions.register(cfs)
         self.stores[t.id] = cfs
         return cfs

@@ -214,6 +214,8 @@ class ColumnFamilyStore:
                 raise
             reader = SSTableReader(desc, self.table)
             self.tracker.add(reader)
+            if getattr(self, "backup_enabled", lambda: False)():
+                self._backup_sstable(desc)
             self.metrics["flushes"] += 1
             self.metrics["bytes_flushed"] += reader.data_size
             if self.commitlog and flush_pos:
@@ -221,6 +223,25 @@ class ColumnFamilyStore:
             if self.compaction_listener:
                 self.compaction_listener(self)
             return reader
+
+    def _backup_sstable(self, desc) -> None:
+        """Hardlink a freshly-flushed sstable's components into
+        backups/ (incremental_backups: every flushed sstable is
+        retained there until the operator clears it — zero copy cost,
+        links share the immutable data blocks)."""
+        bdir = os.path.join(self.directory, "backups")
+        os.makedirs(bdir, exist_ok=True)
+        prefix = f"{desc.version}-{desc.generation}-"
+        for fn in os.listdir(self.directory):
+            if fn.startswith(prefix):
+                dst = os.path.join(bdir, fn)
+                if not os.path.exists(dst):
+                    try:
+                        os.link(os.path.join(self.directory, fn), dst)
+                    except OSError:
+                        import shutil
+                        shutil.copy2(os.path.join(self.directory, fn),
+                                     dst)
 
     # -------------------------------------------------------------- read --
 

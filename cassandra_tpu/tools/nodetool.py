@@ -817,6 +817,564 @@ def rebuild(node, keyspace: str | None = None) -> dict:
 
 
 COMMANDS: dict = {}
+# --------------------------------------------------------------------------
+# round-5 breadth: the reference's long tail, each wired to real machinery
+# (tools/nodetool/*.java counterparts named per function)
+
+
+def describering(node, keyspace: str) -> list[dict]:
+    """nodetool describering: every token range with its endpoints
+    (tools/nodetool/DescribeRing.java)."""
+    from ..cluster.replication import ReplicationStrategy
+    ks = node.schema.keyspaces[keyspace]
+    strat = ReplicationStrategy.create(ks.params.replication)
+    out = []
+    for lo, hi in node.ring.all_ranges():
+        out.append({"start_token": lo, "end_token": hi,
+                    "endpoints": [e.name for e in
+                                  strat.replicas(node.ring, hi)]})
+    return out
+
+
+def cmsadmin(node) -> dict:
+    """nodetool cmsadmin describe: CMS membership + epoch state
+    (tools/nodetool/CMSAdmin.java over the Paxos-backed CMS)."""
+    sync = getattr(node, "schema_sync", None)
+    if sync is None:
+        return {"cms": None, "reason": "no metadata log on this node"}
+    return {"members": [m.name for m in sync.cms_members()],
+            "is_member": sync.cms.is_member(),
+            "epoch": sync.epoch,
+            "log_tail": [(e[0], e[1][:60]) for e in
+                         sync.entries_after(max(0, sync.epoch - 5))]}
+
+
+def failuredetectorinfo(node) -> list[dict]:
+    """nodetool failuredetector: per-endpoint phi
+    (tools/nodetool/FailureDetectorInfo.java)."""
+    g = node.gossiper
+    now = g.clock()
+    out = []
+    with g._lock:
+        for ep, st in g.states.items():
+            if ep == g.ep:
+                continue
+            out.append({"endpoint": ep.name, "alive": st.alive,
+                        "phi": round(g.detector.phi(st, now), 3)})
+    return out
+
+
+def gcstats(node=None, engine=None) -> dict:
+    """nodetool gcstats — the runtime's collector statistics (for a
+    Python runtime: gc generation counts/collections, the JVM GC role)."""
+    import gc
+    stats = gc.get_stats()
+    return {"collections": [s.get("collections", 0) for s in stats],
+            "collected": [s.get("collected", 0) for s in stats],
+            "uncollectable": [s.get("uncollectable", 0) for s in stats],
+            "tracked_objects": len(gc.get_objects())}
+
+
+def tablehistograms(engine, keyspace: str | None = None) -> dict:
+    """nodetool tablehistograms: per-table size/cell distributions from
+    live sstable metadata (tools/nodetool/TableHistograms.java)."""
+    out = {}
+    for cfs in engine.stores.values():
+        t = cfs.table
+        if keyspace and t.keyspace != keyspace:
+            continue
+        live = cfs.live_sstables()
+        sizes = sorted(s.data_size for s in live)
+        cells = sorted(s.n_cells for s in live)
+        parts = sorted(s.n_partitions for s in live)
+
+        def pct(v, p):
+            return v[min(len(v) - 1, int(len(v) * p))] if v else 0
+        out[t.full_name()] = {
+            "sstables": len(live),
+            "data_size": {"p50": pct(sizes, 0.5), "max": pct(sizes, 1.0)},
+            "cells": {"p50": pct(cells, 0.5), "max": pct(cells, 1.0)},
+            "partitions": {"p50": pct(parts, 0.5),
+                           "max": pct(parts, 1.0)},
+        }
+    return out
+
+
+def toppartitions(engine, keyspace: str, table: str,
+                  k: int = 10) -> list[dict]:
+    """nodetool toppartitions: largest partitions by on-disk cells,
+    summed across live sstables' partition directories
+    (tools/nodetool/TopPartitions.java, size sampler role)."""
+    import numpy as np
+    cfs = engine.store(keyspace, table)
+    totals: dict[bytes, int] = {}
+    for sst in cfs.live_sstables():
+        # per-partition cell counts: first-cell offsets diffed against
+        # the next start (the last partition runs to n_cells)
+        c0 = np.append(np.asarray(sst._part_cell0), sst.n_cells)
+        for i in range(sst.n_partitions):
+            pk = sst.partition_key_at(i)
+            totals[pk] = totals.get(pk, 0) + int(c0[i + 1] - c0[i])
+    top = sorted(totals.items(), key=lambda kv: -kv[1])[:k]
+    return [{"partition_key": pk.hex(), "cells": n} for pk, n in top]
+
+
+def rangekeysample(engine, keyspace: str, table: str,
+                   n: int = 100) -> list[str]:
+    """nodetool rangekeysample: sampled partition keys from the
+    partition directories (tools/nodetool/RangeKeySample.java)."""
+    cfs = engine.store(keyspace, table)
+    keys = []
+    for sst in cfs.live_sstables():
+        step = max(1, sst.n_partitions // max(1, n // max(
+            1, len(cfs.live_sstables()))))
+        for i in range(0, sst.n_partitions, step):
+            keys.append(sst.partition_key_at(i).hex())
+    return keys[:n]
+
+
+def datapaths(engine, keyspace: str | None = None) -> dict:
+    """nodetool datapaths (tools/nodetool/DataPaths.java)."""
+    return {cfs.table.full_name(): cfs.directory
+            for cfs in engine.stores.values()
+            if not keyspace or cfs.table.keyspace == keyspace}
+
+
+def viewbuildstatus(node, keyspace: str | None = None) -> list[dict]:
+    """nodetool viewbuildstatus (tools/nodetool/ViewBuildStatus.java):
+    registered views and their backfill state (registrations persist;
+    backfill runs at CREATE, so a registered view is built)."""
+    out = []
+    for (ks, name), info in getattr(node.schema, "views", {}).items():
+        if keyspace and ks != keyspace:
+            continue
+        out.append({"keyspace": ks, "view": name,
+                    "base": ".".join(info.get("base", ("?", "?"))),
+                    "status": "SUCCESS"})
+    return out
+
+
+# ---- gossip / binary / protocol toggles ----------------------------------
+
+
+def disablegossip(node) -> dict:
+    node.gossiper.stop()
+    return {"gossip": "stopped"}
+
+
+def enablegossip(node) -> dict:
+    if not node.gossiper.is_running():
+        node.gossiper.start()
+    return {"gossip": "running"}
+
+
+def disablebinary(node) -> dict:
+    """Refuse NEW native-protocol connections (in-flight ones drain —
+    tools/nodetool/DisableBinary.java semantics)."""
+    for srv in getattr(node, "cql_servers", []):
+        srv.paused = True
+    return {"native_transport": "paused"}
+
+
+def enablebinary(node) -> dict:
+    for srv in getattr(node, "cql_servers", []):
+        srv.paused = False
+    return {"native_transport": "running"}
+
+
+def disableoldprotocolversions(node) -> dict:
+    """Only the NEWEST protocol version may connect
+    (tools/nodetool/DisableOldProtocolVersions.java)."""
+    out = {}
+    for srv in getattr(node, "cql_servers", []):
+        from ..transport_server import SUPPORTED_VERSIONS
+        srv.min_version = max(SUPPORTED_VERSIONS)
+        out["min_version"] = srv.min_version
+    return out or {"min_version": None}
+
+
+def enableoldprotocolversions(node) -> dict:
+    out = {}
+    for srv in getattr(node, "cql_servers", []):
+        from ..transport_server import SUPPORTED_VERSIONS
+        srv.min_version = min(SUPPORTED_VERSIONS)
+        out["min_version"] = srv.min_version
+    return out or {"min_version": None}
+
+
+# ---- hints ---------------------------------------------------------------
+
+
+def pausehandoff(node) -> dict:
+    """Alias pair of disable/enablehandoff the reference also ships."""
+    node.hints.enabled = False
+    return {"handoff": "paused"}
+
+
+def resumehandoff(node) -> dict:
+    node.hints.enabled = True
+    return {"handoff": "running"}
+
+
+def disablehintsfordc(node, dc: str) -> dict:
+    node.hints.disabled_dcs.add(dc)
+    return {"hints_disabled_dcs": sorted(node.hints.disabled_dcs)}
+
+
+def enablehintsfordc(node, dc: str) -> dict:
+    node.hints.disabled_dcs.discard(dc)
+    return {"hints_disabled_dcs": sorted(node.hints.disabled_dcs)}
+
+
+def getmaxhintwindow(node) -> dict:
+    return {"max_hint_window_ms": node.max_hint_window_ms}
+
+
+def setmaxhintwindow(node, ms: int) -> dict:
+    node.max_hint_window_ms = int(ms)
+    return {"max_hint_window_ms": node.max_hint_window_ms}
+
+
+# ---- seeds / schema / triggers / batchlog --------------------------------
+
+
+def getseeds(node) -> list[str]:
+    return [e.name for e in node.gossiper.seeds]
+
+
+def reloadseeds(node, seeds: list | None = None) -> list[str]:
+    """Re-resolve the seed list (tools/nodetool/ReloadSeeds.java);
+    in-process deployments pass the new list directly."""
+    if seeds:
+        by_name = {e.name: e for e in node.ring.endpoints}
+        node.gossiper.seeds = [by_name[s] for s in seeds if s in by_name]
+    return getseeds(node)
+
+
+def resetlocalschema(node) -> dict:
+    """Drop to the cluster's schema log state and re-pull
+    (tools/nodetool/ResetLocalSchema.java)."""
+    sync = getattr(node, "schema_sync", None)
+    if sync is None:
+        return {"pulled": False, "reason": "no metadata log on this node"}
+    ok = sync.pull_from_peers(timeout=5.0)
+    return {"pulled": ok, "epoch": sync.epoch}
+
+
+def reloadlocalschema(node) -> dict:
+    """Reload schema from the local epoch log
+    (tools/nodetool/ReloadLocalSchema.java)."""
+    sync = getattr(node, "schema_sync", None)
+    if sync is None:
+        return {"epoch": None,
+                "reason": "no metadata log on this node",
+                "tables": sum(len(k.tables) for k in
+                              node.schema.keyspaces.values())}
+    return {"epoch": sync.epoch,
+            "entries": len(sync.entries_after(0))}
+
+
+def reloadtriggers(node) -> dict:
+    """Re-load trigger code from the triggers directory
+    (tools/nodetool/ReloadTriggers.java): drop the compiled-function
+    cache so every registered trigger re-imports its file on next
+    fire — updated trigger code takes effect without DDL."""
+    trg = getattr(node.engine, "triggers", None)
+    if trg is None:
+        return {"triggers": "no trigger service"}
+    n = len(trg._fns)
+    trg._fns.clear()
+    return {"triggers": "reloaded", "cached_fns_dropped": n}
+
+
+def replaybatchlog(node) -> dict:
+    """Force a batchlog replay pass (tools/nodetool/ReplayBatchlog.java)."""
+    n = 0
+    for bid, mutations in list(node.batchlog.pending()):
+        for m in mutations:
+            node.engine.apply(m)
+        node.batchlog.remove(bid)
+        n += 1
+    return {"replayed_batches": n}
+
+
+# ---- caches --------------------------------------------------------------
+
+
+def invalidatekeycache(engine) -> dict:
+    n = 0
+    for cfs in engine.stores.values():
+        for sst in cfs.live_sstables():
+            kc = getattr(sst, "key_cache", None)
+            if kc is not None and hasattr(kc, "clear"):
+                kc.clear()
+                n += 1
+    return {"cleared": n}
+
+
+def _invalidate_auth_cache(node) -> dict:
+    auth = getattr(node.engine, "auth", None)
+    if auth is None:
+        return {"invalidated": False}
+    auth.cache.invalidate_all()
+    return {"invalidated": True}
+
+
+def invalidatepermissionscache(node) -> dict:
+    return _invalidate_auth_cache(node)
+
+
+def invalidaterolescache(node) -> dict:
+    return _invalidate_auth_cache(node)
+
+
+def invalidatenetworkpermissionscache(node) -> dict:
+    return _invalidate_auth_cache(node)
+
+
+def invalidatecidrpermissionscache(node) -> dict:
+    return _invalidate_auth_cache(node)
+
+
+def setcachecapacity(engine, row_entries: int | None = None,
+                     chunk_bytes: int | None = None) -> dict:
+    """nodetool setcachecapacity (row-cache entries, chunk-cache bytes)."""
+    out = {}
+    if row_entries is not None:
+        for cfs in engine.stores.values():
+            if cfs.row_cache is not None:
+                cfs.row_cache.capacity = int(row_entries)
+        out["row_entries"] = int(row_entries)
+    if chunk_bytes is not None:
+        from ..storage import chunk_cache
+        chunk_cache.GLOBAL.capacity = int(chunk_bytes)
+        out["chunk_bytes"] = int(chunk_bytes)
+    return out
+
+
+# ---- auth / cidr ---------------------------------------------------------
+
+
+def getauthcacheconfig(node) -> dict:
+    auth = getattr(node.engine, "auth", None)
+    return {"validity_seconds": auth.cache.validity if auth else None}
+
+
+def setauthcacheconfig(node, validity_seconds: float) -> dict:
+    auth = getattr(node.engine, "auth", None)
+    if auth is None:
+        raise RuntimeError("auth is not enabled")
+    auth.cache.validity = float(validity_seconds)
+    auth.cache.invalidate_all()
+    return {"validity_seconds": auth.cache.validity}
+
+
+def getcidrgroupsofip(node, ip: str) -> list[str]:
+    """CIDR groups containing an address
+    (tools/nodetool/GetCIDRGroupsOfIP.java)."""
+    import ipaddress
+    auth = getattr(node.engine, "auth", None)
+    if auth is None:
+        return []
+    addr = ipaddress.ip_address(ip)
+    return sorted(name for name, cidrs in auth.cidr_groups.items()
+                  if any(addr in ipaddress.ip_network(c)
+                         for c in cidrs))
+
+
+def cidrfilteringstats(node) -> dict:
+    auth = getattr(node.engine, "auth", None)
+    if auth is None:
+        return {"groups": 0, "cidrs": 0, "restricted_roles": 0}
+    return {"groups": len(auth.cidr_groups),
+            "cidrs": sum(len(v) for v in auth.cidr_groups.values()),
+            "restricted_roles": sum(
+                1 for r in auth.roles.values()
+                if r.get("cidr_groups"))}
+
+
+# ---- audit / FQL ---------------------------------------------------------
+
+
+def enableauditlog(node, path: str | None = None) -> dict:
+    import os as _os
+
+    from ..service.audit import AuditLog
+    if node.engine.audit_log is None:
+        path = path or _os.path.join(node.engine.data_dir, "audit.jsonl")
+        node.engine.audit_log = AuditLog(path)
+    return {"audit": "enabled", "path": node.engine.audit_log.path}
+
+
+def disableauditlog(node) -> dict:
+    if node.engine.audit_log is not None:
+        node.engine.audit_log.close()
+        node.engine.audit_log = None
+    return {"audit": "disabled"}
+
+
+def getauditlog(node) -> dict:
+    a = node.engine.audit_log
+    return {"enabled": a is not None,
+            "path": a.path if a is not None else None}
+
+
+def enablefullquerylog(node, path: str | None = None) -> dict:
+    import os as _os
+
+    from ..service.audit import AuditLog
+    if node.engine.fql_log is None:
+        path = path or _os.path.join(node.engine.data_dir, "fql.jsonl")
+        node.engine.fql_log = AuditLog(path)
+    return {"fql": "enabled", "path": node.engine.fql_log.path}
+
+
+def disablefullquerylog(node) -> dict:
+    if node.engine.fql_log is not None:
+        node.engine.fql_log.close()
+        node.engine.fql_log = None
+    return {"fql": "disabled"}
+
+
+def getfullquerylog(node) -> dict:
+    f = node.engine.fql_log
+    return {"enabled": f is not None,
+            "path": f.path if f is not None else None}
+
+
+def resetfullquerylog(node) -> dict:
+    """Disable AND delete the log file
+    (tools/nodetool/ResetFullQueryLog.java)."""
+    import os as _os
+    f = node.engine.fql_log
+    path = f.path if f is not None else None
+    disablefullquerylog(node)
+    if path and _os.path.exists(path):
+        _os.remove(path)
+    return {"fql": "reset"}
+
+
+# ---- compaction / sstables ----------------------------------------------
+
+
+def getcompactionthreshold(engine, keyspace: str, table: str) -> dict:
+    cfs = engine.store(keyspace, table)
+    opts = cfs.table.params.compaction
+    return {"min_threshold": int(opts.get("min_threshold", 4)),
+            "max_threshold": int(opts.get("max_threshold", 32))}
+
+
+def setcompactionthreshold(engine, keyspace: str, table: str,
+                           min_threshold: int,
+                           max_threshold: int) -> dict:
+    if int(min_threshold) < 2 or int(max_threshold) < int(min_threshold):
+        raise ValueError("need 2 <= min_threshold <= max_threshold")
+    cfs = engine.store(keyspace, table)
+    cfs.table.params.compaction["min_threshold"] = int(min_threshold)
+    cfs.table.params.compaction["max_threshold"] = int(max_threshold)
+    return getcompactionthreshold(engine, keyspace, table)
+
+
+def stop(engine, compaction_type: str | None = None) -> dict:
+    """nodetool stop: abort in-flight compactions cooperatively — each
+    task polls the abort event between rounds and rolls back through
+    its lifecycle transaction (tools/nodetool/Stop.java)."""
+    import time as _t
+    engine.compactions.abort_event.set()
+    _t.sleep(0.1)       # let pollers observe it
+    engine.compactions.abort_event.clear()
+    return {"stopped": True}
+
+
+def stopdaemon(node) -> dict:
+    """nodetool stopdaemon: full node shutdown
+    (tools/nodetool/StopDaemon.java). In a daemon the process exits via
+    its signal handler; in-process callers get a stopped node."""
+    node.shutdown()
+    return {"daemon": "stopped"}
+
+
+def forcecompact(engine, keyspace: str, table: str) -> dict:
+    """nodetool forcecompact (major on one table, ignoring strategy
+    selection — tools/nodetool/ForceCompact.java)."""
+    out = engine.compactions.major_compaction(engine.store(keyspace,
+                                                           table))
+    return out or {"compacted": False}
+
+
+def recompresssstables(engine, keyspace: str,
+                       table: str | None = None) -> list[dict]:
+    """nodetool recompress_sstables: rewrite under the CURRENT
+    compression params (tools/nodetool/RecompressSSTables.java) — the
+    upgradesstables machinery with a forced rewrite."""
+    return upgradesstables(engine, keyspace, table)
+
+
+def rebuildindex(node, keyspace: str, table: str,
+                 index_names: str | None = None) -> dict:
+    """nodetool rebuild_index: drop the index's per-sstable components
+    and rebuild from base data (tools/nodetool/RebuildIndex.java)."""
+    registry = getattr(node, "indexes", None) or         getattr(node.engine, "indexes", None)
+    if registry is None:
+        raise RuntimeError("no index registry")
+    rebuilt = []
+    for (ks0, tb0, col), idx in list(registry.indexes.items()):
+        if ks0 != keyspace or tb0 != table:
+            continue
+        if hasattr(idx, "rebuild"):
+            idx.rebuild()
+        rebuilt.append(col)
+    return {"rebuilt": rebuilt}
+
+
+# ---- backups -------------------------------------------------------------
+
+
+def enablebackup(engine) -> dict:
+    engine.incremental_backup = True
+    return {"incremental_backup": True}
+
+
+def disablebackup(engine) -> dict:
+    engine.incremental_backup = False
+    return {"incremental_backup": False}
+
+
+def statusbackup(engine) -> dict:
+    return {"incremental_backup": bool(engine.incremental_backup)}
+
+
+
+def import_sstables(engine, keyspace: str, table: str,
+                    directory: str) -> dict:
+    """nodetool import (tools/nodetool/Import.java): copy sstables from
+    an external directory into the table's data directory under fresh
+    generations, then load them — the safer successor to `refresh`
+    (files never collide with live generations)."""
+    import os as _os
+    import shutil as _shutil
+
+    from ..storage.sstable import Descriptor
+    cfs = engine.store(keyspace, table)
+    descs = Descriptor.list_in(directory)
+    if not descs:
+        raise FileNotFoundError(f"no sstables under {directory}")
+    copied = 0
+    for desc in descs:
+        gen = cfs.next_generation()
+        prefix = f"{desc.version}-{desc.generation}-"
+        for fn in sorted(_os.listdir(directory)):
+            if fn.startswith(prefix):
+                _shutil.copy2(
+                    _os.path.join(directory, fn),
+                    _os.path.join(cfs.directory,
+                                  f"{desc.version}-{gen}-{fn[len(prefix):]}"))
+        copied += 1
+    cfs.reload_sstables()
+    return {"imported_sstables": copied,
+            "live_sstables": len(cfs.live_sstables())}
+
+
 for _name, _target in [
         ("status", "node"), ("info", "engine"), ("ring", "node"),
         ("flush", "engine"), ("compact", "engine"),
@@ -858,8 +1416,44 @@ for _name, _target in [
         ("invalidatecredentialscache", "engine"),
         ("decommission", "node"), ("move", "node"),
         ("bulkload", "node"), ("rebuild", "node"),
-        ("repair_admin", "node")]:
+        ("repair_admin", "node"),
+        ("describering", "node"), ("cmsadmin", "node"),
+        ("failuredetectorinfo", "node"), ("gcstats", "none"),
+        ("tablehistograms", "engine"),
+        ("toppartitions", "engine"), ("rangekeysample", "engine"),
+        ("datapaths", "engine"), ("viewbuildstatus", "node"),
+        ("disablegossip", "node"), ("enablegossip", "node"),
+        ("disablebinary", "node"), ("enablebinary", "node"),
+        ("disableoldprotocolversions", "node"),
+        ("enableoldprotocolversions", "node"),
+        ("pausehandoff", "node"), ("resumehandoff", "node"),
+        ("disablehintsfordc", "node"), ("enablehintsfordc", "node"),
+        ("getmaxhintwindow", "node"), ("setmaxhintwindow", "node"),
+        ("getseeds", "node"), ("reloadseeds", "node"),
+        ("resetlocalschema", "node"), ("reloadlocalschema", "node"),
+        ("reloadtriggers", "node"), ("replaybatchlog", "node"),
+        ("invalidatekeycache", "engine"),
+        ("invalidatepermissionscache", "node"),
+        ("invalidaterolescache", "node"),
+        ("invalidatenetworkpermissionscache", "node"),
+        ("invalidatecidrpermissionscache", "node"),
+        ("setcachecapacity", "engine"),
+        ("getauthcacheconfig", "node"), ("setauthcacheconfig", "node"),
+        ("getcidrgroupsofip", "node"), ("cidrfilteringstats", "node"),
+        ("enableauditlog", "node"), ("disableauditlog", "node"),
+        ("getauditlog", "node"),
+        ("enablefullquerylog", "node"), ("disablefullquerylog", "node"),
+        ("getfullquerylog", "node"), ("resetfullquerylog", "node"),
+        ("getcompactionthreshold", "engine"),
+        ("setcompactionthreshold", "engine"),
+        ("stop", "engine"), ("stopdaemon", "node"),
+        ("forcecompact", "engine"), ("recompresssstables", "engine"),
+        ("rebuildindex", "node"),
+        ("enablebackup", "engine"), ("disablebackup", "engine"),
+        ("statusbackup", "engine")]:
     COMMANDS[_name] = (_target, globals()[_name])
+# reserved word: the function is import_sstables, the command 'import'
+COMMANDS["import"] = ("engine", import_sstables)
 
 
 def run_command(name: str, node=None, engine=None, **kwargs):

@@ -306,6 +306,13 @@ class CQLServer:
         self._listen.listen(64)
         self.port = self._listen.getsockname()[1]
         self._closed = False
+        # nodetool disablebinary: new connections are refused while
+        # paused (existing ones keep serving, matching the reference's
+        # native-transport stop semantics for in-flight requests)
+        self.paused = False
+        # nodetool disableoldprotocolversions: refuse protocol versions
+        # below this floor (transport/Server.java minimum_version role)
+        self.min_version = min(SUPPORTED_VERSIONS)
         self._event_conns: set[_Conn] = set()
         self._conn_lock = threading.Lock()
         # live connection registry (system_views.clients / `nodetool
@@ -425,6 +432,12 @@ class CQLServer:
                 sock, _ = self._listen.accept()
             except OSError:
                 return
+            if self.paused:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
             threading.Thread(target=self._serve_raw, args=(sock,),
                              daemon=True).start()
 
@@ -477,7 +490,8 @@ class CQLServer:
                     return
                 info["requests"] += 1
                 ver, flags, stream, opcode, body = env
-                if ver not in SUPPORTED_VERSIONS:
+                if ver not in SUPPORTED_VERSIONS or \
+                        ver < self.min_version:
                     # reject cleanly (spec: respond with a PROTOCOL error
                     # naming the supported versions) and close
                     rsp = struct.pack(">i", ERR_PROTOCOL) + _string(

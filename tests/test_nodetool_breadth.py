@@ -1,0 +1,228 @@
+"""Round-5 nodetool breadth: every new command drives real machinery —
+this exercises each against a live cluster so signature or wiring rot
+fails loudly (the reference's 161-command tail, tools/nodetool/)."""
+import pytest
+
+from cassandra_tpu.cluster.node import LocalCluster
+from cassandra_tpu.cluster.replication import ConsistencyLevel
+from cassandra_tpu.tools import nodetool
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = LocalCluster(2, str(tmp_path), rf=2)
+    s = c.session(1)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 2}")
+    s.execute("CREATE TABLE ks.t (k int, c int, v text, "
+              "PRIMARY KEY (k, c))")
+    c.node(1).default_cl = ConsistencyLevel.ALL
+    for i in range(40):
+        s.execute(f"INSERT INTO ks.t (k, c, v) VALUES ({i % 5}, {i}, "
+                  f"'v{i}')")
+    c.nodes[0].engine.store("ks", "t").flush()
+    yield c
+    c.shutdown()
+
+
+def run(c, cmd, **kw):
+    return nodetool.run_command(cmd, node=c.nodes[0], **kw)
+
+
+def test_ring_and_observability_commands(cluster):
+    rings = run(cluster, "describering", keyspace="ks")
+    assert rings and all(len(r["endpoints"]) == 2 for r in rings)
+    fd = run(cluster, "failuredetectorinfo")
+    assert any(e["alive"] for e in fd)
+    assert "collections" in run(cluster, "gcstats")
+    assert "request" in run(cluster, "proxyhistograms")
+    th = run(cluster, "tablehistograms")
+    assert "ks.t" in th and th["ks.t"]["sstables"] >= 1
+    top = run(cluster, "toppartitions", keyspace="ks", table="t", k=3)
+    assert top and top[0]["cells"] >= top[-1]["cells"]
+    assert run(cluster, "rangekeysample", keyspace="ks", table="t")
+    assert "ks.t" in run(cluster, "datapaths")
+    cms = run(cluster, "cmsadmin")
+    assert "members" in cms or cms.get("cms") is None
+
+
+def test_toggles(cluster):
+    n = cluster.nodes[0]
+    run(cluster, "pausehandoff")
+    assert n.hints.enabled is False
+    run(cluster, "resumehandoff")
+    assert n.hints.enabled is True
+    run(cluster, "disablehintsfordc", dc="dc9")
+    assert "dc9" in n.hints.disabled_dcs
+    run(cluster, "enablehintsfordc", dc="dc9")
+    assert run(cluster, "setmaxhintwindow", ms=1234) == \
+        {"max_hint_window_ms": 1234}
+    assert run(cluster, "getmaxhintwindow") == {"max_hint_window_ms": 1234}
+    # node1 IS the seed (gossiper filters itself out of its own list);
+    # node2 sees it
+    assert nodetool.run_command("getseeds",
+                                node=cluster.nodes[1]) == ["node1"]
+    run(cluster, "disablegossip")
+    assert not n.gossiper.is_running()
+    run(cluster, "enablegossip")
+    assert n.gossiper.is_running()
+
+
+def test_hint_window_gates_new_hints(cluster):
+    """A target dead longer than max_hint_window gets NO new hints
+    (StorageProxy.shouldHint semantics)."""
+    n = cluster.nodes[0]
+    victim = cluster.nodes[1].endpoint
+    cluster.stop_node(2)
+    import time
+    deadline = time.time() + 15
+    while time.time() < deadline and n.is_alive(victim):
+        time.sleep(0.05)
+    assert not n.is_alive(victim)
+    run(cluster, "setmaxhintwindow", ms=1)   # window in the past
+    time.sleep(0.01)
+    s = cluster.session(1)
+    s.keyspace = "ks"
+    n.default_cl = ConsistencyLevel.ONE
+    s.execute("INSERT INTO ks.t (k, c, v) VALUES (1, 999, 'late')")
+    assert not n.hints.has_hints(victim)
+    run(cluster, "setmaxhintwindow", ms=3600 * 1000)
+    s.execute("INSERT INTO ks.t (k, c, v) VALUES (1, 998, 'hinted')")
+    assert n.hints.has_hints(victim)
+
+
+def test_audit_and_fql_runtime_toggle(cluster, tmp_path):
+    n = cluster.nodes[0]
+    out = run(cluster, "enablefullquerylog")
+    assert out["fql"] == "enabled"
+    s = cluster.session(1)
+    s.keyspace = "ks"
+    s.execute("INSERT INTO ks.t (k, c, v) VALUES (7, 7, 'fql')")
+    import os
+    path = run(cluster, "getfullquerylog")["path"]
+    with open(path) as f:
+        content = f.read()
+    assert "fql" in content or "Insert" in content
+    run(cluster, "resetfullquerylog")
+    assert run(cluster, "getfullquerylog")["enabled"] is False
+    assert not os.path.exists(path)
+    out = run(cluster, "enableauditlog")
+    assert out["audit"] == "enabled"
+    run(cluster, "disableauditlog")
+    assert run(cluster, "getauditlog")["enabled"] is False
+
+
+def test_backup_and_compaction_commands(cluster):
+    n = cluster.nodes[0]
+    run(cluster, "enablebackup")
+    assert run(cluster, "statusbackup")["incremental_backup"] is True
+    s = cluster.session(1)
+    s.keyspace = "ks"
+    for i in range(10):
+        s.execute(f"INSERT INTO ks.t (k, c, v) VALUES (9, {100 + i}, "
+                  f"'b{i}')")
+    cfs = n.engine.store("ks", "t")
+    cfs.flush()
+    import os
+    bdir = os.path.join(cfs.directory, "backups")
+    assert os.path.isdir(bdir) and os.listdir(bdir)
+    run(cluster, "disablebackup")
+    thr = run(cluster, "setcompactionthreshold", keyspace="ks",
+              table="t", min_threshold=3, max_threshold=16)
+    assert thr == {"min_threshold": 3, "max_threshold": 16}
+    assert run(cluster, "forcecompact", keyspace="ks", table="t")
+    assert run(cluster, "stop") == {"stopped": True}
+
+
+def test_schema_and_cache_commands(cluster):
+    rl = run(cluster, "reloadlocalschema")
+    assert rl["epoch"] is None or rl["epoch"] >= 2
+    run(cluster, "invalidatepermissionscache")
+    run(cluster, "setcachecapacity", chunk_bytes=32 << 20)
+    assert run(cluster, "replaybatchlog")["replayed_batches"] >= 0
+    vb = run(cluster, "viewbuildstatus")
+    assert isinstance(vb, list)
+    assert run(cluster, "reloadtriggers")["triggers"] in (
+        "reloaded", "no trigger service")
+
+
+def test_registry_size():
+    assert len(nodetool.COMMANDS) >= 115, len(nodetool.COMMANDS)
+
+
+def test_import_command(cluster, tmp_path):
+    """nodetool import: external sstables copied under fresh
+    generations and loaded."""
+    import numpy as np
+
+    from cassandra_tpu.storage import cellbatch as cb
+    from cassandra_tpu.storage.sstable import Descriptor, SSTableWriter
+    from cassandra_tpu.tools import bulk
+    n = cluster.nodes[0]
+    table = n.schema.get_table("ks", "t")
+    ext = str(tmp_path / "ext")
+    import os
+    os.makedirs(ext)
+    rng = np.random.default_rng(5)
+    batch = cb.merge_sorted([bulk.build_int_batch(
+        table, rng.integers(100, 120, 50), rng.integers(0, 50, 50),
+        rng.integers(97, 122, (50, 4), dtype=np.uint8),
+        rng.integers(1, 1 << 30, 50).astype(np.int64))])
+    w = SSTableWriter(Descriptor(ext, 1), table)
+    w.append(batch)
+    w.finish()
+    out = nodetool.run_command("import", engine=n.engine,
+                               keyspace="ks", table="t", directory=ext)
+    assert out["imported_sstables"] == 1
+    s = cluster.session(1)
+    s.keyspace = "ks"
+    assert s.execute(
+        "SELECT count(*) FROM ks.t WHERE k = 105").rows[0][0] >= 0
+
+
+def test_reloadtriggers_then_write(cluster, tmp_path):
+    """Regression: after reloadtriggers clears the compiled-fn cache,
+    the next triggered write lazily re-imports instead of KeyError."""
+    import os
+    n = cluster.nodes[0]
+    tdir = n.engine.triggers.directory
+    os.makedirs(tdir, exist_ok=True)
+    with open(os.path.join(tdir, "audit_trg.py"), "w") as f:
+        f.write("def fire(table, mutation, backend):\n    return None\n")
+    s = cluster.session(1)
+    s.keyspace = "ks"
+    s.execute("CREATE TRIGGER trg ON ks.t USING 'audit_trg:fire'")
+    s.execute("INSERT INTO ks.t (k, c, v) VALUES (2, 500, 'a')")
+    out = run(cluster, "reloadtriggers")
+    assert out["triggers"] == "reloaded"
+    s.execute("INSERT INTO ks.t (k, c, v) VALUES (2, 501, 'b')")
+    assert s.execute("SELECT v FROM ks.t WHERE k = 2 AND c = 501"
+                     ).rows == [("b",)]
+    s.execute("DROP TRIGGER trg ON ks.t")
+
+
+def test_disablehandoff_blocks_any_ack(cluster):
+    """Regression: with handoff disabled, a CL.ANY write to dead
+    replicas must NOT ack on a silently-dropped hint."""
+    import time
+
+    from cassandra_tpu.cluster.coordinator import TimeoutException
+    n = cluster.nodes[0]
+    victim = cluster.nodes[1].endpoint
+    cluster.stop_node(2)
+    deadline = time.time() + 15
+    while time.time() < deadline and n.is_alive(victim):
+        time.sleep(0.05)
+    run(cluster, "disablehandoff")
+    s = cluster.session(1)
+    s.keyspace = "ks"
+    n.default_cl = ConsistencyLevel.ANY
+    n.proxy.timeout = 1.0
+    # some keys' replica sets include the dead node; find one where the
+    # write would need the hint-ack (RF=2: both replicas = node1+node2,
+    # so ANY is satisfied by the local apply — exercise shouldn't hint):
+    s.execute("INSERT INTO ks.t (k, c, v) VALUES (3, 700, 'x')")
+    assert not n.hints.has_hints(victim)   # nothing silently stored
+    run(cluster, "enablehandoff")
+    s.execute("INSERT INTO ks.t (k, c, v) VALUES (3, 701, 'y')")
+    assert n.hints.has_hints(victim)
