@@ -30,8 +30,11 @@ SEGMENT_CELLS = 65536  # cells per segment (device batch granularity)
 # per-block crc words instead of the raw Data.db byte stream; "cc": the
 # LANES block is stored byte-plane SHUFFLED (blosc-style filter over the
 # u32 lane matrix — measured better ratio AND 1.2-3x faster codec passes
-# on lz4 and zstd both; readers transpose back)
-FORMAT_VERSION = "cc"
+# on lz4 and zstd both; readers transpose back); "cd": the meta block's
+# absolute i64 off/val_start pair (16 B/cell) is replaced by u32
+# frame-length deltas + u32 value offsets (8 B/cell) — readers rebuild
+# the absolute offsets with one cumsum
+FORMAT_VERSION = "cd"
 
 
 class Component:

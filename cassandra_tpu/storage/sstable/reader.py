@@ -299,9 +299,26 @@ class SSTableReader:
         o += 4 * n
         flags = meta[o:o + n]
         o += n
-        off = meta[o:o + 8 * (n + 1)].view("<i8")
-        o += 8 * (n + 1)
-        val_start = meta[o:o + 8 * n].view("<i8")
+        if self.desc.version >= "cd":
+            # delta layout: u32 frame lengths + u32 value offsets —
+            # rebuild the absolute i64 offsets with one cumsum. Same
+            # anti-corruption stance as the lanes-length check above:
+            # a crafted/corrupt meta length must fail as corruption,
+            # not as a numpy shape error
+            if uls[0] != 25 * n:
+                raise CorruptSSTableError(
+                    f"{self.desc}: segment {i} meta length {uls[0]} "
+                    f"!= {25 * n}")
+            frame_len = meta[o:o + 4 * n].view("<u4")
+            o += 4 * n
+            val_rel = meta[o:o + 4 * n].view("<u4")
+            off = np.zeros(n + 1, dtype=np.int64)
+            np.cumsum(frame_len, out=off[1:])
+            val_start = off[:-1] + val_rel
+        else:
+            off = meta[o:o + 8 * (n + 1)].view("<i8")
+            o += 8 * (n + 1)
+            val_start = meta[o:o + 8 * n].view("<i8")
 
         batch = CellBatch(lanes, ts.view(np.int64), ldt.view(np.int32),
                           ttl.view(np.int32), flags, off.view(np.int64),

@@ -451,21 +451,32 @@ class SSTableWriter:
         # then zero-copy scatter-gather compression (the previous
         # tobytes/join/ctypes staging copied every byte ~4x — measured as
         # the dominant write-path cost)
-        off_rel = (seg.off - seg.off[0]).astype("<i8")
-        vs_rel = (seg.val_start - seg.off[0]).astype("<i8")
-        # ts 8 + ldt 4 + ttl 4 + flags 1 + off 8 + val_start 8 = 33 B/cell,
-        # plus the off array's extra (n+1)th entry
-        meta = np.empty(n * 33 + 8, dtype=np.uint8)
+        # "cd" meta layout: ts 8 + ldt 4 + ttl 4 + flags 1 +
+        # frame_len u32 + val_rel u32 = 25 B/cell. Frame lengths are
+        # the off deltas and val_rel the value offset inside each frame
+        # — half the bytes of the absolute i64 pair they replace, and
+        # far more compressible (small near-constant integers)
+        deltas = seg.off[1:] - seg.off[:-1]
+        vrel64 = seg.val_start - seg.off[:-1]
+        if len(deltas) and (int(deltas.max()) >= 1 << 32
+                            or int(vrel64.max()) >= 1 << 32):
+            # u32 lanes cannot hold a >=4GiB frame — fail loudly
+            # instead of wrapping into silent corruption
+            raise ValueError(
+                f"cell frame exceeds the u32 offset lane "
+                f"(max frame {int(deltas.max())} bytes)")
+        frame_len = deltas.astype("<u4")
+        val_rel = vrel64.astype("<u4")
+        meta = np.empty(n * 25, dtype=np.uint8)
         pos = 0
         for arr, width in ((seg.ts.astype("<i8", copy=False), 8),
                            (seg.ldt.astype("<i4", copy=False), 4),
                            (seg.ttl.astype("<i4", copy=False), 4),
                            (seg.flags.astype("u1", copy=False), 1),
-                           (off_rel, 8), (vs_rel, 8)):
-            end = pos + (n + 1 if arr is off_rel else n) * width
+                           (frame_len, 4), (val_rel, 4)):
+            end = pos + n * width
             meta[pos:end] = np.ascontiguousarray(arr).view(np.uint8)
             pos = end
-        meta = meta[:pos]
         payload_b = np.ascontiguousarray(seg.payload)
         attempt = []
         for i in range(3):
