@@ -1659,13 +1659,28 @@ class Executor:
             paged = True
             ck_rel, filters = {}, []   # applied inline by the pager
         for _, batch in batches:
+            saw_regular = False
+            static_d = None
             for r in rows_from_batch(t, batch):
                 d = row_to_dict(t, r, with_meta=want_meta)
                 if r.is_static:
                     statics_by_pk[r.pk] = d
+                    static_d = d
                     continue
+                saw_regular = True
                 d["__pk"] = r.pk
                 rows.append(d)
+            if static_d is not None and not saw_regular and not ck_rel:
+                # a partition with ONLY static content still produces
+                # one CQL row (null clusterings/regulars) — reference
+                # SelectStatement static-row semantics; clustering
+                # restrictions exclude it. The null columns are
+                # populated explicitly so ORDER BY and projections see
+                # real keys.
+                phantom = dict(static_d)
+                for col in t.clustering_columns + t.regular_columns:
+                    phantom.setdefault(col.name, None)
+                rows.append(phantom)
         # join static values (and their cell metadata) onto the rows
         # (the pager already joined + filtered + applied ppl inline)
         for d in [] if paged else rows:
@@ -1677,8 +1692,6 @@ class Executor:
                         if want_meta and c.name in st.get("__meta__", {}):
                             d.setdefault("__meta__", {})[c.name] = \
                                 st["__meta__"][c.name]
-        # static-only partitions still produce one row in CQL
-        # (skipped for round 1 simplicity when regular rows exist)
 
         gr = getattr(self.backend, "guardrails", None)
         if gr is not None and batches:
@@ -1696,7 +1709,10 @@ class Executor:
 
         if s.order_by:
             col, desc = s.order_by[0]
-            rows.sort(key=lambda r: r[col], reverse=desc)
+            # nulls (static-only phantom rows) group after values
+            rows.sort(key=lambda r: (r.get(col) is None, r.get(col)
+                                     if r.get(col) is not None else 0),
+                      reverse=desc)
 
 
         if s.per_partition_limit is not None and not paged:
@@ -1830,10 +1846,51 @@ class Executor:
 
         last_row = None
         more = False
+        # static-only partition tracking: a partition whose only live
+        # content is its static row still yields ONE result row (null
+        # clusterings/regulars — reference SelectStatement semantics).
+        # Resuming mid-partition counts as already-emitted.
+        cur_pk = state.pk if state is not None and state.ck else None
+        cur_emitted = cur_pk is not None
+        cur_static = None
+
+        def flush_static_only():
+            if cur_pk is None or cur_emitted or cur_static is None \
+                    or ck_rel:
+                return
+            if not post_agg and limit is not None \
+                    and len(rows) >= limit:
+                return
+            if page_size is not None and len(rows) + 1 >= page_size:
+                # a phantom row must never fill or split a page: the
+                # paging position tracks the last REGULAR row, so an
+                # emitted phantom past it would duplicate on resume —
+                # leave it for the next page's re-scan instead
+                return
+            d = dict(cur_static)
+            for col in t.clustering_columns + t.regular_columns:
+                d.setdefault(col.name, None)
+            ok = all(self._match(d.get(col.name), op, v)
+                     for col, op, v in filters)
+            if ok:
+                rows.append(d)
+
         for row in paging_mod.paged_rows(cfs, t, state=state,
                                          on_batch=on_batch):
+            if row.pk != cur_pk:
+                flush_static_only()
+                # a flushed phantom can meet the limit exactly — the
+                # regular path's append-then-break invariant assumes
+                # len(rows) < limit before every append, so re-check
+                # here before consuming the next partition
+                if not post_agg and limit is not None \
+                        and len(rows) >= limit:
+                    break
+                cur_pk, cur_emitted, cur_static = row.pk, False, None
             if row.is_static:
-                statics[row.pk] = row_to_dict(t, row, with_meta=want_meta)
+                sd = row_to_dict(t, row, with_meta=want_meta)
+                statics[row.pk] = sd
+                cur_static = sd
                 continue
             d = row_to_dict(t, row, with_meta=want_meta)
             # join static values BEFORE filtering — a filter on a static
@@ -1867,12 +1924,15 @@ class Executor:
                 if c > ppl:
                     continue
             rows.append(d)
+            cur_emitted = True
             last_row = row
             if not post_agg and limit is not None and len(rows) >= limit:
                 break                         # limit satisfied: no more
             if page_size is not None and len(rows) >= page_size:
                 more = True
                 break
+        else:
+            flush_static_only()               # stream ended cleanly
         new_state = None
         if more and last_row is not None:
             rem = (limit - len(rows)) if limit is not None else -1

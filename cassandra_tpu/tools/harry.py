@@ -387,6 +387,11 @@ class Model:
                 if st is not None:
                     cols["st"] = st
                 out[ck] = cols
+        if not out and st is not None:
+            # a partition whose only live content is its static row
+            # still yields ONE row with null clusterings (reference
+            # SelectStatement static semantics; engine matches)
+            out[None] = {"st": st}
         return out
 
 

@@ -558,3 +558,60 @@ def test_alter_table_caching(session):
     session.execute("ALTER TABLE ac WITH caching = "
                     "{'rows_per_partition': 'NONE'}")
     assert cfs.row_cache is None
+
+
+def test_static_only_partition_produces_row(session):
+    """A partition whose only live content is its static row yields ONE
+    result row with null clusterings/regulars — point query, range
+    scan, and count(*) agree (reference SelectStatement static
+    semantics); clustering restrictions exclude it."""
+    s = session
+    s.execute("CREATE TABLE sonly (k int, c int, v text, "
+              "st text static, PRIMARY KEY (k, c))")
+    s.execute("UPDATE sonly SET st = 'S1' WHERE k = 1")
+    s.execute("UPDATE sonly SET st = 'S2' WHERE k = 2")
+    s.execute("INSERT INTO sonly (k, c, v) VALUES (2, 5, 'x')")
+    assert s.execute("SELECT k, c, v, st FROM sonly WHERE k = 1").rows \
+        == [(1, None, None, "S1")]
+    assert sorted(s.execute("SELECT k, c, st FROM sonly").rows) == \
+        [(1, None, "S1"), (2, 5, "S2")]
+    assert s.execute("SELECT count(*) FROM sonly").rows == [(2,)]
+    assert s.execute("SELECT k FROM sonly WHERE k = 1 AND c > 0").rows \
+        == []
+    # deleting the static content removes the phantom row
+    s.execute("DELETE st FROM sonly WHERE k = 1")
+    assert s.execute("SELECT k FROM sonly WHERE k = 1").rows == []
+
+
+def test_static_only_row_with_order_by_and_paging(session):
+    """Regression pair: ORDER BY over a result containing a phantom
+    static-only row must not crash (nulls group last ascending), and a
+    paged scan honors LIMIT across pages with phantom rows present."""
+    s = session
+    s.execute("CREATE TABLE sol (k int, c int, v text, "
+              "st text static, PRIMARY KEY (k, c))")
+    s.execute("UPDATE sol SET st = 'S' WHERE k = 1")
+    s.execute("INSERT INTO sol (k, c, v) VALUES (1, 9, 'a')")
+    s.execute("INSERT INTO sol (k, c, v) VALUES (1, 3, 'b')")
+    rows = s.execute("SELECT c FROM sol WHERE k = 1 ORDER BY c ASC").rows
+    assert rows == [(3,), (9,)]
+    # phantom-only partition under ORDER BY: no crash, null groups last
+    s.execute("DELETE FROM sol WHERE k = 1 AND c = 9")
+    s.execute("DELETE FROM sol WHERE k = 1 AND c = 3")
+    rows = s.execute("SELECT c FROM sol WHERE k = 1 ORDER BY c ASC").rows
+    assert rows == [(None,)]
+    # paged LIMIT with static-only partitions interleaved
+    for k in range(2, 8):
+        s.execute(f"UPDATE sol SET st = 'S{k}' WHERE k = {k}")
+    for k in (2, 4, 6):
+        s.execute(f"INSERT INTO sol (k, c, v) VALUES ({k}, 1, 'r')")
+    total = []
+    state = None
+    while True:
+        rs = s.execute("SELECT k FROM sol LIMIT 5", fetch_size=3,
+                       paging_state=state)
+        total.extend(rs.rows)
+        state = rs.paging_state
+        if not state:
+            break
+    assert len(total) == 5, total
