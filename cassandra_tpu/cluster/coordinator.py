@@ -505,11 +505,20 @@ class StorageProxy:
     # --------------------------------------------------------- range read
 
     def scan_window(self, keyspace: str, table_name: str, lo: int, hi: int,
-                    cl: str = ConsistencyLevel.ONE) -> cb.CellBatch:
+                    cl: str = ConsistencyLevel.ONE,
+                    limits: cb.DataLimits | None = None) -> cb.CellBatch:
         """Bounded range read: partitions with token in (lo, hi], fetched
         from the replicas that OWN each intersecting vnode arc — not a
         full-ring scatter (RangeCommands per-range replica plans). Data
-        responses from blockFor replicas per arc are merged."""
+        responses from blockFor replicas per arc are merged.
+
+        `limits` pushes a live-row bound to each arc's replicas
+        (DataLimits.java over RangeCommands): responses are bounded by
+        the LIMIT, not the arc. Short-read protection runs PER ARC with
+        the same frontier rule as read_partition — a truncated source
+        vouches only for rows up to its last shipped row, so the arc's
+        merged result is cut at the earliest frontier and re-queried
+        doubled on shortfall."""
         if cl == ConsistencyLevel.EACH_QUORUM:
             raise ValueError(
                 "EACH_QUORUM ConsistencyLevel is only supported for writes")
@@ -538,6 +547,13 @@ class StorageProxy:
                 if s_lo < s_hi:
                     spans.append((s_lo, s_hi, rhi))
         results: list[cb.CellBatch] = []
+        if limits is not None and limits.per_partition is not None:
+            # the arc stop-rule below counts live rows ACROSS partitions;
+            # a per-partition bound needs per-partition accounting the
+            # range layer doesn't do — callers keep it coordinator-side
+            raise ValueError(
+                "per_partition limits are not pushable to range reads")
+        target_rows = limits.row_limit if limits is not None else None
         for s_lo, s_hi, owner_tok in spans:
             replicas = strat.replicas(self.node.ring, owner_tok) \
                 or [self.node.endpoint]
@@ -548,37 +564,81 @@ class StorageProxy:
                     f"< {block_for}")
             live.sort(key=lambda r: r != self.node.endpoint)
             targets = live[:max(block_for, 1)]
-            handler = _Await(len(targets))
-            got: list = []
-            lock = threading.Lock()
-            for target in targets:
-                if target == self.node.endpoint:
-                    b = self.node.engine.store(
-                        keyspace, table_name).scan_window(s_lo, s_hi)
+            effective = limits
+            rounds = self.SHORT_READ_MAX_ROUNDS if target_rows is not None \
+                else 0
+            for rnd in range(rounds + 1):
+                if rnd == rounds:
+                    effective = None    # final round: no truncation
+                arc_res = self._arc_round(keyspace, table_name, s_lo,
+                                          s_hi, targets, ck_comp,
+                                          effective)
+                merged = cb.merge_sorted(
+                    [b for b, _ in arc_res if len(b)]) \
+                    if any(len(b) for b, _ in arc_res) \
+                    else cb.CellBatch.empty()
+                if effective is None or target_rows is None:
+                    break
+                truncated = [b for b, more in arc_res if more]
+                if not truncated:
+                    break
+                frontiers = [cb.row_frontier(b) for b in truncated]
+                if all(f is not None for f in frontiers):
+                    fmin = min(frontiers)
+                    covered = merged.slice_range(
+                        0, cb.covered_prefix(merged, fmin))
+                    if cb.live_row_count(covered) >= target_rows:
+                        merged = covered
+                        break
+                from ..service.metrics import GLOBAL
+                GLOBAL.incr("reads.short_read_retries")
+                effective = effective.doubled()
+            if len(merged):
+                results.append(merged)
+        return cb.merge_sorted(results) if results \
+            else cb.CellBatch.empty()
+
+    def _arc_round(self, keyspace, table_name, s_lo, s_hi, targets,
+                   ck_comp, limits):
+        """One fetch of an arc from its targets at the given limits.
+        Returns [(batch, more)]."""
+        wire_limits = limits.to_wire() if limits is not None else None
+        handler = _Await(len(targets))
+        got: list = []
+        lock = threading.Lock()
+        for target in targets:
+            if target == self.node.endpoint:
+                b = self.node.engine.store(
+                    keyspace, table_name).scan_window(s_lo, s_hi)
+                b, more = cb.truncate_live_rows(b, limits)
+                with lock:
+                    got.append((b, more))
+                handler.ack()
+            else:
+                def on_rsp(m):
                     with lock:
-                        got.append(b)
+                        payload = m.payload
+                        if isinstance(payload, tuple):
+                            pdict, more = payload
+                        else:       # unlimited responses ship bare
+                            pdict, more = payload, False
+                        b = cb_deserialize(pdict)
+                        b.ck_comp = ck_comp
+                        got.append((b, bool(more)))
                     handler.ack()
-                else:
-                    def on_rsp(m):
-                        with lock:
-                            b = cb_deserialize(m.payload)
-                            b.ck_comp = ck_comp
-                            got.append(b)
-                        handler.ack()
-                    self.messaging.send_with_callback(
-                        Verb.RANGE_REQ,
-                        (keyspace, table_name, s_lo, s_hi), target,
-                        on_response=on_rsp,
-                        on_failure=lambda mid: handler.fail(),
-                        timeout=self.range_timeout)
-            if not handler.await_(self.range_timeout):
-                raise TimeoutException(
-                    f"range ({s_lo}, {s_hi}]: "
-                    f"{len(handler.responses)}/{len(targets)} responses")
-            with lock:
-                results.extend(got)
-        return cb.merge_sorted([b for b in results if len(b)]) \
-            if any(len(b) for b in results) else cb.CellBatch.empty()
+                self.messaging.send_with_callback(
+                    Verb.RANGE_REQ,
+                    (keyspace, table_name, s_lo, s_hi, wire_limits),
+                    target,
+                    on_response=on_rsp,
+                    on_failure=lambda mid: handler.fail(),
+                    timeout=self.range_timeout)
+        if not handler.await_(self.range_timeout):
+            raise TimeoutException(
+                f"range ({s_lo}, {s_hi}]: "
+                f"{len(handler.responses)}/{len(targets)} responses")
+        with lock:
+            return list(got)
 
     def scan_all(self, keyspace: str, table_name: str,
                  cl: str = ConsistencyLevel.ONE) -> cb.CellBatch:

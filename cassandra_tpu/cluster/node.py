@@ -148,8 +148,16 @@ class Node:
         keyspace, table_name, *window = msg.payload
         store = self.engine.store(keyspace, table_name)
         if window:
-            lo, hi = window
+            lo, hi = window[0], window[1]
             batch = store.scan_window(int(lo), int(hi))
+            if len(window) > 2 and window[2] is not None:
+                # DataLimits pushdown for range reads: truncate the arc
+                # response at the source (db/filter/DataLimits over
+                # RangeCommands); `more` feeds per-arc short-read
+                # protection at the coordinator
+                limits = cbmod.DataLimits.from_wire(window[2])
+                batch, more = cbmod.truncate_live_rows(batch, limits)
+                return Verb.RANGE_RSP, (cb_serialize(batch), more)
         else:
             batch = store.scan_all()
         return Verb.RANGE_RSP, cb_serialize(batch)
@@ -584,12 +592,13 @@ class _DistributedStore:
         return self.node.proxy.scan_all(self.keyspace, self.name,
                                         self.node.default_cl)
 
-    def scan_window(self, lo: int, hi: int, now=None):
+    def scan_window(self, lo: int, hi: int, now=None, limits=None):
         return self.node.proxy.scan_window(self.keyspace, self.name, lo,
-                                           hi, self.node.default_cl)
+                                           hi, self.node.default_cl,
+                                           limits=limits)
 
     def iter_scan(self, now=None, after: int = -(1 << 63),
-                  window_parts: int = 64):
+                  window_parts: int = 64, limits=None):
         """Bounded cluster scan: one vnode arc per window, each fetched
         from that arc's replicas only (paging substrate; window_parts is
         a partition-count hint the arc granularity stands in for)."""
@@ -600,7 +609,7 @@ class _DistributedStore:
         for hi in cuts:
             if hi <= pos and not (pos == MIN and hi == MIN):
                 continue
-            batch = self.scan_window(pos, hi, now)
+            batch = self.scan_window(pos, hi, now, limits=limits)
             if len(batch):
                 yield batch
             pos = hi

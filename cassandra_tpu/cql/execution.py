@@ -1835,6 +1835,18 @@ class Executor:
             seen_per_pk[state.pk] = state.ppl_seen
         gr = getattr(self.backend, "guardrails", None)
         dead_total = [0]   # tombstones accumulate over the WHOLE read
+        # range-read DataLimits pushdown: only when every fetched row is
+        # a result row AND this is a single unpaged pass (paged resumes
+        # re-fetch windows from their start, so a truncated window could
+        # hide rows a later page needs), AND no statics (a static
+        # pseudo-row per partition would pad the limit unboundedly)
+        push = None
+        if page_size is None and state is None and not ck_rel \
+                and not filters and not post_agg and ppl is None \
+                and limit is not None and limit > 0 \
+                and not t.static_columns:
+            from ..storage.cellbatch import DataLimits
+            push = DataLimits(row_limit=limit)
 
         def on_batch(batch):
             if gr is not None:
@@ -1876,7 +1888,7 @@ class Executor:
                 rows.append(d)
 
         for row in paging_mod.paged_rows(cfs, t, state=state,
-                                         on_batch=on_batch):
+                                         on_batch=on_batch, limits=push):
             if row.pk != cur_pk:
                 flush_static_only()
                 # a flushed phantom can meet the limit exactly — the

@@ -62,7 +62,7 @@ class PagingState:
 
 def paged_rows(store, table, now: int | None = None,
                state: PagingState | None = None, window_parts: int = 64,
-               on_batch=None):
+               on_batch=None, limits=None):
     """Yield RowData in token order, starting strictly after `state`.
     `store` provides iter_scan(now, after, window_parts) — the local
     ColumnFamilyStore or the coordinator's distributed store. on_batch
@@ -78,7 +78,8 @@ def paged_rows(store, table, now: int | None = None,
                     comp(state.ck) if state.ck else b"")
     from ..utils import murmur3, partitioners
     for batch in store.iter_scan(now=now, after=after,
-                                 window_parts=window_parts):
+                                 window_parts=window_parts,
+                                 limits=limits):
         if on_batch is not None:
             on_batch(batch)
         for row in rows_from_batch(table, batch):

@@ -340,9 +340,11 @@ class ColumnFamilyStore:
         return sorted(cands)[:k]
 
     def iter_scan(self, now: int | None = None, after: int = -(1 << 63),
-                  window_parts: int = 64):
+                  window_parts: int = 64, limits=None):
         """Yield merged CellBatches window by window, each window covering
-        up to window_parts partitions — full scans in bounded memory."""
+        up to window_parts partitions — full scans in bounded memory.
+        `limits` truncates each window at its live-row bound (the local
+        leg of the DataLimits range pushdown — spares row assembly)."""
         now = now if now is not None else timeutil.now_seconds()
         pos = after
         while True:
@@ -351,6 +353,11 @@ class ColumnFamilyStore:
                 return
             hi = toks[-1]
             batch = self.scan_window(pos, hi, now=now)
+            if limits is not None:
+                # local leg of the range DataLimits pushdown: spare the
+                # row assembly beyond the limit (distributed stores
+                # truncate replica-side and track `more` themselves)
+                batch, _ = truncate_live_rows(batch, limits)
             if len(batch):
                 yield batch
             pos = hi
