@@ -574,12 +574,21 @@ class StorageProxy:
                                           s_hi, targets, ck_comp,
                                           effective)
                 merged = cb.merge_sorted(
-                    [b for b, _ in arc_res if len(b)]) \
-                    if any(len(b) for b, _ in arc_res) \
+                    [b for _, b, _ in arc_res if len(b)]) \
+                    if any(len(b) for _, b, _ in arc_res) \
                     else cb.CellBatch.empty()
+                if effective is None and len(targets) > 1:
+                    # blocking range read repair (the DataResolver role
+                    # single-partition reads already have): unlimited
+                    # arcs repair divergent replicas partition by
+                    # partition — limited views are partial, so they
+                    # never drive repairs
+                    self._range_read_repair(
+                        keyspace, table_name, merged,
+                        [(ep, b) for ep, b, _ in arc_res])
                 if effective is None or target_rows is None:
                     break
-                truncated = [b for b, more in arc_res if more]
+                truncated = [b for _, b, more in arc_res if more]
                 if not truncated:
                     break
                 frontiers = [cb.row_frontier(b) for b in truncated]
@@ -598,6 +607,52 @@ class StorageProxy:
         return cb.merge_sorted(results) if results \
             else cb.CellBatch.empty()
 
+
+    def _range_read_repair(self, keyspace, table_name, merged,
+                           replica_batches) -> None:
+        """Push the merged truth for every partition a replica's copy
+        diverges on (service/reads/repair for RangeCommands). Whole-arc
+        digests gate the per-partition work; repairs are one-way
+        mutations like the single-partition path."""
+        want = self._digest(merged)
+        divergent = [(ep, b) for ep, b in replica_batches
+                     if self._digest(b) != want]
+        if not divergent:
+            return
+        from .repair import iter_partitions
+        t = self.node.schema.get_table(keyspace, table_name)
+        # per-partition digests of each DIVERGENT replica's view (a
+        # replica whose whole-arc digest matches cannot differ on any
+        # partition), keyed by the 16-byte partition lane prefix
+        def part_map(batch):
+            out = {}
+            for s, e, _tok in iter_partitions(batch):
+                part = batch.slice_range(s, e)
+                key = batch.lanes[s, :4].astype(">u4").tobytes()
+                out[key] = part
+            return out
+        replica_parts = [(ep, part_map(b)) for ep, b in divergent]
+        from ..service.metrics import GLOBAL
+        for s, e, _tok in iter_partitions(merged):
+            truth = merged.slice_range(s, e)
+            key = merged.lanes[s, :4].astype(">u4").tobytes()
+            tdig = self._digest(truth)
+            m = None
+            for ep, parts in replica_parts:
+                have = parts.get(key)
+                if have is not None and self._digest(have) == tdig:
+                    continue
+                if m is None:
+                    m = batch_to_mutation(t, truth)
+                    if m is None:
+                        break
+                GLOBAL.incr("reads.range_repairs")
+                if ep == self.node.endpoint:
+                    self.node.engine.apply(m)
+                else:
+                    self.messaging.send_one_way(
+                        Verb.MUTATION_REQ, m.serialize(), ep)
+
     def _arc_round(self, keyspace, table_name, s_lo, s_hi, targets,
                    ck_comp, limits):
         """One fetch of an arc from its targets at the given limits.
@@ -612,10 +667,13 @@ class StorageProxy:
                     keyspace, table_name).scan_window(s_lo, s_hi)
                 b, more = cb.truncate_live_rows(b, limits)
                 with lock:
-                    got.append((b, more))
+                    got.append((target, b, more))
                 handler.ack()
             else:
-                def on_rsp(m):
+                def on_rsp(m, t=target):
+                    # responses carry their ENDPOINT: callbacks append
+                    # in arrival order, and read repair must attribute
+                    # each batch to the replica that sent it
                     with lock:
                         payload = m.payload
                         if isinstance(payload, tuple):
@@ -624,7 +682,7 @@ class StorageProxy:
                             pdict, more = payload, False
                         b = cb_deserialize(pdict)
                         b.ck_comp = ck_comp
-                        got.append((b, bool(more)))
+                        got.append((t, b, bool(more)))
                     handler.ack()
                 self.messaging.send_with_callback(
                     Verb.RANGE_REQ,
