@@ -68,6 +68,13 @@ class Node:
         return self.paxos.cas(keyspace, table, pk, ck, check_fn,
                               mutation_fn, timeout=self.proxy.timeout)
 
+    def cas_partition(self, keyspace, table, pk, check_and_build):
+        """Partition-scoped CAS: conditional batches
+        (StorageProxy.cas over BatchStatement conditions)."""
+        return self.paxos.cas_partition(keyspace, table, pk,
+                                        check_and_build,
+                                        timeout=self.proxy.timeout)
+
     @property
     def batchlog(self):
         """Logged batches persist in the coordinator's batchlog before the

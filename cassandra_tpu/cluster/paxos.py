@@ -378,6 +378,26 @@ class PaxosService:
         """Linearizable compare-and-set: check_fn(current_row_dict|None) ->
         bool; mutation_fn() -> Mutation applied iff the check passed.
         Returns (applied, current_row)."""
+
+        def check_and_build(read_row):
+            current = read_row(ck)
+            if not check_fn(current):
+                return None, current
+            return mutation_fn(), current
+
+        return self.cas_partition(keyspace, table, pk, check_and_build,
+                                  timeout, attempts)
+
+    def cas_partition(self, keyspace: str, table, pk: bytes,
+                      check_and_build, timeout: float = 5.0,
+                      attempts: int = 10):
+        """Partition-scoped CAS — the primitive under single-row LWT and
+        CONDITIONAL BATCHES (BatchStatement.executeWithConditions: the
+        Paxos instance is keyed by (table, partition), so conditions
+        over MULTIPLE rows of one partition serialize in one round).
+        check_and_build(read_row) runs at the linearization point with
+        read_row(ck) -> row_dict|None (QUORUM reads); it returns
+        (Mutation|None, info) — None aborts with applied=False."""
         node = self.node
         ks = node.schema.keyspaces[keyspace]
         strat = ReplicationStrategy.create(ks.params.replication)
@@ -421,12 +441,22 @@ class PaxosService:
                 # either way: retry our own round on fresh state
                 continue
 
-            # linearization-point read (QUORUM)
-            current = self._read_row(keyspace, table, pk, ck)
-            if not check_fn(current):
-                return False, current
+            # linearization point: reads at QUORUM, conditions, and
+            # the mutation build happen under the promised ballot. The
+            # partition is read ONCE per attempt and indexed by
+            # clustering — N conditions must not cost N quorum reads
+            # inside the contention window
+            row_cache: dict = {}
 
-            mutation = mutation_fn()
+            def read_row(ck_):
+                if "rows" not in row_cache:
+                    row_cache["rows"] = self._read_partition_rows(
+                        keyspace, table, pk)
+                return row_cache["rows"].get(ck_)
+
+            mutation, info = check_and_build(read_row)
+            if mutation is None:
+                return False, info
             value = mutation.serialize()
             accepts = self._quorum_round(
                 "PAXOS_PROPOSE", (table.id, pk, ballot.pack(), value),
@@ -439,7 +469,7 @@ class PaxosService:
                                (table.id, pk, ballot.pack(), value),
                                live, timeout, need)
             self._commit_to_pending(strat, token, all_replicas, value)
-            return True, current
+            return True, info
         raise last_contention or CasContention("cas retries exhausted")
 
     def _commit_to_pending(self, strat, token, natural, value) -> None:
@@ -477,6 +507,19 @@ class PaxosService:
             ts = max(time.time_ns(), PaxosService._last_ballot_ts + 1)
             PaxosService._last_ballot_ts = ts
         return Ballot(ts, self.node.endpoint.name)
+
+    def _read_partition_rows(self, keyspace: str, table,
+                             pk: bytes) -> dict:
+        """One QUORUM partition read, indexed {ck_frame: row_dict} —
+        the shared read under multi-condition CAS."""
+        from ..storage.rows import row_to_dict, rows_from_batch
+        batch = self.node.proxy.read_partition(
+            keyspace, table.name, pk, ConsistencyLevel.QUORUM)
+        out = {}
+        for r in rows_from_batch(table, batch):
+            if not r.is_static:
+                out[r.ck_frame] = row_to_dict(table, r)
+        return out
 
     def _read_row(self, keyspace, table, pk, ck):
         from ..storage.rows import row_to_dict, rows_from_batch

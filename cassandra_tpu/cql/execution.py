@@ -224,10 +224,13 @@ def _call_function(fn: ast.FunctionCall, params):
 
 class _MutationCollector:
     """Backend proxy that records mutations instead of applying them
-    (logged-batch collection)."""
+    (logged-batch collection). fire_triggers=False collects WITHOUT
+    trigger augmentation — conditional batches match single-row LWT,
+    which never fires triggers."""
 
-    def __init__(self, backend):
+    def __init__(self, backend, fire_triggers: bool = True):
         self._backend = backend
+        self._fire_triggers = fire_triggers
         self.mutations: list[Mutation] = []
 
     collects_only = True   # _apply_dml: no view derivation on collect
@@ -236,6 +239,8 @@ class _MutationCollector:
     def triggers(self):
         # triggers still augment while collecting: a logged batch must
         # journal the trigger output with the base writes
+        if not self._fire_triggers:
+            return None
         return getattr(self._backend, "triggers", None)
 
     def apply(self, mutation, durable: bool = True) -> None:
@@ -1143,52 +1148,63 @@ class Executor:
 
     # ----------------------------------------------------------------- DML
 
+
+    def _expand_json_insert(self, s, t, params):
+        """INSERT JSON -> a COPY of the statement with columns/values
+        expanded from the document (Json.java prepareAndCollectMarkers
+        + DEFAULT NULL semantics). Shared by the direct insert path and
+        conditional batches (which need the key columns up front)."""
+        import copy
+        import json as json_mod
+
+        from ..transport_server import WireValue
+        doc = s.json_payload
+        if isinstance(doc, ast.BindMarker):
+            # resolve the marker OURSELVES: the generic no-type wire
+            # heuristic would decode small byte payloads as integers
+            if isinstance(params, dict):
+                if doc.name not in params:
+                    raise InvalidRequest(
+                        f"missing named parameter {doc.name}")
+                doc = params[doc.name]
+            else:
+                if doc.index >= len(params):
+                    raise InvalidRequest("not enough bind parameters")
+                doc = params[doc.index]
+        else:
+            doc = bind_term(doc, None, params)
+        if isinstance(doc, (WireValue, bytes, bytearray)):
+            doc = bytes(doc).decode()
+        try:
+            data = json_mod.loads(doc)
+        except (TypeError, ValueError) as e:
+            raise InvalidRequest(f"bad JSON payload: {e}")
+        if not isinstance(data, dict):
+            raise InvalidRequest("INSERT JSON expects an object")
+        s = copy.copy(s)
+        s.json = False
+        s.columns, s.values = [], []
+        for k, v in data.items():
+            col = t.columns.get(k)
+            if col is None:
+                raise InvalidRequest(f"unknown column {k}")
+            s.columns.append(k)
+            s.values.append(ast.Literal(
+                _from_json(v, col.cql_type), "json"))
+        # DEFAULT NULL semantics (reference Json.java): columns the
+        # document omits are written null, replacing the whole row
+        named = set(data)
+        for col in t.regular_columns + t.static_columns:
+            if col.name not in named:
+                s.columns.append(col.name)
+                s.values.append(ast.Literal(None, "null"))
+        return s
+
     def _exec_InsertStatement(self, s, params, keyspace, now):
         t = self._table(s, keyspace)
         self._reject_view_write(t)
         if getattr(s, "json", False):
-            import copy
-            import json as json_mod
-            from ..transport_server import WireValue
-            doc = s.json_payload
-            if isinstance(doc, ast.BindMarker):
-                # resolve the marker OURSELVES: the generic no-type wire
-                # heuristic would decode small byte payloads as integers
-                if isinstance(params, dict):
-                    if doc.name not in params:
-                        raise InvalidRequest(
-                            f"missing named parameter {doc.name}")
-                    doc = params[doc.name]
-                else:
-                    if doc.index >= len(params):
-                        raise InvalidRequest("not enough bind parameters")
-                    doc = params[doc.index]
-            else:
-                doc = bind_term(doc, None, params)
-            if isinstance(doc, (WireValue, bytes, bytearray)):
-                doc = bytes(doc).decode()
-            try:
-                data = json_mod.loads(doc)
-            except (TypeError, ValueError) as e:
-                raise InvalidRequest(f"bad JSON payload: {e}")
-            if not isinstance(data, dict):
-                raise InvalidRequest("INSERT JSON expects an object")
-            s = copy.copy(s)
-            s.columns, s.values = [], []
-            for k, v in data.items():
-                col = t.columns.get(k)
-                if col is None:
-                    raise InvalidRequest(f"unknown column {k}")
-                s.columns.append(k)
-                s.values.append(ast.Literal(
-                    _from_json(v, col.cql_type), "json"))
-            # DEFAULT NULL semantics (reference Json.java): columns the
-            # document omits are written null, replacing the whole row
-            named = set(data)
-            for col in t.regular_columns + t.static_columns:
-                if col.name not in named:
-                    s.columns.append(col.name)
-                    s.values.append(ast.Literal(None, "null"))
+            s = self._expand_json_insert(s, t, params)
         now = now or timeutil.now_micros()
         ts = now if s.timestamp is None \
             else int(bind_term(s.timestamp, None, params))
@@ -1500,19 +1516,148 @@ class Executor:
             return APPLIED
         return ResultSet([], [])
 
+
+    def _exec_conditional_batch(self, s, params, keyspace, now,
+                                user=None):
+        """Conditional (LWT) batch: every statement must target ONE
+        partition of ONE table; all conditions evaluate against that
+        partition's current rows at the Paxos linearization point, and
+        the combined mutations apply atomically iff every condition
+        passes (BatchStatement.executeWithConditions — the reference's
+        single-partition restriction, CASBatch semantics)."""
+        if s.kind == "counter":
+            raise InvalidRequest("counter batches cannot be conditional")
+        # resolve the common (table, pk); reject cross-partition batches
+        table = None
+        pk = None
+        per_stmt = []    # (sub, ck_bytes)
+        for sub in s.statements:
+            t = self._table(sub, keyspace)
+            if table is None:
+                table = t
+            elif t.id != table.id:
+                raise InvalidRequest(
+                    "conditional batches must target a single table")
+            is_cond = bool(getattr(sub, "if_not_exists", False)
+                           or getattr(sub, "if_exists", False)
+                           or getattr(sub, "conditions", None))
+            if type(sub).__name__ == "InsertStatement":
+                if getattr(sub, "json", False):
+                    # expand NOW: the key columns live in the document
+                    sub = self._expand_json_insert(sub, t, params)
+                vals = {}
+                for cname, term in zip(sub.columns, sub.values):
+                    col = t.columns.get(cname)
+                    if col is None:
+                        raise InvalidRequest(f"unknown column {cname}")
+                    vals[cname] = bind_term(term, col.cql_type, params)
+                try:
+                    this_pk = t.serialize_partition_key(
+                        [vals[c.name] for c in t.partition_key_columns])
+                    ck = t.serialize_clustering(
+                        [vals[c.name] for c in t.clustering_columns]) \
+                        if t.clustering_columns else b""
+                except KeyError as e:
+                    raise InvalidRequest(f"missing key column {e}")
+            else:
+                pk_vals, ck_rel, filters = self._split_where(
+                    t, sub.where, params)
+                if filters:
+                    raise InvalidRequest(
+                        "non-primary-key columns in a conditional "
+                        "batch WHERE")
+                pks = self._pk_bytes_list(t, pk_vals)
+                if len(pks) != 1:
+                    raise InvalidRequest(
+                        "conditional batches must target a single "
+                        "partition")
+                this_pk = pks[0]
+                # the clustering is only needed to READ a condition's
+                # row: unconditional partition/range deletes and
+                # static-only updates keep their standalone semantics
+                ck = self._full_ck(t, ck_rel, params) \
+                    if (is_cond and t.clustering_columns) else b""
+            if pk is None:
+                pk = this_pk
+            elif this_pk != pk:
+                raise InvalidRequest(
+                    "conditional batches must target a single partition")
+            per_stmt.append((sub, ck))
+
+        def check_and_build(read_row):
+            # evaluate EVERY condition against the partition's current
+            # rows (LWT reads happen under the promised ballot)
+            for sub, ck in per_stmt:
+                if not (getattr(sub, "if_not_exists", False)
+                        or getattr(sub, "if_exists", False)
+                        or getattr(sub, "conditions", None)):
+                    continue
+                existing = read_row(ck)
+                if getattr(sub, "if_not_exists", False):
+                    if existing is not None:
+                        return None, existing
+                elif getattr(sub, "if_exists", False):
+                    if existing is None:
+                        return None, None
+                if getattr(sub, "conditions", None):
+                    if not self._check_conditions(
+                            table, existing, sub.conditions, params):
+                        return None, existing
+            # all conditions passed: collect the batch's mutations.
+            # fire_triggers=False matches single-row LWT (which never
+            # fires triggers); conditions are stripped on COPIES — the
+            # originals may be shared prepared-statement ASTs executing
+            # concurrently on other connections
+            import copy as copy_mod
+            collector = _MutationCollector(self.backend,
+                                           fire_triggers=False)
+            sub_exec = Executor(collector)
+            for sub, _ck in per_stmt:
+                sub2 = copy_mod.copy(sub)
+                if hasattr(sub2, "if_not_exists"):
+                    sub2.if_not_exists = False
+                if hasattr(sub2, "if_exists"):
+                    sub2.if_exists = False
+                if hasattr(sub2, "conditions"):
+                    sub2.conditions = None
+                sub_exec.execute(sub2, params, keyspace,
+                                 now_micros=now, user=user)
+            combined = Mutation(table.id, pk)
+            for m in collector.mutations:
+                if m.table_id != table.id or m.pk != pk:
+                    raise InvalidRequest(
+                        "conditional batches must mutate only their "
+                        "own partition")
+                combined.ops.extend(m.ops)
+            return combined, None
+
+        casfn = getattr(self.backend, "cas_partition", None)
+        if casfn is not None:
+            applied, info = casfn(table.keyspace, table, pk,
+                                  check_and_build)
+        else:
+            # single-engine backend: no distributed linearization needed
+            m, info = check_and_build(
+                lambda ck: self._read_row(table, pk, ck, now))
+            applied = m is not None
+            if applied:
+                self._apply_dml(m, now, augment=False)
+        if applied:
+            return APPLIED
+        return self._not_applied(table, info)
+
     def _exec_BatchStatement(self, s, params, keyspace, now, user=None):
         now = now or timeutil.now_micros()
         gr = getattr(self.backend, "guardrails", None)
         if gr is not None:
             gr.check_batch_size(len(s.statements))
-        for sub in s.statements:
-            if getattr(sub, "if_not_exists", False) \
-                    or getattr(sub, "if_exists", False) \
-                    or getattr(sub, "conditions", None):
-                raise InvalidRequest(
-                    "conditional statements are not supported in batches "
-                    "(round 1; the reference restricts them to a single "
-                    "partition)")
+        conditional = [sub for sub in s.statements
+                       if getattr(sub, "if_not_exists", False)
+                       or getattr(sub, "if_exists", False)
+                       or getattr(sub, "conditions", None)]
+        if conditional:
+            return self._exec_conditional_batch(s, params, keyspace, now,
+                                                user)
         def _targets_counter(sub) -> bool:
             try:
                 t = self.schema.get_table(

@@ -838,3 +838,107 @@ def test_range_read_repair_converges_replicas(tmp_path):
         assert ok, vals
     finally:
         c.shutdown()
+
+
+def test_conditional_batch_single_partition(tmp_path):
+    """LWT batches (BatchStatement.executeWithConditions): conditions
+    over multiple rows of ONE partition decide atomically through the
+    partition's Paxos instance; cross-partition conditional batches are
+    refused."""
+    from cassandra_tpu.cluster.node import LocalCluster
+    from cassandra_tpu.cluster.replication import ConsistencyLevel
+    c = LocalCluster(3, str(tmp_path), rf=3)
+    try:
+        s = c.session(1)
+        s.execute("CREATE KEYSPACE ks WITH replication = "
+                  "{'class': 'SimpleStrategy', 'replication_factor': 3}")
+        s.execute("USE ks")
+        s.execute("CREATE TABLE acct (owner text, name text, bal int, "
+                  "PRIMARY KEY (owner, name))")
+        c.node(1).default_cl = ConsistencyLevel.QUORUM
+        s.execute("INSERT INTO acct (owner, name, bal) VALUES "
+                  "('alice', 'checking', 100)")
+        s.execute("INSERT INTO acct (owner, name, bal) VALUES "
+                  "('alice', 'savings', 50)")
+        # transfer iff the source still holds the expected balance
+        rs = s.execute(
+            "BEGIN BATCH "
+            "UPDATE acct SET bal = 70 WHERE owner = 'alice' AND "
+            "name = 'checking' IF bal = 100; "
+            "UPDATE acct SET bal = 80 WHERE owner = 'alice' AND "
+            "name = 'savings'; "
+            "APPLY BATCH")
+        assert rs.rows[0][0] is True
+        got = dict(s.execute("SELECT name, bal FROM acct "
+                             "WHERE owner = 'alice'").rows)
+        assert got == {"checking": 70, "savings": 80}
+        # failed condition: NOTHING applies
+        rs = s.execute(
+            "BEGIN BATCH "
+            "UPDATE acct SET bal = 0 WHERE owner = 'alice' AND "
+            "name = 'checking' IF bal = 999; "
+            "UPDATE acct SET bal = 0 WHERE owner = 'alice' AND "
+            "name = 'savings'; "
+            "APPLY BATCH")
+        assert rs.rows[0][0] is False
+        got = dict(s.execute("SELECT name, bal FROM acct "
+                             "WHERE owner = 'alice'").rows)
+        assert got == {"checking": 70, "savings": 80}
+        # IF NOT EXISTS in a batch
+        rs = s.execute(
+            "BEGIN BATCH "
+            "INSERT INTO acct (owner, name, bal) VALUES "
+            "('alice', 'broker', 5) IF NOT EXISTS; "
+            "APPLY BATCH")
+        assert rs.rows[0][0] is True
+        rs = s.execute(
+            "BEGIN BATCH "
+            "INSERT INTO acct (owner, name, bal) VALUES "
+            "('alice', 'broker', 9) IF NOT EXISTS; "
+            "APPLY BATCH")
+        assert rs.rows[0][0] is False
+        # cross-partition refusal
+        import pytest as _pytest
+        with _pytest.raises(Exception, match="single partition"):
+            s.execute(
+                "BEGIN BATCH "
+                "UPDATE acct SET bal = 1 WHERE owner = 'alice' AND "
+                "name = 'checking' IF bal = 70; "
+                "UPDATE acct SET bal = 1 WHERE owner = 'bob' AND "
+                "name = 'checking'; "
+                "APPLY BATCH")
+    finally:
+        c.shutdown()
+
+
+def test_conditional_batch_json_and_shared_ast(tmp_path):
+    """Regression pair: INSERT...JSON works inside conditional batches
+    (key columns come from the document), and repeated execution of the
+    SAME parsed batch keeps its conditions (no shared-AST stripping)."""
+    from cassandra_tpu.cluster.node import LocalCluster
+    c = LocalCluster(1, str(tmp_path), rf=1)
+    try:
+        s = c.session(1)
+        s.execute("CREATE KEYSPACE ks WITH replication = "
+                  "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+        s.execute("USE ks")
+        s.execute("CREATE TABLE j (k int, c int, v int, "
+                  "PRIMARY KEY (k, c))")
+        q = ("BEGIN BATCH "
+             "INSERT INTO j JSON '{\"k\": 1, \"c\": 2, \"v\": 9}' "
+             "IF NOT EXISTS; APPLY BATCH")
+        assert s.execute(q).rows[0][0] is True
+        # second run of the same statement text (same prepared-cache
+        # entry underneath): the IF must still be there and fail
+        assert s.execute(q).rows[0][0] is False
+        assert s.execute("SELECT v FROM j WHERE k = 1 AND c = 2"
+                         ).rows == [(9,)]
+        # unconditional partition delete rides in a conditional batch
+        rs = s.execute(
+            "BEGIN BATCH "
+            "UPDATE j SET v = 10 WHERE k = 1 AND c = 2 IF v = 9; "
+            "DELETE FROM j WHERE k = 1; "
+            "APPLY BATCH")
+        assert rs.rows[0][0] is True
+    finally:
+        c.shutdown()

@@ -18,7 +18,7 @@ def cluster(tmp_path):
         # generous budget: this box has one core and these tests never
         # rely on fast timeout failure — a tight budget only buys
         # flakes (round-3 verdict Weak #4)
-        n.proxy.timeout = 5.0
+        n.proxy.timeout = 10.0
     s = c.session(1)
     s.execute("CREATE KEYSPACE ks WITH replication = "
               "{'class': 'SimpleStrategy', 'replication_factor': 2}")
@@ -54,8 +54,18 @@ def _assert_rows(cluster, node_i, lo, hi, cl=ConsistencyLevel.QUORUM):
     s = cluster.session(node_i)
     s.keyspace = "ks"
     cluster.node(node_i).default_cl = cl
+    from cassandra_tpu.cluster.coordinator import TimeoutException
+
+    def _read(q):
+        # one retry absorbs a single slow-disk stall on this 1-core
+        # box under full-suite load; correctness still requires the
+        # row to be THERE
+        try:
+            return s.execute(q)
+        except TimeoutException:
+            return s.execute(q)
     for i in range(lo, hi):
-        rows = s.execute(f"SELECT v FROM kv WHERE k = {i}").rows
+        rows = _read(f"SELECT v FROM kv WHERE k = {i}").rows
         assert rows == [(f"v{i}",)], f"row {i} missing via node{node_i}"
 
 
