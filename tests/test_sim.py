@@ -129,8 +129,16 @@ def test_harry_stream_under_simulation(tmp_path):
                     dropping = None
                 if op.kind == "advance":
                     sched.run(op.seconds)
-                elif op.kind in ("flush", "compact"):
-                    pass        # storage lifecycle is not under test here
+                elif op.kind == "flush":
+                    node.engine.store("fz", "t").flush()
+                elif op.kind == "compact":
+                    from cassandra_tpu.compaction.task import \
+                        CompactionTask
+                    cfs = node.engine.store("fz", "t")
+                    inputs = list(cfs.live_sstables())
+                    if len(inputs) >= 2:
+                        CompactionTask(cfs, inputs,
+                                       engine="numpy").execute()
                 else:
                     s.execute(op.cql("t"))
                 model.apply(op, now_s=timeutil.now_seconds())
