@@ -24,7 +24,10 @@ executed) AND the host has one core — so the device path's remaining
 ~0.4s link wait cannot beat the C++ merge's 0.06s. The v3 layout took
 the device engine from 24 to ~73 MiB/s on this link (BASELINE.md has
 the full accounting + the untunneled-chip projection); CompactionTask
-takes engine= per deployment. Phase timings are in detail.phases.
+takes engine= per deployment. Phase timings are in detail.phases; the
+write leg reports `compress` and `io_write` separately (plus `seal` for
+the final fsync/rename) since the pipelined executor split them onto
+their own threads — CTPU_BENCH_PIPELINED=0 A/Bs the serial write path.
 
 Prints ONE json line. The device kernel is warmed on a separate copy of
 the data so compile time is excluded.
@@ -142,8 +145,14 @@ def run_compaction(base_dir, table, seed, cfg):
     cfs.reload_sstables()
     inputs = cfs.tracker.view()
     engine = os.environ.get("CTPU_BENCH_ENGINE", "native")
+    # CTPU_BENCH_PIPELINED=0 disables the threaded compress->io_write
+    # split for A/B runs; the default exercises the full pipeline
+    # (decode+merge / compress / io_write on three threads; phases
+    # report `compress` and `io_write` separately)
+    pipelined = os.environ.get("CTPU_BENCH_PIPELINED", "1") != "0"
     task = CompactionTask(cfs, inputs, engine=engine,
-                          use_device=engine == "device")
+                          use_device=engine == "device",
+                          pipelined_io=pipelined)
     t0 = time.time()
     stats = task.execute()
     stats["wall"] = time.time() - t0

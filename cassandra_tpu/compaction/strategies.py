@@ -399,6 +399,28 @@ class _SequentialTasks:
         self.tasks = tasks
         self.inputs = [s for t in tasks for s in t.inputs]
 
+    # executor plumbing (CompactionManager._execute_task assigns these):
+    # forward to every wrapped task so the shared throttle and the
+    # progress handle cover all groups, not just the wrapper object
+
+    @property
+    def limiter(self):
+        return self.tasks[0].limiter if self.tasks else None
+
+    @limiter.setter
+    def limiter(self, v):
+        for t in self.tasks:
+            t.limiter = v
+
+    @property
+    def progress(self):
+        return self.tasks[0].progress if self.tasks else None
+
+    @progress.setter
+    def progress(self, v):
+        for t in self.tasks:
+            t.progress = v
+
     def execute(self) -> dict:
         stats = None
         for t in self.tasks:

@@ -203,19 +203,35 @@ class CompactionTask:
                  max_output_bytes: int | None = None,
                  level: int = 0, use_device: bool | None = None,
                  round_cells: int | None = None,
-                 engine: str | None = None):
+                 engine: str | None = None,
+                 limiter=None, progress=None,
+                 pipelined_io: bool = True):
         """engine: 'device' (TPU kernel), 'native' (C++ streaming merge),
         'numpy' (reference path). All three are tested bit-identical.
         Default (engine=None, use_device unset): the native engine when
         the library is available, else numpy — the measured winner when
         the accelerator link is bandwidth-bound (BASELINE.md); pass
         engine='device' (or use_device=True) on deployments with a
-        locally attached chip."""
+        locally attached chip.
+
+        limiter: a utils.ratelimit.RateLimiter debited per round with the
+        round's share of on-disk input bytes (compaction_throughput).
+        progress: a compaction.executor.CompactionProgress the task
+        updates as it runs (nodetool compactionstats / the
+        compactions_in_progress virtual table).
+        pipelined_io: thread the output's disk writes behind the
+        compress stage (SSTableWriter threaded_io) — the write leg of
+        the decode→merge→compress→write pipeline. Output bytes are
+        identical either way; disable to keep everything on two threads.
+        """
         self.cfs = cfs
         self.inputs = inputs
         self.max_output_bytes = max_output_bytes
         self.level = level
         self.use_device = bool(use_device)
+        self.limiter = limiter
+        self.progress = progress
+        self.pipelined_io = pipelined_io
         if engine is None:
             if use_device:
                 engine = "device"
@@ -265,7 +281,8 @@ class CompactionTask:
             txn.track_new(gen)
             w = SSTableWriter(desc, table,
                               estimated_partitions=max(
-                                  sum(r.n_partitions for r in self.inputs), 16))
+                                  sum(r.n_partitions for r in self.inputs), 16),
+                              prof=prof, threaded_io=self.pipelined_io)
             w.level = self.level
             # outputs carry the MINIMUM repairedAt of the inputs
             # (CompactionTask.getMinRepairedAt): mixing repaired with
@@ -285,16 +302,25 @@ class CompactionTask:
         werr: list[BaseException] = []
         wstate = {"writer": None, "cells": 0}
 
+        progress = self.progress
+
         def write_loop():
+            # compress stage of the pipeline: writer.append cuts
+            # segments and compresses them; the disk write itself runs
+            # on the writer's own I/O thread (pipelined_io) so the
+            # three stages decode+merge / compress / io_write overlap.
+            # Phase timings land in prof as 'compress' and 'io_write'
+            # (the former single 'write' phase, split).
             try:
                 while True:
                     merged = wq.get()
                     if merged is None:
                         return
-                    tw = time.perf_counter()
-                    wstate["writer"].append(merged)
-                    prof["write"] = prof.get("write", 0.0) + \
-                        (time.perf_counter() - tw)
+                    w = wstate["writer"]
+                    off0 = w._data_off
+                    w.append(merged)
+                    if progress is not None:
+                        progress.add_written(w._data_off - off0)
                     wstate["cells"] += len(merged)
                     if self.max_output_bytes and \
                             wstate["writer"]._data_off >= \
@@ -321,8 +347,17 @@ class CompactionTask:
             if len(merged):
                 wq.put(merged)
 
+        # throttle + progress work in on-disk byte terms: each round
+        # consumed cells are mapped back to their share of the input
+        # files' bytes, so compaction_throughput limits disk read rate
+        # (the reference debits its limiter per scanned partition) and
+        # progress.bytes_read converges on total_bytes exactly
+        bytes_per_cell = bytes_read / max(cells_read, 1)
+
         wthread = None
         try:
+            if progress is not None:
+                progress.set_phase("decode")
             wstate["writer"] = new_writer()
             wthread = threading.Thread(target=write_loop, name="compact-w")
             wthread.start()
@@ -331,10 +366,14 @@ class CompactionTask:
                 if werr:       # writer died: fail fast, don't keep merging
                     break
                 abort = getattr(cfs, "compaction_abort", None)
-                if abort is not None and abort.is_set():
-                    # nodetool stop: cooperative cancel between rounds;
-                    # the lifecycle txn below never commits, so the
-                    # partial output rolls back on the crash-safe path
+                if (abort is not None and abort.is_set()) or \
+                        (progress is not None and progress.stop_requested):
+                    # nodetool stop: cooperative cancel between rounds
+                    # (per-task via the progress handle under the
+                    # executor; the legacy shared event covers tasks
+                    # driven without one); the lifecycle txn below never
+                    # commits, so the partial output rolls back on the
+                    # crash-safe path
                     raise RuntimeError(
                         "compaction stopped by operator request")
                 active = [c for c in cursors if c.has_data]
@@ -360,6 +399,13 @@ class CompactionTask:
                         slices.append(s)
                 if not slices:
                     continue
+                round_bytes = int(sum(len(s) for s in slices)
+                                  * bytes_per_cell)
+                if progress is not None:
+                    progress.set_phase("merge")
+                    progress.add_read(round_bytes)
+                if self.limiter is not None:
+                    self.limiter.acquire(round_bytes)
                 if self.engine == "device":
                     pending.append(dmerge.submit_merge(
                         slices, gc_before=gc_before, now=now,
@@ -380,9 +426,11 @@ class CompactionTask:
                 raise werr[0]
             cells_written = wstate["cells"]
             writer = wstate["writer"]
+            if progress is not None:
+                progress.set_phase("seal")
             tw = time.perf_counter()
             writer.finish()
-            prof["write"] = prof.get("write", 0.0) + \
+            prof["seal"] = prof.get("seal", 0.0) + \
                 (time.perf_counter() - tw)
             new_readers.append(SSTableReader(writer.desc, table))
             for r in self.inputs:

@@ -115,6 +115,12 @@ class Config:
     # compaction (cassandra.yaml:1217-1250)
     concurrent_compactors: int = mut(1)
     compaction_throughput: float = spec("rate", 64.0, mutable=True)
+    # modern-yaml name for the same throttle (DataRateSpec
+    # compaction_throughput_mib_per_sec). Negative = unset: the engine
+    # falls back to compaction_throughput; setting either at runtime
+    # reaches the live limiter.
+    compaction_throughput_mib_per_sec: float = spec("rate", -1.0,
+                                                    mutable=True)
     sstable_preemptive_open_interval: int = spec("storage",
                                                  50 * 1024 * 1024)
 

@@ -89,11 +89,17 @@ class MetricsRegistry:
     def snapshot(self) -> dict:
         with self._lock:
             out = dict(self._counters)
-            for name, h in self._hists.items():
-                out[f"{name}.count"] = h.count
-                out[f"{name}.mean_us"] = round(h.mean_us, 1)
-                out[f"{name}.p99_us"] = h.percentile(0.99)
-            return out
+            hists = list(self._hists.items())
+        # histogram reads happen OUTSIDE the registry lock (each hist
+        # has its own): keeps snapshot cheap under concurrent updates.
+        # Live gauges are engine-scoped by design — see
+        # CompactionManager.gauges() / the system_views.metrics vtable —
+        # so in-process multi-node deployments never cross-report.
+        for name, h in hists:
+            out[f"{name}.count"] = h.count
+            out[f"{name}.mean_us"] = round(h.mean_us, 1)
+            out[f"{name}.p99_us"] = h.percentile(0.99)
+        return out
 
 
 GLOBAL = MetricsRegistry()

@@ -86,14 +86,36 @@ class StorageEngine:
         # NOTE the default is the REFERENCE default (64 MiB/s,
         # cassandra.yaml:1243) — out-of-the-box nodes are throttled like
         # the reference; bench.py drives CompactionTask directly and is
-        # unaffected. `compaction_throughput: 0` disables.
+        # unaffected. `compaction_throughput: 0` disables. The modern
+        # knob name compaction_throughput_mib_per_sec takes precedence
+        # when set (>= 0).
+        tput = self.settings.get("compaction_throughput_mib_per_sec")
+        if tput < 0:
+            tput = self.settings.get("compaction_throughput")
         self.compactions = CompactionManager(
-            throughput_mib_s=self.settings.get("compaction_throughput"),
-            auto=False)
-        # hot-reload: `nodetool setcompactionthroughput` / settings table
-        self._throttle_listener = self.compactions.set_throughput
+            throughput_mib_s=tput, auto=False,
+            concurrent=self.settings.get("concurrent_compactors"))
+        # hot-reload: `nodetool setcompactionthroughput` /
+        # `setconcurrentcompactors` / settings table. Either knob change
+        # re-resolves the pair under the documented precedence (modern
+        # name wins when set), so a legacy-knob write can never clobber
+        # a set compaction_throughput_mib_per_sec.
+
+        def _resolve_throughput(_v):
+            mib = self.settings.get("compaction_throughput_mib_per_sec")
+            if mib < 0:
+                mib = self.settings.get("compaction_throughput")
+            self.compactions.set_throughput(mib)
+
+        self._throttle_listener = _resolve_throughput
         self.settings.on_change("compaction_throughput",
                                 self._throttle_listener)
+        self.settings.on_change("compaction_throughput_mib_per_sec",
+                                self._throttle_listener)
+        self._compactor_listener = \
+            self.compactions.set_concurrent_compactors
+        self.settings.on_change("concurrent_compactors",
+                                self._compactor_listener)
         self._load_schema()
         self._schema_listener = lambda s: self._save_schema()
         self.schema.listeners.append(self._schema_listener)
@@ -292,6 +314,10 @@ class StorageEngine:
             pass
         self.settings.remove_listener("compaction_throughput",
                                       self._throttle_listener)
+        self.settings.remove_listener("compaction_throughput_mib_per_sec",
+                                      self._throttle_listener)
+        self.settings.remove_listener("concurrent_compactors",
+                                      self._compactor_listener)
         self.compactions.close()
         if self.commitlog:
             self.commitlog.close()

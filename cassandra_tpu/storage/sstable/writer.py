@@ -16,6 +16,7 @@ import mmap
 import os
 import struct
 import threading
+import time
 import zlib
 
 import numpy as np
@@ -66,11 +67,39 @@ class SSTableWriter:
     DIRECT_ALIGN = 4096
     BOUNCE_BYTES = 8 << 20
 
+    # bounded staging queue for the threaded-I/O mode: compression of
+    # segment k+1 overlaps the disk write of segment k; 4 buffers bound
+    # the memory held and give backpressure when the disk falls behind
+    IO_QUEUE_DEPTH = 4
+
     def __init__(self, descriptor: Descriptor, table: TableMetadata,
                  estimated_partitions: int = 1024,
-                 segment_cells: int = SEGMENT_CELLS):
+                 segment_cells: int = SEGMENT_CELLS,
+                 prof: dict | None = None,
+                 threaded_io: bool = False):
+        """prof: optional dict accumulating per-phase wall seconds
+        ('compress' = serialize+compress+CRC, 'io_write' = fd writes).
+        threaded_io: stage compressed segments through a bounded queue
+        drained by a dedicated I/O thread, so compression of the next
+        segment overlaps the previous segment's disk write (the write
+        stage of the compaction pipeline; see compaction/executor.py)."""
         self.desc = descriptor
         self.table = table
+        self.prof = prof
+        self._threaded_io = threaded_io
+        self._io_thread: threading.Thread | None = None
+        self._io_error: list[BaseException] = []
+        self._wq = None
+        if threaded_io:
+            import queue
+            self._wq = queue.Queue(maxsize=self.IO_QUEUE_DEPTH)
+            # double-buffered pack scratch: the compress stage packs
+            # segment k+1 into one buffer while the I/O thread drains
+            # segment k from the other — ZERO copies between stages
+            # (ownership travels through the queue and returns here)
+            self._pack_free: queue.Queue = queue.Queue()
+            for _ in range(2):
+                self._pack_free.put(np.empty(0, dtype=np.uint8))
         self.params: CompressionParams = table.params.compression
         self.compressor = self.params.compressor_or_noop()
         self.segment_cells = segment_cells
@@ -105,6 +134,8 @@ class SSTableWriter:
             self._bounce_fill = 0
         self._data_crc = 0
         self._data_off = 0
+        self._written_off = 0   # bytes actually handed to the fd (the
+        #                         I/O thread's cursor in threaded mode)
         self._allocated = 0
         self._index_entries: list[bytes] = []
         self._bloom = bloom.BloomFilter.create(max(estimated_partitions, 16))
@@ -186,6 +217,7 @@ class SSTableWriter:
             self._cut_segment(min(self.segment_cells, self._pending_cells))
         if self.K is None:
             self.K = 13
+        self._stop_io_thread()   # drain staged segments, surface errors
         self._stop_syncer()   # join BEFORE the final fsync + close
         if self._sync_error is not None:
             raise self._sync_error
@@ -274,9 +306,81 @@ class SSTableWriter:
             # fs without fallocate support: fall back to plain extend
             self._allocated = 1 << 62
 
-    def _write_all(self, mv: memoryview) -> None:
+    def _acct(self, key: str, dt: float) -> None:
+        if self.prof is not None:
+            self.prof[key] = self.prof.get(key, 0.0) + dt
+
+    def _write_all(self, mv: memoryview, reclaim=None) -> None:
+        """Hand a compressed run of bytes to the data file. In threaded
+        mode ownership of `reclaim` (the pack scratch backing mv) moves
+        to the I/O thread and returns via the free pool — zero copy;
+        without a reclaimable buffer the bytes are copied onto the
+        queue. Otherwise written synchronously."""
+        if self._threaded_io:
+            if self._io_error:
+                raise self._io_error[0]   # fail the producer fast
+            if self._io_thread is None:
+                self._io_thread = threading.Thread(
+                    target=self._io_loop, name="sstable-io", daemon=True)
+                self._io_thread.start()
+            self._wq.put((mv if reclaim is not None else bytes(mv),
+                          reclaim))
+            return
+        t0 = time.perf_counter()
+        self._write_sync(mv)
+        self._acct("io_write", time.perf_counter() - t0)
+
+    def _take_pack_buf(self, need: int) -> "np.ndarray":
+        """Borrow a pack scratch buffer from the free pool (blocks when
+        both are in flight — the pipeline's backpressure), growing it if
+        this segment needs more room."""
+        buf = self._pack_free.get()
+        if buf.nbytes < need:
+            buf = np.empty(need, dtype=np.uint8)
+        return buf
+
+    def _io_loop(self) -> None:
+        item = None
+        try:
+            while True:
+                item = self._wq.get()
+                if item is None:
+                    return
+                buf, reclaim = item
+                t0 = time.perf_counter()
+                self._write_sync(memoryview(buf) if not
+                                 isinstance(buf, memoryview) else buf)
+                self._acct("io_write", time.perf_counter() - t0)
+                if reclaim is not None:
+                    self._pack_free.put(reclaim)
+        except BaseException as e:
+            self._io_error.append(e)
+            # return every owned scratch buffer (including the one whose
+            # write just failed) and drain: the producer must block on
+            # neither the pool nor the queue — it surfaces the error at
+            # its next _write_all
+            if item is not None and item[1] is not None:
+                self._pack_free.put(item[1])
+            while True:
+                item = self._wq.get()
+                if item is None:
+                    return
+                if item[1] is not None:
+                    self._pack_free.put(item[1])
+
+    def _stop_io_thread(self) -> None:
+        if self._io_thread is None:
+            return
+        self._wq.put(None)
+        self._io_thread.join()
+        self._io_thread = None
+        if self._io_error:
+            raise self._io_error[0]
+
+    def _write_sync(self, mv: memoryview) -> None:
         total = mv.nbytes
-        self._ensure_alloc(self._data_off + total)
+        self._ensure_alloc(self._written_off + total)
+        self._written_off += total
         if self._direct:
             # stage into the aligned bounce buffer; flush full buffers
             # (BOUNCE_BYTES is a multiple of DIRECT_ALIGN, so steady-state
@@ -360,6 +464,10 @@ class SSTableWriter:
             os.close(fd)
 
     def abort(self) -> None:
+        if self._io_thread is not None:   # stop without raising
+            self._wq.put(None)
+            self._io_thread.join(timeout=30.0)
+            self._io_thread = None
         self._stop_syncer()
         if not self._data.closed:
             self._data.close()
@@ -456,6 +564,7 @@ class SSTableWriter:
         # the off deltas and val_rel the value offset inside each frame
         # — half the bytes of the absolute i64 pair they replace, and
         # far more compressible (small near-constant integers)
+        t_ser = time.perf_counter()
         deltas = seg.off[1:] - seg.off[:-1]
         vrel64 = seg.val_start - seg.off[:-1]
         if len(deltas) and (int(deltas.max()) >= 1 << 32
@@ -515,15 +624,21 @@ class SSTableWriter:
             lanes_b = lanes_c
             blocks = [meta, lanes_b, payload_b]
             need = sum(b.nbytes for b in blocks)
-            if self._pack_out is None or self._pack_out.nbytes < need:
-                self._pack_out = np.empty(need, dtype=np.uint8)
+            if self._threaded_io:
+                out = self._take_pack_buf(need)
+            else:
+                if self._pack_out is None or self._pack_out.nbytes < need:
+                    self._pack_out = np.empty(need, dtype=np.uint8)
+                out = self._pack_out
             total, sizes, raws, crcs = self._packer.pack(
                 blocks, attempt, maxlen, shuffle_block=1,
-                lane_width=seg.n_lanes, out=self._pack_out)
+                lane_width=seg.n_lanes, out=out)
             for i in range(3):
                 entry += account(i, int(sizes[i]), blocks[i].nbytes,
                                  int(crcs[i]), attempt[i])
-            self._write_all(memoryview(self._pack_out)[:total])
+            self._acct("compress", time.perf_counter() - t_ser)
+            self._write_all(memoryview(out)[:total],
+                            reclaim=out if self._threaded_io else None)
             self._data_off += total
         else:
             # per-block fallback (encrypted tables / codecs without a
@@ -534,6 +649,7 @@ class SSTableWriter:
             blocks = [meta, lanes_b, payload_b]
             tried = [b for b, a in zip(blocks, attempt) if a]
             dst, dst_offs, sizes = self.compressor.compress_iov(tried)
+            self._acct("compress", time.perf_counter() - t_ser)
             # min_compress_ratio fallback: store uncompressed when too
             # poor (CompressedSequentialWriter.java:160-175 semantics)
             ti = 0

@@ -96,12 +96,40 @@ def build_engine_virtuals(engine) -> VirtualSchema:
                 i += 1
     vs.register(VirtualTable(t_ch, history_rows))
 
+    # --- compactions_in_progress (db/virtual/SSTableTasksTable +
+    # ActiveCompactions): live per-task progress while compactor slots
+    # run — phase, bytes read/written, % done, ETA
+    t_cip = make_table(
+        "system_views", "compactions_in_progress", pk=["id"],
+        cols={"id": "int", "keyspace_name": "text", "table_name": "text",
+              "kind": "text", "phase": "text", "bytes_total": "bigint",
+              "bytes_read": "bigint", "bytes_written": "bigint",
+              "progress_pct": "double", "active_seconds": "double",
+              "eta_seconds": "double"})
+
+    def cip_rows():
+        for s in engine.compactions.active.snapshot():
+            yield {"id": s["id"], "keyspace_name": s["keyspace"],
+                   "table_name": s["table"], "kind": s["kind"],
+                   "phase": s["phase"], "bytes_total": s["total_bytes"],
+                   "bytes_read": s["bytes_read"],
+                   "bytes_written": s["bytes_written"],
+                   "progress_pct": s["progress_pct"],
+                   "active_seconds": s["active_seconds"],
+                   "eta_seconds": (-1.0 if s["eta_seconds"] is None
+                                   else s["eta_seconds"])}
+    vs.register(VirtualTable(t_cip, cip_rows))
+
     t_metrics = make_table("system_views", "metrics", pk=["name"],
                            cols={"name": "text", "value": "double"})
 
     def metric_rows():
         from ..service.metrics import GLOBAL
         for k, v in sorted(GLOBAL.snapshot().items()):
+            yield {"name": k, "value": float(v)}
+        # engine-scoped compaction gauges (process-global registration
+        # would cross-report between in-process nodes)
+        for k, v in sorted(engine.compactions.gauges().items()):
             yield {"name": k, "value": float(v)}
         for cfs in engine.stores.values():
             base = f"table.{cfs.table.keyspace}.{cfs.table.name}"

@@ -66,11 +66,20 @@ def compact(engine, keyspace: str | None = None,
     return out
 
 
-def compactionstats(engine) -> list[dict]:
-    out = []
-    for cfs in engine.stores.values():
-        out.extend(cfs.compaction_history)
-    return out
+def compactionstats(engine) -> dict:
+    """nodetool compactionstats: pending count + per-task live progress
+    (ActiveCompactions / CompactionManager.getMetrics in the reference;
+    history moved to `compactionhistory`)."""
+    cm = engine.compactions
+    ex = cm.executor.stats()
+    return {
+        "pending_tasks": cm.pending_tasks(),
+        "active_tasks": ex["active"],
+        "concurrent_compactors": ex["concurrent"],
+        "throughput_mib_per_sec": cm.limiter.mib_per_s,
+        "completed_tasks": len(cm.completed),
+        "active_compactions": cm.active.snapshot(),
+    }
 
 
 def tablestats(engine, keyspace: str | None = None) -> dict:
@@ -235,10 +244,13 @@ def describecluster(node) -> dict:
 
 
 def setcompactionthroughput(engine, mib_s: int) -> dict:
-    """nodetool setcompactionthroughput (0 = unthrottled). Routed through
+    """nodetool setcompactionthroughput (0 = unthrottled). Sets BOTH
+    knob spellings so the modern name's precedence can never shadow an
+    operator command. Routed through
     the mutable settings surface so the settings vtable, listeners and
     the limiter stay consistent."""
     engine.settings.set("compaction_throughput", float(mib_s))
+    engine.settings.set("compaction_throughput_mib_per_sec", float(mib_s))
     return {"compaction_throughput_mib": mib_s}
 
 
@@ -436,10 +448,13 @@ def netstats(node) -> dict:
 def tpstats(engine) -> list[dict]:
     """nodetool tpstats (thread_pools vtable data)."""
     cm = engine.compactions
+    ex = cm.executor.stats()
     return [{"pool": "CompactionExecutor",
-             "active": 1 if cm.auto and cm._worker
-             and cm._worker.is_alive() else 0,
-             "pending": cm._queue.qsize(), "completed": len(cm.completed)},
+             "active": ex["active"],
+             "pending": cm.pending_tasks(),
+             # compactions actually executed (agrees with
+             # compactionstats.completed_tasks), not executor callables
+             "completed": len(cm.completed)},
             {"pool": "MemtableFlushWriter", "active": 0, "pending": 0,
              "completed": sum(cfs.metrics.get("flushes", 0)
                               for cfs in engine.stores.values())}]
@@ -514,6 +529,11 @@ def getconcurrentcompactors(engine) -> dict:
 
 
 def setconcurrentcompactors(engine, n: int) -> dict:
+    """nodetool setconcurrentcompactors: validated here so the settings
+    surface can never report a value the executor silently clamps
+    (DatabaseDescriptor.setConcurrentCompactors rejects < 1 too)."""
+    if int(n) < 1:
+        raise ValueError(f"concurrent_compactors must be >= 1, got {n}")
     engine.settings.set("concurrent_compactors", int(n))
     return getconcurrentcompactors(engine)
 
@@ -1276,14 +1296,18 @@ def setcompactionthreshold(engine, keyspace: str, table: str,
 
 
 def stop(engine, compaction_type: str | None = None) -> dict:
-    """nodetool stop: abort in-flight compactions cooperatively — each
-    task polls the abort event between rounds and rolls back through
-    its lifecycle transaction (tools/nodetool/Stop.java)."""
-    import time as _t
-    engine.compactions.abort_event.set()
-    _t.sleep(0.1)       # let pollers observe it
-    engine.compactions.abort_event.clear()
-    return {"stopped": True}
+    """nodetool stop: abort in-flight compactions cooperatively — the
+    stop request lands on each active task's OWN progress handle, so it
+    covers exactly the tasks running NOW (a task starting a moment
+    later is unaffected — the reference's semantics) and a task that
+    has not polled yet still sees it; every signalled task rolls back
+    through its lifecycle transaction (tools/nodetool/Stop.java,
+    CompactionInfo.Holder.stop). The shared cfs.compaction_abort event
+    remains a programmatic kill switch for tasks driven outside the
+    manager; it is deliberately NOT pulsed here — a timed pulse would
+    spuriously abort tasks that start inside the window."""
+    n = engine.compactions.stop_active()
+    return {"stopped": True, "signalled": n}
 
 
 def stopdaemon(node) -> dict:

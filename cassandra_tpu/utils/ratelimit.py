@@ -1,0 +1,78 @@
+"""Token-bucket rate limiter for background I/O throttling.
+
+Reference counterpart: the Guava RateLimiter used by
+CompactionManager.getRateLimiter (db/compaction/CompactionManager.java)
+fed from `compaction_throughput` (conf/cassandra.yaml:1243), and the
+equivalent stream throttle in streaming/StreamManager.java.
+
+One bucket per consumer group (compaction, streaming): tokens are BYTES,
+refilled continuously at the configured rate; `acquire(n)` debits n
+tokens, sleeping when the bucket runs dry. A burst allowance of one
+second's worth of tokens lets short bursts through without jitter while
+holding the long-run average at the configured rate. Rate 0 (or
+negative) disarms the limiter entirely — acquire becomes free.
+
+The clock and sleep functions are injectable so token accounting is
+testable without real sleeps (and so a simulated deployment could drive
+it on virtual time).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+
+class RateLimiter:
+    """Thread-safe token-bucket limiter in MiB/s (0 = unthrottled)."""
+
+    def __init__(self, mib_per_s: float = 0.0, clock=time.monotonic,
+                 sleep=time.sleep):
+        self._clock = clock
+        self._sleep = sleep
+        self.rate = max(mib_per_s, 0.0) * 2**20   # bytes/s
+        self._allowance = self.rate               # burst: 1s of tokens
+        self._last = clock()
+        self._lock = threading.Lock()
+        # cumulative accounting (compactionstats / metrics)
+        self.bytes_acquired = 0
+        self.seconds_throttled = 0.0
+
+    @property
+    def mib_per_s(self) -> float:
+        return self.rate / 2**20
+
+    def set_rate(self, mib_per_s: float) -> None:
+        """Hot-reload (nodetool setcompactionthroughput /
+        DatabaseDescriptor.setCompactionThroughputMebibytesPerSec)."""
+        with self._lock:
+            self.rate = max(mib_per_s, 0.0) * 2**20
+            self._allowance = min(self._allowance, self.rate)
+            self._last = self._clock()
+
+    def acquire(self, nbytes: int) -> float:
+        """Debit nbytes tokens, sleeping until the bucket allows them.
+        Returns seconds slept (0.0 on the unthrottled fast path)."""
+        if self.rate <= 0:
+            return 0.0
+        with self._lock:
+            if self.rate <= 0:   # re-check: set_rate(0) may have raced
+                return 0.0
+            now = self._clock()
+            self._allowance = min(
+                self.rate, self._allowance + (now - self._last) * self.rate)
+            self._last = now
+            self.bytes_acquired += nbytes
+            # debit may drive the bucket NEGATIVE (debt, Guava-style):
+            # the debt is visible to every later acquirer, so concurrent
+            # compactors' waits stack arithmetically and the AGGREGATE
+            # rate holds even though the sleeps themselves overlap
+            self._allowance -= nbytes
+            wait = (-self._allowance / self.rate
+                    if self._allowance < 0 else 0.0)
+            if wait > 0.0:
+                self.seconds_throttled += wait
+        # sleep OUTSIDE the lock: a throttled task must not block other
+        # compactors' token accounting
+        if wait > 0.0:
+            self._sleep(wait)
+        return wait

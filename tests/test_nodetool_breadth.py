@@ -131,7 +131,8 @@ def test_backup_and_compaction_commands(cluster):
               table="t", min_threshold=3, max_threshold=16)
     assert thr == {"min_threshold": 3, "max_threshold": 16}
     assert run(cluster, "forcecompact", keyspace="ks", table="t")
-    assert run(cluster, "stop") == {"stopped": True}
+    st = run(cluster, "stop")
+    assert st["stopped"] is True and st["signalled"] == 0  # none in flight
 
 
 def test_schema_and_cache_commands(cluster):

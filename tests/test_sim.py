@@ -89,6 +89,71 @@ def test_cms_heal_race_invariants_across_seeds(tmp_path, seed):
         f"seed {seed}: client-acked DDL missing from the log"
 
 
+def _executor_harry_state(tmp_path, seed, tag):
+    """A seeded harry op stream where flush-triggered compactions run
+    through the engine's CompactionManager -> CompactionExecutor in
+    SYNCHRONOUS inline mode (run_pending). Returns a fingerprint of the
+    quiescent storage state: per-sstable (cells, digest) plus row count.
+    """
+    import os
+
+    from cassandra_tpu.tools.harry import OpGenerator
+
+    with simulated(seed) as sched:
+        c = SimCluster(sched, str(tmp_path / tag), n=3)
+        try:
+            s = c.session(1)
+            node = c.node(1)
+            s.execute("CREATE KEYSPACE ex WITH replication = "
+                      "{'class': 'SimpleStrategy', "
+                      "'replication_factor': 3}")
+            s.execute("USE ex")
+            s.execute("CREATE TABLE t (k int, c int, v text, w int, "
+                      "st text static, m map<text,int>, "
+                      "PRIMARY KEY (k, c))")
+            sched.run(1.0)
+            gen = OpGenerator(seed)
+            eng = node.engine
+            cfs = eng.store("ex", "t")
+            for op in gen:
+                if op.index >= 250:
+                    break
+                if op.kind == "advance":
+                    sched.run(op.seconds)
+                elif op.kind == "flush":
+                    cfs.flush()
+                elif op.kind == "compact":
+                    # the executor's synchronous mode: deterministic,
+                    # runs on this (pumping) thread
+                    eng.compactions.run_pending()
+                else:
+                    s.execute(op.cql("t"))
+            cfs.flush()
+            eng.compactions.major_compaction(cfs)
+            state = []
+            for sst in sorted(cfs.live_sstables(),
+                              key=lambda r: r.n_cells):
+                with open(sst.desc.path("Digest.crc32")) as f:
+                    state.append((sst.n_cells, f.read().strip()))
+            nrows = len(cfs.scan_all())
+            assert eng.compactions.compacting_generations(cfs) == set()
+            return state, nrows
+        finally:
+            c.shutdown()
+
+
+def test_executor_sync_mode_keeps_sim_deterministic(tmp_path):
+    """Same seed, same harry stream, compactions routed through the
+    CompactionExecutor's synchronous mode: the resulting storage state
+    (sstable digests + logical rows) must be IDENTICAL across runs —
+    the property that keeps executor-era compaction simulable."""
+    s1, n1 = _executor_harry_state(tmp_path, 31337, "a")
+    s2, n2 = _executor_harry_state(tmp_path, 31337, "b")
+    assert s1 == s2
+    assert n1 == n2
+    assert s1, "no sstables produced — scenario under-exercised storage"
+
+
 def test_harry_stream_under_simulation(tmp_path):
     """A seeded harry op stream against a simulated 3-node cluster with
     periodic MUTATION drops: hints replay on virtual time, and the
