@@ -12,11 +12,17 @@ from __future__ import annotations
 import threading
 import time
 
+from ..service import tracing
+from ..service.metrics import GLOBAL as METRICS
 from ..storage import cellbatch as cb
 from ..storage.mutation import Mutation
 from .messaging import MessagingService, Verb
 from .replication import ConsistencyLevel, ReplicationStrategy
 from .ring import Endpoint, Ring
+
+# per-verb coordinator latency group (ClientRequestMetrics role):
+# request.read / request.write / request.range decaying histograms
+REQUEST = METRICS.group("request")
 
 
 class UnavailableException(Exception):
@@ -159,6 +165,11 @@ class StorageProxy:
 
     def mutate(self, keyspace: str, mutation: Mutation,
                cl: str = ConsistencyLevel.ONE) -> None:
+        with REQUEST.timer("write"):
+            self._mutate(keyspace, mutation, cl)
+
+    def _mutate(self, keyspace: str, mutation: Mutation,
+                cl: str = ConsistencyLevel.ONE) -> None:
         replicas, strat, token = self._plan(keyspace, mutation.pk)
         block_for = ConsistencyLevel.block_for(cl, strat,
                                                self.node.endpoint.dc)
@@ -250,6 +261,12 @@ class StorageProxy:
         limits until the merged live-row count reaches the target or no
         replica was truncated
         (service/reads/ShortReadPartitionsProtection.java:40)."""
+        with REQUEST.timer("read"):
+            return self._read_partition(keyspace, table_name, pk, cl,
+                                        limits)
+
+    def _read_partition(self, keyspace, table_name, pk, cl,
+                        limits=None) -> cb.CellBatch:
         if cl == ConsistencyLevel.EACH_QUORUM:
             raise ValueError(
                 "EACH_QUORUM ConsistencyLevel is only supported for writes")
@@ -320,6 +337,7 @@ class StorageProxy:
             {d for _, d in digests}
         if len(want) > 1:
             # digest mismatch: full-data second round from every target
+            tracing.trace("Digest mismatch: full data round + read repair")
             results, _ = self._fetch(keyspace, table_name, pk, targets,
                                      [], limits=limits)
             if len(results) < block_for:
@@ -392,6 +410,7 @@ class StorageProxy:
         if not done and spares:
             from ..service.metrics import GLOBAL
             GLOBAL.incr("reads.speculative_retries")
+            tracing.trace(f"Speculative retry to {spares[0].name}")
             # a redundant data read: its full payload can substitute for
             # a straggling digest (ack tallies are read-resolver inputs)
             send_to(spares[0], False)
@@ -410,6 +429,7 @@ class StorageProxy:
         for ep, batch in results:
             if self._digest(batch) == want:
                 continue
+            tracing.trace(f"Read repair: pushing merged row to {ep.name}")
             m = batch_to_mutation(t, merged)
             if m is None:
                 continue
@@ -519,6 +539,12 @@ class StorageProxy:
         vouches only for rows up to its last shipped row, so the arc's
         merged result is cut at the earliest frontier and re-queried
         doubled on shortfall."""
+        with REQUEST.timer("range"):
+            return self._scan_window(keyspace, table_name, lo, hi, cl,
+                                     limits)
+
+    def _scan_window(self, keyspace, table_name, lo, hi, cl,
+                     limits=None) -> cb.CellBatch:
         if cl == ConsistencyLevel.EACH_QUORUM:
             raise ValueError(
                 "EACH_QUORUM ConsistencyLevel is only supported for writes")

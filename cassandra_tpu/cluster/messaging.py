@@ -18,7 +18,14 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from ..service import tracing
+from ..service.metrics import GLOBAL as METRICS
 from .ring import Endpoint
+
+
+# metric-name cache for the per-verb received counters (one entry per
+# verb string, built lazily)
+_VERB_RECEIVED: dict = {}
 
 
 class Verb:
@@ -61,6 +68,11 @@ class Message:
     to: Endpoint
     id: int = 0
     reply_to: int = 0
+    # distributed tracing headers (tracing/Tracing.java message params):
+    # requests carry the coordinator's session id; responses echo it back
+    # along with the replica-side (elapsed_us, source, activity) events
+    trace_session: str | None = None
+    trace_events: list | None = None
 
 
 class MessageFilters:
@@ -164,6 +176,10 @@ class MessagingService:
 
     def send_one_way(self, verb: str, payload, to: Endpoint) -> None:
         msg = Message(verb, payload, self.ep, to, next(self._ids))
+        st = tracing.active()
+        if st is not None:
+            msg.trace_session = st.session_id
+            st.add(f"Sending {verb} to {to.name}")
         self.metrics["sent"] += 1
         self.transport.deliver(msg)
 
@@ -171,6 +187,22 @@ class MessagingService:
                            on_response, on_failure=None,
                            timeout: float = 5.0) -> int:
         msg = Message(verb, payload, self.ep, to, next(self._ids))
+        st = tracing.active()
+        if st is not None:
+            # tracing header: the session id rides the message; the
+            # failure wrapper records by id because expirations fire on
+            # the reaper thread, outside this contextvar
+            msg.trace_session = st.session_id
+            st.add(f"Sending {verb} to {to.name}")
+            sid, orig_fail = st.session_id, on_failure
+
+            def on_failure(arg, _of=orig_fail, _sid=sid, _to=to, _v=verb):
+                tracing.record(
+                    _sid, f"Failure/timeout waiting for {_v} "
+                          f"response from {_to.name}",
+                    source=self.ep.name)
+                if _of is not None:
+                    _of(arg)
         with self._cb_lock:
             self._callbacks[msg.id] = (on_response, on_failure,
                                        time.monotonic() + timeout)
@@ -181,16 +213,21 @@ class MessagingService:
         self.transport.deliver(msg)
         return msg.id
 
-    def respond(self, original: Message, verb: str, payload) -> None:
+    def respond(self, original: Message, verb: str, payload,
+                trace_events: list | None = None) -> None:
         msg = Message(verb, payload, self.ep, original.sender,
-                      next(self._ids), reply_to=original.id)
+                      next(self._ids), reply_to=original.id,
+                      trace_session=original.trace_session,
+                      trace_events=trace_events)
         self.transport.deliver(msg)
 
-    def respond_failure(self, original: Message, exc: Exception) -> None:
+    def respond_failure(self, original: Message, exc: Exception,
+                        trace_events: list | None = None) -> None:
         """The one definition of the FAILURE_RSP wire shape; classify
         remote errors with failure_kind(), never by parsing repr text."""
         self.respond(original, Verb.FAILURE_RSP,
-                     {"kind": type(exc).__name__, "error": repr(exc)})
+                     {"kind": type(exc).__name__, "error": repr(exc)},
+                     trace_events=trace_events)
 
     @staticmethod
     def failure_kind(payload) -> str | None:
@@ -216,7 +253,21 @@ class MessagingService:
         verb-handler execution (the _run loop body; the deterministic
         simulator calls this directly as a scheduled event)."""
         self.metrics["received"] += 1
+        # per-verb group (InternodeInboundTable / per-verb Dropwizard
+        # meters): verb.<verb>.received counters in the global registry;
+        # names cached per verb so the hot path skips the f-string build
+        name = _VERB_RECEIVED.get(msg.verb)
+        if name is None:
+            name = _VERB_RECEIVED[msg.verb] = \
+                f"verb.{msg.verb.lower()}.received"
+        METRICS.incr(name)
         if msg.reply_to:
+            if msg.trace_session and msg.trace_events:
+                # replica events merge BEFORE the callback acks — the
+                # waiting coordinator may finish (and persist) the
+                # session the instant the callback fires
+                tracing.record_remote(msg.trace_session, msg.trace_events,
+                                      source=msg.sender.name)
             with self._cb_lock:
                 cb = self._callbacks.pop(msg.reply_to, None)
             if cb is not None:
@@ -238,14 +289,32 @@ class MessagingService:
         handler = self.handlers.get(msg.verb)
         if handler is None:
             return
+        rst = token = None
+        if msg.trace_session:
+            # replica-side session: record handler events under the
+            # propagated id; they ship back on the response and merge
+            # into the coordinator's timeline
+            rst = tracing.TraceState(session_id=msg.trace_session,
+                                     source=self.ep.name)
+            rst.add(f"{msg.verb} received from {msg.sender.name}")
+            token = tracing.activate(rst)
         try:
             result = handler(msg)
         except Exception as e:
-            self.respond_failure(msg, e)
+            if rst is not None:
+                rst.add(f"{msg.verb} failed: {type(e).__name__}")
+            self.respond_failure(msg, e,
+                                 trace_events=rst.events if rst else None)
             return
+        finally:
+            if token is not None:
+                tracing.deactivate(token)
         if result is not None:
             rsp_verb, payload = result
-            self.respond(msg, rsp_verb, payload)
+            if rst is not None:
+                rst.add(f"Enqueuing {rsp_verb} to {msg.sender.name}")
+            self.respond(msg, rsp_verb, payload,
+                         trace_events=rst.events if rst else None)
 
     def _reap(self) -> None:
         """Expire callbacks whose responses never arrived."""

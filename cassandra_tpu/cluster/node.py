@@ -118,6 +118,18 @@ class Node:
     def fql_log(self):
         return self.engine.fql_log
 
+    @property
+    def settings(self):
+        """Node-backed sessions read runtime settings (trace sampling,
+        thresholds) off their backend like engine-backed ones."""
+        return self.engine.settings
+
+    @property
+    def trace_store(self):
+        """Coordinator-side trace sessions persist on this node's own
+        engine store (system_traces role)."""
+        return self.engine.trace_store
+
     # ------------------------------------------------------------- verbs --
 
     def _register_verbs(self):

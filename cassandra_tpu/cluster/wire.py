@@ -9,7 +9,9 @@ network input is untrusted, and pickle is an RCE surface
 (the reference's serializers are likewise explicit per-type codecs).
 
 Frame layout (tcp.py): [u32 length][u32 crc32(body)][body]
-Body: encoded tuple (id, reply_to, verb, sender, to, payload).
+Body: encoded tuple (id, reply_to, verb, sender, to, payload,
+trace_session, trace_events) — the trailing tracing headers are None
+when the request is untraced; decoders tolerate legacy 6-tuples.
 """
 from __future__ import annotations
 
@@ -199,12 +201,18 @@ def _dec(buf: bytes, pos: int, depth: int = 0):
 
 def encode_message(msg) -> bytes:
     out = bytearray()
-    _enc((msg.id, msg.reply_to, msg.verb, msg.sender, msg.to, msg.payload),
-         out)
+    _enc((msg.id, msg.reply_to, msg.verb, msg.sender, msg.to, msg.payload,
+          msg.trace_session, msg.trace_events), out)
     return bytes(out)
 
 
 def decode_message(buf: bytes):
     from .messaging import Message
-    (mid, reply_to, verb, sender, to, payload), _ = _dec(buf, 0)
-    return Message(verb, payload, sender, to, mid, reply_to)
+    fields, _ = _dec(buf, 0)
+    # 6-tuple frames predate the tracing headers; tolerate both
+    mid, reply_to, verb, sender, to, payload = fields[:6]
+    trace_session = fields[6] if len(fields) > 6 else None
+    trace_events = fields[7] if len(fields) > 7 else None
+    return Message(verb, payload, sender, to, mid, reply_to,
+                   trace_session=trace_session,
+                   trace_events=trace_events)

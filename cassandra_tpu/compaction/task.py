@@ -471,6 +471,12 @@ class CompactionTask:
             raise
 
         dt = time.time() - t0
+        if prof:
+            # per-phase wall seconds aggregate process-wide: the
+            # system_views.device_profile vtable and bench.py's
+            # kernel_profile section read them alongside kernel stats
+            from ..service.profiling import GLOBAL as kprof
+            kprof.add_phases(prof)
         bytes_written = sum(r.data_size for r in new_readers)
         stats = {
             "inputs": len(self.inputs),

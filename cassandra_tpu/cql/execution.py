@@ -1738,7 +1738,8 @@ class Executor:
         # virtual tables (db/virtual role) intercept before real schema
         vts = getattr(self.backend, "virtual_tables", None)
         vks = s.keyspace or keyspace
-        if vts is not None and vks in ("system", "system_views"):
+        if vts is not None and vks in ("system", "system_views",
+                                       "system_traces"):
             vt = vts.get(vks, s.table)
             if vt is not None:
                 rows = vt.rows()

@@ -123,8 +123,12 @@ class QueryProcessor:
         finally:
             mon = getattr(self.executor.backend, "monitor", None)
             if mon is not None:
+                from ..service import tracing
+                # a slow statement that was traced links to its timeline
+                # (system_views.slow_queries.trace_session)
                 mon.record(query, time_mod.perf_counter() - t0,
-                           keyspace)
+                           keyspace,
+                           trace_session=tracing.current_id())
 
 
 class Session:
@@ -147,24 +151,41 @@ class Session:
                 paging_state: bytes | None = None) -> ResultSet:
         """fetch_size pages large scans: the ResultSet carries at most
         fetch_size rows plus .paging_state to pass back for the next page
-        (driver-style paging)."""
+        (driver-style paging).
+
+        Tracing: trace=True opens an explicit session (cqlsh TRACING ON)
+        and attaches it to the result. Otherwise the backend's mutable
+        `trace_probability` setting (nodetool settraceprobability) is
+        consulted: sampled statements trace in the background, landing in
+        the backend's TraceStore only — the result set stays untouched.
+        Either way the session persists to the store even when the
+        statement RAISES (a timed-out read still renders its timeline)."""
+        from ..service import tracing
+        backend = self.processor.executor.backend
+        st = None
         if trace:
-            from ..service import tracing
-            st = tracing.begin()
+            st = tracing.begin(request=query[:200])
             tracing.trace(f"Parsing {query[:60]}")
-            try:
-                rs = self.processor.process(query, params, self.keyspace,
-                                            user=self.user,
-                                            page_size=fetch_size,
-                                            paging_state=paging_state)
-            finally:
-                tracing.end()
-            rs.trace = st
         else:
+            settings = getattr(backend, "settings", None)
+            if settings is not None and tracing.should_sample(
+                    settings.get("trace_probability")):
+                st = tracing.begin(request=query[:200])
+                tracing.trace(
+                    f"Sampled by trace_probability: {query[:60]}")
+        try:
             rs = self.processor.process(query, params, self.keyspace,
                                         user=self.user,
                                         page_size=fetch_size,
                                         paging_state=paging_state)
+        finally:
+            if st is not None:
+                tracing.end()
+                store = getattr(backend, "trace_store", None)
+                if store is not None:
+                    store.save(st)
+        if trace:
+            rs.trace = st
         if hasattr(rs, "keyspace"):
             self.keyspace = rs.keyspace
         return rs

@@ -734,7 +734,7 @@ class DeviceMergeHandle:
     from the kernel writeback cache (CompactionTask.java:207 hot loop)."""
 
     __slots__ = ("mode", "result", "cat", "n", "fut", "meta", "cfg",
-                 "gc_before", "now", "purgeable_ts_fn", "prof")
+                 "gc_before", "now", "purgeable_ts_fn", "prof", "kernel")
 
 
 def submit_merge(batches: list[CellBatch], gc_before: int = 0,
@@ -764,12 +764,20 @@ def submit_merge(batches: list[CellBatch], gc_before: int = 0,
         h.result = cb_merge_fallback(batches, gc_before, now,
                                      purgeable_ts_fn)
         return h
+    from ..service.profiling import GLOBAL as _kprof
     fast = _plane_pack_fast(cat, batches)
     if fast is not None:
         buf, cfg, meta = fast
         t2 = _time.perf_counter()
         h.fut = _plane_program_fast(jax.device_put(buf), cfg)
+        # jit compiles synchronously inside the dispatch call: the first
+        # call per (kernel, padded-shape, cfg) IS the compile — the
+        # profiler splits compile vs warm dispatch on exactly that key
+        _kprof.record_dispatch("merge.plane_fast",
+                               (int(buf.shape[0]), cfg),
+                               _time.perf_counter() - t2)
         h.mode, h.meta, h.cfg = "fast", meta, cfg
+        h.kernel = "merge.plane_fast"
         if prof is not None:
             prof["pack"] = prof.get("pack", 0.0) + (t2 - t1)
         return h
@@ -790,7 +798,11 @@ def submit_merge(batches: list[CellBatch], gc_before: int = 0,
     t2 = _time.perf_counter()
     planes_d = {k: jax.device_put(v) for k, v in planes.items()}
     h.fut = _plane_program(planes_d, cfg)
+    _kprof.record_dispatch("merge.plane_v2",
+                           (int(planes["rank"].shape[0]), cfg),
+                           _time.perf_counter() - t2)
     h.mode, h.cfg = "v2", cfg
+    h.kernel = "merge.plane_v2"
     if prof is not None:
         prof["pack"] = prof.get("pack", 0.0) + (t2 - t1)
     return h
@@ -814,6 +826,8 @@ def collect_merge(h: DeviceMergeHandle) -> CellBatch:
     t1 = _time.perf_counter()
     combined = np.asarray(h.fut)
     t2 = _time.perf_counter()
+    from ..service.profiling import GLOBAL as _kprof
+    _kprof.record_execute(h.kernel, t2 - t1)
 
     if h.mode == "fast":
         bits = combined[:n]

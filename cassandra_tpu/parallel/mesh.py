@@ -159,8 +159,19 @@ def _run_sharded(cat: CellBatch, mesh: Mesh, gc_before: int, now: int):
                                                    gc_before, now)
     step = sharded_merge_step(mesh)
     jop = {k: jnp.asarray(v) for k, v in operands.items()}
+    import time as _time
+
+    from ..service.profiling import GLOBAL as _kprof
+    t0 = _time.perf_counter()
     perm, packed, stats = step(jop)
+    _kprof.record_dispatch(
+        "merge.sharded_step",
+        (mesh.devices.size, tuple(jop["lanes"].shape)),
+        _time.perf_counter() - t0)
+    t0 = _time.perf_counter()
     perm = np.asarray(perm)
+    _kprof.record_execute("merge.sharded_step",
+                          _time.perf_counter() - t0)
     keep, amb, expired, shadowed = unpack_masks(np.asarray(packed))
     # equal-(identity, ts) winners need the exact death/value rules — per
     # shard, map sorted positions back into cat and resolve on host.

@@ -23,7 +23,8 @@ class QueryMonitor:
         self._ids = 0
 
     def record(self, query: str, seconds: float,
-               keyspace: str | None = None) -> None:
+               keyspace: str | None = None,
+               trace_session: str | None = None) -> None:
         ms = seconds * 1000.0
         if ms < self.threshold_ms:
             return
@@ -37,6 +38,9 @@ class QueryMonitor:
                 "keyspace": keyspace,
                 "duration_ms": round(ms, 3),
                 "at": timeutil.now_micros() // 1000,
+                # set when the slow statement ran traced/sampled — links
+                # the entry to its system_traces timeline
+                "trace_session": trace_session,
             })
 
     def entries(self) -> list[dict]:

@@ -1,0 +1,90 @@
+"""Device-kernel and compaction-phase profiling.
+
+The JAX merge/reconcile kernels (ops/merge.py) were a black box: a
+first call on a new operand shape pays XLA compilation (seconds to
+minutes for big sorts), warm calls pay dispatch + device execution, and
+nothing recorded which was which. This module is the accounting layer:
+
+  record_dispatch(kernel, shape_key, s)
+      timed around the jitted call itself. jit compiles synchronously
+      inside the call, so the FIRST dispatch for a (kernel, shape_key)
+      pair is the compile: it is recorded under compile_s/compiles and
+      excluded from the warm dispatch_s average. Every later dispatch of
+      the same shape is warm. `compiles` is therefore exactly the
+      recompile count by operand shape — a workload churning shape
+      buckets shows up as a climbing compile counter.
+  record_execute(kernel, s)
+      timed around blocking on the result (device wait).
+  add_phases({phase: seconds})
+      folds a CompactionTask.profile (io_decode / merge / pack / device /
+      gather / compress / io_write / seal) into the process aggregate.
+
+Surfaces: snapshot() feeds the system_views.device_profile virtual
+table and the `kernel_profile` section of bench.py output.
+
+Process-global (like the device itself); engine-scoped consumers read
+through the vtable which serves this singleton — acceptable because the
+accelerator is shared by every in-process node anyway.
+"""
+from __future__ import annotations
+
+import threading
+
+
+class KernelProfiler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._kernels: dict[str, dict] = {}
+        self._phases: dict[str, float] = {}
+
+    def _kernel_locked(self, name: str) -> dict:
+        k = self._kernels.get(name)
+        if k is None:
+            k = self._kernels[name] = {
+                "calls": 0, "compiles": 0, "compile_s": 0.0,
+                "dispatch_s": 0.0, "execute_s": 0.0, "shapes": set()}
+        return k
+
+    def record_dispatch(self, kernel: str, shape_key, seconds: float) -> None:
+        with self._lock:
+            k = self._kernel_locked(kernel)
+            k["calls"] += 1
+            if shape_key not in k["shapes"]:
+                k["shapes"].add(shape_key)
+                k["compiles"] += 1
+                k["compile_s"] += seconds
+            else:
+                k["dispatch_s"] += seconds
+
+    def record_execute(self, kernel: str, seconds: float) -> None:
+        with self._lock:
+            k = self._kernel_locked(kernel)
+            k["execute_s"] += seconds
+
+    def add_phases(self, profile: dict) -> None:
+        with self._lock:
+            for phase, seconds in profile.items():
+                self._phases[phase] = self._phases.get(phase, 0.0) \
+                    + float(seconds)
+
+    def snapshot(self) -> dict:
+        """{"kernels": {name: {calls, compiles, shapes, compile_s,
+        dispatch_s, execute_s}}, "phases": {name: seconds}}."""
+        with self._lock:
+            kernels = {
+                name: {"calls": k["calls"], "compiles": k["compiles"],
+                       "shapes": len(k["shapes"]),
+                       "compile_s": round(k["compile_s"], 6),
+                       "dispatch_s": round(k["dispatch_s"], 6),
+                       "execute_s": round(k["execute_s"], 6)}
+                for name, k in self._kernels.items()}
+            phases = {p: round(s, 6) for p, s in self._phases.items()}
+        return {"kernels": kernels, "phases": phases}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._kernels.clear()
+            self._phases.clear()
+
+
+GLOBAL = KernelProfiler()

@@ -144,6 +144,10 @@ class StorageEngine:
             self.settings.config.guardrails)
         from ..service.monitoring import QueryMonitor
         self.monitor = QueryMonitor()
+        # completed request traces (system_traces role): explicit
+        # TRACING ON sessions and trace_probability-sampled ones
+        from ..service.tracing import TraceStore
+        self.trace_store = TraceStore()
 
     @property
     def _schema_path(self) -> str:
@@ -250,7 +254,9 @@ class StorageEngine:
             # consumer never sees must not exist (CommitLogSegmentManagerCDC
             # ordering); a full cdc_raw FAILS the write like the reference
             self.cdc.append(mutation)
-        cfs.apply(mutation, self.commitlog, durable)
+        from ..service.metrics import Timer
+        with Timer(cfs.write_hist):
+            cfs.apply(mutation, self.commitlog, durable)
         if cfs.should_flush():
             cfs.flush()
 

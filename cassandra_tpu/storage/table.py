@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 import numpy as np
 
@@ -129,6 +130,16 @@ class ColumnFamilyStore:
         self._switch_lock = threading.RLock()
         self.metrics = {"writes": 0, "reads": 0, "flushes": 0,
                         "bytes_flushed": 0}
+        # per-table latency group (TableMetrics role): decaying
+        # read/write latency hists under table.<ks>.<name>.* — counters
+        # stay in the plain dict above (the metrics vtable merges both).
+        # Hists are resolved ONCE: the hot paths touch only the per-hist
+        # lock, never the global registry lock.
+        from ..service.metrics import GLOBAL as _METRICS
+        self.latency = _METRICS.group(
+            f"table.{table.keyspace}.{table.name}")
+        self.read_hist = self.latency.hist("read_latency")
+        self.write_hist = self.latency.hist("write_latency")
         from .lifecycle import replay_directory
         replay_directory(self.directory)
         for desc in Descriptor.list_in(self.directory):
@@ -254,6 +265,7 @@ class ColumnFamilyStore:
         feeds the row cache); truncation spares downstream assembly and,
         replica-side, the wire."""
         self.metrics["reads"] += 1
+        _t0 = time.perf_counter()
         from ..service.tracing import active, trace
         now = now if now is not None else timeutil.now_seconds()
         read_gen = None
@@ -264,6 +276,8 @@ class ColumnFamilyStore:
                     trace("Row cache hit")
                 if limits is not None:
                     cached, _ = truncate_live_rows(cached, limits)
+                self.read_hist.update_us(
+                    (time.perf_counter() - _t0) * 1e6)
                 return cached
             # captured BEFORE the source snapshot (see RowCache.put)
             read_gen = self.row_cache.generation
@@ -288,6 +302,7 @@ class ColumnFamilyStore:
             self.row_cache.put(pk, merged, read_gen)
         if limits is not None:
             merged, _ = truncate_live_rows(merged, limits)
+        self.read_hist.update_us((time.perf_counter() - _t0) * 1e6)
         return merged
 
     def scan_all(self, now: int | None = None) -> CellBatch:

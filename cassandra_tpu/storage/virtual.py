@@ -140,15 +140,78 @@ def build_engine_virtuals(engine) -> VirtualSchema:
     t_slow = make_table("system_views", "slow_queries", pk=["id"],
                         cols={"id": "int", "query": "text",
                               "keyspace_name": "text",
-                              "duration_ms": "double", "at": "bigint"})
+                              "duration_ms": "double", "at": "bigint",
+                              "trace_session": "text"})
 
     def slow_rows():
         mon = getattr(engine, "monitor", None)
         for e in (mon.entries() if mon else []):
             yield {"id": e["id"], "query": e["query"],
                    "keyspace_name": e["keyspace"],
-                   "duration_ms": e["duration_ms"], "at": e["at"]}
+                   "duration_ms": e["duration_ms"], "at": e["at"],
+                   "trace_session": e.get("trace_session") or ""}
     vs.register(VirtualTable(t_slow, slow_rows))
+
+    # --- system_traces (tracing/TraceKeys role): completed sessions
+    # (explicit TRACING ON + trace_probability-sampled) and their merged
+    # coordinator+replica event timelines
+    t_tsess = make_table("system_traces", "sessions", pk=["session_id"],
+                         cols={"session_id": "text", "request": "text",
+                               "started_at": "bigint",
+                               "duration_us": "bigint",
+                               "events": "int"})
+
+    def tsess_rows():
+        store = getattr(engine, "trace_store", None)
+        for st in (store.sessions() if store else []):
+            yield {"session_id": st.session_id, "request": st.request,
+                   "started_at": int(st.started_at * 1000),
+                   "duration_us": st.duration_us,
+                   "events": len(st.events)}
+    vs.register(VirtualTable(t_tsess, tsess_rows))
+
+    t_tev = make_table("system_traces", "events", pk=["session_id"],
+                       ck=["event_id"],
+                       cols={"session_id": "text", "event_id": "int",
+                             "activity": "text", "source": "text",
+                             "source_elapsed": "bigint"})
+
+    def tev_rows():
+        store = getattr(engine, "trace_store", None)
+        for st in (store.sessions() if store else []):
+            for i, (us, src, activity) in enumerate(list(st.events)):
+                yield {"session_id": st.session_id, "event_id": i,
+                       "activity": activity, "source": src,
+                       "source_elapsed": int(us)}
+    vs.register(VirtualTable(t_tev, tev_rows))
+
+    # --- device_profile (the observability layer over ops/merge.py):
+    # per-kernel compile/dispatch/execute split + recompiles-by-shape,
+    # plus the aggregated compaction phase timings (compress/io_write/
+    # seal/...) — one table, `kind` distinguishes the two row families
+    t_dp = make_table("system_views", "device_profile", pk=["name"],
+                      cols={"name": "text", "kind": "text",
+                            "calls": "bigint", "compiles": "bigint",
+                            "shapes": "bigint",
+                            "compile_seconds": "double",
+                            "dispatch_seconds": "double",
+                            "execute_seconds": "double"})
+
+    def dp_rows():
+        from ..service.profiling import GLOBAL as kprof
+        snap = kprof.snapshot()
+        for name, k in sorted(snap["kernels"].items()):
+            yield {"name": name, "kind": "kernel", "calls": k["calls"],
+                   "compiles": k["compiles"], "shapes": k["shapes"],
+                   "compile_seconds": k["compile_s"],
+                   "dispatch_seconds": k["dispatch_s"],
+                   "execute_seconds": k["execute_s"]}
+        for phase, secs in sorted(snap["phases"].items()):
+            yield {"name": f"phase.{phase}", "kind": "phase",
+                   "calls": 0, "compiles": 0, "shapes": 0,
+                   "compile_seconds": 0.0, "dispatch_seconds": 0.0,
+                   "execute_seconds": secs}
+    vs.register(VirtualTable(t_dp, dp_rows))
 
     # --- settings (db/virtual/SettingsTable.java): the typed config,
     # live values, with mutability flag
@@ -350,15 +413,18 @@ def build_engine_virtuals(engine) -> VirtualSchema:
     # ClientRequestMetrics): served from the global latency histogram
     t_cqlm = make_table("system_views", "cql_metrics", pk=["name"],
                         cols={"name": "text", "p50_us": "double",
+                              "p95_us": "double",
                               "p99_us": "double", "max_us": "double",
                               "count": "bigint"})
 
     def cqlm_rows():
         from ..service.metrics import GLOBAL
-        h = GLOBAL.hist("cql.request")
-        yield {"name": "cql.request", "p50_us": h.percentile(0.5),
-               "p99_us": h.percentile(0.99), "max_us": h.percentile(1.0),
-               "count": h.count}
+        for name in ("cql.request", "request.read", "request.write",
+                     "request.range"):
+            s = GLOBAL.hist(name).summary()
+            yield {"name": name, "p50_us": s["p50_us"],
+                   "p95_us": s["p95_us"], "p99_us": s["p99_us"],
+                   "max_us": s["max_us"], "count": s["count"]}
     vs.register(VirtualTable(t_cqlm, cqlm_rows))
 
     return vs

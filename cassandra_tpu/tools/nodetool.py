@@ -7,7 +7,8 @@ calls; a remote admin protocol can wrap these functions); `python -m
 cassandra_tpu.tools.nodetool <cmd> --data <dir>` drives a local engine.
 
 Implemented commands: status, info, flush, compact, compactionstats,
-tablestats, repair, cleanup, gettraces? (tracing via session), ring.
+tablestats, repair, cleanup, gettraces, exportmetrics, ring, and the
+breadth registry below (~120 commands).
 """
 from __future__ import annotations
 
@@ -463,15 +464,15 @@ def tpstats(engine) -> list[dict]:
 def proxyhistograms(node) -> dict:
     """nodetool proxyhistograms: coordinator-side latency percentiles."""
     from ..service.metrics import GLOBAL
-    h = GLOBAL.hist("cql.request")
+    s = GLOBAL.hist("cql.request").summary()   # one consistent read
     with node.proxy._lat_lock:
         lat = dict(node.proxy._latency)
-    return {"request": {"p50_us": h.percentile(0.5),
-                        "p95_us": h.percentile(0.95),
-                        "p99_us": h.percentile(0.99),
-                        "count": h.count},
-            "replica_ewma_ms": {ep.name: round(s * 1000, 3)
-                                for ep, s in lat.items()}}
+    return {"request": {"p50_us": s["p50_us"],
+                        "p95_us": s["p95_us"],
+                        "p99_us": s["p99_us"],
+                        "count": s["count"]},
+            "replica_ewma_ms": {ep.name: round(v * 1000, 3)
+                                for ep, v in lat.items()}}
 
 
 def compactionhistory(engine) -> list[dict]:
@@ -544,9 +545,38 @@ def gettraceprobability(engine) -> dict:
 
 def settraceprobability(engine, p: float) -> dict:
     """nodetool settraceprobability: sample rate for background request
-    tracing (service/tracing.py consults it)."""
+    tracing — Session.execute consults it via tracing.should_sample();
+    sampled statements land in the engine's TraceStore
+    (system_traces.sessions / `nodetool gettraces`)."""
+    if not 0.0 <= float(p) <= 1.0:
+        raise ValueError(f"trace probability must be in [0, 1], got {p}")
     engine.settings.set("trace_probability", float(p))
     return gettraceprobability(engine)
+
+
+def gettraces(engine, limit: int = 20) -> list[dict]:
+    """nodetool gettraces: recent completed trace sessions with their
+    merged coordinator+replica timelines (system_traces role)."""
+    out = []
+    for st in engine.trace_store.sessions()[-int(limit):]:
+        out.append({
+            "session_id": st.session_id,
+            "request": st.request,
+            "started_at_ms": int(st.started_at * 1000),
+            "duration_us": st.duration_us,
+            "events": [{"elapsed_us": us, "source": src,
+                        "activity": activity}
+                       for us, src, activity in list(st.events)],
+        })
+    return out
+
+
+def exportmetrics(engine) -> str:
+    """nodetool exportmetrics: the full registry in Prometheus
+    exposition format (counters, gauges, decayed latency summaries) plus
+    this engine's compaction gauges."""
+    from ..service.metrics import prometheus_text
+    return prometheus_text(extra_gauges=engine.compactions.gauges())
 
 
 def disableautocompaction(engine) -> dict:
@@ -1422,6 +1452,7 @@ for _name, _target in [
         ("setconcurrentcompactors", "engine"),
         ("gettraceprobability", "engine"),
         ("settraceprobability", "engine"),
+        ("gettraces", "engine"), ("exportmetrics", "engine"),
         ("disableautocompaction", "engine"),
         ("enableautocompaction", "engine"),
         ("statusautocompaction", "engine"),

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""CI check: every metric name registered in the codebase follows the
+documented scheme (docs/observability.md):
+
+    group(.sub)*.name — dot-separated, >= 2 components, each component
+    lowercase [a-z0-9_]+ (the first starting with a letter).
+
+Scanned call sites: .incr("...") / .hist("...") / .timer("...") /
+.counter("...") / .register_gauge("...") / .group("...") string literals
+(plain and f-strings) under cassandra_tpu/ and bench.py. f-string
+placeholders ({...}) count as one valid component — dynamic parts like
+`table.{ks}.{name}.writes` pass structurally; their runtime values are
+the caller's contract.
+
+Names passed to a *group* facade (cfs.latency.hist("read_latency")) are
+single components: the group prefix supplies the rest.
+
+Exit 0 = clean; exit 1 prints each violating file:line and name.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# whole-file scan (\s* spans newlines): a literal on the line AFTER the
+# open paren is still validated
+CALL_RE = re.compile(
+    r"\.(incr|hist|timer|counter|register_gauge|group)\(\s*f?([\"'])"
+    r"(?P<name>[^\"']+)\2")
+
+COMPONENT = r"[a-z][a-z0-9_]*"
+ANY_COMPONENT = r"(?:[a-z0-9_]+|X)"      # X = collapsed f-placeholder
+FULL_RE = re.compile(rf"^{COMPONENT}(\.{ANY_COMPONENT})+$")
+PREFIX_RE = re.compile(rf"^{COMPONENT}(\.{ANY_COMPONENT})*$")
+SINGLE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _collapse_placeholders(name: str) -> str:
+    return re.sub(r"\{[^{}]*\}", "X", name)
+
+
+def check_name(method: str, raw: str) -> bool:
+    name = _collapse_placeholders(raw)
+    if method == "group":
+        return PREFIX_RE.match(name) is not None
+    if "." in name:
+        return FULL_RE.match(name) is not None
+    # dotless: a group-member name (one component)
+    return SINGLE_RE.match(name) is not None
+
+
+def scan(paths=None) -> list[tuple[str, int, str, str]]:
+    """[(relpath, lineno, method, name)] violations."""
+    if paths is None:
+        paths = []
+        for root, _dirs, files in os.walk(os.path.join(REPO,
+                                                       "cassandra_tpu")):
+            paths += [os.path.join(root, f) for f in files
+                      if f.endswith(".py")]
+        paths.append(os.path.join(REPO, "bench.py"))
+    bad = []
+    for p in sorted(paths):
+        with open(p, encoding="utf-8") as f:
+            text = f.read()
+        for m in CALL_RE.finditer(text):
+            method, name = m.group(1), m.group("name")
+            if not check_name(method, name):
+                lineno = text.count("\n", 0, m.start()) + 1
+                bad.append((os.path.relpath(p, REPO), lineno,
+                            method, name))
+    return bad
+
+
+def main() -> int:
+    bad = scan()
+    if bad:
+        print("metric names outside the documented group.sub.name "
+              "scheme (docs/observability.md):", file=sys.stderr)
+        for path, lineno, method, name in bad:
+            print(f"  {path}:{lineno}  .{method}({name!r})",
+                  file=sys.stderr)
+        return 1
+    print("metric names OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
