@@ -161,6 +161,131 @@ def run_compaction(base_dir, table, seed, cfg):
     return stats
 
 
+# ------------------------------------------------------------ read bench --
+
+READ_PARTITIONS = 192
+READ_ROWS = 8
+READ_ROUNDS = 5          # live sstables in the fixture
+READ_SAMPLES = 1200
+
+
+def _build_read_fixture(cfs, table, now: int) -> None:
+    """Freshest-sstable-wins fixture: every round fully supersedes each
+    partition (partition deletion + re-insert, newer timestamps) and
+    flushes, so the newest sstable's deletion covers everything older —
+    the workload timestamp-skip collation exists for. gc_grace keeps the
+    deletions un-purged at read time."""
+    from cassandra_tpu.storage import cellbatch as cb
+    from cassandra_tpu.storage.cellbatch import CellBatchBuilder
+    from cassandra_tpu.storage.sstable import Descriptor, SSTableWriter
+
+    vcol = table.columns["v"].column_id
+    rng = np.random.default_rng(7)
+    for r in range(READ_ROUNDS):
+        b = CellBatchBuilder(table)
+        ts0 = (r + 1) * 1_000_000
+        for p in range(READ_PARTITIONS):
+            pk = table.serialize_partition_key([p])
+            b.add_partition_deletion(pk, ts0, ldt=now)
+            for c in range(READ_ROWS):
+                ck = table.serialize_clustering([c])
+                b.add_row_liveness(pk, ck, ts0 + 1 + c)
+                b.add_cell(pk, ck, vcol,
+                           rng.integers(0, 256, VALUE_BYTES,
+                                        dtype=np.uint8).tobytes(),
+                           ts0 + 1 + c)
+        merged = cb.merge_sorted([b.seal()], now=now)
+        gen = cfs.next_generation()
+        w = SSTableWriter(Descriptor(cfs.directory, gen), table,
+                          estimated_partitions=READ_PARTITIONS)
+        w.append(merged)
+        w.finish()
+    cfs.reload_sstables()
+
+
+def run_read_bench(base_dir: str) -> dict:
+    """Read-path section: single-partition p50/p99 and batched
+    multi-partition reads, fastpath (CTPU_READ_FASTPATH=1: timestamp-
+    skip collation + batched segment gather) A/B'd against the naive
+    collation — results must be bit-identical; the fixture also proves
+    mean sstables_consulted collapses to ~1 with READ_ROUNDS live
+    sstables."""
+    from cassandra_tpu.schema import make_table
+    from cassandra_tpu.service.metrics import GLOBAL as METRICS
+    from cassandra_tpu.storage.cellbatch import content_digest
+    from cassandra_tpu.storage.row_cache import RowCache
+    from cassandra_tpu.storage.table import ColumnFamilyStore
+
+    table = make_table("bench", "readfix", pk=["id"], ck=["c"],
+                       cols={"id": "int", "c": "int", "v": "blob"})
+    cfs = ColumnFamilyStore(table, base_dir, commitlog=None)
+    now = int(time.time())
+    _build_read_fixture(cfs, table, now)
+    pks = [table.serialize_partition_key([p])
+           for p in range(READ_PARTITIONS)]
+    rng = np.random.default_rng(11)
+    seq = [pks[i] for i in rng.integers(0, len(pks), READ_SAMPLES)]
+    hist = METRICS.hist("table.bench.readfix.sstables_per_read")
+
+    def leg(env_val: str, batch_k: int = 0):
+        prev = os.environ.get("CTPU_READ_FASTPATH")
+        os.environ["CTPU_READ_FASTPATH"] = env_val
+        c0, t0 = hist.count, hist.total_us
+        lats, digests = [], []
+        try:
+            if batch_k:
+                for i in range(0, len(seq), batch_k):
+                    grp = seq[i:i + batch_k]
+                    t = time.perf_counter()
+                    res = cfs.read_partitions(grp, now=now)
+                    lats.append((time.perf_counter() - t) * 1e6
+                                / len(grp))
+                    digests += [content_digest(b) for _, b in res]
+            else:
+                for pk in seq:
+                    t = time.perf_counter()
+                    b = cfs.read_partition(pk, now=now)
+                    lats.append((time.perf_counter() - t) * 1e6)
+                    digests.append(content_digest(b))
+        finally:
+            if prev is None:
+                os.environ.pop("CTPU_READ_FASTPATH", None)
+            else:
+                os.environ["CTPU_READ_FASTPATH"] = prev
+        arr = np.array(lats)
+        dc = hist.count - c0
+        stats = {"p50_us": round(float(np.percentile(arr, 50)), 1),
+                 "p99_us": round(float(np.percentile(arr, 99)), 1),
+                 "mean_sstables_consulted":
+                 round((hist.total_us - t0) / dc, 2) if dc else None}
+        return stats, digests
+
+    naive, d_naive = leg("0")
+    fast, d_fast = leg("1")
+    batch_naive, db_naive = leg("0", batch_k=16)
+    batch_fast, db_fast = leg("1", batch_k=16)
+    # row-cache leg: attach a cache, warm it, measure repeat reads
+    cfs.row_cache = RowCache(cfs.directory)
+    _, d_warm = leg("1")
+    cached, d_cached = leg("1")
+    cfs.row_cache.clear()   # don't pin fixture merges in the shared
+    cfs.row_cache = None    # service for the rest of the bench process
+    identical = (d_naive == d_fast == d_warm == d_cached
+                 and db_naive == db_fast)
+    return {
+        "fixture": {"partitions": READ_PARTITIONS,
+                    "rows_per_partition": READ_ROWS,
+                    "sstables": READ_ROUNDS, "reads": len(seq)},
+        "single_partition_us": {"naive": naive, "fastpath": fast,
+                                "row_cache": cached},
+        "batch16_per_key_us": {"naive": batch_naive,
+                               "fastpath": batch_fast},
+        "identical_results": bool(identical),
+        "fastpath_speedup_p50": round(
+            naive["p50_us"] / max(fast["p50_us"], 0.1), 2),
+    }
+
+
 def _kernel_probe(table):
     """Two tiny merge rounds through the DEVICE path (on whatever JAX
     backend is active — the pinned CPU one for host engines): the first
@@ -258,6 +383,10 @@ def main():
             # per-kernel compile/dispatch/execute split + recompile
             # counts by operand shape, plus aggregated phase timings
             "kernel_profile": profiling.GLOBAL.snapshot(),
+            # read-path fast lane A/B (docs/read-path.md): timestamp-
+            # skip collation + batched partition reads vs the naive
+            # every-sstable collation, bit-identical results required
+            "read_path": run_read_bench(os.path.join(base, "read")),
         }
         print(json.dumps(result))
     finally:

@@ -450,6 +450,11 @@ class CompactionTask:
             # (reference SSTableReader ref-counting, utils/concurrent/Ref).
             txn.commit()
             cfs.tracker.replace(self.inputs, live_new)
+            if cfs.row_cache is not None:
+                # compaction-generation change: the read fast lane pins
+                # cached merges to the sstable set they were computed
+                # from (storage/row_cache.py invalidation contract)
+                cfs.row_cache.clear()
             for r in self.inputs:
                 r.release()
         except BaseException:

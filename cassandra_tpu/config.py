@@ -160,6 +160,11 @@ class Config:
     # caches (cassandra.yaml key/row/counter cache section)
     key_cache_size: int = spec("storage", 50 * 1024 * 1024, mutable=True)
     row_cache_size: int = spec("storage", 0, mutable=True)
+    # modern MiB-count knob for the shared row cache
+    # (storage/row_cache.py). Negative = unset: fall back to a non-zero
+    # row_cache_size, then the built-in default; 0 disables caching
+    # even for tables that opted in via WITH caching.
+    row_cache_size_mib: int = mut(-1)
     counter_cache_size: int = spec("storage", 25 * 1024 * 1024,
                                    mutable=True)
     cache_save_period: float = spec("duration", 14400.0, mutable=True)

@@ -1125,7 +1125,10 @@ class Executor:
                 except KeyError:
                     cfs = None
                 if cfs is not None and hasattr(cfs, "row_cache"):
-                    cfs.row_cache = RowCache() if \
+                    if cfs.row_cache is not None:
+                        cfs.row_cache.clear()   # dropping the handle must
+                        # not leave entries pinned in the shared service
+                    cfs.row_cache = RowCache(cfs.directory) if \
                         p.caching.get("rows_per_partition") != "NONE" \
                         else None
         self.schema._changed()
@@ -1794,8 +1797,15 @@ class Executor:
             batches = []
         elif pk_vals:
             push = self._pushdown_limits(t, s, params, ck_rel, filters)
-            batches = [(pk, cfs.read_partition(pk, limits=push))
-                       for pk in self._pk_bytes_list(t, pk_vals)]
+            pks = self._pk_bytes_list(t, pk_vals)
+            if len(pks) > 1 and hasattr(cfs, "read_partitions"):
+                # IN (...) / multi-key reads: one batched bloom +
+                # key-cache + segment-gather pass per sstable instead of
+                # len(pks) independent read_partition walks
+                batches = cfs.read_partitions(pks, limits=push)
+            else:
+                batches = [(pk, cfs.read_partition(pk, limits=push))
+                           for pk in pks]
         else:
             # full scan: paged, windowed, bounded memory (QueryPagers)
             rows, statics_by_pk, new_paging_state = self._paged_scan(

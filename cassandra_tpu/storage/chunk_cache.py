@@ -55,9 +55,13 @@ class ChunkCache:
             return
         with self._lock:
             if key in self._lru:
-                self._lru.move_to_end(key)
-                return
+                # replace: an existing entry may be getting swapped for
+                # a repaired copy (reader ck_comp fix-up) — the atomic
+                # reference swap is safe for concurrent readers holding
+                # the old object
+                self._bytes -= self._sizes[key]
             self._lru[key] = batch
+            self._lru.move_to_end(key)
             self._sizes[key] = size
             self._bytes += size
             while self._bytes > self.capacity and self._lru:

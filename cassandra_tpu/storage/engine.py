@@ -116,6 +116,19 @@ class StorageEngine:
             self.compactions.set_concurrent_compactors
         self.settings.on_change("concurrent_compactors",
                                 self._compactor_listener)
+        # row cache capacity: either knob change re-resolves under the
+        # documented precedence (row_cache_size_mib wins when >= 0)
+        from .row_cache import GLOBAL as _row_cache
+        from .row_cache import resolve_capacity as _rc_capacity
+
+        def _resolve_row_cache(_v):
+            _row_cache.set_capacity(_rc_capacity(self.settings))
+
+        self._rowcache_listener = _resolve_row_cache
+        self.settings.on_change("row_cache_size", self._rowcache_listener)
+        self.settings.on_change("row_cache_size_mib",
+                                self._rowcache_listener)
+        _resolve_row_cache(None)
         self._load_schema()
         self._schema_listener = lambda s: self._save_schema()
         self.schema.listeners.append(self._schema_listener)
@@ -324,6 +337,10 @@ class StorageEngine:
                                       self._throttle_listener)
         self.settings.remove_listener("concurrent_compactors",
                                       self._compactor_listener)
+        self.settings.remove_listener("row_cache_size",
+                                      self._rowcache_listener)
+        self.settings.remove_listener("row_cache_size_mib",
+                                      self._rowcache_listener)
         self.compactions.close()
         if self.commitlog:
             self.commitlog.close()

@@ -45,6 +45,16 @@ class KeyCache:
         with self._lock:
             self._lru.clear()
 
+    def invalidate_generation(self, directory: str, generation: int):
+        """Drop a dead sstable's entries eagerly (truncate path — the
+        generation number can be REUSED by a store recreated over the
+        same directory)."""
+        with self._lock:
+            dead = [k for k in self._lru
+                    if k[0] == directory and k[1] == generation]
+            for k in dead:
+                del self._lru[k]
+
     def keys(self) -> list[tuple]:
         with self._lock:
             return list(self._lru)

@@ -211,8 +211,18 @@ class SSTableReader:
             if cached.ck_comp is None and self._table is not None:
                 # a schema-less (offline-tool) reader may have warmed
                 # this entry; range-tombstone reconciliation needs the
-                # composite translator back
-                cached.ck_comp = self._table.clustering_comp
+                # composite translator back. Fix up a SHALLOW COPY (the
+                # arrays stay shared — they are immutable by the cache
+                # contract): the cached object is read concurrently by
+                # other threads and an in-place attribute store here
+                # would race their merge passes
+                import copy
+                fixed = copy.copy(cached)
+                fixed.ck_comp = self._table.clustering_comp
+                # swap the repaired copy in (atomic reference replace)
+                # so later hits skip both the None-check and the copy
+                chunk_cache.put(key, fixed)
+                return fixed
             return cached
         batch = self._decode_segment(i)
         chunk_cache.put(key, batch)
@@ -345,7 +355,46 @@ class SSTableReader:
     def might_contain(self, pk: bytes) -> bool:
         return self.bloom.might_contain(pk)
 
+    def _key_cache_key(self, pk: bytes) -> tuple:
+        return (self.desc.directory, self.desc.generation, pk)
+
+    def _verified_key_cache_hit(self, key_cache, ck: tuple,
+                                pk: bytes) -> int | None:
+        """Key-cache hit with the same pk verification the search path
+        does: a (directory, generation) pair can be REUSED after a
+        truncate recreates the store, and a stale index must fall back
+        to the search, never silently serve another partition."""
+        hit = key_cache.get(ck)
+        if hit is None:
+            return None
+        p = hit[0]
+        if p < self.n_partitions and self.partition_key_at(p) == pk:
+            return p
+        return None
+
+    @property
+    def _dir_keys(self) -> tuple[np.ndarray, np.ndarray]:
+        """(hi64, lo64) packing of the partition directory's four lanes
+        — lexicographic order over the lanes equals unsigned order over
+        the pair, so batched lookups are two np.searchsorted calls
+        (cached on first use)."""
+        if not hasattr(self, "_dir_keys_cached"):
+            l4 = self._part_lane4.astype(np.uint64)
+            self._dir_keys_cached = (
+                (l4[:, 0] << np.uint64(32)) | l4[:, 1],
+                (l4[:, 2] << np.uint64(32)) | l4[:, 3])
+        return self._dir_keys_cached
+
     def _partition_index(self, pk: bytes) -> int | None:
+        """Directory position of pk, through the shared key cache
+        (cache/KeyCacheKey role: a hit skips the directory search;
+        entries are generation-scoped so stale ones can never serve a
+        new sstable)."""
+        from ..key_cache import GLOBAL as key_cache
+        ck = self._key_cache_key(pk)
+        hit = self._verified_key_cache_hit(key_cache, ck, pk)
+        if hit is not None:
+            return hit
         from ..cellbatch import pk_lanes
         target = pk_lanes(pk)
         # binary search over big-endian-stored directory
@@ -361,8 +410,22 @@ class SSTableReader:
         if lo < self.n_partitions and tuple(int(x) for x in view[lo]) == target:
             if self.partition_key_at(lo) != pk:
                 raise CorruptSSTableError("partition key hash collision")
+            key_cache.put(ck, (lo,))
             return lo
         return None
+
+    def warm_key(self, pk: bytes) -> bool:
+        """Re-populate the key cache for pk through the normal lookup
+        path (AutoSavingCache warm leg). True when the key exists."""
+        if not self.might_contain(pk):
+            return False
+        return self._partition_index(pk) is not None
+
+    def _partition_cell_range(self, p: int) -> tuple[int, int]:
+        c0 = int(self._part_cell0[p])
+        c1 = int(self._part_cell0[p + 1]) if p + 1 < self.n_partitions \
+            else self.n_cells
+        return c0, c1
 
     def read_partition(self, pk: bytes) -> CellBatch | None:
         """All cells of one partition (None if absent)."""
@@ -371,10 +434,91 @@ class SSTableReader:
         p = self._partition_index(pk)
         if p is None:
             return None
-        c0 = int(self._part_cell0[p])
-        c1 = int(self._part_cell0[p + 1]) if p + 1 < self.n_partitions \
-            else self.n_cells
+        c0, c1 = self._partition_cell_range(p)
         return self._cell_range(c0, c1)
+
+    def _partition_indexes_batch(self, pks: list[bytes]) -> list[int | None]:
+        """Vectorized directory lookup for many keys: all (token, pkh)
+        targets bracket against the directory with two searchsorted
+        passes instead of a per-key Python binary search."""
+        from ..cellbatch import pk_lanes
+        targets = np.array([pk_lanes(pk) for pk in pks], dtype=np.uint64)
+        t_hi = (targets[:, 0] << np.uint64(32)) | targets[:, 1]
+        t_lo = (targets[:, 2] << np.uint64(32)) | targets[:, 3]
+        dir_hi, dir_lo = self._dir_keys
+        left = np.searchsorted(dir_hi, t_hi, side="left")
+        right = np.searchsorted(dir_hi, t_hi, side="right")
+        out: list[int | None] = []
+        for i, pk in enumerate(pks):
+            lo, hi = int(left[i]), int(right[i])
+            if lo >= hi:
+                out.append(None)
+                continue
+            # token collisions are rare: the hi64 run is almost always
+            # one entry; resolve the pk-hash lanes within it
+            j = lo + int(np.searchsorted(dir_lo[lo:hi], t_lo[i],
+                                         side="left"))
+            if j < hi and int(dir_lo[j]) == int(t_lo[i]):
+                if self.partition_key_at(j) != pk:
+                    raise CorruptSSTableError(
+                        "partition key hash collision")
+                out.append(j)
+            else:
+                out.append(None)
+        return out
+
+    def read_partitions_batch(self, pks: list[bytes]
+                              ) -> tuple[dict, list[bytes]]:
+        """Many partitions in one pass (the multi-partition read fast
+        lane): ONE batched bloom probe, key-cache hits then one
+        vectorized directory search for the misses, and each covering
+        segment decoded ONCE for every partition it holds — instead of
+        len(pks) independent read_partition walks. Returns
+        (pk -> CellBatch for present keys, bloom-passing pks). Content
+        is bit-identical to per-key read_partition calls."""
+        out: dict[bytes, CellBatch] = {}
+        if not pks:
+            return out, []
+        mask = self.bloom.might_contain_batch(list(pks))
+        cands = [pk for pk, m in zip(pks, mask) if m]
+        if not cands:
+            return out, cands
+        from ..key_cache import GLOBAL as key_cache
+        ranges: dict[bytes, tuple[int, int]] = {}
+        miss: list[bytes] = []
+        for pk in cands:
+            hit = self._verified_key_cache_hit(
+                key_cache, self._key_cache_key(pk), pk)
+            if hit is not None:
+                ranges[pk] = self._partition_cell_range(hit)
+            else:
+                miss.append(pk)
+        if miss:
+            for pk, p in zip(miss, self._partition_indexes_batch(miss)):
+                if p is not None:
+                    key_cache.put(self._key_cache_key(pk), (p,))
+                    ranges[pk] = self._partition_cell_range(p)
+        # gather: decode each needed segment once (ascending disk
+        # order), slice every partition's cells out of the shared batch
+        seg_memo: dict[int, CellBatch] = {}
+        for pk, (c0, c1) in sorted(ranges.items(), key=lambda kv: kv[1]):
+            s0 = int(np.searchsorted(self._seg_cell0, c0, side="right")) - 1
+            s1 = int(np.searchsorted(self._seg_cell0, c1, side="left"))
+            parts = []
+            for s in range(s0, max(s1, s0 + 1)):
+                seg = seg_memo.get(s)
+                if seg is None:
+                    seg = seg_memo[s] = self._read_segment(s)
+                lo = max(c0 - int(self._seg_cell0[s]), 0)
+                hi = min(c1 - int(self._seg_cell0[s]), len(seg))
+                if lo > 0 or hi < len(seg):
+                    parts.append(seg.slice_range(lo, hi))
+                else:
+                    parts.append(seg)
+            batch = CellBatch.concat(parts) if len(parts) > 1 else parts[0]
+            batch.sorted = True
+            out[pk] = batch
+        return out, cands
 
     def _cell_range(self, c0: int, c1: int) -> CellBatch:
         s0 = int(np.searchsorted(self._seg_cell0, c0, side="right")) - 1

@@ -15,11 +15,30 @@ import numpy as np
 
 from ..schema import TableMetadata
 from ..utils import timeutil
-from .cellbatch import (CellBatch, merge_sorted,
+from .cellbatch import (FLAG_PARTITION_DEL, CellBatch, merge_sorted,
                         truncate_live_rows)
 from .memtable import Memtable
 from .mutation import Mutation
+from .row_cache import RowCache
 from .sstable import Descriptor, SSTableReader, SSTableWriter
+
+
+def read_fastpath_enabled() -> bool:
+    """CTPU_READ_FASTPATH=0 disables timestamp-skip collation and batched
+    partition reads for A/B runs (bench.py read section,
+    scripts/check_readpath_ab.py). Read per call so a toggle mid-process
+    takes effect immediately."""
+    return os.environ.get("CTPU_READ_FASTPATH", "1") != "0"
+
+
+def _partition_deletion_ts(batch: CellBatch) -> int | None:
+    """Timestamp of the newest partition-scope deletion in a source's
+    view of one partition (None when it has none) — the accumulator the
+    timestamp-skip rule compares remaining sstables against."""
+    mask = (batch.flags & FLAG_PARTITION_DEL) != 0
+    if not mask.any():
+        return None
+    return int(batch.ts[mask].max())
 
 
 class Tracker:
@@ -29,15 +48,28 @@ class Tracker:
     def __init__(self):
         self._lock = threading.RLock()
         self.sstables: list[SSTableReader] = []
+        self._by_max_ts: list[SSTableReader] | None = None
 
     def view(self) -> list[SSTableReader]:
         with self._lock:
             return list(self.sstables)
 
+    def view_by_max_ts(self) -> list[SSTableReader]:
+        """max_ts-DESCENDING snapshot for the read fast lane, memoized —
+        the ordering only changes when the sstable set does, and the
+        per-read sort was measurable on the path being optimized."""
+        with self._lock:
+            if self._by_max_ts is None:
+                self._by_max_ts = sorted(self.sstables,
+                                         key=lambda r: r.max_ts,
+                                         reverse=True)
+            return list(self._by_max_ts)
+
     def add(self, reader: SSTableReader) -> None:
         with self._lock:
             self.sstables.append(reader)
             self.sstables.sort(key=lambda r: r.desc.generation)
+            self._by_max_ts = None
 
     def replace(self, removed: list[SSTableReader],
                 added: list[SSTableReader]) -> None:
@@ -45,71 +77,7 @@ class Tracker:
             keep = [s for s in self.sstables if s not in removed]
             self.sstables = sorted(keep + added,
                                    key=lambda r: r.desc.generation)
-
-
-class RowCache:
-    """Partition-level row cache (cache/RowCache + RowCacheKey role):
-    caches the MERGED partition at the replica, invalidated on write to
-    the key and on truncate. Flush/compaction never invalidate — they
-    preserve logical content. Partitions holding TTL cells are never
-    cached: their liveness depends on the read clock. Enabled per table
-    via `WITH caching = {'rows_per_partition': 'ALL'}`."""
-
-    def __init__(self, capacity: int = 1024):
-        from collections import OrderedDict
-        self.capacity = capacity
-        self._d: "OrderedDict[bytes, CellBatch]" = OrderedDict()
-        self._lock = threading.Lock()
-        self.hits = 0
-        self.misses = 0
-        # bumped by every invalidation. A reader captures it BEFORE
-        # snapshotting its sources and put() refuses the entry if it
-        # moved — otherwise a read racing a write could re-cache its
-        # pre-write merge AFTER the writer's invalidate and serve stale
-        # data forever (the reference row cache's sentinel protocol)
-        self.generation = 0
-
-    def __len__(self) -> int:
-        with self._lock:
-            return len(self._d)
-
-    def keys(self) -> list[bytes]:
-        """LRU-ordered pks (oldest first) — AutoSavingCache snapshot."""
-        with self._lock:
-            return list(self._d)
-
-    def get(self, pk: bytes):
-        with self._lock:
-            batch = self._d.get(pk)
-            if batch is None:
-                self.misses += 1
-                return None
-            self._d.move_to_end(pk)
-            self.hits += 1
-            return batch
-
-    def put(self, pk: bytes, batch: CellBatch,
-            read_generation: int) -> None:
-        from .cellbatch import FLAG_EXPIRING
-        if len(batch) and (batch.flags & FLAG_EXPIRING).any():
-            return
-        with self._lock:
-            if self.generation != read_generation:
-                return    # an invalidation raced this read: don't cache
-            self._d[pk] = batch
-            self._d.move_to_end(pk)
-            while len(self._d) > self.capacity:
-                self._d.popitem(last=False)
-
-    def invalidate(self, pk: bytes) -> None:
-        with self._lock:
-            self.generation += 1
-            self._d.pop(pk, None)
-
-    def clear(self) -> None:
-        with self._lock:
-            self.generation += 1
-            self._d.clear()
+            self._by_max_ts = None
 
 
 class ColumnFamilyStore:
@@ -140,14 +108,26 @@ class ColumnFamilyStore:
             f"table.{table.keyspace}.{table.name}")
         self.read_hist = self.latency.hist("read_latency")
         self.write_hist = self.latency.hist("write_latency")
+        # sstables consulted per point read (TableMetrics
+        # sstablesPerReadHistogram role) — the observable proof that
+        # timestamp-skip collation is actually skipping
+        self.sstables_per_read = self.latency.hist("sstables_per_read")
+        self.multiread_hist = self.latency.hist("multiread_latency")
         from .lifecycle import replay_directory
         replay_directory(self.directory)
         for desc in Descriptor.list_in(self.directory):
             self.tracker.add(SSTableReader(desc, self.table))
         self.compaction_listener = None  # set by CompactionManager
         self.compaction_history: list[dict] = []
-        self.row_cache = RowCache() if table.params.caching.get(
-            "rows_per_partition", "NONE") != "NONE" else None
+        # the row-cache store key is the data directory: unique per
+        # store, so in-process multi-node clusters never cross-serve
+        self.row_cache = RowCache(self.directory) \
+            if table.params.caching.get(
+                "rows_per_partition", "NONE") != "NONE" else None
+        if self.row_cache is not None:
+            # entries surviving from a previous in-process store over
+            # this directory predate whatever happened to it since
+            self.row_cache.clear()
         self._gen_lock = threading.Lock()
         self._last_gen = max(
             [d.generation for d in Descriptor.list_in(self.directory)],
@@ -225,6 +205,12 @@ class ColumnFamilyStore:
                 raise
             reader = SSTableReader(desc, self.table)
             self.tracker.add(reader)
+            if self.row_cache is not None:
+                # sstable-set change: cached merges must never outlive
+                # the generation they were computed from (also closes
+                # the switch→tracker.add window where a racing read
+                # could have cached a view missing the flushing data)
+                self.row_cache.clear()
             if getattr(self, "backup_enabled", lambda: False)():
                 self._backup_sstable(desc)
             self.metrics["flushes"] += 1
@@ -256,6 +242,53 @@ class ColumnFamilyStore:
 
     # -------------------------------------------------------------- read --
 
+    def _collate_sources(self, pk: bytes) -> tuple[list, int]:
+        """Gather the partition's per-source views: memtable first, then
+        sstables. With the fast lane on (CTPU_READ_FASTPATH), sstables
+        are consulted in DESCENDING max_ts order and consultation STOPS
+        as soon as the accumulated state is provably newer than every
+        remaining sstable: once a partition-scope deletion with
+        timestamp D has been collected, a remaining sstable whose
+        max_ts < D cannot contribute — every cell it could hold
+        (including its tombstones; the skip is tombstone-aware because
+        deletion shadowing uses ts <= D, see CellBatch.reconcile step 3)
+        is shadowed by D, so the merged result is bit-identical to the
+        full collation (the reference's mostRecentPartitionTombstone
+        break in SinglePartitionReadCommand.queryMemtableAndDisk).
+        Timestamps ALONE never justify a skip: an older sstable may hold
+        rows the newer state does not shadow (docs/read-path.md).
+
+        Returns (sources, sstables_consulted) where consulted counts
+        sstables that passed their bloom filter and did index/data work.
+        """
+        fast = read_fastpath_enabled()
+        sources = []
+        top_pd_ts = None
+        with self._switch_lock:
+            mem = self.memtable
+        m = mem.read_partition(pk)
+        if m is not None:
+            sources.append(m)
+            top_pd_ts = _partition_deletion_ts(m)
+        ssts = self.tracker.view_by_max_ts() if fast \
+            else self.tracker.view()
+        consulted = 0
+        for sst in ssts:
+            if fast and top_pd_ts is not None and sst.max_ts < top_pd_ts:
+                # ts-descending order: every remaining sstable is at
+                # least as old — stop, don't just skip this one
+                break
+            if not sst.might_contain(pk):
+                continue
+            consulted += 1
+            part = sst.read_partition(pk)
+            if part is not None:
+                sources.append(part)
+                t = _partition_deletion_ts(part)
+                if t is not None and (top_pd_ts is None or t > top_pd_ts):
+                    top_pd_ts = t
+        return sources, consulted
+
     def read_partition(self, pk: bytes, now: int | None = None,
                        limits=None) -> CellBatch:
         """Merged view of one partition across memtable + sstables
@@ -281,16 +314,8 @@ class ColumnFamilyStore:
                 return cached
             # captured BEFORE the source snapshot (see RowCache.put)
             read_gen = self.row_cache.generation
-        sources = []
-        with self._switch_lock:
-            mem = self.memtable
-        m = mem.read_partition(pk)
-        if m is not None:
-            sources.append(m)
-        for sst in self.tracker.view():
-            part = sst.read_partition(pk)
-            if part is not None:
-                sources.append(part)
+        sources, consulted = self._collate_sources(pk)
+        self.sstables_per_read.update_us(consulted)
         if active() is not None:   # tracing off: zero-cost path
             trace(f"Merging {len(sources)} source(s) for partition read")
         if not sources:
@@ -304,6 +329,86 @@ class ColumnFamilyStore:
             merged, _ = truncate_live_rows(merged, limits)
         self.read_hist.update_us((time.perf_counter() - _t0) * 1e6)
         return merged
+
+    def read_partitions(self, pks: list[bytes], now: int | None = None,
+                        limits=None) -> list[tuple[bytes, CellBatch]]:
+        """Batched multi-partition read (the `IN (...)` / multi-key
+        internal-read fast lane). Per sstable, ALL still-outstanding keys
+        resolve their bloom + key-cache + partition-directory candidates
+        in one vectorized probe and the hit segments decode once for
+        every partition they cover (SSTableReader.read_partitions_batch)
+        instead of N independent read_partition walks. Timestamp-skip
+        collation applies per key, exactly as in read_partition. Returns
+        [(pk, merged batch)] in input order; duplicate keys share one
+        merge. Falls back to per-key reads when the fastpath is off."""
+        if not read_fastpath_enabled():
+            return [(pk, self.read_partition(pk, now=now, limits=limits))
+                    for pk in pks]
+        _t0 = time.perf_counter()
+        from ..service.tracing import active, trace
+        now = now if now is not None else timeutil.now_seconds()
+        self.metrics["reads"] += len(pks)
+        merged: dict[bytes, CellBatch] = {}
+        read_gen = None
+        pending: list[bytes] = []
+        for pk in dict.fromkeys(pks):       # unique, input-ordered
+            if self.row_cache is not None:
+                cached = self.row_cache.get(pk)
+                if cached is not None:
+                    merged[pk] = cached
+                    continue
+            pending.append(pk)
+        if self.row_cache is not None and pending:
+            read_gen = self.row_cache.generation
+        if pending:
+            with self._switch_lock:
+                mem = self.memtable
+            sources = {pk: [] for pk in pending}
+            top_pd: dict[bytes, int] = {}
+            consulted = {pk: 0 for pk in pending}
+            for pk in pending:
+                m = mem.read_partition(pk)
+                if m is not None:
+                    sources[pk].append(m)
+                    t = _partition_deletion_ts(m)
+                    if t is not None:
+                        top_pd[pk] = t
+            active_pks = list(pending)
+            for sst in self.tracker.view_by_max_ts():
+                # keys whose accumulated partition deletion already
+                # covers this (and every remaining) sstable drop out
+                active_pks = [pk for pk in active_pks
+                              if top_pd.get(pk) is None
+                              or sst.max_ts >= top_pd[pk]]
+                if not active_pks:
+                    break
+                parts, passed = sst.read_partitions_batch(active_pks)
+                for pk in passed:
+                    consulted[pk] += 1
+                for pk, part in parts.items():
+                    sources[pk].append(part)
+                    t = _partition_deletion_ts(part)
+                    if t is not None and (pk not in top_pd
+                                          or t > top_pd[pk]):
+                        top_pd[pk] = t
+            if active() is not None:
+                trace(f"Batched read: {len(pending)} partition(s), "
+                      f"{len(self.tracker.view())} live sstable(s)")
+            from .cellbatch import lanes_for_table
+            for pk in pending:
+                self.sstables_per_read.update_us(consulted[pk])
+                if not sources[pk]:
+                    m = CellBatch.empty(lanes_for_table(self.table))
+                else:
+                    m = merge_sorted(sources[pk], now=now)
+                if self.row_cache is not None:
+                    self.row_cache.put(pk, m, read_gen)
+                merged[pk] = m
+        self.multiread_hist.update_us((time.perf_counter() - _t0) * 1e6)
+        if limits is None:
+            return [(pk, merged[pk]) for pk in pks]
+        return [(pk, truncate_live_rows(merged[pk], limits)[0])
+                for pk in pks]
 
     def scan_all(self, now: int | None = None) -> CellBatch:
         """Full-table merged view (range-read building block; small data)."""
@@ -390,10 +495,13 @@ class ColumnFamilyStore:
             old = self.tracker.view()
             self.tracker.replace(old, [])
             from .chunk_cache import GLOBAL as chunk_cache
+            from .key_cache import GLOBAL as key_cache
             for sst in old:
                 sst.close()
                 chunk_cache.invalidate_generation(sst.desc.directory,
                                                   sst.desc.generation)
+                key_cache.invalidate_generation(sst.desc.directory,
+                                                sst.desc.generation)
                 # the whole generation family: standard components AND
                 # attached index components (Index_<col>.db)
                 prefix = f"{sst.desc.version}-{sst.desc.generation}-"

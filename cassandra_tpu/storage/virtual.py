@@ -222,14 +222,20 @@ def build_engine_virtuals(engine) -> VirtualSchema:
         {"name": n, "value": v, "mutable": m}
         for n, v, m in engine.settings.all())))
 
-    # --- caches (db/virtual/CachesTable.java): chunk + per-table row
+    # --- caches (db/virtual/CachesTable.java): chunk + key + row
     def cache_rows():
-        from . import chunk_cache
+        from . import chunk_cache, key_cache, row_cache
         s = chunk_cache.GLOBAL.stats()
         yield {"name": "chunks", "entries": s.get("entries", 0),
                "size_bytes": s.get("bytes", 0),
                "capacity_bytes": s.get("capacity", 0),
                "hits": s.get("hits", 0), "misses": s.get("misses", 0)}
+        k = key_cache.GLOBAL.stats()
+        yield {"name": "keys", "entries": k.get("entries", 0),
+               "size_bytes": 0, "capacity_bytes": 0,
+               "hits": k.get("hits", 0), "misses": k.get("misses", 0)}
+        # per-table handle hit/miss counters (engine-scoped), shared
+        # service bytes/capacity/entry totals (storage/row_cache.py)
         row_hits = row_miss = rows_cached = 0
         for cfs in engine.stores.values():
             rc = cfs.row_cache
@@ -237,8 +243,11 @@ def build_engine_virtuals(engine) -> VirtualSchema:
                 row_hits += rc.hits
                 row_miss += rc.misses
                 rows_cached += len(rc)
-        yield {"name": "rows", "entries": rows_cached, "size_bytes": 0,
-               "capacity_bytes": 0, "hits": row_hits, "misses": row_miss}
+        r = row_cache.GLOBAL.stats()
+        yield {"name": "rows", "entries": rows_cached,
+               "size_bytes": r.get("bytes", 0),
+               "capacity_bytes": r.get("capacity", 0),
+               "hits": row_hits, "misses": row_miss}
 
     t_caches = make_table("system_views", "caches", pk=["name"],
                           cols={"name": "text", "entries": "bigint",

@@ -27,8 +27,24 @@ def status(node) -> list[dict]:
     return out
 
 
+def _cache_line(stats: dict, entries=None, size=None) -> dict:
+    hits = int(stats.get("hits", 0))
+    misses = int(stats.get("misses", 0))
+    total = hits + misses
+    return {
+        "entries": int(stats.get("entries", 0)
+                       if entries is None else entries),
+        "size_bytes": int(stats.get("bytes", 0) if size is None else size),
+        "capacity_bytes": int(stats.get("capacity", 0)),
+        "hits": hits, "misses": misses,
+        "hit_ratio": round(hits / total, 3) if total else None,
+    }
+
+
 def info(engine) -> dict:
-    """nodetool info: storage totals."""
+    """nodetool info: storage totals + key/row/chunk cache hit ratios
+    (the reference prints 'Key Cache : entries …, hits …, requests …'
+    lines; the caches were invisible outside vtables before)."""
     tables = {}
     for cfs in engine.stores.values():
         tables[cfs.table.full_name()] = {
@@ -36,7 +52,26 @@ def info(engine) -> dict:
             "memtable_cells": len(cfs.memtable),
             "disk_bytes": sum(s.size_bytes for s in cfs.live_sstables()),
         }
-    return {"tables": tables}
+    from ..storage import chunk_cache, key_cache, row_cache
+    key = _cache_line(key_cache.GLOBAL.stats(), size=0)
+    # the key cache is entry-bounded, not byte-bounded
+    key["capacity_entries"] = key.pop("capacity_bytes")
+    row = row_cache.GLOBAL.stats()
+    # hit/miss per THIS engine's table handles; bytes/capacity are the
+    # shared service's (one process-wide row cache)
+    row_hits = sum(cfs.row_cache.hits for cfs in engine.stores.values()
+                   if cfs.row_cache is not None)
+    row_miss = sum(cfs.row_cache.misses for cfs in engine.stores.values()
+                   if cfs.row_cache is not None)
+    row_entries = sum(len(cfs.row_cache)
+                      for cfs in engine.stores.values()
+                      if cfs.row_cache is not None)
+    row.update({"hits": row_hits, "misses": row_miss})
+    return {"tables": tables, "caches": {
+        "key": key,
+        "row": _cache_line(row, entries=row_entries),
+        "chunk": _cache_line(chunk_cache.GLOBAL.stats()),
+    }}
 
 
 def flush(engine, keyspace: str | None = None,
@@ -1152,13 +1187,11 @@ def replaybatchlog(node) -> dict:
 
 
 def invalidatekeycache(engine) -> dict:
-    n = 0
-    for cfs in engine.stores.values():
-        for sst in cfs.live_sstables():
-            kc = getattr(sst, "key_cache", None)
-            if kc is not None and hasattr(kc, "clear"):
-                kc.clear()
-                n += 1
+    """The key cache is process-global (storage/key_cache.GLOBAL),
+    generation-scoped per sstable — clear it wholesale."""
+    from ..storage.key_cache import GLOBAL as key_cache
+    n = len(key_cache.keys())
+    key_cache.clear()
     return {"cleared": n}
 
 

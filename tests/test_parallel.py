@@ -86,6 +86,38 @@ def test_shard_balance():
     assert counts.max() <= 3 * max(counts.mean(), 1)  # roughly balanced
 
 
+def test_shard_balance_skewed():
+    """Count-weighted boundaries: with ~40% of cells in 2 hot
+    partitions the remaining shards must re-balance around the hot
+    spots instead of starving (the positional quantile gave a
+    min/mean of ~0.05 on the skewed multichip sweep)."""
+    from cassandra_tpu.parallel.mesh import shard_imbalance
+    rng = np.random.default_rng(9)
+    n = 60_000
+    hot = rng.random(n) < 0.4
+    pk = np.where(hot, rng.integers(0, 2, n), rng.integers(2, 2048, n))
+    b = cb.CellBatchBuilder(T)
+    order_ck = rng.integers(0, 10_000, n)
+    for i in range(n):
+        b.add_cell(IDT.serialize(int(pk[i])),
+                   T.serialize_clustering([int(order_ck[i])]),
+                   COL_REGULAR_BASE, b"v", 100)
+    cat = b.seal()
+    _, shard_of, _, _ = shard_batch(cat, 8)
+    counts = np.bincount(shard_of, minlength=8)
+    mean = counts.mean()
+    # hot partitions are unsplittable (~20% of cells each ≈ 1.6x the
+    # 1/8 mean), so max/mean ~1.6 is the floor; the greedy boundaries
+    # must land near it and must not starve any shard
+    assert shard_imbalance(counts) <= 2.0, counts.tolist()
+    assert counts.min() >= mean / 3, counts.tolist()
+    # a partition still never splits
+    tok = (cat.lanes[:, 0].astype(np.uint64) << np.uint64(32)) \
+        | cat.lanes[:, 1].astype(np.uint64)
+    for t in np.unique(tok[np.asarray(hot)]):
+        assert len(np.unique(shard_of[tok == t])) == 1
+
+
 def test_materialized_shards_bitmatch_single_device():
     from cassandra_tpu.parallel.mesh import materialize_sharded_merge
     batches = build_workload(n_parts=60, n_cks=4, gens=3)

@@ -1,0 +1,242 @@
+"""Read-path fast lane (docs/read-path.md): timestamp-skip collation,
+batched multi-partition reads, row-cache invalidation contract, and the
+CTPU_READ_FASTPATH=0/1 A/B bit-identity guarantee."""
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+from cassandra_tpu.schema import Schema, make_table
+from cassandra_tpu.storage import cellbatch as cb
+from cassandra_tpu.storage.cellbatch import (CellBatchBuilder,
+                                             content_digest)
+from cassandra_tpu.storage.row_cache import RowCache
+from cassandra_tpu.storage.sstable import (Descriptor, SSTableReader,
+                                           SSTableWriter)
+from cassandra_tpu.storage.table import ColumnFamilyStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def fastpath_env():
+    prev = os.environ.get("CTPU_READ_FASTPATH")
+    yield
+    if prev is None:
+        os.environ.pop("CTPU_READ_FASTPATH", None)
+    else:
+        os.environ["CTPU_READ_FASTPATH"] = prev
+
+
+def _table(name):
+    return make_table("ks", name, pk=["id"], ck=["c"],
+                      cols={"id": "int", "c": "int", "v": "blob"})
+
+
+def _write_round(cfs, table, ts0, pks, rows=4, delete_first=True,
+                 now=1000):
+    """One flushed sstable: optionally a partition deletion, then rows
+    with timestamps ts0+1.. (freshest-sstable-wins when delete_first)."""
+    b = CellBatchBuilder(table)
+    vcol = table.columns["v"].column_id
+    for p in pks:
+        pk = table.serialize_partition_key([p])
+        if delete_first:
+            b.add_partition_deletion(pk, ts0, ldt=now)
+        for c in rows if isinstance(rows, range) else range(rows):
+            ck = table.serialize_clustering([c])
+            b.add_row_liveness(pk, ck, ts0 + 1 + c)
+            b.add_cell(pk, ck, vcol, b"v%d" % c, ts0 + 1 + c)
+    merged = cb.merge_sorted([b.seal()], now=now)
+    gen = cfs.next_generation()
+    w = SSTableWriter(Descriptor(cfs.directory, gen), table,
+                      estimated_partitions=len(pks))
+    w.append(merged)
+    w.finish()
+    cfs.reload_sstables()
+
+
+def _read_all(cfs, table, pks, now=1000):
+    return [content_digest(cfs.read_partition(
+        table.serialize_partition_key([p]), now=now)) for p in pks]
+
+
+def test_timestamp_skip_consults_one_sstable(tmp_path, fastpath_env):
+    """Freshest-sstable-wins workload: the newest sstable's partition
+    deletion covers every older one — sstables_consulted drops to 1
+    with 5 live sstables, and results stay bit-identical to the naive
+    every-sstable collation."""
+    table = _table("rfx_skip")
+    cfs = ColumnFamilyStore(table, str(tmp_path), commitlog=None)
+    pks = list(range(16))
+    for r in range(5):
+        _write_round(cfs, table, (r + 1) * 1_000_000, pks)
+    assert len(cfs.live_sstables()) == 5
+
+    os.environ["CTPU_READ_FASTPATH"] = "0"
+    h = cfs.sstables_per_read
+    c0, t0 = h.count, h.total_us
+    naive = _read_all(cfs, table, pks)
+    assert (h.total_us - t0) / (h.count - c0) == 5.0   # consults all
+
+    os.environ["CTPU_READ_FASTPATH"] = "1"
+    c0, t0 = h.count, h.total_us
+    fast = _read_all(cfs, table, pks)
+    assert (h.total_us - t0) / (h.count - c0) == 1.0   # skips the rest
+    assert fast == naive
+
+
+def test_no_skip_without_covering_deletion(tmp_path, fastpath_env):
+    """Rounds that ADD disjoint rows (no partition deletion): nothing
+    proves older sstables are shadowed, so the fast lane must consult
+    every one — timestamps alone never justify a skip — and the merged
+    result must include every round's rows."""
+    table = _table("rfx_noskip")
+    cfs = ColumnFamilyStore(table, str(tmp_path), commitlog=None)
+    pks = list(range(8))
+    for r in range(4):
+        _write_round(cfs, table, (r + 1) * 1_000_000, pks,
+                     rows=range(r * 4, r * 4 + 4), delete_first=False)
+    os.environ["CTPU_READ_FASTPATH"] = "1"
+    h = cfs.sstables_per_read
+    c0, t0 = h.count, h.total_us
+    fast = _read_all(cfs, table, pks)
+    assert (h.total_us - t0) / (h.count - c0) == 4.0
+    os.environ["CTPU_READ_FASTPATH"] = "0"
+    assert _read_all(cfs, table, pks) == fast
+    # and all 16 rows per partition actually merged
+    batch = cfs.read_partition(table.serialize_partition_key([0]),
+                               now=1000)
+    from cassandra_tpu.storage.cellbatch import live_row_count
+    assert live_row_count(batch) == 16
+
+
+def test_batched_read_matches_single(tmp_path, fastpath_env):
+    """read_partitions (one bloom/key-cache/segment-gather pass per
+    sstable) returns bit-identical batches, in input order, including
+    absent and duplicate keys."""
+    table = _table("rfx_batch")
+    cfs = ColumnFamilyStore(table, str(tmp_path), commitlog=None)
+    rng = np.random.default_rng(3)
+    for r in range(3):
+        _write_round(cfs, table, (r + 1) * 1_000_000,
+                     sorted(rng.choice(32, 20, replace=False)),
+                     delete_first=(r == 2))
+    os.environ["CTPU_READ_FASTPATH"] = "1"
+    order = [int(x) for x in rng.integers(0, 40, 25)] + [3, 3]  # dups +
+    # keys beyond 32 are absent everywhere
+    pks = [table.serialize_partition_key([p]) for p in order]
+    batched = cfs.read_partitions(pks, now=1000)
+    assert [pk for pk, _ in batched] == pks
+    singles = [content_digest(cfs.read_partition(pk, now=1000))
+               for pk in pks]
+    assert [content_digest(b) for _, b in batched] == singles
+    os.environ["CTPU_READ_FASTPATH"] = "0"
+    naive = cfs.read_partitions(pks, now=1000)
+    assert [content_digest(b) for _, b in naive] == singles
+
+
+def test_row_cache_invalidated_on_flush_and_compaction(tmp_path,
+                                                       fastpath_env):
+    """The cache never outlives the sstable set its merges were computed
+    from: flush and compaction both clear the table's entries."""
+    table = _table("rfx_cache")
+    cfs = ColumnFamilyStore(table, str(tmp_path), commitlog=None)
+    cfs.row_cache = RowCache(cfs.directory)
+    pks = [0, 1, 2]
+    for r in range(2):
+        _write_round(cfs, table, (r + 1) * 1_000_000, pks)
+    _read_all(cfs, table, pks)
+    assert len(cfs.row_cache) == 3
+    # flush invalidates
+    from cassandra_tpu.storage.mutation import Mutation
+    from cassandra_tpu.schema import COL_ROW_LIVENESS
+    from cassandra_tpu.storage.cellbatch import FLAG_ROW_LIVENESS
+    pk0 = table.serialize_partition_key([0])
+    m = Mutation(table.id, pk0)
+    m.add(table.serialize_clustering([9]), COL_ROW_LIVENESS, b"", b"",
+          9_000_000, flags=FLAG_ROW_LIVENESS)
+    cfs.apply(m)
+    assert cfs.flush() is not None
+    assert len(cfs.row_cache) == 0
+    _read_all(cfs, table, pks)
+    assert len(cfs.row_cache) == 3
+    # compaction invalidates
+    from cassandra_tpu.compaction.task import CompactionTask
+    CompactionTask(cfs, cfs.live_sstables()).execute()
+    assert len(cfs.live_sstables()) == 1
+    assert len(cfs.row_cache) == 0
+    # and post-compaction reads serve the same content from one sstable
+    _read_all(cfs, table, pks)
+    assert len(cfs.row_cache) == 3
+
+
+def test_chunk_cache_entry_not_mutated_by_schema_fixup(tmp_path):
+    """A schema-less (offline-tool) reader warms the chunk cache; a
+    schema'd reader needing ck_comp must fix up a COPY, never the shared
+    cached object other threads may be reading."""
+    from cassandra_tpu.storage.chunk_cache import GLOBAL as chunk_cache
+    table = _table("rfx_chunk")
+    cfs = ColumnFamilyStore(table, str(tmp_path), commitlog=None)
+    _write_round(cfs, table, 1_000_000, [0, 1])
+    desc = cfs.live_sstables()[0].desc
+    chunk_cache.clear()
+    schemaless = SSTableReader(desc)          # no table: ck_comp stays None
+    warmed = list(schemaless.scanner())
+    assert all(b.ck_comp is None for b in warmed)
+    key = (desc.directory, desc.generation, 0)
+    cached_before = chunk_cache.get(key)
+    assert cached_before is not None and cached_before.ck_comp is None
+    with_schema = SSTableReader(desc, table)
+    seg = with_schema._read_segment(0)
+    assert seg.ck_comp is not None            # fixed up for this reader
+    # the object other threads may hold is never mutated in place; the
+    # cache entry is atomically REPLACED with the repaired copy instead
+    assert cached_before.ck_comp is None
+    assert seg is not cached_before
+    assert chunk_cache.get(key) is seg        # repaired copy swapped in
+    np.testing.assert_array_equal(seg.lanes, cached_before.lanes)
+    schemaless.close()
+    with_schema.close()
+
+
+def test_key_cache_stale_entry_falls_back_to_search(tmp_path):
+    """A (directory, generation) pair can be reused after truncate
+    recreates a store: a key-cache hit must verify the stored index
+    still resolves this pk (like the search path does) and fall back
+    to the directory search when it doesn't — never silently serve
+    another partition's cells."""
+    from cassandra_tpu.storage.key_cache import GLOBAL as key_cache
+    table = _table("rfx_stale")
+    cfs = ColumnFamilyStore(table, str(tmp_path), commitlog=None)
+    _write_round(cfs, table, 1_000_000, list(range(8)))
+    sst = cfs.live_sstables()[0]
+    pk = table.serialize_partition_key([5])
+    correct = sst._partition_index(pk)
+    # poison the cache with a wrong (but in-range) index, then an
+    # out-of-range one — both must be rejected and re-resolved
+    key_cache.put(sst._key_cache_key(pk),
+                  ((correct + 1) % sst.n_partitions,))
+    assert sst._partition_index(pk) == correct
+    key_cache.put(sst._key_cache_key(pk), (10_000,))
+    assert sst._partition_index(pk) == correct
+    # truncate drops the generation's key-cache entries eagerly
+    sst2 = cfs.live_sstables()[0]
+    assert key_cache.get(sst2._key_cache_key(pk)) is not None
+    cfs.truncate()
+    assert key_cache.get((sst2.desc.directory, sst2.desc.generation,
+                          pk)) is None
+
+
+def test_ab_fixture_no_divergence(tmp_path):
+    """The CI A/B harness (scripts/check_readpath_ab.py): overwrites,
+    deletions at every scope, TTLs, IN (...) reads — zero divergence
+    between CTPU_READ_FASTPATH=0 and =1."""
+    spec = importlib.util.spec_from_file_location(
+        "check_readpath_ab",
+        os.path.join(REPO, "scripts", "check_readpath_ab.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    diverged = mod.run_check(str(tmp_path))
+    assert diverged == [], "\n".join(diverged)

@@ -1,0 +1,130 @@
+"""AutoSavingCache round-trips (storage/saved_caches.py) and the bloom
+filter's false-positive-rate bound (utils/bloom.py) — satellite coverage
+for the read-path fast lane's cache hierarchy."""
+import os
+
+import numpy as np
+
+from cassandra_tpu.cql import Session
+from cassandra_tpu.schema import Schema
+from cassandra_tpu.storage.engine import StorageEngine
+from cassandra_tpu.storage.key_cache import GLOBAL as key_cache
+from cassandra_tpu.storage.saved_caches import AutoSavingCache
+from cassandra_tpu.utils.bloom import BloomFilter
+
+
+def _engine_with_data(tmp_path, caching=False):
+    eng = StorageEngine(str(tmp_path / "d"), Schema(),
+                        commitlog_sync="batch")
+    s = Session(eng)
+    s.execute("CREATE KEYSPACE ks WITH replication = "
+              "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+    s.execute("USE ks")
+    opt = (" WITH caching = {'keys': 'ALL', 'rows_per_partition': 'ALL'}"
+           if caching else "")
+    s.execute("CREATE TABLE kv (k int, c int, v text, "
+              "PRIMARY KEY (k, c))" + opt)
+    for k in range(8):
+        for c in range(3):
+            s.execute(f"INSERT INTO kv (k, c, v) VALUES ({k}, {c}, "
+                      f"'v{k}{c}')")
+    cfs = eng.store("ks", "kv")
+    cfs.flush()
+    return eng, s, cfs
+
+
+def test_key_cache_save_load_roundtrip(tmp_path):
+    """Keys (never values) persist: save after reads, clear, warm —
+    the key cache refills through SSTableReader.warm_key and the next
+    point read hits it."""
+    eng, s, cfs = _engine_with_data(tmp_path)
+    key_cache.clear()
+    for k in range(8):
+        s.execute(f"SELECT v FROM kv WHERE k = {k}")   # populate
+    assert len(key_cache.keys()) == 8
+    saver = AutoSavingCache(eng)
+    counts = saver.save()
+    assert counts["key"] == 8
+    assert os.path.exists(os.path.join(saver.directory,
+                                       AutoSavingCache.KEY_FILE))
+
+    key_cache.clear()
+    assert len(key_cache.keys()) == 0
+    warmed = saver.warm()
+    assert warmed["key"] == 8
+    assert len(key_cache.keys()) == 8
+    h0 = key_cache.hits
+    s.execute("SELECT v FROM kv WHERE k = 3")
+    assert key_cache.hits > h0
+    saver.close()
+    eng.close()
+
+
+def test_key_cache_warm_skips_compacted_generations(tmp_path):
+    """A save file referencing generations compacted away since must
+    warm nothing for them (and must not crash)."""
+    eng, s, cfs = _engine_with_data(tmp_path)
+    key_cache.clear()
+    for k in range(8):
+        s.execute(f"SELECT v FROM kv WHERE k = {k}")
+    saver = AutoSavingCache(eng)
+    saver.save()
+    # a second sstable + major compaction replaces every generation
+    s.execute("INSERT INTO kv (k, c, v) VALUES (0, 9, 'x')")
+    cfs.flush()
+    eng.compactions.major_compaction(cfs)
+    key_cache.clear()
+    warmed = saver.warm()
+    assert warmed["key"] == 0
+    saver.close()
+    eng.close()
+
+
+def test_row_cache_keys_roundtrip(tmp_path):
+    """Row-cache KEYS persist; warm re-reads through the normal read
+    path, so the cache refills with current on-disk truth."""
+    eng, s, cfs = _engine_with_data(tmp_path, caching=True)
+    assert cfs.row_cache is not None
+    for k in range(8):
+        s.execute(f"SELECT v FROM kv WHERE k = {k}")
+    assert len(cfs.row_cache) == 8
+    saver = AutoSavingCache(eng)
+    counts = saver.save()
+    assert counts["row"] == 8
+    cfs.row_cache.clear()
+    assert len(cfs.row_cache) == 0
+    warmed = saver.warm()
+    assert warmed["row"] == 8
+    assert len(cfs.row_cache) == 8
+    h0 = cfs.row_cache.hits
+    s.execute("SELECT v FROM kv WHERE k = 5")
+    assert cfs.row_cache.hits > h0
+    saver.close()
+    eng.close()
+
+
+def test_bloom_false_positive_rate_bound():
+    """The filter built for fp_rate=0.01 stays within 2x of its target
+    on absent keys and never reports a false negative."""
+    n = 10_000
+    bf = BloomFilter.create(n, fp_rate=0.01)
+    present = [b"key-%d" % i for i in range(n)]
+    bf.add_batch(present)
+    assert bool(bf.might_contain_batch(present).all())   # no false negs
+    absent = [b"absent-%d" % i for i in range(2 * n)]
+    fp = int(bf.might_contain_batch(absent).sum())
+    assert fp / len(absent) < 0.02, fp
+
+
+def test_bloom_fp_rate_tracks_target_across_densities():
+    rng = np.random.default_rng(5)
+    for target in (0.1, 0.01):
+        n = 5_000
+        bf = BloomFilter.create(n, fp_rate=target)
+        keys = [bytes(k) for k in rng.integers(
+            0, 256, (n, 12)).astype(np.uint8)]
+        bf.add_batch(keys)
+        absent = [b"x" + bytes(k) for k in rng.integers(
+            0, 256, (10_000, 12)).astype(np.uint8)]
+        fp = int(bf.might_contain_batch(absent).sum()) / len(absent)
+        assert fp < 2.5 * target, (target, fp)
