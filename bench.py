@@ -161,6 +161,157 @@ def run_compaction(base_dir, table, seed, cfg):
     return stats
 
 
+# ----------------------------------------------------------- write bench --
+
+WRITE_THREADS = 8
+WRITE_VALUE = 64
+
+
+def _write_leg(base_dir: str, fast: bool, threads: int, n_total: int,
+               sync: str = "batch") -> dict:
+    """mutations/s through StorageEngine.apply with `threads` writers,
+    commitlog in a durable mode — the group-commit + sharded-memtable
+    surface. Returns rate + commitlog sync stats for the leg."""
+    import threading
+
+    from cassandra_tpu.schema import Schema, make_table
+    from cassandra_tpu.storage.engine import StorageEngine
+    from cassandra_tpu.storage.mutation import Mutation
+
+    os.environ["CTPU_WRITE_FASTPATH"] = "1" if fast else "0"
+    d = os.path.join(base_dir,
+                     f"{'fast' if fast else 'naive'}-{sync}-{threads}t")
+    schema = Schema()
+    schema.create_keyspace("wb")
+    table = make_table("wb", "t", pk=["id"], ck=["c"],
+                       cols={"id": "int", "c": "int", "v": "blob"})
+    schema.add_table(table)
+    engine = StorageEngine(d, schema, commitlog_sync=sync)
+    vcol = table.columns["v"].column_id
+    rng = np.random.default_rng(5)
+    vals = rng.integers(0, 256, (n_total, WRITE_VALUE), dtype=np.uint8)
+    muts = []
+    for i in range(n_total):
+        m = Mutation(table.id, table.serialize_partition_key([i % 512]))
+        m.add(table.serialize_clustering([i]), vcol, b"",
+              vals[i].tobytes(), 1_000_000 + i)
+        muts.append(m)
+    cl = engine.commitlog
+    syncs0 = cl._sync_hist.count
+    t0 = time.perf_counter()
+    if threads == 1:
+        for m in muts:
+            engine.apply(m)
+    else:
+        def worker(sl):
+            for m in sl:
+                engine.apply(m)
+        ts = [threading.Thread(target=worker, args=(muts[i::threads],))
+              for i in range(threads)]
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join()
+    wall = time.perf_counter() - t0
+    out = {"mutations_per_s": round(n_total / wall, 1),
+           "wall_s": round(wall, 3),
+           "mutations": n_total,
+           # naive durable modes fsync inline, once per mutation (those
+           # don't route through the sync-latency hist)
+           "fsyncs": (cl._sync_hist.count - syncs0) if fast else n_total}
+    engine.close()
+    return out
+
+
+def _flush_leg(base_dir: str, fast: bool, n_parts: int,
+               rows_per_part: int) -> dict:
+    """Flush MiB/s: fill one memtable through the real ingest path
+    (apply_batch, no commitlog), then time ColumnFamilyStore.flush
+    (fast lane = shard-drain -> compress -> io_write pipeline; naive =
+    sort-everything-then-serial-write)."""
+    from cassandra_tpu.schema import make_table
+    from cassandra_tpu.storage.mutation import Mutation
+    from cassandra_tpu.storage.table import ColumnFamilyStore
+
+    os.environ["CTPU_WRITE_FASTPATH"] = "1" if fast else "0"
+    table = make_table("wb", "flush" + ("f" if fast else "n"),
+                       pk=["id"], ck=["c"],
+                       cols={"id": "int", "c": "int", "v": "blob"})
+    cfs = ColumnFamilyStore(table, base_dir, commitlog=None)
+    vcol = table.columns["v"].column_id
+    rng = np.random.default_rng(9)
+    vals = rng.integers(0, 256,
+                        (n_parts * rows_per_part, WRITE_VALUE),
+                        dtype=np.uint8)
+    muts, i = [], 0
+    for p in range(n_parts):
+        m = Mutation(table.id, table.serialize_partition_key([p]))
+        for r in range(rows_per_part):
+            m.add(table.serialize_clustering([r]), vcol, b"",
+                  vals[i].tobytes(), 1_000_000 + i)
+            i += 1
+        muts.append(m)
+    for j in range(0, len(muts), 256):
+        cfs.apply_batch(muts[j:j + 256])
+    n_cells = len(cfs.memtable)
+    t0 = time.perf_counter()
+    reader = cfs.flush()
+    wall = time.perf_counter() - t0
+    data_mib = reader.data_size / 2**20
+    for s in cfs.live_sstables():
+        s.close()
+    return {"cells": n_cells, "sstable_mib": round(data_mib, 2),
+            "wall_s": round(wall, 3),
+            "mib_per_s": round(data_mib / wall, 2)}
+
+
+def run_write_bench(base_dir: str) -> dict:
+    """Write-path section: group-commit + sharded-memtable mutations/s
+    at 1 and 8 writer threads (CTPU_WRITE_FASTPATH A/B, batch-durable
+    commitlog), flush MiB/s (pipelined vs serial), commitlog sync
+    latency histograms, and the group-window mode. The A/B content
+    identity itself is CI-enforced by scripts/check_writepath_ab.py."""
+    from cassandra_tpu.service.metrics import GLOBAL as METRICS
+
+    prev = os.environ.get("CTPU_WRITE_FASTPATH")
+    try:
+        naive1 = _write_leg(base_dir, False, 1, 400)
+        naive8 = _write_leg(base_dir, False, WRITE_THREADS, 400)
+        fast1 = _write_leg(base_dir, True, 1, 1200)
+        fast8 = _write_leg(base_dir, True, WRITE_THREADS, 4000)
+        group8 = _write_leg(base_dir, True, WRITE_THREADS, 1500,
+                            sync="group")
+        flush_naive = _flush_leg(os.path.join(base_dir, "fln"), False,
+                                 4096, 48)
+        flush_fast = _flush_leg(os.path.join(base_dir, "flf"), True,
+                                4096, 48)
+    finally:
+        if prev is None:
+            os.environ.pop("CTPU_WRITE_FASTPATH", None)
+        else:
+            os.environ["CTPU_WRITE_FASTPATH"] = prev
+    return {
+        "mutations_per_s": {
+            "naive": {"1_thread": naive1, "8_threads": naive8},
+            "fastpath": {"1_thread": fast1, "8_threads": fast8},
+            "group_mode_8_threads": group8,
+        },
+        "speedup_8_threads": round(
+            fast8["mutations_per_s"] / max(naive8["mutations_per_s"],
+                                           0.1), 2),
+        "flush": {"naive": flush_naive, "pipelined": flush_fast,
+                  "speedup": round(flush_fast["mib_per_s"]
+                                   / max(flush_naive["mib_per_s"], 0.01),
+                                   2)},
+        "commitlog": {
+            "sync_latency_us":
+                METRICS.hist("commitlog.sync_latency").summary(),
+            "waiting_on_commit_us":
+                METRICS.hist("commitlog.waiting_on_commit").summary(),
+        },
+    }
+
+
 # ------------------------------------------------------------ read bench --
 
 READ_PARTITIONS = 192
@@ -387,6 +538,10 @@ def main():
             # skip collation + batched partition reads vs the naive
             # every-sstable collation, bit-identical results required
             "read_path": run_read_bench(os.path.join(base, "read")),
+            # write-path fast lane A/B (docs/write-path.md): group-commit
+            # commitlog + sharded memtable + pipelined flush vs the
+            # per-mutation-fsync serial path
+            "write_path": run_write_bench(os.path.join(base, "write")),
         }
         print(json.dumps(result))
     finally:

@@ -96,7 +96,13 @@ class Node:
         if target.dc in self.hints.disabled_dcs:
             return False
         st = self.gossiper.states.get(target)
-        if st is not None and not st.alive:
+        if st is not None and not st.alive and st.last_heartbeat != 0:
+            # last_heartbeat == 0 means the peer was never heard from:
+            # downtime is UNKNOWN, not "since the epoch" — the reference
+            # (Gossiper.getEndpointDowntime) reports 0 there and hints.
+            # Without this a replica marked down before its first
+            # heartbeat silently lost every hint. (assassinate pushes
+            # last_heartbeat far negative, so it still refuses here.)
             dead_s = self.gossiper.clock() - st.last_heartbeat
             if dead_s * 1000.0 > self.max_hint_window_ms:
                 return False

@@ -101,8 +101,14 @@ class Config:
     hints_directory: str = ""
 
     # commitlog (cassandra.yaml:419-480)
-    commitlog_sync: str = "periodic"            # periodic | batch
+    commitlog_sync: str = "periodic"            # periodic | batch | group
     commitlog_sync_period: float = spec("duration", 10.0)
+    # group-commit window: minimum spacing between fsyncs under
+    # commitlog_sync: group (GroupCommitLogService's
+    # commitlog_sync_group_window); writers arriving inside the window
+    # coalesce into the next sync. Seconds after parsing ("10ms").
+    commitlog_sync_group_window: float = spec("duration", 0.010,
+                                              mutable=True)
     commitlog_segment_size: int = spec("storage", 32 * 1024 * 1024)
     commitlog_compression: str = ""             # codec name or ""
     cdc_enabled: bool = False
@@ -111,6 +117,9 @@ class Config:
     memtable_flush_writers: int = 2
     memtable_cleanup_threshold: float = 0.25
     memtable_heap_space: int = spec("storage", 256 * 1024 * 1024)
+    # token-range shards per memtable (TrieMemtable shard count role):
+    # 0 = auto (8 with the write fast lane on, 1 with it off)
+    memtable_shards: int = 0
 
     # compaction (cassandra.yaml:1217-1250)
     concurrent_compactors: int = mut(1)

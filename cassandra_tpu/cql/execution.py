@@ -1693,10 +1693,25 @@ class Executor:
                 sub_exec.execute(sub, params, keyspace, now_micros=now,
                                  user=user)
             bid = batchlog.store(collector.mutations)
+            # augment=False: triggers already ran during collection
+            # (their output IS in collector.mutations and the
+            # batchlog); a second pass here would double-fire.
+            # Mutations for view-less tables take the backend's batched
+            # fast lane (one commitlog barrier + one memtable shard
+            # pass — StorageEngine.apply_batch); view-bearing tables
+            # need per-mutation pre/post reads and stay on _apply_dml.
+            apply_b = getattr(self.backend, "apply_batch", None)
+            plain, viewed = [], []
             for m in collector.mutations:
-                # augment=False: triggers already ran during collection
-                # (their output IS in collector.mutations and the
-                # batchlog); a second pass here would double-fire
+                t = self.schema.table_by_id(m.table_id)
+                if apply_b is not None and (t is None
+                                            or not self._views_of(t)):
+                    plain.append(m)
+                else:
+                    viewed.append(m)
+            if plain:
+                apply_b(plain)
+            for m in viewed:
                 self._apply_dml(m, now, augment=False)
             batchlog.remove(bid)
             return ResultSet([], [])

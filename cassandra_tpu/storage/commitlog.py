@@ -3,24 +3,55 @@
 Reference counterpart: db/commitlog/CommitLog.java:300 (add),
 CommitLogSegment, AbstractCommitLogSegmentManager (segment rotation,
 per-table dirty tracking), CommitLogReplayer (boot replay). Sync
-strategies: 'periodic' (buffered, background fsync every N ms) and 'batch'
-(fsync before ack) — conf/cassandra.yaml commitlog_sync options.
+strategies (AbstractCommitLogService subclasses, conf/cassandra.yaml
+commitlog_sync options):
+  'periodic'  buffered appends, background fsync every sync_period_ms;
+              acked writes may be lost on crash inside the window.
+  'batch'     durable before ack. Fast lane (CTPU_WRITE_FASTPATH=1):
+              writers append to the buffered segment and PARK on a sync
+              barrier; a SYNC LEADER elected among the parked writers
+              runs one flush+fsync that acks every writer it covers, so
+              N concurrent writers pay ~1 fsync instead of N
+              (BatchCommitLogService with a zero window).
+              Fast lane off: fsync inline under the segment lock.
+  'group'     durable before ack like batch, but the syncer thread
+              paces fsyncs commitlog_sync_group_window apart, trading
+              ack latency for larger coalesced groups
+              (GroupCommitLogService). Fast lane off: degrades to the
+              inline-fsync batch behavior (same durability, no grouping).
+
+Rotation is double-buffered on the fast lane: the outgoing segment is
+handed to the syncer to flush+fsync+close+archive off the write path,
+so appends to segment k+1 proceed while k syncs. Segments are block-
+preallocated at open (fsutil.preallocate_keep_size).
 
 Record frame: [u32 length][u32 crc32-of-payload][payload]. A zero length
 or short read terminates replay of a segment (torn tail after crash).
 """
 from __future__ import annotations
 
+import logging
 import os
 import re
 import struct
 import threading
+import time
 import zlib
 
 from ..utils import fsutil
 from .mutation import Mutation
 
 _SEG_RE = re.compile(r"^commitlog-(\d+)\.log$")
+
+_log = logging.getLogger(__name__)
+
+
+def write_fastpath_enabled() -> bool:
+    """CTPU_WRITE_FASTPATH=0 disables the write-path fast lane (commitlog
+    group commit, sharded memtable ingest, pipelined flush) for A/B runs
+    (bench.py write_path section, scripts/check_writepath_ab.py). Read
+    per call so a toggle mid-process takes effect immediately."""
+    return os.environ.get("CTPU_WRITE_FASTPATH", "1") != "0"
 
 
 class CommitLogPosition(tuple):
@@ -52,17 +83,21 @@ class CommitLog:
     def __init__(self, directory: str, segment_size: int = 32 * 1024 * 1024,
                  sync_mode: str = "periodic", sync_period_ms: int = 1000,
                  archive_dir: str | None = None, encrypt: bool = False,
-                 compression: str | None = None):
+                 compression: str | None = None,
+                 group_window_ms: float = 10.0):
         """archive_dir: finished segments are copied there on rotation
         and at close (CommitLogArchiver role — the restore half is
         replay_archived / StorageEngine.restore_point_in_time).
         encrypt: segments carry an AES-CTR header and record payloads
         are keystream-XORed at their file offset
-        (db/commitlog/EncryptedSegment.java role; CRCs cover ciphertext)."""
+        (db/commitlog/EncryptedSegment.java role; CRCs cover ciphertext).
+        group_window_ms: minimum spacing between fsyncs under
+        sync_mode='group' (commitlog_sync_group_window)."""
         self.directory = directory
         self.segment_size = segment_size
         self.sync_mode = sync_mode
         self.sync_period_ms = sync_period_ms
+        self.group_window_ms = group_window_ms
         self.archive_dir = archive_dir
         self.encrypt = encrypt
         self.compression = compression or None
@@ -96,15 +131,42 @@ class CommitLog:
                 target=self._archive_loop, daemon=True,
                 name="commitlog-archiver")
             self._archive_thread.start()
+        # ---- group-commit sync barrier (AbstractCommitLogService role):
+        # writers park in _await_sync until _synced covers their frame;
+        # the syncer thread coalesces all parked writers into one fsync.
+        self._sync_cond = threading.Condition()
+        self._synced = CommitLogPosition(0, 0)
+        self._sync_req = threading.Event()   # "waiters (or dirty retired
+        #                                       segments) need a sync"
+        self._waiting = 0                    # writers parked on the barrier
+        self._leader_active = False          # a writer is running the sync
+        self._sync_error: BaseException | None = None
+        # serializes sync CYCLES (leader writer vs syncer thread): two
+        # concurrent _do_sync calls could otherwise race a rotation —
+        # one closing a just-retired file the other captured for fsync
+        self._sync_mutex = threading.Lock()
+        self._sync_failures = 0
+        self._failure_logged = False
+        self._last_sync = 0.0
+        # rotated-but-unsynced segments: (seg_id, file) pairs the syncer
+        # flushes, fsyncs, closes and hands to the archiver — the double
+        # buffer that keeps rotation off the write path
+        self._retiring: list[tuple[int, object]] = []
+        from ..service.metrics import GLOBAL as _METRICS
+        self._wait_hist = _METRICS.hist("commitlog.waiting_on_commit")
+        self._sync_hist = _METRICS.hist("commitlog.sync_latency")
+        self._metrics = _METRICS
         self._open_segment()
         # dirty tracking: segment -> set of table ids with unflushed writes
         self._dirty: dict[int, set] = {}
         self._stop = threading.Event()
-        self._syncer = None
-        if sync_mode == "periodic":
-            self._syncer = threading.Thread(target=self._sync_loop,
-                                            daemon=True)
-            self._syncer.start()
+        # ONE syncer for every mode: periodic ticks on sync_period_ms;
+        # batch/group wake on _sync_req (fast lane) and stay idle when
+        # writers fsync inline (fast lane off)
+        self._syncer = threading.Thread(target=self._sync_loop,
+                                        daemon=True,
+                                        name="commitlog-syncer")
+        self._syncer.start()
 
     # ------------------------------------------------------------ segments
 
@@ -120,20 +182,22 @@ class CommitLog:
         return sorted(out)
 
     def _open_segment(self) -> None:
-        prev = None
+        """Open the segment for _seg_id, retiring the previous file to
+        the syncer (flush to the OS now so a concurrent _do_sync never
+        misses buffered bytes; fsync+close+archive happen off the write
+        path — the rotation half of the double buffer). Callers hold
+        _lock except the __init__ call, which races nothing."""
         if self._file:
-            self._file.flush()
-            os.fsync(self._file.fileno())
-            self._file.close()
             prev = self._seg_id - 1
+            self._file.flush()
+            if self.archive_dir:
+                # deletion must wait for the PITR copy; claim BEFORE the
+                # retire becomes visible to discard_completed
+                self._archiving.add(prev)
+            self._retiring.append((prev, self._file))
+            self._sync_req.set()
         self._file = open(self._seg_path(self._seg_id), "ab")
         self._seg_comp = None
-        if prev is not None and self.archive_dir:
-            # async: the rotated segment is immutable; the worker copies
-            # it off the write path (deletion waits for the archive)
-            self._archiving.add(prev)
-            self._archive_q.append(prev)
-            self._archive_ev.set()
         if self.encrypt:
             from . import encryption as enc_mod
             ctx = enc_mod.get_context()
@@ -174,50 +238,259 @@ class CommitLog:
 
     # ----------------------------------------------------------------- add
 
-    def add(self, mutation: Mutation) -> CommitLogPosition:
-        """Append a mutation; returns its position. With sync_mode='batch'
-        the record is durable when this returns (CommitLog.add:300)."""
-        payload = mutation.serialize()
-        with self._lock:
-            if self._file.tell() + len(payload) + 12 > self.segment_size:
-                self._seg_id += 1
-                self._open_segment()
-            pos = CommitLogPosition(self._seg_id, self._file.tell())
-            raw_len = len(payload)
-            if self._seg_comp is not None:
-                c = self._seg_comp.compress(payload)
-                if len(c) < raw_len:
-                    payload = c
-            if self._seg_enc is not None:
-                from . import encryption as enc_mod
-                kid, nonce = self._seg_enc
-                hdr = 12 if self._seg_comp is not None else 8
-                payload = enc_mod.get_context().xor_at(
-                    kid, nonce, pos.offset + hdr, payload)
-            if self._seg_comp is not None:
-                frame = struct.pack("<III", len(payload),
-                                    zlib.crc32(payload), raw_len) + payload
-            else:
-                frame = struct.pack("<II", len(payload),
-                                    zlib.crc32(payload)) + payload
-            self._file.write(frame)
-            self._dirty.setdefault(self._seg_id, set()).add(mutation.table_id)
-            if self.sync_mode == "batch":
-                self._file.flush()
-                os.fsync(self._file.fileno())
+    def _append_locked(self, mutation: Mutation,
+                       payload: bytes) -> CommitLogPosition:
+        """Frame + write one serialized mutation; caller holds _lock."""
+        if self._file.tell() + len(payload) + 12 > self.segment_size:
+            self._seg_id += 1
+            self._open_segment()
+        pos = CommitLogPosition(self._seg_id, self._file.tell())
+        raw_len = len(payload)
+        if self._seg_comp is not None:
+            c = self._seg_comp.compress(payload)
+            if len(c) < raw_len:
+                payload = c
+        if self._seg_enc is not None:
+            from . import encryption as enc_mod
+            kid, nonce = self._seg_enc
+            hdr = 12 if self._seg_comp is not None else 8
+            payload = enc_mod.get_context().xor_at(
+                kid, nonce, pos.offset + hdr, payload)
+        if self._seg_comp is not None:
+            frame = struct.pack("<III", len(payload),
+                                zlib.crc32(payload), raw_len) + payload
+        else:
+            frame = struct.pack("<II", len(payload),
+                                zlib.crc32(payload)) + payload
+        self._file.write(frame)
+        self._dirty.setdefault(self._seg_id, set()).add(mutation.table_id)
         return pos
 
-    def sync(self) -> None:
+    def append(self, mutation: Mutation
+               ) -> tuple[CommitLogPosition, CommitLogPosition | None]:
+        """Append WITHOUT waiting for durability: returns (position,
+        barrier) where barrier is the position await_durable must reach
+        before the write may be acked (None when the mode needs no wait
+        — periodic, or the record was inline-fsynced). Callers that
+        hold a coarser lock (the ColumnFamilyStore write barrier) use
+        this so the durability wait happens OUTSIDE that lock — parked
+        writers must not serialize the writers behind them, or group
+        commit coalesces nothing."""
+        poss, barrier = self.append_batch([mutation])
+        return poss[0], barrier
+
+    def append_batch(self, mutations: list[Mutation]
+                     ) -> tuple[list[CommitLogPosition],
+                                CommitLogPosition | None]:
+        """Batch form of append(): the whole batch lands under ONE lock
+        acquisition and shares one durability barrier (the commitlog
+        half of the batched write fast lane)."""
+        if not mutations:
+            return [], None
+        payloads = [m.serialize() for m in mutations]
+        fast = self.sync_mode in ("batch", "group") \
+            and write_fastpath_enabled()
+        barrier = None
         with self._lock:
-            self._file.flush()
-            os.fsync(self._file.fileno())
+            out = [self._append_locked(m, p)
+                   for m, p in zip(mutations, payloads)]
+            if self.sync_mode in ("batch", "group"):
+                end = CommitLogPosition(self._seg_id, self._file.tell())
+                if fast:
+                    barrier = end
+                else:
+                    self._file.flush()
+                    os.fsync(self._file.fileno())
+        if not fast and self.sync_mode in ("batch", "group"):
+            self._advance_synced(end)
+        return out, barrier
+
+    def await_durable(self, barrier: CommitLogPosition | None) -> None:
+        """Block until a barrier returned by append/append_batch is
+        durable (no-op for None)."""
+        if barrier is not None:
+            self._await_sync(barrier)
+
+    def add(self, mutation: Mutation) -> CommitLogPosition:
+        """Append a mutation; returns its position. With
+        sync_mode='batch'/'group' the record is durable when this
+        returns (CommitLog.add:300). Fast lane: the writer appends
+        buffered and parks on the sync barrier; one syncer fsync acks
+        every parked writer at once."""
+        pos, barrier = self.append(mutation)
+        self.await_durable(barrier)
+        return pos
+
+    def add_batch(self, mutations: list[Mutation]) -> list[CommitLogPosition]:
+        """add() for a batch: one lock acquisition, one sync barrier."""
+        out, barrier = self.append_batch(mutations)
+        self.await_durable(barrier)
+        return out
+
+    # ------------------------------------------------------ sync barrier --
+
+    def _advance_synced(self, pos: CommitLogPosition) -> None:
+        with self._sync_cond:
+            if pos > self._synced:
+                self._synced = pos
+            self._sync_error = None
+            self._sync_cond.notify_all()
+
+    def _await_sync(self, pos: CommitLogPosition) -> None:
+        """Park until `pos` is durable (the reference's WaitQueue in
+        AbstractCommitLogService.finishWriteFor).
+
+        batch mode elects a SYNC LEADER among the parked writers: the
+        first unsynced writer runs flush+fsync itself and releases
+        everyone its sync covered; writers arriving during that fsync
+        park, and one of them leads the next cycle back-to-back. A
+        dedicated syncer thread would add a thread handoff (and, in a
+        GIL runtime, a scheduling gap that measured LARGER than the
+        fsync itself) to every cycle. group mode keeps the syncer
+        thread: the window is a pacing decision, not a handoff."""
+        t0 = time.perf_counter()
+        lead_mode = self.sync_mode == "batch"
+        with self._sync_cond:
+            self._waiting += 1
+            fail0 = self._sync_failures
+        try:
+            while True:
+                lead = False
+                with self._sync_cond:
+                    if self._synced >= pos:
+                        return
+                    if self._sync_failures > fail0 \
+                            and self._sync_error is not None:
+                        # a sync attempted AFTER this append failed:
+                        # durability cannot be confirmed — fail the
+                        # write like the inline fsync would have.
+                        # (An error predating this writer is not fatal
+                        # on sight: this writer triggers a fresh sync,
+                        # which either succeeds — releasing it — or
+                        # fails anew and raises here.)
+                        raise self._sync_error
+                    if lead_mode and not self._leader_active:
+                        self._leader_active = True
+                        lead = True
+                    else:
+                        if not lead_mode:
+                            # group mode: the syncer paces the cycles.
+                            # (batch mode must NOT wake it — a leader
+                            # exists or will be elected next iteration,
+                            # and a parallel syncer cycle would double
+                            # the fsyncs group commit just coalesced.)
+                            self._sync_req.set()
+                        self._sync_cond.wait(0.05)
+                        if not lead_mode:
+                            # re-arm against a lost wakeup (the syncer
+                            # may have cleared the request while this
+                            # writer was between append and park)
+                            self._sync_req.set()
+                if lead:
+                    try:
+                        self._do_sync()
+                    except (OSError, ValueError) as e:
+                        self._record_sync_failure(e)
+                    finally:
+                        with self._sync_cond:
+                            self._leader_active = False
+                            self._sync_cond.notify_all()
+        finally:
+            with self._sync_cond:
+                self._waiting -= 1
+            self._wait_hist.update_us((time.perf_counter() - t0) * 1e6)
+
+    def sync(self) -> None:
+        """Flush + fsync everything appended so far (public surface for
+        close/tests; the syncer thread uses the same primitive)."""
+        self._do_sync()
+
+    def _do_sync(self) -> None:
+        """One coalesced sync cycle: flush the active segment's python
+        buffer under the lock, then fsync OUTSIDE it (appends to the
+        same — or the next — segment proceed during the device flush),
+        then release every writer parked at or before the synced
+        position. Retired segments sync first so the position order
+        (segment, offset) stays truthful. Cycles are serialized by
+        _sync_mutex: a concurrent cycle could close a just-retired file
+        this one captured for fsync."""
+        t0 = time.perf_counter()
+        with self._sync_mutex:
+            with self._lock:
+                retiring = self._retiring
+                self._retiring = []
+                f = self._file
+                target = None
+                if f is not None and not f.closed:
+                    f.flush()
+                    target = CommitLogPosition(self._seg_id, f.tell())
+            try:
+                while retiring:
+                    seg, rf = retiring[0]
+                    rf.flush()
+                    os.fsync(rf.fileno())
+                    # durable: safe to drop from the re-queue window
+                    # even if close/archive below has trouble
+                    retiring.pop(0)
+                    rf.close()
+                    if self.archive_dir:
+                        with self._lock:
+                            self._archive_q.append(seg)
+                        self._archive_ev.set()
+                if target is not None:
+                    os.fsync(f.fileno())
+            except BaseException:
+                # un-synced retired segments go BACK on the queue: a
+                # later successful cycle advancing _synced past their
+                # positions must not ack writers whose bytes were never
+                # fsynced (and the archiver claim must stay honorable)
+                with self._lock:
+                    self._retiring[:0] = retiring
+                raise
+        self._sync_hist.update_us((time.perf_counter() - t0) * 1e6)
+        self._last_sync = time.perf_counter()
+        if target is not None:
+            self._advance_synced(target)
+
+    def _record_sync_failure(self, exc: BaseException) -> None:
+        """Satellite fix: a failing sync used to kill the loop silently.
+        Count it (commitlog.sync_failures), log ONCE, propagate to
+        parked writers (their ack must not lie), keep the loop alive —
+        a transient EIO/ENOSPC must not permanently disable syncing."""
+        self._metrics.incr("commitlog.sync_failures")
+        if not self._failure_logged:
+            self._failure_logged = True
+            _log.warning("commitlog sync failed (%s); further failures "
+                         "are counted in commitlog.sync_failures", exc)
+        with self._sync_cond:
+            self._sync_failures += 1
+            self._sync_error = exc
+            self._sync_cond.notify_all()
 
     def _sync_loop(self) -> None:
-        while not self._stop.wait(self.sync_period_ms / 1000.0):
+        period = self.sync_period_ms / 1000.0
+        while True:
+            if self.sync_mode == "periodic":
+                if self._stop.wait(period):
+                    return
+            else:
+                self._sync_req.wait(timeout=0.2)
+                if self._stop.is_set():
+                    return
+                if not self._sync_req.is_set():
+                    continue
+                self._sync_req.clear()
+                if self.sync_mode == "group":
+                    # spacing, not latency-from-request: coalesce every
+                    # writer arriving inside the window since last sync
+                    rem = self.group_window_ms / 1000.0 \
+                        - (time.perf_counter() - self._last_sync)
+                    if rem > 0 and self._stop.wait(rem):
+                        return
             try:
-                self.sync()
-            except (OSError, ValueError):
-                return
+                self._do_sync()
+            except (OSError, ValueError) as e:
+                self._record_sync_failure(e)
 
     # -------------------------------------------------------------- replay
 
@@ -373,10 +646,60 @@ class CommitLog:
                     pass
                 self._dirty.pop(s, None)
 
+    def stats(self) -> dict:
+        """One consistent operator view (nodetool commitlogstats + the
+        system_views.commitlog status row)."""
+        with self._lock:
+            dirty = sorted(self._dirty)
+            retiring = len(self._retiring)
+            pos = CommitLogPosition(
+                self._seg_id,
+                self._file.tell() if self._file and not self._file.closed
+                else 0)
+        with self._sync_cond:
+            waiting = self._waiting
+            synced = self._synced
+        files = []
+        for seg in self.segment_ids():
+            p = self._seg_path(seg)
+            try:
+                files.append((os.path.basename(p), os.path.getsize(p)))
+            except OSError:
+                continue
+        return {
+            "sync_mode": self.sync_mode,
+            "segments": len(files),
+            "total_bytes": sum(sz for _fn, sz in files),
+            "files": files,
+            "active_segment": pos.segment_id,
+            "active_offset": pos.offset,
+            "oldest_dirty": dirty[0] if dirty else None,
+            "pending_syncs": waiting + retiring,
+            "synced_segment": synced.segment_id,
+            "synced_offset": synced.offset,
+            "sync_failures": self._sync_failures,
+        }
+
     def close(self) -> None:
         self._stop.set()
+        self._sync_req.set()
         if self._syncer:
             self._syncer.join(timeout=2)
+        # retired segments the syncer didn't get to: finish them inline
+        # (flush+fsync+close, then the PITR copy)
+        with self._lock:
+            retiring = self._retiring
+            self._retiring = []
+        for seg, rf in retiring:
+            try:
+                rf.flush()
+                os.fsync(rf.fileno())
+                rf.close()
+            except (OSError, ValueError):
+                pass
+            self._archive(seg)
+            with self._lock:
+                self._archiving.discard(seg)
         # drain pending async archives BEFORE the final archive so the
         # directory copy is complete when close() returns
         deadline = 50
@@ -388,6 +711,12 @@ class CommitLog:
             if self._file and not self._file.closed:
                 self._file.flush()
                 os.fsync(self._file.fileno())
+                final = CommitLogPosition(self._seg_id, self._file.tell())
                 self._file.close()
                 # a cleanly-closed active segment is archivable too
                 self._archive(self._seg_id)
+            else:
+                final = None
+        if final is not None:
+            # everything is durable: release any writer still parked
+            self._advance_synced(final)

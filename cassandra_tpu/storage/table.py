@@ -17,6 +17,7 @@ from ..schema import TableMetadata
 from ..utils import timeutil
 from .cellbatch import (FLAG_PARTITION_DEL, CellBatch, merge_sorted,
                         truncate_live_rows)
+from .commitlog import write_fastpath_enabled
 from .memtable import Memtable
 from .mutation import Mutation
 from .row_cache import RowCache
@@ -39,6 +40,78 @@ def _partition_deletion_ts(batch: CellBatch) -> int | None:
     if not mask.any():
         return None
     return int(batch.ts[mask].max())
+
+
+class WriteBarrier:
+    """The OpOrder role (utils/concurrent/OpOrder.java, used by the
+    reference's Flush at db/ColumnFamilyStore.java:1180-1240): writers
+    enter in SHARED mode — concurrently; the commitlog segment lock and
+    the memtable shard locks provide the fine-grained exclusion — while
+    the memtable switch enters EXCLUSIVE, so every write lands atomically
+    on one side of the flush point. Exclusive-preferring: a pending
+    switch blocks new shared entries, so flush cannot starve. NOT
+    reentrant in either mode."""
+
+    __slots__ = ("_cond", "_shared", "_excl", "_excl_waiting")
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._shared = 0
+        self._excl = False
+        self._excl_waiting = 0
+
+    def shared(self):
+        return _SharedEntry(self)
+
+    def exclusive(self):
+        return _ExclusiveEntry(self)
+
+
+class _SharedEntry:
+    __slots__ = ("_b",)
+
+    def __init__(self, b):
+        self._b = b
+
+    def __enter__(self):
+        b = self._b
+        with b._cond:
+            while b._excl or b._excl_waiting:
+                b._cond.wait()
+            b._shared += 1
+        return self
+
+    def __exit__(self, *exc):
+        b = self._b
+        with b._cond:
+            b._shared -= 1
+            if b._shared == 0:
+                b._cond.notify_all()
+
+
+class _ExclusiveEntry:
+    __slots__ = ("_b",)
+
+    def __init__(self, b):
+        self._b = b
+
+    def __enter__(self):
+        b = self._b
+        with b._cond:
+            b._excl_waiting += 1
+            try:
+                while b._excl or b._shared:
+                    b._cond.wait()
+            finally:
+                b._excl_waiting -= 1
+            b._excl = True
+        return self
+
+    def __exit__(self, *exc):
+        b = self._b
+        with b._cond:
+            b._excl = False
+            b._cond.notify_all()
 
 
 class Tracker:
@@ -84,8 +157,10 @@ class ColumnFamilyStore:
     DEFAULT_FLUSH_THRESHOLD = 64 * 1024 * 1024  # bytes of live memtable data
 
     def __init__(self, table: TableMetadata, data_dir: str,
-                 commitlog=None, flush_threshold: int | None = None):
+                 commitlog=None, flush_threshold: int | None = None,
+                 memtable_shards: int | None = None):
         self.table = table
+        self.memtable_shards = memtable_shards
         self.directory = os.path.join(
             data_dir, table.keyspace,
             f"{table.name}-{table.id.hex[:8]}")
@@ -93,9 +168,10 @@ class ColumnFamilyStore:
         self.commitlog = commitlog
         self.flush_threshold = flush_threshold or self.DEFAULT_FLUSH_THRESHOLD
         self.tracker = Tracker()
-        self.memtable = Memtable(table)
+        self.memtable = Memtable(table, shards=memtable_shards)
         self._flush_lock = threading.Lock()
-        self._switch_lock = threading.RLock()
+        # write barrier (OpOrder role): writers shared, switch exclusive
+        self._barrier = WriteBarrier()
         self.metrics = {"writes": 0, "reads": 0, "flushes": 0,
                         "bytes_flushed": 0}
         # per-table latency group (TableMetrics role): decaying
@@ -161,19 +237,49 @@ class ColumnFamilyStore:
     def apply(self, mutation: Mutation, commitlog=None,
               durable: bool = True) -> None:
         """Commitlog append + memtable put as one unit against a single
-        memtable epoch (Keyspace.applyInternal ordering). Holding the
-        switch lock across both makes every write either fully before a
-        flush's switch point (old memtable, CL position < flush position)
-        or fully after (new memtable, CL position >= flush position) —
-        the role of the reference's OpOrder write barrier
-        (db/ColumnFamilyStore.java:1180-1240)."""
-        with self._switch_lock:
+        memtable epoch (Keyspace.applyInternal ordering). The shared
+        side of the write barrier makes every write either fully before
+        a flush's switch point (old memtable, CL position < flush
+        position) or fully after (new memtable, CL position >= flush
+        position) — without serializing writers against each other.
+        The commitlog DURABILITY wait happens outside the barrier:
+        parked writers must not block the writers coalescing behind
+        them (that wait is the group-commit batch forming)."""
+        wait_for = None
+        with self._barrier.shared():
             if commitlog is not None and durable:
-                commitlog.add(mutation)
+                _pos, wait_for = commitlog.append(mutation)
             self.memtable.apply(mutation)
             self.metrics["writes"] += 1
+        # invalidate BEFORE the durability wait: the memtable already
+        # holds the cells, and a failed sync raising past a stale cache
+        # entry would leave cache-hit and memtable reads divergent
         if self.row_cache is not None:
             self.row_cache.invalidate(mutation.pk)
+        if wait_for is not None:
+            commitlog.await_durable(wait_for)
+
+    def apply_batch(self, mutations: list[Mutation], commitlog=None,
+                    durable: bool = True) -> None:
+        """Batched apply against ONE memtable epoch: the whole batch is
+        commitlog-appended under one lock acquisition + one durability
+        barrier (CommitLog.append_batch), then memtable-applied taking
+        each token shard's lock once (Memtable.apply_batch). Same
+        barrier atomicity as apply()."""
+        if not mutations:
+            return
+        wait_for = None
+        with self._barrier.shared():
+            if commitlog is not None and durable:
+                _poss, wait_for = commitlog.append_batch(mutations)
+            self.memtable.apply_batch(mutations)
+            self.metrics["writes"] += len(mutations)
+        # invalidation before the durability wait — see apply()
+        if self.row_cache is not None:
+            for pk in {m.pk for m in mutations}:
+                self.row_cache.invalidate(pk)
+        if wait_for is not None:
+            commitlog.await_durable(wait_for)
 
     def should_flush(self) -> bool:
         return self.memtable.live_bytes >= self.flush_threshold
@@ -182,23 +288,38 @@ class ColumnFamilyStore:
 
     def flush(self) -> SSTableReader | None:
         """Switch the memtable and write it out (ColumnFamilyStore.Flush).
-        Returns the new sstable reader (None if memtable was empty)."""
+        Returns the new sstable reader (None if memtable was empty).
+
+        Fast lane (CTPU_WRITE_FASTPATH): the retired memtable drains
+        SHARD BY SHARD — each shard's drain+sort (numpy, GIL-releasing)
+        overlaps the previous shard's compress (native packer) and the
+        one before that's disk write (the SSTableWriter's threaded-I/O
+        double buffer from the compaction pipeline) — a 3-stage flush
+        pipeline whose output is bit-identical to the serial
+        sort-everything-then-write path (shards are disjoint ascending
+        token ranges, so per-shard sorted runs concatenate in global
+        order; proven by scripts/check_writepath_ab.py)."""
         with self._flush_lock:
-            with self._switch_lock:
+            with self._barrier.exclusive():
                 old = self.memtable
                 if old.is_empty:
                     return None
                 flush_pos = self.commitlog.current_position() \
                     if self.commitlog else None
-                self.memtable = Memtable(self.table)
-            batch = old.flush_batch()
+                self.memtable = Memtable(self.table,
+                                     shards=self.memtable_shards)
+            fast = write_fastpath_enabled()
             gen = self.next_generation()
             desc = Descriptor(self.directory, gen)
             writer = SSTableWriter(
                 desc, self.table,
-                estimated_partitions=len(old._partitions))
+                estimated_partitions=old.partition_count(),
+                threaded_io=fast)
             try:
-                writer.append(batch)
+                if fast:
+                    self._append_pipelined(old, writer)
+                else:
+                    writer.append(old.flush_batch())
                 stats = writer.finish()
             except BaseException:
                 writer.abort()
@@ -220,6 +341,46 @@ class ColumnFamilyStore:
             if self.compaction_listener:
                 self.compaction_listener(self)
             return reader
+
+    @staticmethod
+    def _append_pipelined(old: Memtable, writer: SSTableWriter) -> None:
+        """Drain → compress → io as three overlapped stages: a drain
+        thread runs the memtable's shard sort generator into a bounded
+        queue (backpressure: two runs in flight), the flush thread packs
+        each run through the writer's native compressor, and the
+        writer's own I/O thread lands bytes on disk."""
+        import queue
+        q: queue.Queue = queue.Queue(maxsize=2)
+        err: list[BaseException] = []
+
+        def _drain():
+            try:
+                for run in old.flush_shards():
+                    q.put(run)
+            except BaseException as e:   # surfaced on the flush thread
+                err.append(e)
+            finally:
+                q.put(None)
+
+        t = threading.Thread(target=_drain, daemon=True,
+                             name="memtable-drain")
+        t.start()
+        done = False
+        try:
+            while True:
+                run = q.get()
+                if run is None:
+                    done = True
+                    break
+                writer.append(run)
+        finally:
+            # if append raised, the producer may be parked on a full
+            # queue: drain to its terminal None so join cannot hang
+            while not done:
+                done = q.get() is None
+            t.join()
+        if err:
+            raise err[0]
 
     def _backup_sstable(self, desc) -> None:
         """Hardlink a freshly-flushed sstable's components into
@@ -264,8 +425,7 @@ class ColumnFamilyStore:
         fast = read_fastpath_enabled()
         sources = []
         top_pd_ts = None
-        with self._switch_lock:
-            mem = self.memtable
+        mem = self.memtable
         m = mem.read_partition(pk)
         if m is not None:
             sources.append(m)
@@ -361,8 +521,7 @@ class ColumnFamilyStore:
         if self.row_cache is not None and pending:
             read_gen = self.row_cache.generation
         if pending:
-            with self._switch_lock:
-                mem = self.memtable
+            mem = self.memtable
             sources = {pk: [] for pk in pending}
             top_pd: dict[bytes, int] = {}
             consulted = {pk: 0 for pk in pending}
@@ -490,8 +649,9 @@ class ColumnFamilyStore:
     def truncate(self) -> None:
         if self.row_cache is not None:
             self.row_cache.clear()
-        with self._switch_lock:
-            self.memtable = Memtable(self.table)
+        with self._barrier.exclusive():
+            self.memtable = Memtable(self.table,
+                                     shards=self.memtable_shards)
             old = self.tracker.view()
             self.tracker.replace(old, [])
             from .chunk_cache import GLOBAL as chunk_cache

@@ -372,20 +372,30 @@ def build_engine_virtuals(engine) -> VirtualSchema:
         {"id": i, "message": w}
         for i, w in enumerate(engine.guardrails.warnings))))
 
-    # --- commitlog segments
+    # --- commitlog: a `<status>` summary row (segment count, oldest
+    # dirty segment, writers parked on the group-commit barrier, sync
+    # failures — CommitLogMetrics role) plus one row per segment file
     t_cl = make_table("system_views", "commitlog", pk=["name"],
-                      cols={"name": "text", "size_bytes": "bigint"})
+                      cols={"name": "text", "size_bytes": "bigint",
+                            "segments": "int", "oldest_dirty": "int",
+                            "pending_syncs": "int",
+                            "sync_failures": "bigint"})
 
     def cl_rows():
-        import os as _os
         cl = engine.commitlog
         if cl is None:
             return
-        d = cl.directory
-        for fn in sorted(_os.listdir(d)):
-            p = _os.path.join(d, fn)
-            if _os.path.isfile(p):
-                yield {"name": fn, "size_bytes": _os.path.getsize(p)}
+        st = cl.stats()
+        od = st["oldest_dirty"]
+        yield {"name": "<status>", "size_bytes": st["total_bytes"],
+               "segments": st["segments"],
+               "oldest_dirty": -1 if od is None else od,
+               "pending_syncs": st["pending_syncs"],
+               "sync_failures": st["sync_failures"]}
+        for fn, sz in st["files"]:
+            yield {"name": fn, "size_bytes": sz, "segments": 0,
+                   "oldest_dirty": -1, "pending_syncs": 0,
+                   "sync_failures": 0}
     vs.register(VirtualTable(t_cl, cl_rows))
 
     # --- batches on disk (batchlog backlog)

@@ -7,8 +7,8 @@ calls; a remote admin protocol can wrap these functions); `python -m
 cassandra_tpu.tools.nodetool <cmd> --data <dir>` drives a local engine.
 
 Implemented commands: status, info, flush, compact, compactionstats,
-tablestats, repair, cleanup, gettraces, exportmetrics, ring, and the
-breadth registry below (~120 commands).
+commitlogstats, tablestats, repair, cleanup, gettraces, exportmetrics,
+ring, and the breadth registry below (~120 commands).
 """
 from __future__ import annotations
 
@@ -115,6 +115,29 @@ def compactionstats(engine) -> dict:
         "throughput_mib_per_sec": cm.limiter.mib_per_s,
         "completed_tasks": len(cm.completed),
         "active_compactions": cm.active.snapshot(),
+    }
+
+
+def commitlogstats(engine) -> dict:
+    """nodetool commitlogstats: segment inventory + group-commit health
+    (the reference surfaces CommitLogMetrics — waitingOnCommit,
+    waitingOnSegmentAllocation, pending/completed tasks — via JMX; here
+    the same numbers come from CommitLog.stats() and the
+    commitlog.waiting_on_commit / commitlog.sync_latency histograms)."""
+    cl = engine.commitlog
+    if cl is None:
+        return {"enabled": False}
+    from ..service.metrics import GLOBAL
+    st = cl.stats()
+    st.pop("files", None)
+    return {
+        "enabled": True,
+        **st,
+        "group_window_ms": cl.group_window_ms,
+        "waiting_on_commit_us":
+            GLOBAL.hist("commitlog.waiting_on_commit").summary(),
+        "sync_latency_us":
+            GLOBAL.hist("commitlog.sync_latency").summary(),
     }
 
 
@@ -1465,7 +1488,8 @@ def import_sstables(engine, keyspace: str, table: str,
 for _name, _target in [
         ("status", "node"), ("info", "engine"), ("ring", "node"),
         ("flush", "engine"), ("compact", "engine"),
-        ("compactionstats", "engine"), ("tablestats", "engine"),
+        ("compactionstats", "engine"), ("commitlogstats", "engine"),
+        ("tablestats", "engine"),
         ("repair", "node"), ("cleanup", "node"),
         ("getendpoints", "node"), ("gossipinfo", "node"),
         ("version", "none"), ("describecluster", "node"),
