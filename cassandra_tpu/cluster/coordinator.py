@@ -37,34 +37,53 @@ class _Await:
     """Counts acks toward a blockFor target
     (AbstractWriteResponseHandler / ReadCallback role). With
     fail_fast_total set, the waiter wakes as soon as enough failures
-    make block_for unreachable instead of burning the full timeout."""
+    make block_for unreachable instead of burning the full timeout —
+    and add_target() RAISES the reachable total when a redundant
+    (speculative) request goes out, so an early failure wake does not
+    become a permanently latched false timeout once the spare could
+    still complete the round."""
 
     def __init__(self, block_for: int, fail_fast_total: int | None = None):
         self.block_for = block_for
         self.fail_fast_total = fail_fast_total
         self.responses: list = []
         self.failures = 0
-        self._ev = threading.Event()
-        self._lock = threading.Lock()
+        self._cond = threading.Condition()
 
     def ack(self, payload=None) -> None:
-        with self._lock:
+        with self._cond:
             self.responses.append(payload)
-            if len(self.responses) >= self.block_for:
-                self._ev.set()
+            self._cond.notify_all()
 
     def fail(self) -> None:
-        with self._lock:
+        with self._cond:
             self.failures += 1
-            if self.fail_fast_total is not None and \
-                    self.fail_fast_total - self.failures < self.block_for:
-                self._ev.set()
+            self._cond.notify_all()
+
+    def add_target(self, n: int = 1) -> None:
+        """A redundant request was issued: block_for is reachable again
+        even with the recorded failures."""
+        with self._cond:
+            if self.fail_fast_total is not None:
+                self.fail_fast_total += n
+                self._cond.notify_all()
+
+    def _woken_locked(self) -> bool:
+        if len(self.responses) >= self.block_for:
+            return True
+        return self.fail_fast_total is not None and \
+            self.fail_fast_total - self.failures < self.block_for
 
     def await_(self, timeout: float) -> bool:
         if self.block_for == 0:
             return True
-        self._ev.wait(timeout)
-        with self._lock:
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while not self._woken_locked():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
             return len(self.responses) >= self.block_for
 
 
@@ -359,7 +378,13 @@ class StorageProxy:
         truncated-by-limits flag (short-read protection input)."""
         ck_comp = self.node.schema.get_table(
             keyspace, table_name).clustering_comp
-        handler = _Await(len(data_targets) + len(digest_targets))
+        # fail-fast: a replica answering with an ERROR (corrupt sstable,
+        # stopped storage) wakes the wait immediately so the speculative
+        # retry below fails over to a spare instead of burning the full
+        # speculative delay / read timeout
+        handler = _Await(len(data_targets) + len(digest_targets),
+                         fail_fast_total=len(data_targets)
+                         + len(digest_targets))
         results: list = []
         digests: list = []
         lock = threading.Lock()
@@ -369,8 +394,19 @@ class StorageProxy:
         def send_to(target, digest_only):
             sent = time.monotonic()
             if target == self.node.endpoint:
-                batch = self.node.engine.store(
-                    keyspace, table_name).read_partition(pk)
+                try:
+                    batch = self.node.engine.store(
+                        keyspace, table_name).read_partition(pk)
+                except Exception:
+                    # a LOCAL replica read error (corrupt sstable under
+                    # ignore/stop, stopped storage) is a failed
+                    # RESPONSE, not a coordinator crash: count it so
+                    # the fail-fast wait fails over to another replica
+                    # — the same contract a remote FAILURE_RSP gets
+                    METRICS.incr("reads.local_read_failures")
+                    self._record_latency(target, self.read_timeout)
+                    handler.fail()
+                    return
                 batch, more = cb.truncate_live_rows(batch, limits)
                 with lock:
                     if digest_only:
@@ -412,7 +448,11 @@ class StorageProxy:
             GLOBAL.incr("reads.speculative_retries")
             tracing.trace(f"Speculative retry to {spares[0].name}")
             # a redundant data read: its full payload can substitute for
-            # a straggling digest (ack tallies are read-resolver inputs)
+            # a straggling digest (ack tallies are read-resolver inputs).
+            # Raise the reachable-total FIRST so an error-triggered
+            # fail-fast wake does not latch the final wait shut while
+            # the spare's response is in flight
+            handler.add_target()
             send_to(spares[0], False)
         # the read budget is self.read_timeout TOTAL, not per wait
         handler.await_(max(self.read_timeout - (time.monotonic() - t0), 0.0))

@@ -53,23 +53,37 @@ class HintsService:
 
     def dispatch(self, target: Endpoint, send_fn) -> int:
         """Replay hints for a recovered target through send_fn(mutation);
-        the file is removed once fully dispatched."""
+        the file is removed once fully dispatched.
+
+        A CRC-corrupt RECORD is skipped and replay continues with the
+        remainder (its length header still frames the stream; only the
+        payload is rotten) — one flipped bit must not drop every hint
+        queued behind it. Structural corruption (zero/overrunning
+        length) makes the rest of the stream unframeable: replay stops
+        there. Both count hints.corrupt_records."""
+        from ..service.metrics import GLOBAL
+        from ..utils import faultfs
         p = self._path(target)
         with self._lock:
             if not os.path.exists(p):
                 return 0
+            faultfs.check("hints.read", p)
             with open(p, "rb") as f:
                 data = f.read()
+            if faultfs.GLOBAL.active:
+                data = faultfs.GLOBAL.on_read("hints.read", p, data)
             n = 0
             pos = 0
             while pos + 8 <= len(data):
                 length, crc = struct.unpack_from("<II", data, pos)
                 if length == 0 or pos + 8 + length > len(data):
+                    GLOBAL.incr("hints.corrupt_records")
                     break
                 payload = data[pos + 8: pos + 8 + length]
                 pos += 8 + length
                 if zlib.crc32(payload) != crc:
-                    break
+                    GLOBAL.incr("hints.corrupt_records")
+                    continue
                 send_fn(Mutation.deserialize(payload))
                 n += 1
             os.remove(p)

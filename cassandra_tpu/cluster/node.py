@@ -39,6 +39,12 @@ class Node:
                                  interval=gossip_interval)
         self.gossiper.on_alive = self._on_peer_alive
         self.gossiper.on_dead = self._on_peer_dead
+        # disk/commit failure policy `stop`/`die`: the engine's failure
+        # handler calls back so the node leaves the ring the way the
+        # reference's StorageService.stopTransports does. on_stop only:
+        # the die path chains into _stop, so registering on both would
+        # run the transition (and push the DOWN event) twice
+        self.engine.failures.on_stop(self._on_storage_failure)
         # server-push event bus (transport EVENT role): CQL servers and
         # tests subscribe; liveness/topology/schema transitions fan out
         self._event_listeners: list = []
@@ -251,6 +257,24 @@ class Node:
         self.emit_event("STATUS_CHANGE", {"change": "DOWN",
                                           "host": ep.host,
                                           "port": ep.port})
+
+    def _on_storage_failure(self, err) -> None:
+        """A `stop`/`die` failure policy tripped: transition out of the
+        ring. Own gossip status flips to shutdown and the gossiper
+        stops speaking — peers convict via phi accrual exactly as they
+        would for a dead process (the reference stops gossip and the
+        client transports; the admin/CQL servers here check the same
+        failure gates on every request)."""
+        g = self.gossiper
+        with g._lock:
+            st = g.states.get(self.endpoint)
+            if st is not None:
+                st.app_states["status"] = "shutdown"
+                st.version += 1
+        g.stop()
+        self.emit_event("STATUS_CHANGE", {"change": "DOWN",
+                                          "host": self.endpoint.host,
+                                          "port": self.endpoint.port})
 
     def _hint_loop(self):
         while not self._stop_hints.wait(0.5):

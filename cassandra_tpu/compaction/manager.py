@@ -189,6 +189,7 @@ class CompactionManager:
         return stats
 
     def _maybe_compact(self, cfs, locked: bool = False) -> int:
+        from ..storage.sstable.reader import CorruptSSTableError
         n = 0
         lock = self.cfs_lock(cfs)
         if not locked:
@@ -199,7 +200,20 @@ class CompactionManager:
                 task = strategy.next_background_task()
                 if task is None:
                     break
-                stats = self._execute_task(cfs, task)
+                try:
+                    stats = self._execute_task(cfs, task)
+                except CorruptSSTableError:
+                    # the task aborted itself (txn rolled back) and —
+                    # under best_effort — quarantined the rotten input.
+                    # If the input left the live set, re-select: the
+                    # strategy re-plans without it. If it is still
+                    # live (policy ignore/stop/die), stop: re-selecting
+                    # would pick the same doomed inputs forever.
+                    live = {s.desc.generation for s in cfs.live_sstables()}
+                    if all(r.desc.generation in live for r in task.inputs):
+                        break
+                    strategy = get_strategy(cfs)
+                    continue
                 if stats is None:
                     break   # input claimed elsewhere: drop this
                     #         selection (a later flush re-enqueues)

@@ -248,6 +248,27 @@ class CompactionTask:
         # bench.py -- the breakdown the perf work navigates by)
         self.profile: dict = {}
 
+    def _handle_corrupt_input(self, exc: BaseException) -> None:
+        """Corruption surfacing mid-compaction aborts ONLY this task
+        (the lifecycle txn already rolled back); route the failing
+        input through the store's disk failure policy so best_effort
+        quarantines it and the strategy re-plans without it
+        (CompactionManager re-selects after the quarantine)."""
+        from ..storage.sstable.reader import CorruptSSTableError
+        if not isinstance(exc, CorruptSSTableError):
+            return
+        failures = getattr(self.cfs, "failures", None)
+        if failures is None:
+            return
+        bad = None
+        if exc.descriptor is not None:
+            bad = next((r for r in self.inputs
+                        if r.desc == exc.descriptor), None)
+        path = bad.desc.path("Data.db") if bad is not None else ""
+        policy = failures.handle_corruption(exc, path)
+        if policy == "best_effort" and bad is not None:
+            self.cfs.quarantine_sstable(bad, exc)
+
     def execute(self) -> dict:
         """Run the compaction; returns stats (reference logs these at
         CompactionTask.java:252-266)."""
@@ -457,7 +478,7 @@ class CompactionTask:
                 cfs.row_cache.clear()
             for r in self.inputs:
                 r.release()
-        except BaseException:
+        except BaseException as exc:
             pending.clear()
             if wthread is not None and wthread.is_alive():
                 # blocking put is safe: the consumer is either processing
@@ -473,6 +494,7 @@ class CompactionTask:
             for r in new_readers:
                 r.close()
             txn.abort()   # no-op if the COMMIT record already landed
+            self._handle_corrupt_input(exc)
             raise
 
         dt = time.time() - t0

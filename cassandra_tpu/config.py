@@ -178,6 +178,15 @@ class Config:
                                    mutable=True)
     cache_save_period: float = spec("duration", 14400.0, mutable=True)
 
+    # failure handling (cassandra.yaml disk_failure_policy /
+    # commit_failure_policy; storage/failures.py validates values and
+    # reacts to runtime changes). Defaults diverge from the reference's
+    # stop/stop deliberately: best_effort quarantines corrupt sstables
+    # and keeps serving, ignore preserves the pre-policy commitlog
+    # behavior — docs/fault-tolerance.md discusses the trade.
+    disk_failure_policy: str = mut("best_effort")
+    commit_failure_policy: str = mut("ignore")
+
     # security
     authenticator: str = "AllowAllAuthenticator"
     authorizer: str = "AllowAllAuthorizer"

@@ -149,6 +149,71 @@ class SimEvent:
         return self._sched.pump_until(self.is_set, deadline)
 
 
+class SimCondition:
+    """threading.Condition whose wait() pumps the scheduler (virtual
+    time). The underlying lock stays a REAL RLock — the single pumping
+    thread holds it re-entrancy-safely — and wait() releases it while
+    pumping so events fired by the pump (acks, failures) can take it
+    to notify."""
+
+    def __init__(self, sched: SimScheduler, lock=None):
+        self._sched = sched
+        self._lock = lock if lock is not None else _real_threading.RLock()
+        self._seq = 0   # bumped per notify; waiters watch for a change
+
+    def acquire(self, *a, **kw):
+        return self._lock.acquire(*a, **kw)
+
+    def release(self):
+        self._lock.release()
+
+    def __enter__(self):
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._lock.release()
+
+    def notify(self, n: int = 1) -> None:
+        self._seq += 1
+
+    def notify_all(self) -> None:
+        self._seq += 1
+
+    def wait(self, timeout: float | None = None) -> bool:
+        start = self._seq
+        if _real_threading.current_thread() is not self._sched.owner:
+            # foreign threads may not pump; poll in real time instead
+            deadline = _real_time.monotonic() + (timeout or 60.0)
+            self._lock.release()
+            try:
+                while self._seq == start and \
+                        _real_time.monotonic() < deadline:
+                    _real_time.sleep(0.01)
+            finally:
+                self._lock.acquire()
+            return self._seq != start
+        deadline = self._sched.now + (1e12 if timeout is None
+                                      else max(timeout, 0.0))
+        self._lock.release()
+        try:
+            return self._sched.pump_until(lambda: self._seq != start,
+                                          deadline)
+        finally:
+            self._lock.acquire()
+
+    def wait_for(self, predicate, timeout: float | None = None):
+        deadline = None if timeout is None else self._sched.now + timeout
+        result = predicate()
+        while not result:
+            if deadline is not None and self._sched.now >= deadline:
+                break
+            self.wait(None if deadline is None
+                      else deadline - self._sched.now)
+            result = predicate()
+        return result
+
+
 class SimThread:
     """threading.Thread stand-in: the target runs as ONE scheduled
     event on the pumping thread (it may itself block via SimEvent,
@@ -199,6 +264,9 @@ class _FakeThreading:
 
     def Event(self):
         return SimEvent(self._sched)
+
+    def Condition(self, lock=None):
+        return SimCondition(self._sched, lock)
 
     def Thread(self, target=None, args=(), kwargs=None, daemon=None,
                name=None):

@@ -84,7 +84,8 @@ class CommitLog:
                  sync_mode: str = "periodic", sync_period_ms: int = 1000,
                  archive_dir: str | None = None, encrypt: bool = False,
                  compression: str | None = None,
-                 group_window_ms: float = 10.0):
+                 group_window_ms: float = 10.0,
+                 failure_handler=None):
         """archive_dir: finished segments are copied there on rotation
         and at close (CommitLogArchiver role — the restore half is
         replay_archived / StorageEngine.restore_point_in_time).
@@ -92,8 +93,13 @@ class CommitLog:
         are keystream-XORed at their file offset
         (db/commitlog/EncryptedSegment.java role; CRCs cover ciphertext).
         group_window_ms: minimum spacing between fsyncs under
-        sync_mode='group' (commitlog_sync_group_window)."""
+        sync_mode='group' (commitlog_sync_group_window).
+        failure_handler: a storage.failures.FailureHandler — every sync
+        failure funnels into its commit_failure_policy (stop_commit
+        halts new writes while reads continue; ignore keeps the
+        count-and-propagate behavior)."""
         self.directory = directory
+        self._failure_handler = failure_handler
         self.segment_size = segment_size
         self.sync_mode = sync_mode
         self.sync_period_ms = sync_period_ms
@@ -417,6 +423,12 @@ class CommitLog:
         t0 = time.perf_counter()
         with self._sync_mutex:
             with self._lock:
+                from ..utils import faultfs
+                # commitlog.fsync fault checkpoint: an injected EIO here
+                # takes the same path a dying device would — caught by
+                # the sync loop / leader, counted, routed to the commit
+                # failure policy, propagated to parked writers
+                faultfs.check("commitlog.fsync", self.directory)
                 retiring = self._retiring
                 self._retiring = []
                 f = self._file
@@ -466,6 +478,11 @@ class CommitLog:
             self._sync_failures += 1
             self._sync_error = exc
             self._sync_cond.notify_all()
+        if self._failure_handler is not None:
+            # commit_failure_policy decision (stop_commit halts future
+            # writes at the engine gate; die/stop take the node out) —
+            # AFTER the parked writers were released with the error
+            self._failure_handler.handle_commit(exc)
 
     def _sync_loop(self) -> None:
         period = self.sync_period_ms / 1000.0

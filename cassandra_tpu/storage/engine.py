@@ -60,6 +60,12 @@ class StorageEngine:
             if existing is None:
                 enc_mod.set_context(enc_mod.EncryptionContext(keystore_dir))
             self.encryption_ctx = enc_mod.get_context()
+        # storage failure policies (FSErrorHandler/JVMStabilityInspector
+        # role; storage/failures.py): created BEFORE the commitlog and
+        # the stores so every disk/commit error from first open onward
+        # funnels into one policy decision
+        from .failures import FailureHandler
+        self.failures = FailureHandler(self.settings)
         from .cdc import CDCLog
         self.cdc = CDCLog(os.path.join(data_dir, "cdc_raw"))
         self.commitlog = CommitLog(
@@ -70,7 +76,8 @@ class StorageEngine:
             compression=commitlog_compression
             or (self.settings.get("commitlog_compression") or None),
             group_window_ms=self.settings.get(
-                "commitlog_sync_group_window") * 1000.0) \
+                "commitlog_sync_group_window") * 1000.0,
+            failure_handler=self.failures) \
             if durable_writes else None
         # nodetool enablebackup: flushed sstables hardlink into
         # <table>/backups/ (incremental_backups role). Set BEFORE any
@@ -231,7 +238,8 @@ class StorageEngine:
         cfs = ColumnFamilyStore(t, self.data_dir, self.commitlog,
                                 flush_threshold=self.flush_threshold,
                                 memtable_shards=self.settings.get(
-                                    "memtable_shards") or None)
+                                    "memtable_shards") or None,
+                                failures=self.failures)
         cfs.backup_enabled = lambda: self.incremental_backup
         self.compactions.register(cfs)
         self.stores[t.id] = cfs
@@ -266,6 +274,7 @@ class StorageEngine:
         """Keyspace.apply: commitlog first, then memtable (one atomic unit
         vs concurrent flushes); flush when the memtable crosses its
         threshold."""
+        self.failures.check_can_write()
         cfs = self.stores.get(mutation.table_id)
         if cfs is None:
             raise KeyError(f"unknown table id {mutation.table_id}")
@@ -304,6 +313,7 @@ class StorageEngine:
         (Memtable.apply_batch) instead of a full cycle per mutation."""
         if not mutations:
             return
+        self.failures.check_can_write()
         from ..service.metrics import GLOBAL, Timer
         from ..service.tracing import active, trace
         GLOBAL.incr("storage.writes", len(mutations))
@@ -409,6 +419,7 @@ class StorageEngine:
                                       self._rowcache_listener)
         self.settings.remove_listener("row_cache_size_mib",
                                       self._rowcache_listener)
+        self.failures.close()
         self.compactions.close()
         if self.commitlog:
             self.commitlog.close()

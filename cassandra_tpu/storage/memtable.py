@@ -151,6 +151,56 @@ class Memtable:
                 for m in by_shard[idx]:
                     self._apply_locked(sh, m)
 
+    @staticmethod
+    def _copy_rows(b: CellBatchBuilder, idxs, d: CellBatchBuilder) -> int:
+        """Append rows `idxs` of builder `b` into builder `d` (the same
+        row-copy _subset performs, but landing in another builder).
+        Returns the payload bytes copied. Caller holds both shard
+        locks."""
+        copied = 0
+        for i in idxs:
+            frame = bytes(b._payload[b._value_off[i]:b._value_off[i + 1]])
+            d._lanes.append(b._lanes[i])
+            d._ts.append(b._ts[i])
+            d._ldt.append(b._ldt[i])
+            d._ttl.append(b._ttl[i])
+            d._flags.append(b._flags[i])
+            d._val_start.append(len(d._payload)
+                                + (b._val_start[i] - b._value_off[i]))
+            d._payload += frame
+            d._value_off.append(len(d._payload))
+            copied += len(frame)
+        return copied
+
+    def absorb(self, other: "Memtable") -> None:
+        """Fold another memtable's buffered cells into this one — the
+        flush FAILURE path: when the sstable write dies (EIO), the
+        switched-out memtable is reinstated as active and the
+        replacement's writes (applied while the doomed flush ran) are
+        absorbed back so nothing acked is lost. Reconciliation is
+        timestamp-based, so append order does not change read results.
+        Caller must have quiesced writers on BOTH memtables (the
+        ColumnFamilyStore holds its write barrier exclusively)."""
+        for sh in other._shards:
+            with sh.lock:
+                b = sh.builder
+                if not len(b):
+                    continue
+                for key16, idxs in sh.partitions.items():
+                    pk = b.pk_map[key16]
+                    dst = self._shard_of(pk)
+                    with dst.lock:
+                        d = dst.builder
+                        start = len(d)
+                        nbytes = self._copy_rows(b, idxs, d)
+                        d._ck_fits = d._ck_fits and b._ck_fits
+                        d.pk_map[key16] = pk
+                        dst.partitions.setdefault(key16, []).extend(
+                            range(start, len(d)))
+                        dst.live_bytes += nbytes
+                        dst.ops += len(idxs)
+                        dst.version += 1
+
     # -------------------------------------------------------------- read --
 
     @staticmethod

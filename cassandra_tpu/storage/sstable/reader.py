@@ -19,6 +19,7 @@ import numpy as np
 
 from ...ops.codec import CompressionParams
 from ...utils import bloom as bloom_mod
+from ...utils import faultfs
 from ..cellbatch import CellBatch
 from .format import Component, Descriptor
 
@@ -26,7 +27,14 @@ _BIAS = 1 << 63
 
 
 class CorruptSSTableError(Exception):
-    pass
+    """Data on disk is wrong (CRC/length/directory mismatch), not just
+    unreachable. Carries the owning descriptor so the quarantine path
+    can identify WHICH sstable failed inside a multi-input operation
+    (compaction, batched read)."""
+
+    def __init__(self, msg: str = "", descriptor: Descriptor | None = None):
+        super().__init__(msg)
+        self.descriptor = descriptor
 
 
 class SSTableReader:
@@ -36,10 +44,37 @@ class SSTableReader:
         # decoded here carry it as ck_comp when the table is known
         self._table = table
         self.desc = descriptor
+        try:
+            self._open(descriptor)
+        except (CorruptSSTableError, OSError):
+            raise
+        except Exception as e:
+            # a malformed component (truncated stats JSON, garbage index
+            # bytes landing as struct/numpy/key errors) is CORRUPTION,
+            # not a programming error — type it so the failure policy
+            # layer can quarantine instead of crashing store open
+            from .. import encryption as enc_mod
+            if isinstance(e, enc_mod.EncryptionError):
+                raise   # missing keys are a config problem, not rot
+            raise CorruptSSTableError(
+                f"{descriptor}: unreadable component "
+                f"({type(e).__name__}: {e})", descriptor=descriptor) from e
+
+    def _read_component(self, comp: str) -> bytes:
+        """Component bytes through the sstable.open fault checkpoint."""
+        path = self.desc.path(comp)
+        if faultfs.GLOBAL.active:
+            faultfs.GLOBAL.check("sstable.open", path)
+            with open(path, "rb") as f:
+                return faultfs.GLOBAL.on_read("sstable.open", path,
+                                              f.read())
+        with open(path, "rb") as f:
+            return f.read()
+
+    def _open(self, descriptor: Descriptor) -> None:
         # "cc"+ stores the LANES block byte-plane shuffled (format.py)
         self._shuffled_lanes = descriptor.version >= "cc"
-        with open(descriptor.path(Component.STATS)) as f:
-            self.stats = json.load(f)
+        self.stats = json.loads(self._read_component(Component.STATS))
         self.K = int(self.stats["n_lanes"])
         self.n_cells = int(self.stats["n_cells"])
         self.params = CompressionParams.from_dict(self.stats["compression"])
@@ -64,12 +99,12 @@ class SSTableReader:
                           for c, n in env["nonces"].items()})
 
         # index: fixed-width entries
-        with open(descriptor.path(Component.INDEX), "rb") as f:
-            raw = f.read()
-        raw = self._decrypt_component(Component.INDEX, raw)
+        raw = self._decrypt_component(Component.INDEX,
+                                      self._read_component(Component.INDEX))
         n_seg, k, seg_cells = struct.unpack_from("<III", raw, 0)
         if k != self.K:
-            raise CorruptSSTableError("index/stats lane mismatch")
+            raise CorruptSSTableError("index/stats lane mismatch",
+                                      descriptor=descriptor)
         self.segment_cells = seg_cells
         entry_sz = 12 + 3 * 20 + 2 * 4 * self.K
         self.n_segments = n_seg
@@ -98,9 +133,8 @@ class SSTableReader:
         np.cumsum(self._seg_n, out=self._seg_cell0[1:])
 
         # partition directory
-        with open(descriptor.path(Component.PARTITIONS), "rb") as f:
-            praw = f.read()
-        praw = self._decrypt_component(Component.PARTITIONS, praw)
+        praw = self._decrypt_component(
+            Component.PARTITIONS, self._read_component(Component.PARTITIONS))
         (n_part,) = struct.unpack_from("<I", praw, 0)
         self.n_partitions = n_part
         o = 4
@@ -115,9 +149,12 @@ class SSTableReader:
         self._pk_blob = praw[o:]
         self._pk_off = pk_off
 
-        with open(descriptor.path(Component.FILTER), "rb") as f:
-            self.bloom = bloom_mod.BloomFilter.deserialize(f.read())
+        self.bloom = bloom_mod.BloomFilter.deserialize(
+            self._read_component(Component.FILTER))
 
+        if faultfs.GLOBAL.active:
+            faultfs.GLOBAL.check("sstable.open",
+                                 descriptor.path(Component.DATA))
         self._data = open(descriptor.path(Component.DATA), "rb")
         self.data_size = os.fstat(self._data.fileno()).st_size
         self.size_bytes = sum(
@@ -249,7 +286,7 @@ class SSTableReader:
             # never let a corrupt/crafted index walk past the allocation
             raise CorruptSSTableError(
                 f"{self.desc}: segment {i} lanes length {uls[1]} != "
-                f"{4 * n * self.K}")
+                f"{4 * n * self.K}", descriptor=self.desc)
         if self._shuffled_lanes:
             # stored lanes are byte planes; decode lands in scratch and
             # is unshuffled into the row-major array afterwards
@@ -277,13 +314,21 @@ class SSTableReader:
                 for v in iovs:
                     v[:] = src[o:o + v.nbytes]
                     o += v.nbytes
+        if faultfs.GLOBAL.active:
+            # the sstable.read fault checkpoint: lands EXACTLY where a
+            # bad device would — after the pread, before integrity
+            # checks (so a flipped bit must be CAUGHT by the CRCs)
+            got = faultfs.GLOBAL.on_pread(
+                "sstable.read", self.desc.path(Component.DATA), iovs, got)
         if got != sum(cls):
             raise CorruptSSTableError(
-                f"{self.desc}: segment {i} short read ({got}/{sum(cls)})")
+                f"{self.desc}: segment {i} short read ({got}/{sum(cls)})",
+                descriptor=self.desc)
         for b in range(3):
             if zlib.crc32(iovs[b]) != crcs[b]:
                 raise CorruptSSTableError(
-                    f"{self.desc}: segment {i} block {b} CRC mismatch")
+                    f"{self.desc}: segment {i} block {b} CRC mismatch",
+                    descriptor=self.desc)
         if self._enc is not None:
             # CRCs cover the ciphertext; decrypt each block in place at
             # its file offset before decompression
@@ -318,7 +363,7 @@ class SSTableReader:
             if uls[0] != 25 * n:
                 raise CorruptSSTableError(
                     f"{self.desc}: segment {i} meta length {uls[0]} "
-                    f"!= {25 * n}")
+                    f"!= {25 * n}", descriptor=self.desc)
             frame_len = meta[o:o + 4 * n].view("<u4")
             o += 4 * n
             val_rel = meta[o:o + 4 * n].view("<u4")
@@ -409,7 +454,8 @@ class SSTableReader:
                 hi = mid
         if lo < self.n_partitions and tuple(int(x) for x in view[lo]) == target:
             if self.partition_key_at(lo) != pk:
-                raise CorruptSSTableError("partition key hash collision")
+                raise CorruptSSTableError("partition key hash collision",
+                                          descriptor=self.desc)
             key_cache.put(ck, (lo,))
             return lo
         return None
@@ -461,7 +507,8 @@ class SSTableReader:
             if j < hi and int(dir_lo[j]) == int(t_lo[i]):
                 if self.partition_key_at(j) != pk:
                     raise CorruptSSTableError(
-                        "partition key hash collision")
+                        "partition key hash collision",
+                        descriptor=self.desc)
                 out.append(j)
             else:
                 out.append(None)

@@ -23,7 +23,7 @@ import numpy as np
 
 from ...ops.codec import CompressionParams, SegmentPacker, lanes_shuffle
 from ...schema import TableMetadata
-from ...utils import bloom
+from ...utils import bloom, faultfs
 from ..cellbatch import CellBatch
 from .format import SEGMENT_CELLS, Component, Descriptor
 
@@ -114,6 +114,7 @@ class SSTableWriter:
 
         os.makedirs(descriptor.directory, exist_ok=True)
         data_path = descriptor.tmp_path(Component.DATA)
+        self._data_path = data_path   # flush.write fault checkpoint id
         self._direct = True
         try:
             self._data_fd = os.open(
@@ -378,6 +379,14 @@ class SSTableWriter:
             raise self._io_error[0]
 
     def _write_sync(self, mv: memoryview) -> None:
+        fault_after = None
+        if faultfs.GLOBAL.active:
+            # flush.write checkpoint: error mode raises here (nothing
+            # lands), torn_write persists a prefix then raises from the
+            # tail of this call, bitflip corrupts the bytes in flight —
+            # the reader-side CRCs must catch it
+            mv, fault_after = faultfs.GLOBAL.on_write(
+                "flush.write", self._data_path, mv)
         total = mv.nbytes
         self._ensure_alloc(self._written_off + total)
         self._written_off += total
@@ -393,6 +402,8 @@ class SSTableWriter:
                 mv = mv[take:]
                 if self._bounce_fill == self.BOUNCE_BYTES:
                     self._flush_bounce()
+            if fault_after is not None:
+                raise fault_after
             return
         # buffered fallback: raw FileIO.write may write short (and caps
         # single writes around 2 GiB on Linux) — loop until all lands
@@ -401,6 +412,8 @@ class SSTableWriter:
             if n is None or n <= 0:
                 raise OSError("short write to Data.db")
             mv = mv[n:]
+        if fault_after is not None:
+            raise fault_after
         self._bytes_since_sync += total
         if self._bytes_since_sync >= self.TRICKLE_FSYNC_BYTES:
             self._bytes_since_sync = 0

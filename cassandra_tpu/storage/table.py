@@ -18,10 +18,13 @@ from ..utils import timeutil
 from .cellbatch import (FLAG_PARTITION_DEL, CellBatch, merge_sorted,
                         truncate_live_rows)
 from .commitlog import write_fastpath_enabled
+from .failures import (FailureHandler, list_quarantined,
+                       quarantine_descriptor_files)
 from .memtable import Memtable
 from .mutation import Mutation
 from .row_cache import RowCache
 from .sstable import Descriptor, SSTableReader, SSTableWriter
+from .sstable.reader import CorruptSSTableError
 
 
 def read_fastpath_enabled() -> bool:
@@ -158,8 +161,13 @@ class ColumnFamilyStore:
 
     def __init__(self, table: TableMetadata, data_dir: str,
                  commitlog=None, flush_threshold: int | None = None,
-                 memtable_shards: int | None = None):
+                 memtable_shards: int | None = None,
+                 failures: FailureHandler | None = None):
         self.table = table
+        # disk/commit failure policy decisions (FSErrorHandler role);
+        # engine-scoped when opened by a StorageEngine, a private
+        # best_effort/ignore default for standalone stores
+        self.failures = failures or FailureHandler()
         self.memtable_shards = memtable_shards
         self.directory = os.path.join(
             data_dir, table.keyspace,
@@ -189,10 +197,25 @@ class ColumnFamilyStore:
         # timestamp-skip collation is actually skipping
         self.sstables_per_read = self.latency.hist("sstables_per_read")
         self.multiread_hist = self.latency.hist("multiread_latency")
+        # corrupt-sstable quarantine (the reference's markSuspect +
+        # JVMStabilityInspector routing): records survive restarts via
+        # the on-disk quarantine/ directory
+        self._quarantine_lock = threading.Lock()
+        self.quarantined: list[dict] = list_quarantined(self.directory)
         from .lifecycle import replay_directory
         replay_directory(self.directory)
         for desc in Descriptor.list_in(self.directory):
-            self.tracker.add(SSTableReader(desc, self.table))
+            try:
+                self.tracker.add(SSTableReader(desc, self.table))
+            except (CorruptSSTableError, OSError) as e:
+                # a corrupt sstable must not abort store OPEN: route the
+                # error through the policy; best_effort quarantines the
+                # files and the store comes up without them
+                policy = self.failures.handle(e, desc.path("Data.db"))
+                if policy == "best_effort":
+                    self._quarantine_descriptor(desc, e)
+                else:
+                    raise
         self.compaction_listener = None  # set by CompactionManager
         self.compaction_history: list[dict] = []
         # the row-cache store key is the data directory: unique per
@@ -205,8 +228,13 @@ class ColumnFamilyStore:
             # this directory predate whatever happened to it since
             self.row_cache.clear()
         self._gen_lock = threading.Lock()
+        # quarantined generations count too: their files left the live
+        # directory, and a restart re-minting one of their numbers
+        # would make the quarantine records misreport the new sstable
+        # (and its dedupe block a future quarantine of it)
         self._last_gen = max(
-            [d.generation for d in Descriptor.list_in(self.directory)],
+            [d.generation for d in Descriptor.list_in(self.directory)]
+            + [q["generation"] for q in self.quarantined],
             default=0)
 
     def reload_sstables(self) -> None:
@@ -231,6 +259,57 @@ class ColumnFamilyStore:
             self._last_gen = max(self._last_gen + 1,
                                  Descriptor.next_generation(self.directory))
             return self._last_gen
+
+    # --------------------------------------------------------- quarantine --
+
+    def _quarantine_descriptor(self, desc, err) -> dict | None:
+        """Move one generation's files into quarantine/ and record it.
+        Idempotent per generation (concurrent readers hitting the same
+        rot race to a single quarantine)."""
+        with self._quarantine_lock:
+            if any(q["generation"] == desc.generation
+                   for q in self.quarantined):
+                return None
+            entry = quarantine_descriptor_files(desc, reason=repr(err))
+            self.quarantined.append(entry)
+        return entry
+
+    def quarantine_sstable(self, sst: SSTableReader, err) -> dict | None:
+        """Blacklist a corrupt sstable out of the live view: snapshot
+        its components into quarantine/ for forensics, drop it from the
+        tracker (reads, compaction candidate selection, streaming and
+        snapshots all plan from the tracker), and invalidate every
+        cache that could still serve its bytes. In-flight reads holding
+        the reader finish safely on its open fd (release, not close)."""
+        entry = self._quarantine_descriptor(sst.desc, err)
+        if entry is None:
+            return None
+        self.tracker.replace([sst], [])
+        sst.release()
+        from .chunk_cache import GLOBAL as chunk_cache
+        from .key_cache import GLOBAL as key_cache
+        chunk_cache.invalidate_generation(sst.desc.directory,
+                                          sst.desc.generation)
+        key_cache.invalidate_generation(sst.desc.directory,
+                                        sst.desc.generation)
+        if self.row_cache is not None:
+            # cached merges were computed over a source set that
+            # included the quarantined sstable
+            self.row_cache.clear()
+        return entry
+
+    def _degrade_on_corruption(self, sst: SSTableReader,
+                               err: BaseException) -> None:
+        """One sstable failed mid-read. Route through the disk failure
+        policy: best_effort quarantines it and RETURNS so the read
+        re-serves from the remaining sources; every other policy
+        re-raises (ignore = let the request fail; stop/die have already
+        taken the node out of service via the handler)."""
+        path = sst.desc.path("Data.db")
+        policy = self.failures.handle(err, path)
+        if policy != "best_effort":
+            raise err
+        self.quarantine_sstable(sst, err)
 
     # ------------------------------------------------------------- write --
 
@@ -321,10 +400,25 @@ class ColumnFamilyStore:
                 else:
                     writer.append(old.flush_batch())
                 stats = writer.finish()
-            except BaseException:
+                # the read-back is part of the flush: a failure HERE
+                # (EIO/corruption re-opening the just-written sstable)
+                # must restore the memtable too, or acked writes vanish
+                # from reads. abort() after finish() is a no-op on the
+                # renamed components — the orphan sstable reconciles
+                # away (or quarantines) at the next store open.
+                reader = SSTableReader(desc, self.table)
+            except BaseException as e:
                 writer.abort()
+                # a failed flush must not LOSE the memtable: reinstate
+                # the retired one as active (absorbing whatever landed
+                # in its replacement while the doomed write ran) so the
+                # data stays readable and a later flush can retry; the
+                # commitlog segments stay dirty (no discard_completed)
+                self._restore_memtable(old)
+                if isinstance(e, (OSError, CorruptSSTableError)):
+                    self.failures.handle(
+                        e, getattr(writer, "_data_path", ""))
                 raise
-            reader = SSTableReader(desc, self.table)
             self.tracker.add(reader)
             if self.row_cache is not None:
                 # sstable-set change: cached merges must never outlive
@@ -341,6 +435,17 @@ class ColumnFamilyStore:
             if self.compaction_listener:
                 self.compaction_listener(self)
             return reader
+
+    def _restore_memtable(self, old: Memtable) -> None:
+        """Flush-failure recovery: swap the retired memtable back in
+        under the exclusive barrier (writers quiesced) after absorbing
+        the replacement's writes, so every acked write is still served
+        from memory and the next flush retries the whole set."""
+        with self._barrier.exclusive():
+            current = self.memtable
+            if not current.is_empty:
+                old.absorb(current)
+            self.memtable = old
 
     @staticmethod
     def _append_pipelined(old: Memtable, writer: SSTableWriter) -> None:
@@ -441,7 +546,15 @@ class ColumnFamilyStore:
             if not sst.might_contain(pk):
                 continue
             consulted += 1
-            part = sst.read_partition(pk)
+            try:
+                part = sst.read_partition(pk)
+            except (CorruptSSTableError, OSError) as e:
+                # graceful degradation: under best_effort the corrupt
+                # source is quarantined and the merge continues over
+                # the remaining sstables (obsolete data possible at
+                # CL.ONE — reference best_effort semantics)
+                self._degrade_on_corruption(sst, e)
+                continue
             if part is not None:
                 sources.append(part)
                 t = _partition_deletion_ts(part)
@@ -457,6 +570,7 @@ class ColumnFamilyStore:
         the limit-th live row — the full merge still happens (and still
         feeds the row cache); truncation spares downstream assembly and,
         replica-side, the wire."""
+        self.failures.check_can_read()
         self.metrics["reads"] += 1
         _t0 = time.perf_counter()
         from ..service.tracing import active, trace
@@ -501,6 +615,7 @@ class ColumnFamilyStore:
         collation applies per key, exactly as in read_partition. Returns
         [(pk, merged batch)] in input order; duplicate keys share one
         merge. Falls back to per-key reads when the fastpath is off."""
+        self.failures.check_can_read()
         if not read_fastpath_enabled():
             return [(pk, self.read_partition(pk, now=now, limits=limits))
                     for pk in pks]
@@ -541,7 +656,12 @@ class ColumnFamilyStore:
                               or sst.max_ts >= top_pd[pk]]
                 if not active_pks:
                     break
-                parts, passed = sst.read_partitions_batch(active_pks)
+                try:
+                    parts, passed = sst.read_partitions_batch(active_pks)
+                except (CorruptSSTableError, OSError) as e:
+                    # same degradation contract as the single-key path
+                    self._degrade_on_corruption(sst, e)
+                    continue
                 for pk in passed:
                     consulted[pk] += 1
                 for pk, part in parts.items():
@@ -571,6 +691,7 @@ class ColumnFamilyStore:
 
     def scan_all(self, now: int | None = None) -> CellBatch:
         """Full-table merged view (range-read building block; small data)."""
+        self.failures.check_can_read()
         now = now if now is not None else timeutil.now_seconds()
         sources = [self.memtable.scan()]
         for sst in self.tracker.view():
@@ -587,10 +708,17 @@ class ColumnFamilyStore:
         """Merged view of partitions with token in (lo, hi] — the bounded
         range-read primitive behind paging (service/pager/QueryPagers
         role: read a window, not the table)."""
+        self.failures.check_can_read()
         now = now if now is not None else timeutil.now_seconds()
         sources = [self.memtable.scan_window(lo, hi)]
         for sst in self.tracker.view():
-            w = sst.scan_tokens(lo, hi)
+            try:
+                w = sst.scan_tokens(lo, hi)
+            except (CorruptSSTableError, OSError) as e:
+                # range reads degrade like point reads (best_effort
+                # quarantines the rotten source and the scan continues)
+                self._degrade_on_corruption(sst, e)
+                continue
             if w is not None and len(w):
                 sources.append(w)
         sources = [s for s in sources if len(s)]

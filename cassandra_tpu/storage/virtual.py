@@ -120,6 +120,29 @@ def build_engine_virtuals(engine) -> VirtualSchema:
                                    else s["eta_seconds"])}
     vs.register(VirtualTable(t_cip, cip_rows))
 
+    # --- quarantined_sstables (storage/failures.py quarantine records):
+    # corrupt sstables blacklisted out of the live set, with the error
+    # that condemned them and where their components went
+    t_quar = make_table(
+        "system_views", "quarantined_sstables", pk=["keyspace_name"],
+        ck=["table_name", "generation"],
+        cols={"keyspace_name": "text", "table_name": "text",
+              "generation": "int", "reason": "text",
+              "quarantined_at": "bigint", "size_bytes": "bigint",
+              "path": "text"})
+
+    def quarantine_rows():
+        for cfs in engine.stores.values():
+            for q in list(getattr(cfs, "quarantined", [])):
+                yield {"keyspace_name": cfs.table.keyspace,
+                       "table_name": cfs.table.name,
+                       "generation": q["generation"],
+                       "reason": q.get("reason", "")[:200],
+                       "quarantined_at": int(q.get("at", 0) * 1000),
+                       "size_bytes": q.get("bytes", 0),
+                       "path": q.get("path", "")}
+    vs.register(VirtualTable(t_quar, quarantine_rows))
+
     t_metrics = make_table("system_views", "metrics", pk=["name"],
                            cols={"name": "text", "value": "double"})
 

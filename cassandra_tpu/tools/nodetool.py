@@ -442,11 +442,22 @@ def clearsnapshot(engine, tag: str | None = None) -> int:
 
 
 def scrub(engine, keyspace: str | None = None,
-          table: str | None = None) -> list[dict]:
+          table: str | None = None, snapshot_before: bool = True,
+          quarantine: bool = False) -> list[dict]:
     """nodetool scrub: rewrite each sstable keeping every readable
     segment, dropping corrupt ones (io/sstable/format/
     SortedTableScrubber role). The unreadable cells are gone either way;
-    scrub turns a read-aborting sstable into a clean one."""
+    scrub turns a read-aborting sstable into a clean one.
+
+    snapshot_before: hardlink the whole live set into a
+    `pre-scrub-<ts>` snapshot first (the reference's
+    snapshot-before-scrub — scrub is lossy by design, so the originals
+    stay recoverable). quarantine: an sstable too rotten to rewrite at
+    all (index/open-level corruption, I/O errors) moves into the
+    quarantine set instead of staying live and aborting the scrub."""
+    import time as _time
+
+    from ..storage import snapshot as snap
     from ..storage.rewrite import rewrite_sstable
     from ..storage.sstable.reader import CorruptSSTableError
     out = []
@@ -456,6 +467,10 @@ def scrub(engine, keyspace: str | None = None,
         if table and cfs.table.name != table:
             continue
         with engine.compactions.cfs_lock(cfs):
+            tag = None
+            if snapshot_before and cfs.live_sstables():
+                tag = f"pre-scrub-{int(_time.time() * 1000)}"
+                snap.snapshot(cfs, tag)
             for sst in list(cfs.live_sstables()):
                 counts = {"kept": 0, "dropped": 0}
 
@@ -469,12 +484,24 @@ def scrub(engine, keyspace: str | None = None,
                         w.append(seg)
                         counts["kept"] += 1
 
-                rewrite_sstable(cfs, sst,
-                                [(sst.repaired_at, sst.level, fill)])
+                try:
+                    rewrite_sstable(cfs, sst,
+                                    [(sst.repaired_at, sst.level, fill)])
+                except (CorruptSSTableError, OSError) as e:
+                    if not quarantine:
+                        raise
+                    cfs.failures.handle(e, sst.desc.path("Data.db"))
+                    cfs.quarantine_sstable(sst, e)
+                    out.append({"table": cfs.table.full_name(),
+                                "generation": sst.desc.generation,
+                                "quarantined": True, "error": str(e),
+                                "snapshot": tag})
+                    continue
                 out.append({"table": cfs.table.full_name(),
                             "generation": sst.desc.generation,
                             "segments_kept": counts["kept"],
-                            "segments_dropped": counts["dropped"]})
+                            "segments_dropped": counts["dropped"],
+                            "snapshot": tag})
     return out
 
 
@@ -743,8 +770,13 @@ def getsstables(engine, keyspace: str, table: str, key: str) -> list[str]:
 
 
 def verify(engine, keyspace: str | None = None,
-           table: str | None = None) -> list[dict]:
-    """nodetool verify: recheck each sstable's digest against its data."""
+           table: str | None = None,
+           quarantine: bool = False) -> list[dict]:
+    """nodetool verify: recheck each sstable's digest against its data.
+    quarantine=True hands every failing sstable to the quarantine set
+    (the --quarantine handoff: a failed verify must not leave a known-
+    corrupt file live)."""
+    from ..storage.sstable.reader import CorruptSSTableError
     out = []
     for cfs in list(engine.stores.values()):
         t = cfs.table
@@ -752,17 +784,23 @@ def verify(engine, keyspace: str | None = None,
             continue
         if table and t.name != table:
             continue
-        for sst in cfs.live_sstables():
+        for sst in list(cfs.live_sstables()):
+            entry = {"sstable": sst.desc.generation,
+                     "table": t.full_name()}
             try:
                 ok = sst.verify_digest()
             except Exception as e:
                 ok = False
-                out.append({"sstable": sst.desc.generation,
-                            "table": t.full_name(), "ok": False,
-                            "error": str(e)})
-                continue
-            out.append({"sstable": sst.desc.generation,
-                        "table": t.full_name(), "ok": bool(ok)})
+                entry["error"] = str(e)
+            entry["ok"] = bool(ok)
+            if not ok and quarantine:
+                err = CorruptSSTableError(
+                    f"{sst.desc}: verify failed", descriptor=sst.desc)
+                cfs.failures.handle_corruption(
+                    err, sst.desc.path("Data.db"))
+                cfs.quarantine_sstable(sst, err)
+                entry["quarantined"] = True
+            out.append(entry)
     return out
 
 
@@ -774,6 +812,25 @@ def assassinate(node, endpoint: str) -> dict:
             node.gossiper.force_convict(ep)
             return {"assassinated": endpoint}
     raise ValueError(f"unknown endpoint {endpoint!r}")
+
+
+def listquarantine(engine, keyspace: str | None = None,
+                   table: str | None = None) -> list[dict]:
+    """nodetool listquarantine: corrupt sstables blacklisted out of the
+    live set (the quarantined_sstables vtable's data, per table)."""
+    out = []
+    for cfs in engine.stores.values():
+        if keyspace and cfs.table.keyspace != keyspace:
+            continue
+        if table and cfs.table.name != table:
+            continue
+        for q in list(getattr(cfs, "quarantined", [])):
+            out.append({"table": cfs.table.full_name(),
+                        "generation": q["generation"],
+                        "reason": q.get("reason", ""),
+                        "bytes": q.get("bytes", 0),
+                        "path": q.get("path", "")})
+    return out
 
 
 def listpendinghints(node) -> list[dict]:
@@ -1521,6 +1578,7 @@ for _name, _target in [
         ("invalidatechunkcache", "engine"),
         ("invalidatecountercache", "node"),
         ("getsstables", "engine"), ("verify", "engine"),
+        ("listquarantine", "engine"),
         ("assassinate", "node"), ("listpendinghints", "node"),
         ("getlogginglevels", "none"), ("setlogginglevel", "none"),
         ("updatecidrgroup", "engine"), ("dropcidrgroup", "engine"),

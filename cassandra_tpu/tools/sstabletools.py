@@ -105,26 +105,38 @@ def metadata(engine_dir: str, keyspace: str, table: str,
 
 
 def verify(engine_dir: str, keyspace: str, table: str,
-           generation: int | None = None) -> list[dict]:
-    """sstableverify: full-file digest check + segment CRC walk."""
+           generation: int | None = None,
+           quarantine: bool = False) -> list[dict]:
+    """sstableverify: full-file digest check + segment CRC walk.
+    quarantine=True moves every failing sstable's components into the
+    table directory's quarantine/ set (storage/failures.py layout) so a
+    failed verify never leaves a known-corrupt file live for the next
+    engine open to trip over."""
+    from ..storage.failures import quarantine_descriptor_files
     from ..storage.sstable import SSTableReader
     from ..storage.sstable.reader import CorruptSSTableError
     out = []
     for desc in _descriptors(engine_dir, keyspace, table):
         if generation is not None and desc.generation != generation:
             continue
-        r = SSTableReader(desc)
         status = "ok"
         try:
-            if not r.verify_digest():
-                status = "digest mismatch"
-            else:
-                for _ in r.scanner():   # decodes every segment, CRC-checked
-                    pass
-        except CorruptSSTableError as e:
+            r = SSTableReader(desc)
+            try:
+                if not r.verify_digest():
+                    status = "digest mismatch"
+                else:
+                    for _ in r.scanner():   # every segment, CRC-checked
+                        pass
+            finally:
+                r.close()
+        except (CorruptSSTableError, OSError) as e:
             status = f"corrupt: {e}"
-        out.append({"generation": desc.generation, "status": status})
-        r.close()
+        entry = {"generation": desc.generation, "status": status}
+        if status != "ok" and quarantine:
+            entry["quarantined"] = quarantine_descriptor_files(
+                desc, reason=status)["path"]
+        out.append(entry)
     return out
 
 
@@ -135,10 +147,14 @@ def main(argv=None):
     p.add_argument("--keyspace", required=True)
     p.add_argument("--table", required=True)
     p.add_argument("--generation", type=int)
+    p.add_argument("--quarantine", action="store_true",
+                   help="verify only: move failing sstables into the "
+                        "table's quarantine/ set")
     args = p.parse_args(argv)
     fn = {"dump": dump, "metadata": metadata, "verify": verify}[args.command]
+    kw = {"quarantine": args.quarantine} if args.command == "verify" else {}
     print(json.dumps(fn(args.data, args.keyspace, args.table,
-                        args.generation), indent=2, default=str))
+                        args.generation, **kw), indent=2, default=str))
 
 
 if __name__ == "__main__":
