@@ -437,6 +437,110 @@ def run_read_bench(base_dir: str) -> dict:
     }
 
 
+# ------------------------------------------------------- frontdoor bench --
+
+FRONTDOOR_KEYS = 4096
+FRONTDOOR_OPS = 2048
+
+
+def run_frontdoor_bench(base_dir: str) -> dict:
+    """Front-door section: end-to-end native-protocol ops/s and tail
+    latency through the event-loop server (docs/native-transport.md) at
+    16/64/256 concurrent wire connections via scripts/stress.py, plus an
+    overload run proving the admission gate SHEDS with OVERLOADED errors
+    while in-flight requests never exceed the permit cap (no unbounded
+    queueing, no collapse). The server-thread sampler pins the
+    event-loop contract: thread count stays fixed while serving 256
+    connections."""
+    import threading
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    import stress as stress_mod
+
+    from cassandra_tpu.client import Cluster
+    from cassandra_tpu.schema import Schema
+    from cassandra_tpu.storage.engine import StorageEngine
+    from cassandra_tpu.transport import CQLServer
+
+    engine = StorageEngine(os.path.join(base_dir, "fd"), Schema(),
+                           commitlog_sync="periodic")
+    # throughput legs must not shed: cap above the largest leg's
+    # offered concurrency (the overload leg then pinches it)
+    engine.settings.set("native_transport_max_concurrent_requests", 1024)
+    srv = CQLServer(engine)
+    host, port = "127.0.0.1", srv.port
+    fixed = len(srv.event_loops) + len(srv.dispatcher.threads)
+    server_threads = lambda: stress_mod._server_thread_count(port)  # noqa: E731
+
+    try:
+        # preload the key space (disjoint sequential ranges) so the
+        # mixed legs' reads hit real rows
+        stress_mod.run_stress(host, port, profile="write",
+                              connections=8, ops=FRONTDOOR_KEYS,
+                              dist="sequential", key_space=FRONTDOOR_KEYS,
+                              seed=1)
+        legs = {}
+        samples: list[int] = []
+        for conns in (16, 64, 256):
+            stop = threading.Event()
+
+            def sampler():
+                while not stop.is_set():
+                    samples.append(server_threads())
+                    stop.wait(0.05)
+            st = threading.Thread(target=sampler, daemon=True)
+            st.start()
+            r = stress_mod.run_stress(
+                host, port, profile="mixed", connections=conns,
+                ops=FRONTDOOR_OPS, dist="zipf",
+                key_space=FRONTDOOR_KEYS, seed=conns, setup=False)
+            stop.set()
+            st.join()
+            legs[f"{conns}_connections"] = {
+                k: r[k] for k in ("ops_s", "p50_us", "p99_us", "ok",
+                                  "errors")}
+        threads_fixed = bool(samples) and \
+            min(samples) == max(samples) == fixed
+        # overload run: pinch the permit cap, hammer, prove shedding
+        engine.settings.set("native_transport_max_concurrent_requests", 2)
+        srv.permits.reset_high_water()
+        o = stress_mod.run_stress(host, port, profile="write",
+                                  connections=32, ops=1024,
+                                  dist="uniform",
+                                  key_space=FRONTDOOR_KEYS, seed=99,
+                                  setup=False)
+        hwm = srv.permits.high_water
+        engine.settings.set("native_transport_max_concurrent_requests",
+                            1024)
+        s = Cluster(host, port).connect()
+        responsive = bool(
+            s.execute("SELECT v FROM stress.frontdoor WHERE key = 0")
+            .rows)
+        s.close()
+        shed = o["errors"].get("overloaded", 0)
+        return {
+            "event_loop_threads": len(srv.event_loops),
+            "dispatch_threads": len(srv.dispatcher.threads),
+            "threads_fixed_while_serving_256_connections": threads_fixed,
+            "legs": legs,
+            "overload": {
+                "permit_cap": 2,
+                "ok": o["ok"],
+                "overloaded_errors": shed,
+                "max_in_flight": hwm,
+                "within_cap": hwm <= 2,
+                "responsive_after": responsive,
+                "shed_not_collapsed": bool(
+                    shed > 0 and o["ok"] > 0 and hwm <= 2
+                    and responsive),
+            },
+        }
+    finally:
+        srv.close()
+        engine.close()
+
+
 def _kernel_probe(table):
     """Two tiny merge rounds through the DEVICE path (on whatever JAX
     backend is active — the pinned CPU one for host engines): the first
@@ -542,6 +646,12 @@ def main():
             # commitlog + sharded memtable + pipelined flush vs the
             # per-mutation-fsync serial path
             "write_path": run_write_bench(os.path.join(base, "write")),
+            # native-protocol front door (docs/native-transport.md):
+            # wire ops/s + p50/p99 through the event-loop server at
+            # 16/64/256 connections, plus the overload run proving
+            # OVERLOADED shedding with in-flight <= the permit cap
+            "frontdoor": run_frontdoor_bench(
+                os.path.join(base, "frontdoor")),
         }
         print(json.dumps(result))
     finally:

@@ -21,7 +21,7 @@ import socket
 import struct
 import threading
 
-from . import transport_server as ts
+from .transport import frame as ts
 
 
 class DriverError(Exception):

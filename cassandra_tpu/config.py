@@ -161,6 +161,27 @@ class Config:
     native_transport_max_frame_size: int = spec("storage",
                                                 16 * 1024 * 1024)
     native_transport_max_concurrent_connections: int = mut(-1)
+    # event-loop front door (transport/server.py): selector threads
+    # multiplexing all client sockets (Netty boss/worker role) and the
+    # bounded request-dispatch executor decoupling protocol I/O from
+    # query execution (Dispatcher.java role)
+    native_transport_event_loops: int = 2
+    native_transport_max_threads: int = 4
+    # admission control: permits bounding in-flight (queued + executing)
+    # requests — exhaustion answers OVERLOADED instead of queueing;
+    # <= 0 disables the gate. Hot-reloadable.
+    native_transport_max_concurrent_requests: int = mut(256)
+    # per-client request rate limit in ops/s (4.1's
+    # native_transport_rate_limiting role); 0 disables. Hot-reloadable
+    # like compaction_throughput_mib_per_sec.
+    native_transport_rate_limit_ops: int = mut(0)
+    # prepared-statement registry LRU bound, in STATEMENTS (the
+    # reference's prepared_statements_cache_size is MiB-denominated;
+    # a count is the honest unit for this in-memory registry).
+    # <= 0 = unbounded. Hot-reloadable; eviction counts
+    # prepared_statements.evicted and an EXECUTE against an evicted id
+    # returns the v4/v5 UNPREPARED error so drivers re-prepare.
+    prepared_statements_cache_size: int = mut(1024)
 
     # internode
     storage_port: int = 7000

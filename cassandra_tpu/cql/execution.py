@@ -164,8 +164,8 @@ def bind_term(term, cql_type, params):
             v = params[term.index]
         # native-protocol bound values arrive in wire encoding and
         # deserialize against the statement's target type HERE — the one
-        # place the type is known (transport_server.WireValue)
-        from ..transport_server import WireValue
+        # place the type is known (transport.frame.WireValue)
+        from ..transport.frame import WireValue
         if isinstance(v, WireValue):
             if cql_type is not None:
                 return cql_type.deserialize(bytes(v))
@@ -1160,7 +1160,7 @@ class Executor:
         import copy
         import json as json_mod
 
-        from ..transport_server import WireValue
+        from ..transport.frame import WireValue
         doc = s.json_payload
         if isinstance(doc, ast.BindMarker):
             # resolve the marker OURSELVES: the generic no-type wire

@@ -9,9 +9,14 @@ from __future__ import annotations
 
 import hashlib
 import threading
+from collections import OrderedDict
 
 from .execution import Executor, InvalidRequest, ResultSet
 from .parser import parse
+
+# registry bound when the backend carries no settings (the
+# prepared_statements_cache_size knob overrides; <= 0 = unbounded)
+DEFAULT_PREPARED_CACHE_SIZE = 1024
 
 
 class Prepared:
@@ -23,29 +28,84 @@ class Prepared:
 class QueryProcessor:
     def __init__(self, backend):
         self.executor = Executor(backend)
-        self._prepared: dict[bytes, Prepared] = {}
+        # LRU, bounded by prepared_statements_cache_size: a PREPARE storm
+        # (or a client generating unique statements) can no longer grow
+        # the registry without limit. Eviction counts
+        # `prepared_statements.evicted`; executing an evicted id raises
+        # here and maps to the wire UNPREPARED error in the transport so
+        # drivers transparently re-prepare (QueryProcessor.java's
+        # capacity-bounded preparedStatements cache).
+        self._prepared: "OrderedDict[bytes, Prepared]" = OrderedDict()
         self._lock = threading.Lock()
 
     def parse(self, query: str):
         return parse(query)
 
+    def _prepared_cap(self) -> int:
+        settings = getattr(self.executor.backend, "settings", None)
+        if settings is None:
+            return DEFAULT_PREPARED_CACHE_SIZE
+        try:
+            return int(settings.get("prepared_statements_cache_size"))
+        except Exception:
+            return DEFAULT_PREPARED_CACHE_SIZE
+
     def prepare(self, query: str) -> bytes:
         """Returns the statement id (MD5 of the query, like the reference)."""
+        return self.prepare_full(query)[0]
+
+    def prepare_full(self, query: str) -> tuple[bytes, Prepared]:
+        """(qid, Prepared) — the object is returned from UNDER the
+        registry lock so a concurrent PREPARE storm evicting this very
+        entry can't leave the caller describing a statement it can no
+        longer see (the transport builds the bind metadata from it)."""
         qid = hashlib.md5(query.encode()).digest()
+        evicted = 0
         with self._lock:
-            if qid not in self._prepared:
-                self._prepared[qid] = Prepared(parse(query), query)
-        return qid
+            prep = self._prepared.get(qid)
+            if prep is None:
+                prep = self._prepared[qid] = Prepared(parse(query), query)
+            else:
+                self._prepared.move_to_end(qid)
+            cap = self._prepared_cap()
+            while cap > 0 and len(self._prepared) > cap:
+                self._prepared.popitem(last=False)
+                evicted += 1
+        if evicted:
+            from ..service.metrics import GLOBAL
+            GLOBAL.incr("prepared_statements.evicted", evicted)
+        return qid, prep
+
+    def get_prepared(self, qid: bytes) -> Prepared | None:
+        """LRU-touching lookup (None = never prepared OR evicted; the
+        caller decides between InvalidRequest and wire UNPREPARED)."""
+        with self._lock:
+            prep = self._prepared.get(qid)
+            if prep is not None:
+                self._prepared.move_to_end(qid)
+            return prep
 
     def execute_prepared(self, qid: bytes, params=(),
                          keyspace: str | None = None,
                          user: str | None = None,
                          page_size: int | None = None,
                          paging_state: bytes | None = None) -> ResultSet:
-        with self._lock:
-            prep = self._prepared.get(qid)
+        prep = self.get_prepared(qid)
         if prep is None:
             raise InvalidRequest("unknown prepared statement")
+        return self.execute_statement(prep, params, keyspace, user=user,
+                                      page_size=page_size,
+                                      paging_state=paging_state)
+
+    def execute_statement(self, prep: Prepared, params=(),
+                          keyspace: str | None = None,
+                          user: str | None = None,
+                          page_size: int | None = None,
+                          paging_state: bytes | None = None) -> ResultSet:
+        """Execute an already-resolved Prepared. The transport fetches
+        the Prepared ONCE (for the UNPREPARED check and verb
+        classification) and executes that same object — no second
+        lookup that could race LRU eviction into the wrong error."""
         audit = getattr(self.executor.backend, "audit_log", None)
         if audit is not None:
             audit.log(type(prep.statement).__name__, prep.query, user,

@@ -40,6 +40,12 @@ class StorageEngine:
         self.schema = schema or Schema()
         self.durable = durable_writes
         self.flush_threshold = flush_threshold
+        # inline threshold-flush stalls paid by writers, THIS engine
+        # only (the storage.write_stall histogram is process-global;
+        # the native-transport overload signal needs an engine-scoped
+        # count so one node's stall can't shed a co-hosted node's
+        # traffic)
+        self.write_stalls = 0
         os.makedirs(data_dir, exist_ok=True)
         self.encryption_ctx = None
         if keystore_dir:
@@ -302,6 +308,7 @@ class StorageEngine:
         flush exists to shrink exactly this histogram."""
         if cfs.should_flush():
             from ..service.metrics import GLOBAL, Timer
+            self.write_stalls += 1
             with Timer(GLOBAL.hist("storage.write_stall")):
                 cfs.flush()
 

@@ -566,7 +566,9 @@ def build_node_virtuals(node) -> VirtualSchema:
                        cols={"id": "int", "address": "text",
                              "username": "text", "keyspace_name": "text",
                              "protocol_version": "int",
-                             "requests": "bigint"})
+                             "requests": "bigint",
+                             "in_flight": "int",
+                             "rate_limited": "bigint"})
 
     def client_rows():
         from ..tools.nodetool import clientstats
@@ -574,7 +576,9 @@ def build_node_virtuals(node) -> VirtualSchema:
             yield {"id": c["id"], "address": c["address"],
                    "username": c["user"], "keyspace_name": c["keyspace"],
                    "protocol_version": c["version"],
-                   "requests": c["requests"]}
+                   "requests": c["requests"],
+                   "in_flight": c["in_flight"],
+                   "rate_limited": c["rate_limited"]}
     vs.register(VirtualTable(t_cli, client_rows))
 
     # --- token ownership (TokensTable / nodetool ring backing)

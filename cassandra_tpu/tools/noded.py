@@ -242,7 +242,7 @@ def main(argv=None) -> int:
     if cfg.get("native_port") is not None:
         # client-facing CQL native protocol endpoint (port 9042 role)
         from ..cluster.tls import TLSConfig
-        from ..transport_server import CQLServer
+        from ..transport.server import CQLServer
         # "native_tls": client_encryption_options role
         native = CQLServer(node, cfg.get("host", "127.0.0.1"),
                            int(cfg["native_port"]),

@@ -570,7 +570,10 @@ def compactionhistory(engine) -> list[dict]:
 
 
 def clientstats(node) -> list[dict]:
-    """nodetool clientstats: connected native-protocol clients."""
+    """nodetool clientstats: connected native-protocol clients
+    (ClientsTable role: address, protocol version, requests served,
+    in-flight on the dispatch executor, requests shed by the per-client
+    rate limiter)."""
     out = []
     for srv in getattr(node, "cql_servers", []):
         for info in list(srv.clients.values()):
@@ -579,7 +582,9 @@ def clientstats(node) -> list[dict]:
                         "user": conn.user or "anonymous",
                         "keyspace": conn.keyspace or "",
                         "version": conn.version or 0,
-                        "requests": info["requests"]})
+                        "requests": info["requests"],
+                        "in_flight": conn.in_flight,
+                        "rate_limited": conn.rate_limited})
     return out
 
 
@@ -1152,7 +1157,7 @@ def disableoldprotocolversions(node) -> dict:
     (tools/nodetool/DisableOldProtocolVersions.java)."""
     out = {}
     for srv in getattr(node, "cql_servers", []):
-        from ..transport_server import SUPPORTED_VERSIONS
+        from ..transport.frame import SUPPORTED_VERSIONS
         srv.min_version = max(SUPPORTED_VERSIONS)
         out["min_version"] = srv.min_version
     return out or {"min_version": None}
@@ -1161,7 +1166,7 @@ def disableoldprotocolversions(node) -> dict:
 def enableoldprotocolversions(node) -> dict:
     out = {}
     for srv in getattr(node, "cql_servers", []):
-        from ..transport_server import SUPPORTED_VERSIONS
+        from ..transport.frame import SUPPORTED_VERSIONS
         srv.min_version = min(SUPPORTED_VERSIONS)
         out["min_version"] = srv.min_version
     return out or {"min_version": None}

@@ -1,739 +1,31 @@
-"""CQL native protocol server — the client-facing socket endpoint.
+"""Back-compat shim: the native-protocol endpoint moved to
+`cassandra_tpu/transport/`.
 
-Reference counterpart: transport/Server.java + Dispatcher.java:104 +
-CQLMessageHandler.java (the v4/v5 binary protocol on port 9042, specs:
-doc/native_protocol_v4.spec and v5.spec in the reference tree).
+  transport/frame.py      the v4/v5 wire codec (envelopes, v5 CRC
+                          segment framing, body primitives, result
+                          encoding) — byte-compatible with the codec
+                          that lived here.
+  transport/server.py     the selector-based event-loop CQLServer that
+                          replaced the thread-per-connection server.
+  transport/admission.py  permit gate, overload signals, per-client
+                          rate limiting.
 
-Implemented:
-  protocol v4 AND v5. v5 connections switch to the modern segment
-  framing after STARTUP (17-bit length + self-contained flag header
-  with CRC24, payload with CRC32 trailer — doc/native_protocol_v5.spec
-  "Crc" section); unsupported versions and compression flags are
-  rejected with a PROTOCOL error.
-  STARTUP -> READY (or AUTHENTICATE -> AUTH_RESPONSE -> AUTH_SUCCESS
-  with PasswordAuthenticator semantics when auth is enabled)
-  OPTIONS -> SUPPORTED
-  QUERY / PREPARE / EXECUTE -> RESULT (Void / Rows / SetKeyspace /
-  Prepared / SchemaChange) or ERROR
-  REGISTER -> READY, then server-push EVENT envelopes (stream -1) for
-  STATUS_CHANGE / TOPOLOGY_CHANGE / SCHEMA_CHANGE
-  (transport/messages/RegisterMessage.java, EventMessage.java)
-  paging: page_size + paging_state flags round-trip
-  bound values: wire bytes deserialize against the target column's type
-  at bind time (WireValue marker consumed by cql.execution.bind_term)
-
-Result metadata declares types inferred from the Python values with a
-matching encoding, so any decoder that honours the metadata reads the
-rows correctly.
+Everything importable from this module before the move still is; new
+code should import from `cassandra_tpu.transport` directly.
 """
-from __future__ import annotations
-
-import struct
-import threading
-import socket
-
-from .cql.processor import QueryProcessor
-
-VERSION_REQ = 0x04
-VERSION_RSP = 0x84
-SUPPORTED_VERSIONS = (0x04, 0x05)
-
-OP_ERROR = 0x00
-OP_STARTUP = 0x01
-OP_READY = 0x02
-OP_AUTHENTICATE = 0x03
-OP_OPTIONS = 0x05
-OP_SUPPORTED = 0x06
-OP_QUERY = 0x07
-OP_RESULT = 0x08
-OP_PREPARE = 0x09
-OP_EXECUTE = 0x0A
-OP_REGISTER = 0x0B
-OP_EVENT = 0x0C
-OP_AUTH_RESPONSE = 0x0F
-OP_AUTH_SUCCESS = 0x10
-
-RESULT_VOID = 0x0001
-RESULT_ROWS = 0x0002
-RESULT_SET_KEYSPACE = 0x0003
-RESULT_PREPARED = 0x0004
-RESULT_SCHEMA_CHANGE = 0x0005
-
-ERR_SERVER = 0x0000
-ERR_PROTOCOL = 0x000A
-ERR_BAD_CREDENTIALS = 0x0100
-ERR_INVALID = 0x2200
-
-EVENT_TYPES = ("TOPOLOGY_CHANGE", "STATUS_CHANGE", "SCHEMA_CHANGE")
-
-
-# ------------------------------------------------- v5 segment framing ------
-# doc/native_protocol_v5.spec: post-handshake traffic is framed in
-# segments: 3-byte little-endian header (17-bit payload length, 1-bit
-# self-contained flag) + CRC24 of the header, payload, CRC32 trailer.
-
-_CRC24_INIT = 0x875060
-_CRC24_POLY = 0x1974F0B
-_CRC32_INIT_BYTES = b"\xfa\x2d\x55\xca"
-MAX_SEGMENT_PAYLOAD = (1 << 17) - 1
-
-
-def _crc24(data: bytes) -> int:
-    crc = _CRC24_INIT
-    for b in data:
-        crc ^= b << 16
-        for _ in range(8):
-            crc <<= 1
-            if crc & 0x1000000:
-                crc ^= _CRC24_POLY
-    return crc & 0xFFFFFF
-
-
-def _crc32_v5(data: bytes) -> int:
-    import zlib
-    return zlib.crc32(data, zlib.crc32(_CRC32_INIT_BYTES)) & 0xFFFFFFFF
-
-
-def encode_segment(payload: bytes, self_contained: bool = True) -> bytes:
-    if len(payload) > MAX_SEGMENT_PAYLOAD:
-        raise ValueError("segment payload too large")
-    h = len(payload) | ((1 << 17) if self_contained else 0)
-    hdr = h.to_bytes(3, "little")
-    hdr += _crc24(hdr).to_bytes(3, "little")
-    return hdr + payload + _crc32_v5(payload).to_bytes(4, "little")
-
-
-def decode_segment_header(hdr6: bytes) -> tuple[int, bool]:
-    """(payload_length, self_contained); raises on CRC mismatch."""
-    if int.from_bytes(hdr6[3:6], "little") != _crc24(hdr6[:3]):
-        raise ValueError("segment header CRC mismatch")
-    h = int.from_bytes(hdr6[:3], "little")
-    return h & MAX_SEGMENT_PAYLOAD, bool(h & (1 << 17))
-
-
-class WireValue(bytes):
-    """A bound value still in wire encoding; bind_term deserializes it
-    against the statement's target type."""
-
-
-# --------------------------------------------------------- body primitives --
-
-def _string(s: str) -> bytes:
-    b = s.encode()
-    return struct.pack(">H", len(b)) + b
-
-
-def _long_string(s: str) -> bytes:
-    b = s.encode()
-    return struct.pack(">I", len(b)) + b
-
-
-def _bytes(b: bytes | None) -> bytes:
-    if b is None:
-        return struct.pack(">i", -1)
-    return struct.pack(">i", len(b)) + b
-
-
-def _read_string(buf: bytes, pos: int) -> tuple[str, int]:
-    (n,) = struct.unpack_from(">H", buf, pos)
-    return buf[pos + 2:pos + 2 + n].decode(), pos + 2 + n
-
-
-def _read_long_string(buf: bytes, pos: int) -> tuple[str, int]:
-    (n,) = struct.unpack_from(">I", buf, pos)
-    return buf[pos + 4:pos + 4 + n].decode(), pos + 4 + n
-
-
-def _read_bytes(buf: bytes, pos: int):
-    (n,) = struct.unpack_from(">i", buf, pos)
-    pos += 4
-    if n < 0:
-        return None, pos
-    return bytes(buf[pos:pos + n]), pos + n
-
-
-def _read_string_map(buf: bytes, pos: int) -> tuple[dict, int]:
-    (n,) = struct.unpack_from(">H", buf, pos)
-    pos += 2
-    out = {}
-    for _ in range(n):
-        k, pos = _read_string(buf, pos)
-        v, pos = _read_string(buf, pos)
-        out[k] = v
-    return out, pos
-
-
-# ------------------------------------------------------- result encoding ---
-
-def _infer_type(v):
-    """(option_id, encoder) inferred from the Python value — metadata and
-    encoding stay consistent with each other."""
-    import datetime
-    import uuid as uuid_mod
-    if isinstance(v, bool):
-        return 0x04, lambda x: b"\x01" if x else b"\x00"
-    if isinstance(v, int):
-        return 0x02, lambda x: struct.pack(">q", x)       # bigint
-    if isinstance(v, float):
-        return 0x07, lambda x: struct.pack(">d", x)       # double
-    if isinstance(v, uuid_mod.UUID):
-        return 0x0C, lambda x: x.bytes
-    if isinstance(v, bytes):
-        return 0x03, lambda x: x
-    if isinstance(v, datetime.datetime):
-        return 0x0B, lambda x: struct.pack(
-            ">q", int(x.timestamp() * 1000))
-    return 0x0D, lambda x: str(x).encode()                # varchar
-
-
-def _encode_rows(rs) -> bytes:
-    names = rs.column_names
-    rows = rs.rows
-    # per-column type from the first non-null value (varchar fallback)
-    col_types = []
-    for i in range(len(names)):
-        sample = next((r[i] for r in rows if r[i] is not None), None)
-        col_types.append(_infer_type(sample))
-    flags = 0x0001                       # global table spec
-    paging = getattr(rs, "paging_state", None)
-    if paging is not None:
-        flags |= 0x0002                  # has_more_pages
-    body = bytearray()
-    body += struct.pack(">i", RESULT_ROWS)
-    body += struct.pack(">I", flags)
-    body += struct.pack(">i", len(names))
-    if paging is not None:
-        body += _bytes(paging)
-    body += _string("") + _string("")    # keyspace/table (opaque here)
-    for name, (tid, _enc) in zip(names, col_types):
-        body += _string(name)
-        body += struct.pack(">H", tid)
-    body += struct.pack(">i", len(rows))
-    for r in rows:
-        for v, (_tid, enc) in zip(r, col_types):
-            body += _bytes(None if v is None else enc(v))
-    return bytes(body)
-
-
-class _Conn:
-    """Per-connection state (transport ServerConnection role)."""
-
-    def __init__(self, sock):
-        self.sock = sock
-        self.version: int | None = None
-        self.modern = False            # v5 segment framing active
-        self.keyspace: str | None = None
-        self.user: str | None = None
-        self.authed = False
-        self.peer_ip: str | None = None
-        self.tls_identity: str | None = None   # verified client-cert id
-        self.registrations: set[str] = set()
-        self.buf = bytearray()         # modern-framing reassembly
-        self.wlock = threading.Lock()  # event pushes race responses
-
-    def send_envelope(self, ver_rsp: int, stream: int, op: int,
-                      body: bytes, legacy: bool = False) -> None:
-        env = struct.pack(">BBhBI", ver_rsp, 0, stream, op,
-                          len(body)) + body
-        with self.wlock:
-            if self.modern and not legacy:
-                out = bytearray()
-                if len(env) <= MAX_SEGMENT_PAYLOAD:
-                    out += encode_segment(env, self_contained=True)
-                else:
-                    for i in range(0, len(env), MAX_SEGMENT_PAYLOAD):
-                        out += encode_segment(
-                            env[i:i + MAX_SEGMENT_PAYLOAD],
-                            self_contained=False)
-                self.sock.sendall(bytes(out))
-            else:
-                self.sock.sendall(env)
-
-    def send_error(self, stream: int, code: int, msg: str) -> None:
-        self.send_envelope(0x80 | (self.version or 0x04), stream,
-                           OP_ERROR,
-                           struct.pack(">i", code) + _string(msg))
-
-
-def _inet(host: str, port: int) -> bytes:
-    import ipaddress
-    addr = ipaddress.ip_address(host).packed
-    return bytes([len(addr)]) + addr + struct.pack(">i", port)
-
-
-def _cert_identity(sock) -> str | None:
-    """The VERIFIED client certificate's identity: SAN URI (SPIFFE
-    style) preferred, else subject CN (MutualTlsAuthenticator's
-    identity extraction). None for plaintext / cert-less TLS."""
-    import ssl
-    if not isinstance(sock, ssl.SSLSocket):
-        return None
-    try:
-        cert = sock.getpeercert()
-    except ssl.SSLError:
-        return None
-    if not cert:
-        return None
-    for typ, val in cert.get("subjectAltName", ()):
-        if typ == "URI":
-            return val
-    for rdn in cert.get("subject", ()):
-        for k, v in rdn:
-            if k == "commonName":
-                return v
-    return None
-
-
-class CQLServer:
-    """Threaded native-protocol endpoint over a backend (StorageEngine or
-    cluster Node) — transport/Server.java role."""
-
-    def __init__(self, backend, host: str = "127.0.0.1", port: int = 0,
-                 tls=None):
-        """tls: a cluster.tls.TLSConfig — client_encryption_options
-        role: connections are TLS, with client certs demanded only when
-        the config sets require_client_auth."""
-        self.backend = backend
-        self._tls_ctx = tls.server_context() if tls else None
-        # ONE processor for the whole server: prepared-statement ids are
-        # server-global like the reference's (drivers prepare on one
-        # connection and execute on another); keyspace/user stay
-        # per-connection in _Conn
-        self.processor = QueryProcessor(backend)
-        self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listen.bind((host, port))
-        self._listen.listen(64)
-        self.port = self._listen.getsockname()[1]
-        self._closed = False
-        # nodetool disablebinary: new connections are refused while
-        # paused (existing ones keep serving, matching the reference's
-        # native-transport stop semantics for in-flight requests)
-        self.paused = False
-        # nodetool disableoldprotocolversions: refuse protocol versions
-        # below this floor (transport/Server.java minimum_version role)
-        self.min_version = min(SUPPORTED_VERSIONS)
-        self._event_conns: set[_Conn] = set()
-        self._conn_lock = threading.Lock()
-        # live connection registry (system_views.clients / `nodetool
-        # clientstats`; transport/ConnectedClient role). The server links
-        # itself onto the backend so virtual tables can enumerate.
-        self.clients: dict[int, dict] = {}
-        self._client_ids = 0
-        try:
-            if not hasattr(backend, "cql_servers"):
-                backend.cql_servers = []
-            backend.cql_servers.append(self)
-        except Exception:
-            pass
-        # server-push events: a cluster Node surfaces liveness/topology/
-        # schema transitions through add_event_listener. Pushes run on a
-        # DEDICATED thread with a bounded per-send deadline — the
-        # emitting thread (gossiper, DDL executor) must never block on a
-        # stalled client socket, and a client that stops reading is
-        # dropped rather than wedging event fan-out.
-        import queue as _queue
-        self._event_q: _queue.Queue = _queue.Queue(maxsize=1024)
-        if hasattr(backend, "add_event_listener"):
-            backend.add_event_listener(self._on_node_event)
-            threading.Thread(target=self._event_loop, daemon=True,
-                             name=f"cql-events-{self.port}").start()
-        threading.Thread(target=self._accept_loop, daemon=True,
-                         name=f"cql-server-{self.port}").start()
-
-    # -------------------------------------------------------- event push --
-
-    def _on_node_event(self, kind: str, info: dict) -> None:
-        """Translate a node event into a wire EVENT body and enqueue the
-        push (EventMessage + Server.EventNotifier roles). Never blocks
-        the emitter: a full queue drops the oldest event."""
-        body = _string(kind)
-        if kind in ("STATUS_CHANGE", "TOPOLOGY_CHANGE"):
-            body += _string(info["change"])
-            body += _inet(info.get("host", "127.0.0.1"),
-                          int(info.get("port", 0)))
-        elif kind == "SCHEMA_CHANGE":
-            body += _string(info["change"])       # CREATED/UPDATED/DROPPED
-            body += _string(info["target"])       # KEYSPACE/TABLE/...
-            body += _string(info.get("keyspace") or "")
-            if info["target"] != "KEYSPACE":
-                body += _string(info.get("name") or "")
-        else:
-            return
-        import queue as _queue
-        try:
-            self._event_q.put_nowait((kind, body))
-        except _queue.Full:
-            try:
-                self._event_q.get_nowait()
-                self._event_q.put_nowait((kind, body))
-            except _queue.Empty:
-                pass
-
-    def _event_loop(self) -> None:
-        import select
-        import time as _time
-        while not self._closed:
-            try:
-                item = self._event_q.get(timeout=0.5)
-            except Exception:
-                continue
-            kind, body = item
-            with self._conn_lock:
-                conns = [c for c in self._event_conns
-                         if kind in c.registrations]
-            for c in conns:
-                env = struct.pack(">BBhBI", 0x80 | (c.version or 0x04),
-                                  0, -1, OP_EVENT, len(body)) + body
-                if c.modern:
-                    env = encode_segment(env)
-                try:
-                    with c.wlock:
-                        # bounded send: select-writable + partial sends
-                        # under a 5s deadline; a stalled client is
-                        # closed, never waited on
-                        deadline = _time.monotonic() + 5.0
-                        view = memoryview(env)
-                        while view.nbytes:
-                            left = deadline - _time.monotonic()
-                            if left <= 0:
-                                raise OSError("event send timeout")
-                            r = select.select([], [c.sock], [], left)[1]
-                            if not r:
-                                raise OSError("event send timeout")
-                            n = c.sock.send(view)
-                            view = view[n:]
-                except OSError:
-                    with self._conn_lock:
-                        self._event_conns.discard(c)
-                    try:
-                        c.sock.close()   # serve thread unblocks + cleans
-                    except OSError:
-                        pass
-
-    def close(self) -> None:
-        self._closed = True
-        servers = getattr(self.backend, "cql_servers", None)
-        if servers is not None and self in servers:
-            servers.remove(self)
-        remove = getattr(self.backend, "remove_event_listener", None)
-        if remove is not None:
-            remove(self._on_node_event)
-        try:
-            self._listen.close()
-        except OSError:
-            pass
-
-    # ------------------------------------------------------------ transport
-
-    def _accept_loop(self) -> None:
-        while not self._closed:
-            try:
-                sock, _ = self._listen.accept()
-            except OSError:
-                return
-            if self.paused:
-                try:
-                    sock.close()
-                except OSError:
-                    pass
-                continue
-            threading.Thread(target=self._serve_raw, args=(sock,),
-                             daemon=True).start()
-
-    def _serve_raw(self, sock) -> None:
-        # TLS handshake happens on the per-connection thread — a slow
-        # or plaintext client must not stall the accept loop
-        if self._tls_ctx is not None:
-            import ssl
-            try:
-                sock = self._tls_ctx.wrap_socket(sock, server_side=True)
-            except (ssl.SSLError, OSError):
-                try:
-                    sock.close()
-                except OSError:
-                    pass
-                return
-        self._serve(sock)
-
-    @staticmethod
-    def _read_exact(sock, n: int) -> bytes | None:
-        buf = bytearray()
-        while len(buf) < n:
-            chunk = sock.recv(n - len(buf))
-            if not chunk:
-                return None
-            buf += chunk
-        return bytes(buf)
-
-    def _serve(self, sock: socket.socket) -> None:
-        processor = self.processor
-        conn = _Conn(sock)
-        auth = getattr(self.backend, "auth", None)
-        need_auth = auth is not None and auth.enabled
-        with self._conn_lock:
-            self._client_ids += 1
-            cid = self._client_ids
-        try:
-            peername = sock.getpeername()[:2]
-            peer = "%s:%d" % peername
-            conn.peer_ip = peername[0]
-        except OSError:
-            peer = "?"
-        conn.tls_identity = _cert_identity(sock)
-        info = {"id": cid, "address": peer, "requests": 0, "conn": conn}
-        self.clients[cid] = info
-        try:
-            while not self._closed:
-                env = self._next_envelope(conn)
-                if env is None:
-                    return
-                info["requests"] += 1
-                ver, flags, stream, opcode, body = env
-                if ver not in SUPPORTED_VERSIONS or \
-                        ver < self.min_version:
-                    # reject cleanly (spec: respond with a PROTOCOL error
-                    # naming the supported versions) and close
-                    rsp = struct.pack(">i", ERR_PROTOCOL) + _string(
-                        f"Invalid or unsupported protocol version "
-                        f"({ver}); supported versions are "
-                        f"(4/v4, 5/v5)")
-                    conn.send_envelope(0x80 | max(SUPPORTED_VERSIONS),
-                                       stream, OP_ERROR, rsp,
-                                       legacy=True)
-                    return
-                if conn.version is None:
-                    conn.version = ver
-                elif ver != conn.version:
-                    conn.send_error(stream, ERR_PROTOCOL,
-                                    "protocol version changed mid-stream")
-                    return
-                if flags & 0x01:
-                    conn.send_error(stream, ERR_PROTOCOL,
-                                    "compression is not supported")
-                    return
-                try:
-                    op, rsp = self._dispatch(processor, conn, need_auth,
-                                             auth, opcode, body)
-                except Exception as e:
-                    code = ERR_INVALID if isinstance(e, ValueError) \
-                        else ERR_SERVER
-                    op, rsp = OP_ERROR, struct.pack(">i", code) \
-                        + _string(f"{type(e).__name__}: {e}")
-                conn.send_envelope(0x80 | conn.version, stream, op, rsp)
-                if opcode == OP_STARTUP and conn.version >= 0x05:
-                    # STARTUP processed: v5 switches to segment framing
-                    # (the STARTUP response itself goes out legacy; any
-                    # auth exchange continues framed)
-                    conn.modern = True
-        except (OSError, ValueError):
-            pass
-        finally:
-            self.clients.pop(cid, None)
-            with self._conn_lock:
-                self._event_conns.discard(conn)
-            try:
-                sock.close()
-            except OSError:
-                pass
-
-    def _next_envelope(self, conn: "_Conn"):
-        """Read one envelope: legacy = straight off the socket; modern =
-        from the segment reassembly buffer."""
-        if not conn.modern:
-            hdr = self._read_exact(conn.sock, 9)
-            if hdr is None:
-                return None
-            ver_raw, flags, stream, opcode = struct.unpack(">BBhB",
-                                                           hdr[:5])
-            (length,) = struct.unpack(">I", hdr[5:9])
-            if length > (256 << 20):
-                return None
-            body = self._read_exact(conn.sock, length) if length else b""
-            if body is None:
-                return None
-            return ver_raw & 0x7F, flags, stream, opcode, body
-        # modern framing: refill the envelope buffer segment by segment
-        while True:
-            if len(conn.buf) >= 9:
-                (length,) = struct.unpack_from(">I", conn.buf, 5)
-                if length > (256 << 20):   # same cap as the legacy path
-                    return None
-                if len(conn.buf) >= 9 + length:
-                    hdr = bytes(conn.buf[:9])
-                    body = bytes(conn.buf[9:9 + length])
-                    del conn.buf[:9 + length]
-                    ver_raw, flags, stream, opcode = struct.unpack(
-                        ">BBhB", hdr[:5])
-                    return ver_raw & 0x7F, flags, stream, opcode, body
-            seg_hdr = self._read_exact(conn.sock, 6)
-            if seg_hdr is None:
-                return None
-            plen, _self_contained = decode_segment_header(seg_hdr)
-            payload = self._read_exact(conn.sock, plen + 4)
-            if payload is None:
-                return None
-            payload, crc = payload[:plen], payload[plen:]
-            if int.from_bytes(crc, "little") != _crc32_v5(payload):
-                raise ValueError("segment payload CRC mismatch")
-            conn.buf += payload
-
-    # ------------------------------------------------------------- opcodes
-
-    def _post_auth_checks(self, auth, conn: "_Conn", user: str) -> None:
-        """CIDR + network (datacenter) authorization at connect time
-        (auth/CIDRPermissionsManager, CassandraNetworkAuthorizer)."""
-        if conn.peer_ip:
-            auth.check_cidr(user, conn.peer_ip)
-        ep = getattr(self.backend, "endpoint", None)
-        if ep is not None:
-            auth.check_datacenter(user, ep.dc)
-
-    def _dispatch(self, processor, conn: _Conn, need_auth, auth, opcode,
-                  body):
-        if opcode == OP_OPTIONS:
-            return OP_SUPPORTED, struct.pack(">H", 2) + \
-                _string("CQL_VERSION") + struct.pack(">H", 1) + \
-                _string("3.4.5") + \
-                _string("PROTOCOL_VERSIONS") + struct.pack(">H", 2) + \
-                _string("4/v4") + _string("5/v5")
-        if opcode == OP_STARTUP:
-            if need_auth:
-                # mutual-TLS path (MutualTlsAuthenticator): a VERIFIED
-                # client certificate authenticates by identity mapping
-                # without a password exchange
-                ident = conn.tls_identity
-                if ident is not None and ident in auth.identities:
-                    # mapped identity: cert authenticates; an UNMAPPED
-                    # cert falls through to the password exchange
-                    # (optional-mTLS upgrade path)
-                    try:
-                        user = auth.authenticate_identity(ident)
-                        self._post_auth_checks(auth, conn, user)
-                    except Exception as e:
-                        return OP_ERROR, struct.pack(
-                            ">i", ERR_BAD_CREDENTIALS) + _string(str(e))
-                    conn.user = user
-                    conn.authed = True
-                    return OP_READY, b""
-                return OP_AUTHENTICATE, _string(
-                    "org.apache.cassandra.auth.PasswordAuthenticator")
-            conn.authed = True
-            return OP_READY, b""
-        if opcode == OP_AUTH_RESPONSE:
-            token, _ = _read_bytes(body, 0)
-            parts = (token or b"").split(b"\x00")
-            if len(parts) >= 3:
-                user, pw = parts[1].decode(), parts[2].decode()
-                try:
-                    auth.authenticate(user, pw)
-                    self._post_auth_checks(auth, conn, user)
-                except Exception:
-                    return OP_ERROR, struct.pack(
-                        ">i", ERR_BAD_CREDENTIALS) + _string(
-                        "bad credentials")
-                conn.user = user
-                conn.authed = True
-                return OP_AUTH_SUCCESS, _bytes(None)
-            return OP_ERROR, struct.pack(">i", ERR_BAD_CREDENTIALS) \
-                + _string("malformed SASL token")
-        if not conn.authed:
-            return OP_ERROR, struct.pack(">i", ERR_PROTOCOL) \
-                + _string("STARTUP required")
-        if opcode == OP_REGISTER:
-            (n,) = struct.unpack_from(">H", body, 0)
-            pos = 2
-            for _ in range(n):
-                etype, pos = _read_string(body, pos)
-                if etype not in EVENT_TYPES:
-                    return OP_ERROR, struct.pack(">i", ERR_PROTOCOL) \
-                        + _string(f"unknown event type {etype!r}")
-                conn.registrations.add(etype)
-            with self._conn_lock:
-                self._event_conns.add(conn)
-            return OP_READY, b""
-        if opcode == OP_QUERY:
-            query, pos = _read_long_string(body, 0)
-            return self._run(processor, conn, query, body, pos)
-        if opcode == OP_PREPARE:
-            query, pos = _read_long_string(body, 0)
-            if conn.version >= 0x05 and pos < len(body):
-                (_pflags,) = struct.unpack_from(">I", body, pos)  # keyspace
-            qid = processor.prepare(query)
-            prep = processor._prepared[qid]
-            n_binds = getattr(prep.statement, "n_markers", 0)
-            rsp = bytearray()
-            rsp += struct.pack(">i", RESULT_PREPARED)
-            rsp += struct.pack(">H", len(qid)) + qid
-            if conn.version >= 0x05:
-                # result_metadata_id (short bytes): stable per statement
-                rsp += struct.pack(">H", len(qid)) + qid
-            # bind metadata: declared as BLOB — the server deserializes
-            # wire bytes against the real column type at bind time, so
-            # clients pass pre-serialized values (documented subset)
-            rsp += struct.pack(">Ii", 0x0001, n_binds)   # flags, count
-            rsp += struct.pack(">i", 0)                   # pk_count
-            rsp += _string("") + _string("")              # global spec
-            for i in range(n_binds):
-                rsp += _string(f"p{i}") + struct.pack(">H", 0x03)
-            # result metadata: clients re-read it from each RESULT
-            rsp += struct.pack(">Ii", 0, 0)
-            return OP_RESULT, bytes(rsp)
-        if opcode == OP_EXECUTE:
-            (n,) = struct.unpack_from(">H", body, 0)
-            qid = bytes(body[2:2 + n])
-            pos = 2 + n
-            if conn.version >= 0x05:
-                # v5 EXECUTE carries the result_metadata_id
-                (mn,) = struct.unpack_from(">H", body, pos)
-                pos += 2 + mn
-            if processor._prepared.get(qid) is None:
-                return OP_ERROR, struct.pack(">i", ERR_INVALID) \
-                    + _string("unknown prepared statement")
-            return self._run(processor, conn, None, body, pos, qid=qid)
-        return OP_ERROR, struct.pack(">i", ERR_PROTOCOL) \
-            + _string(f"unsupported opcode {opcode}")
-
-    def _run(self, processor, conn: _Conn, query, body: bytes, pos: int,
-             qid: bytes | None = None):
-        _consistency, = struct.unpack_from(">H", body, pos)
-        pos += 2
-        if conn.version >= 0x05:          # v5 widened flags to [int]
-            (flags,) = struct.unpack_from(">I", body, pos)
-            pos += 4
-        else:
-            flags = body[pos]
-            pos += 1
-        params: tuple = ()
-        page_size = None
-        paging_state = None
-        if flags & 0x01:                 # values
-            (nv,) = struct.unpack_from(">H", body, pos)
-            pos += 2
-            vals = []
-            for _ in range(nv):
-                b, pos = _read_bytes(body, pos)
-                vals.append(None if b is None else WireValue(b))
-            params = tuple(vals)
-        if flags & 0x04:                 # page_size
-            (page_size,) = struct.unpack_from(">i", body, pos)
-            pos += 4
-        if flags & 0x08:                 # paging_state
-            paging_state, pos = _read_bytes(body, pos)
-        if qid is not None:   # EXECUTE: cached statement, no re-parse
-            rs = processor.execute_prepared(
-                qid, params, conn.keyspace, user=conn.user,
-                page_size=page_size, paging_state=paging_state)
-        else:
-            rs = processor.process(query, params, conn.keyspace,
-                                   user=conn.user,
-                                   page_size=page_size,
-                                   paging_state=paging_state)
-        new_ks = getattr(rs, "keyspace", None)
-        if new_ks is not None:
-            conn.keyspace = new_ks
-            return OP_RESULT, struct.pack(">i", RESULT_SET_KEYSPACE) \
-                + _string(new_ks)
-        if not rs.column_names:
-            return OP_RESULT, struct.pack(">i", RESULT_VOID)
-        return OP_RESULT, _encode_rows(rs)
+from .transport.frame import (  # noqa: F401
+    ERR_BAD_CREDENTIALS, ERR_INVALID, ERR_OVERLOADED, ERR_PROTOCOL,
+    ERR_SERVER, ERR_UNPREPARED, EVENT_TYPES, MAX_SEGMENT_PAYLOAD,
+    OP_AUTH_RESPONSE, OP_AUTH_SUCCESS, OP_AUTHENTICATE, OP_ERROR,
+    OP_EVENT, OP_EXECUTE, OP_OPTIONS, OP_PREPARE, OP_QUERY, OP_READY,
+    OP_REGISTER, OP_RESULT, OP_STARTUP, OP_SUPPORTED, RESULT_PREPARED,
+    RESULT_ROWS, RESULT_SCHEMA_CHANGE, RESULT_SET_KEYSPACE, RESULT_VOID,
+    SUPPORTED_VERSIONS, VERSION_REQ, VERSION_RSP, WireValue, _bytes,
+    _crc24, _crc32_v5, _encode_rows, _inet, _infer_type, _long_string,
+    _read_bytes, _read_long_string, _read_string, _read_string_map,
+    _string, decode_segment_header, encode_envelope, encode_segment,
+    error_body, frame_envelope, unprepared_body)
+from .transport.server import CQLServer, Connection, _cert_identity  # noqa: F401
+
+# the old per-connection state class was called _Conn
+_Conn = Connection

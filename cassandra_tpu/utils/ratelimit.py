@@ -12,6 +12,13 @@ second's worth of tokens lets short bursts through without jitter while
 holding the long-run average at the configured rate. Rate 0 (or
 negative) disarms the limiter entirely — acquire becomes free.
 
+The token unit is configurable: the default (unit=2**20) keeps the
+historical MiB/s surface for the I/O throttles; `unit=1.0` makes the
+same bucket count OPERATIONS — the native-transport per-client request
+limiter (`native_transport_rate_limit_ops`) reuses it that way, through
+the non-blocking `try_acquire` (an over-limit client is answered with
+an OVERLOADED error, never slept on).
+
 The clock and sleep functions are injectable so token accounting is
 testable without real sleeps (and so a simulated deployment could drive
 it on virtual time).
@@ -23,13 +30,15 @@ import time
 
 
 class RateLimiter:
-    """Thread-safe token-bucket limiter in MiB/s (0 = unthrottled)."""
+    """Thread-safe token-bucket limiter in rate×unit tokens/s
+    (0 = unthrottled); unit defaults to MiB."""
 
     def __init__(self, mib_per_s: float = 0.0, clock=time.monotonic,
-                 sleep=time.sleep):
+                 sleep=time.sleep, unit: float = 2**20):
         self._clock = clock
         self._sleep = sleep
-        self.rate = max(mib_per_s, 0.0) * 2**20   # bytes/s
+        self._unit = unit
+        self.rate = max(mib_per_s, 0.0) * unit    # tokens/s
         self._allowance = self.rate               # burst: 1s of tokens
         self._last = clock()
         self._lock = threading.Lock()
@@ -39,15 +48,35 @@ class RateLimiter:
 
     @property
     def mib_per_s(self) -> float:
-        return self.rate / 2**20
+        return self.rate / self._unit
 
     def set_rate(self, mib_per_s: float) -> None:
         """Hot-reload (nodetool setcompactionthroughput /
         DatabaseDescriptor.setCompactionThroughputMebibytesPerSec)."""
         with self._lock:
-            self.rate = max(mib_per_s, 0.0) * 2**20
+            self.rate = max(mib_per_s, 0.0) * self._unit
             self._allowance = min(self._allowance, self.rate)
             self._last = self._clock()
+
+    def try_acquire(self, n: int = 1) -> bool:
+        """Non-blocking acquire: True iff n tokens were available right
+        now (no debt is taken on, nothing sleeps). The shedding-style
+        consumers (per-client request limiting) use this; the throttling
+        consumers (compaction/stream I/O) use acquire."""
+        if self.rate <= 0:
+            return True
+        with self._lock:
+            if self.rate <= 0:
+                return True
+            now = self._clock()
+            self._allowance = min(
+                self.rate, self._allowance + (now - self._last) * self.rate)
+            self._last = now
+            if self._allowance < n:
+                return False
+            self._allowance -= n
+            self.bytes_acquired += n
+            return True
 
     def acquire(self, nbytes: int) -> float:
         """Debit nbytes tokens, sleeping until the bucket allows them.

@@ -7,10 +7,10 @@ documented scheme (docs/observability.md):
 
 Scanned call sites: .incr("...") / .hist("...") / .timer("...") /
 .counter("...") / .register_gauge("...") / .group("...") string literals
-(plain and f-strings) under cassandra_tpu/ and bench.py. f-string
-placeholders ({...}) count as one valid component — dynamic parts like
-`table.{ks}.{name}.writes` pass structurally; their runtime values are
-the caller's contract.
+(plain and f-strings) under cassandra_tpu/, scripts/ and bench.py.
+f-string placeholders ({...}) count as one valid component — dynamic
+parts like `table.{ks}.{name}.writes` pass structurally; their runtime
+values are the caller's contract.
 
 Names passed to a *group* facade (cfs.latency.hist("read_latency")) are
 single components: the group prefix supplies the rest.
@@ -56,10 +56,11 @@ def scan(paths=None) -> list[tuple[str, int, str, str]]:
     """[(relpath, lineno, method, name)] violations."""
     if paths is None:
         paths = []
-        for root, _dirs, files in os.walk(os.path.join(REPO,
-                                                       "cassandra_tpu")):
-            paths += [os.path.join(root, f) for f in files
-                      if f.endswith(".py")]
+        self_py = os.path.abspath(__file__)
+        for top in ("cassandra_tpu", "scripts"):
+            for root, _dirs, files in os.walk(os.path.join(REPO, top)):
+                paths += [p for f in files if f.endswith(".py")
+                          and (p := os.path.join(root, f)) != self_py]
         paths.append(os.path.join(REPO, "bench.py"))
     bad = []
     for p in sorted(paths):

@@ -1,0 +1,398 @@
+#!/usr/bin/env python
+"""cassandra-stress-style multi-connection WIRE driver.
+
+Reference counterpart: tools/stress/ (Stress.java) driving the native
+protocol over real sockets — unlike tools/stress.py (which calls a
+Session in-process), every operation here crosses the event-loop server
+(cassandra_tpu/transport/): prepared statements, admission control,
+per-client rate limiting and the v5 segment framing are all on the path.
+
+Workloads: write / read / mixed (--write-ratio) over a fixed integer
+key space, keys drawn uniform / zipf (hot-partition skew) / sequential
+(disjoint per-connection ranges — deterministic, the smoke mode's
+correctness base). One OS thread per connection issues synchronous
+requests, so `--connections` IS the offered concurrency; latencies land
+in a shared service/metrics.LatencyHistogram (the same decaying
+histogram the server exports) plus exact numpy percentiles.
+
+Errors are classified by wire code: OVERLOADED (0x1001) shed by the
+permit gate / overload signals vs rate-limited (same code, rate-limit
+message) vs UNPREPARED (0x2500) vs other. The caller decides whether
+they are failures: the bench's overload run REQUIRES them.
+
+`--smoke` is the tier-2 drill (exit 1 on violation, seconds-long,
+deterministic; CI runs it alongside chaos_storage.py): in-process
+server, then (1) concurrent writes land and read back exactly,
+(2) serving 64 connections creates no new server threads (the
+event-loop contract), (3) with the permit cap pinched the server sheds
+with OVERLOADED while in-flight never exceeds the cap and the server
+stays responsive, (4) the per-client rate limiter sheds and hot-reloads
+off again.
+
+Usage:
+  python scripts/stress.py --profile mixed --connections 64 --ops 8192
+  python scripts/stress.py --host 10.0.0.5 --port 9042 --profile read
+  python scripts/stress.py --smoke
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+KEYSPACE = "stress"
+TABLE = "frontdoor"
+DDL = (f"CREATE KEYSPACE IF NOT EXISTS {KEYSPACE} WITH replication = "
+       "{'class': 'SimpleStrategy', 'replication_factor': 1}",
+       f"CREATE TABLE IF NOT EXISTS {KEYSPACE}.{TABLE} "
+       "(key int PRIMARY KEY, v blob)")
+INSERT = f"INSERT INTO {KEYSPACE}.{TABLE} (key, v) VALUES (?, ?)"
+SELECT = f"SELECT v FROM {KEYSPACE}.{TABLE} WHERE key = ?"
+
+
+def _client_table():
+    """Client-side mirror of the stress table for wire serialization
+    (the driver serializes bind values against CQL types itself)."""
+    from cassandra_tpu.schema import make_table
+    return make_table(KEYSPACE, TABLE, pk=["key"],
+                      cols={"key": "int", "v": "blob"})
+
+
+def _classify(msg: str) -> str:
+    if "0x1001" in msg:
+        return "rate_limited" if "rate limit" in msg.lower() \
+            else "overloaded"
+    if "0x2500" in msg:
+        return "unprepared"
+    return "other"
+
+
+def _keys(dist: str, n: int, key_space: int, rng, worker: int,
+          workers: int) -> np.ndarray:
+    if dist == "sequential":
+        # disjoint per-connection ranges: deterministic coverage of
+        # [0, workers*n) — the smoke read-back check depends on it
+        return np.arange(n) + worker * n
+    if dist == "zipf":
+        # zipf-skewed hot partitions clipped into the key space
+        return np.minimum(rng.zipf(1.3, n), key_space) - 1
+    return rng.integers(0, key_space, n)
+
+
+def _worker(idx: int, host: str, port: int, profile: str, n_ops: int,
+            dist: str, key_space: int, value_bytes: int,
+            write_ratio: float, seed: int, workers: int, hist,
+            barrier, results: list) -> None:
+    from cassandra_tpu.client import Cluster, DriverError, \
+        serialize_params
+    rng = np.random.default_rng(seed * 100_000 + idx)
+    table = _client_table()
+    lats: list = []
+    errs: dict = {}
+    ok = 0
+    # connect + prepare BEFORE the barrier so every worker reaches it
+    # exactly once (a broken barrier strands the whole run); a failed
+    # connection just records itself and sits the run out
+    sess = None
+    try:
+        sess = Cluster(host, port).connect()
+        wq = sess.prepare(INSERT)
+        rq = sess.prepare(SELECT)
+    except Exception as e:
+        errs["connection"] = 1
+        errs["connection_detail"] = f"{type(e).__name__}: {e}"
+        sess = None
+    keys = _keys(dist, n_ops, key_space, rng, idx, workers)
+    if profile == "mixed":
+        is_write = rng.random(n_ops) < write_ratio
+    else:
+        is_write = np.full(n_ops, profile == "write")
+    vals = rng.integers(0, 256, (n_ops, value_bytes), dtype=np.uint8)
+    barrier.wait()
+    if sess is not None:
+        for i in range(n_ops):
+            k = int(keys[i])
+            t0 = time.perf_counter()
+            try:
+                if is_write[i]:
+                    sess.execute_prepared(
+                        wq, serialize_params(table, ["key", "v"],
+                                             [k, vals[i].tobytes()]))
+                else:
+                    sess.execute_prepared(
+                        rq, serialize_params(table, ["key"], [k]))
+                ok += 1
+            except DriverError as e:
+                kind = _classify(str(e))
+                errs[kind] = errs.get(kind, 0) + 1
+                continue   # shed ops are near-instant round trips:
+                # counting them into lats would inflate ops/s and
+                # deflate tail latency exactly when the server sheds
+            except Exception as e:   # dead socket mid-run
+                errs["connection"] = errs.get("connection", 0) + 1
+                errs.setdefault("connection_detail",
+                                f"{type(e).__name__}: {e}")
+                break
+            us = (time.perf_counter() - t0) * 1e6
+            lats.append(us)
+            hist.update_us(us)
+        try:
+            sess.close()
+        except Exception:
+            pass
+    results[idx] = (lats, errs, ok)
+
+
+def run_stress(host: str, port: int, *, profile: str = "mixed",
+               connections: int = 16, ops: int = 4096,
+               dist: str = "uniform", key_space: int = 4096,
+               value_bytes: int = 64, write_ratio: float = 0.5,
+               seed: int = 1, setup: bool = True) -> dict:
+    """Drive `ops` total operations over `connections` concurrent wire
+    connections; returns ops/s + exact p50/p99 + the decaying-histogram
+    summary + error counts by class."""
+    from cassandra_tpu.client import Cluster
+    from cassandra_tpu.service.metrics import LatencyHistogram
+    if setup:
+        s = Cluster(host, port).connect()
+        for ddl in DDL:
+            s.execute(ddl)
+        s.close()
+    per_conn = max(1, ops // connections)
+    hist = LatencyHistogram()
+    barrier = threading.Barrier(connections + 1)
+    results: list = [None] * connections
+    threads = [threading.Thread(
+        target=_worker, daemon=True,
+        args=(i, host, port, profile, per_conn, dist, key_space,
+              value_bytes, write_ratio, seed, connections, hist,
+              barrier, results))
+        for i in range(connections)]
+    for t in threads:
+        t.start()
+    barrier.wait()               # all sessions connected and prepared
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    lats: list = []
+    errors: dict = {}
+    ok = 0
+    for r in results:
+        if r is None:
+            errors["connection"] = errors.get("connection", 0) + 1
+            continue
+        w_lats, w_errs, w_ok = r
+        lats += w_lats
+        ok += w_ok
+        for k, v in w_errs.items():
+            if k == "connection_detail":
+                errors.setdefault(k, v)
+            else:
+                errors[k] = errors.get(k, 0) + v
+    arr = np.array(lats) if lats else np.array([0.0])
+    attempted = ok + sum(v for k, v in errors.items()
+                         if isinstance(v, int))
+    return {
+        "profile": profile, "connections": connections,
+        "dist": dist, "ops": attempted, "ok": ok,
+        "errors": {k: v for k, v in errors.items() if v},
+        "wall_s": round(wall, 3),
+        # throughput and percentiles cover SERVED ops only: shed
+        # requests are near-instant errors and counting them would
+        # overstate capacity precisely when the server is shedding
+        "ops_s": round(ok / wall, 1) if wall > 0 else 0.0,
+        "p50_us": round(float(np.percentile(arr, 50)), 1),
+        "p99_us": round(float(np.percentile(arr, 99)), 1),
+        "hist": hist.summary(),
+    }
+
+
+# ------------------------------------------------------------- smoke -----
+
+def _server_thread_count(port: int) -> int:
+    from cassandra_tpu.transport.server import server_thread_count
+    return server_thread_count(port)
+
+
+def smoke() -> int:
+    """Tier-2 drill: deterministic, seconds-long, exit 1 on violation."""
+    import shutil
+    import tempfile
+
+    from cassandra_tpu.client import Cluster, serialize_params
+    from cassandra_tpu.schema import Schema
+    from cassandra_tpu.storage.engine import StorageEngine
+    from cassandra_tpu.transport import CQLServer
+
+    failures: list[str] = []
+
+    def check(cond: bool, what: str) -> None:
+        print(("ok   " if cond else "FAIL ") + what)
+        if not cond:
+            failures.append(what)
+
+    base = tempfile.mkdtemp(prefix="ctpu-stress-smoke-")
+    engine = StorageEngine(os.path.join(base, "d"), Schema(),
+                           commitlog_sync="periodic")
+    srv = CQLServer(engine)
+    table = _client_table()
+    try:
+        fixed = _server_thread_count(srv.port)
+        check(fixed == len(srv.event_loops) + len(srv.dispatcher.threads),
+              f"server runs a fixed thread set ({fixed})")
+
+        # 1. concurrent writes land: 8 connections, disjoint sequential
+        # key ranges, then every key reads back over a fresh connection
+        n_conns, per = 8, 40
+        w = run_stress("127.0.0.1", srv.port, profile="write",
+                       connections=n_conns, ops=n_conns * per,
+                       dist="sequential", value_bytes=32, seed=7)
+        check(w["ok"] == n_conns * per and not w["errors"],
+              f"8-connection write run clean ({w['ok']} ops)")
+        s = Cluster("127.0.0.1", srv.port).connect()
+        rq = s.prepare(SELECT)
+        missing = sum(
+            1 for k in range(n_conns * per)
+            if not s.execute_prepared(
+                rq, serialize_params(table, ["key"], [k])).rows)
+        check(missing == 0, "every written key reads back "
+              f"({n_conns * per - missing}/{n_conns * per})")
+
+        # 2. event-loop contract: 64 concurrent connections, no new
+        # server threads
+        r = run_stress("127.0.0.1", srv.port, profile="read",
+                       connections=64, ops=256, dist="uniform",
+                       key_space=n_conns * per, seed=8, setup=False)
+        check(r["ok"] > 0 and not r["errors"],
+              f"64-connection read run clean ({r['ok']} ops)")
+        check(_server_thread_count(srv.port) == fixed,
+              "thread count unchanged at 64 connections")
+
+        # 3. overload: pinch the permit cap; the server must SHED with
+        # OVERLOADED (not queue, not collapse) and stay responsive
+        engine.settings.set("native_transport_max_concurrent_requests", 1)
+        srv.permits.reset_high_water()
+        o = run_stress("127.0.0.1", srv.port, profile="write",
+                       connections=16, ops=400, dist="uniform",
+                       key_space=512, value_bytes=32, seed=9,
+                       setup=False)
+        shed = o["errors"].get("overloaded", 0)
+        check(shed > 0, f"permit exhaustion sheds OVERLOADED ({shed})")
+        check(o["ok"] > 0, f"server keeps serving under overload "
+              f"({o['ok']} ok)")
+        check(srv.permits.high_water <= 1,
+              f"in-flight never exceeded the cap "
+              f"(hwm={srv.permits.high_water})")
+        engine.settings.set("native_transport_max_concurrent_requests",
+                            256)
+        probe = s.execute_prepared(
+            rq, serialize_params(table, ["key"], [1]))
+        check(bool(probe.rows), "server responsive after overload run")
+
+        # 4. per-client rate limiting, hot-reloaded on and off.
+        # rate=2: a NEW connection's bucket starts with a 2-token burst
+        # — exactly the worker's two PREPAREs — so every subsequent op
+        # competes for a 2 ops/s refill and the shed assertion holds
+        # unless a trivial SELECT takes 500 ms (vs ~1 ms measured), not
+        # latency-tuned like a generous rate would be
+        engine.settings.set("native_transport_rate_limit_ops", 2)
+        rl = run_stress("127.0.0.1", srv.port, profile="read",
+                        connections=1, ops=60,
+                        dist="uniform", key_space=n_conns * per,
+                        seed=10, setup=False)
+        check(rl["errors"].get("rate_limited", 0) > 0,
+              f"rate limiter sheds "
+              f"({rl['errors'].get('rate_limited', 0)} of "
+              f"{rl['ops']})")
+        engine.settings.set("native_transport_rate_limit_ops", 0)
+        rl2 = run_stress("127.0.0.1", srv.port, profile="read",
+                         connections=1, ops=60, dist="uniform",
+                         key_space=n_conns * per, seed=11, setup=False)
+        check(not rl2["errors"],
+              "rate limit hot-reloads off (clean run)")
+        s.close()
+    finally:
+        srv.close()
+        engine.close()
+        shutil.rmtree(base, ignore_errors=True)
+    if failures:
+        print(f"\nsmoke FAILED: {len(failures)} violation(s)")
+        return 1
+    print("\nsmoke OK")
+    return 0
+
+
+# -------------------------------------------------------------- CLI ------
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="stress")
+    p.add_argument("--profile", choices=("write", "read", "mixed"),
+                   default="mixed")
+    p.add_argument("--connections", type=int, default=16)
+    p.add_argument("--ops", type=int, default=4096)
+    p.add_argument("--dist", choices=("uniform", "zipf", "sequential"),
+                   default="uniform")
+    p.add_argument("--key-space", type=int, default=4096)
+    p.add_argument("--value-bytes", type=int, default=64)
+    p.add_argument("--write-ratio", type=float, default=0.5)
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--host", default=None,
+                   help="drive an EXISTING server (with --port); "
+                        "default spins one up in-process")
+    p.add_argument("--port", type=int, default=9042)
+    p.add_argument("--smoke", action="store_true",
+                   help="tier-2 drill: deterministic seconds-long "
+                        "correctness + overload + rate-limit checks")
+    args = p.parse_args(argv)
+    if args.smoke:
+        return smoke()
+
+    srv = engine = None
+    base = None
+    if args.host is None:
+        import shutil
+        import tempfile
+
+        from cassandra_tpu.schema import Schema
+        from cassandra_tpu.storage.engine import StorageEngine
+        from cassandra_tpu.transport import CQLServer
+        base = tempfile.mkdtemp(prefix="ctpu-stress-")
+        engine = StorageEngine(os.path.join(base, "d"), Schema(),
+                               commitlog_sync="periodic")
+        srv = CQLServer(engine)
+        host, port = "127.0.0.1", srv.port
+    else:
+        host, port = args.host, args.port
+    try:
+        if args.profile == "read":     # preload the key space
+            run_stress(host, port, profile="write",
+                       connections=min(8, args.connections),
+                       ops=args.key_space, dist="sequential",
+                       value_bytes=args.value_bytes, seed=args.seed)
+        out = run_stress(host, port, profile=args.profile,
+                         connections=args.connections, ops=args.ops,
+                         dist=args.dist, key_space=args.key_space,
+                         value_bytes=args.value_bytes,
+                         write_ratio=args.write_ratio, seed=args.seed)
+        print(json.dumps(out))
+    finally:
+        if srv is not None:
+            srv.close()
+            engine.close()
+            import shutil
+            shutil.rmtree(base, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
