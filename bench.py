@@ -136,29 +136,150 @@ def build_inputs(data_dir, table, seed, cfg):
         w.finish()
 
 
-def run_compaction(base_dir, table, seed, cfg):
+def _task_knobs():
+    """Env-gated pipeline knobs shared by the headline + sweep legs:
+    CTPU_BENCH_PIPELINED=0 disables the threaded compress->io_write
+    split; CTPU_BENCH_COMPRESSORS=0 keeps the serial compress thread,
+    =N pins a private N-worker pool, unset = the shared auto-sized
+    pool; CTPU_BENCH_DECODE_AHEAD=0 disables the round-k+1 decode
+    prefetch. Output bytes are identical for every combination
+    (scripts/check_compaction_ab.py proves it)."""
+    pipelined = os.environ.get("CTPU_BENCH_PIPELINED", "1") != "0"
+    da_env = os.environ.get("CTPU_BENCH_DECODE_AHEAD")
+    # None = the task's own default (on for host engines, off for the
+    # device engine's submit/collect pipelining) — only an explicit
+    # env value overrides it
+    decode_ahead = None if da_env is None else da_env != "0"
+    comp = os.environ.get("CTPU_BENCH_COMPRESSORS")
+    pool = None
+    if not pipelined:
+        # PIPELINED=0 means the fully serial write leg: a pool would
+        # force threaded_io back on and corrupt the A/B
+        pool = 0
+    elif comp is not None:
+        n = int(comp)
+        if n <= 0:
+            pool = 0
+        else:
+            pool = _pinned_pool(n)
+    return {"pipelined_io": pipelined, "decode_ahead": decode_ahead,
+            "compress_pool": pool}
+
+
+_PINNED_POOLS: dict = {}
+
+
+def _pinned_pool(n: int):
+    """One pinned pool per worker count for the whole bench process —
+    repeated _task_knobs calls (warm + timed legs) must not leak a
+    fresh set of polling daemon threads each time."""
+    from cassandra_tpu.storage.sstable.compress_pool import CompressorPool
+
+    if n not in _PINNED_POOLS:
+        _PINNED_POOLS[n] = CompressorPool(n)
+    return _PINNED_POOLS[n]
+
+
+def _compact_dir(base_dir, table, cfs=None, **task_kw):
+    """Compact whatever sstables live in base_dir (or under an already
+    constructed cfs); returns stats with wall + per-phase profile +
+    per-phase MiB/s (input bytes over phase seconds — phases on
+    different threads overlap, so these are per-stage capacities, not
+    additive wall shares)."""
     from cassandra_tpu.compaction.task import CompactionTask
     from cassandra_tpu.storage.table import ColumnFamilyStore
 
-    cfs = ColumnFamilyStore(table, base_dir, commitlog=None)
-    build_inputs(cfs.directory, table, seed, cfg)
+    if cfs is None:
+        cfs = ColumnFamilyStore(table, base_dir, commitlog=None)
     cfs.reload_sstables()
     inputs = cfs.tracker.view()
     engine = os.environ.get("CTPU_BENCH_ENGINE", "native")
-    # CTPU_BENCH_PIPELINED=0 disables the threaded compress->io_write
-    # split for A/B runs; the default exercises the full pipeline
-    # (decode+merge / compress / io_write on three threads; phases
-    # report `compress` and `io_write` separately)
-    pipelined = os.environ.get("CTPU_BENCH_PIPELINED", "1") != "0"
     task = CompactionTask(cfs, inputs, engine=engine,
-                          use_device=engine == "device",
-                          pipelined_io=pipelined)
+                          use_device=engine == "device", **task_kw)
     t0 = time.time()
     stats = task.execute()
     stats["wall"] = time.time() - t0
     stats["profile"] = {k: round(v, 3)
                         for k, v in sorted(task.profile.items())}
+    mib = stats["bytes_read"] / 2**20
+    stats["phase_mib_s"] = {k: round(mib / v, 1)
+                            for k, v in stats["profile"].items() if v > 0}
     return stats
+
+
+def run_compaction(base_dir, table, seed, cfg):
+    from cassandra_tpu.storage.table import ColumnFamilyStore
+
+    cfs = ColumnFamilyStore(table, base_dir, commitlog=None)
+    build_inputs(cfs.directory, table, seed, cfg)
+    return _compact_dir(base_dir, table, cfs=cfs, **_task_knobs())
+
+
+def run_compressor_sweep(base_dir, table, cfg, workers=(1, 2, 4)):
+    """compressor_threads sweep on ONE fixture (copied per leg): the
+    serial-compress leg (workers=0) against pinned pools. Shows where
+    the compress stage stops being the wall — scaling flattens once
+    the pipeline is bounded by decode/merge CPU or the disk.
+    decode_ahead is held OFF on every leg so the sweep isolates
+    compress-pool scaling (the prefetch win is a separate lever,
+    A/B'd via CTPU_BENCH_DECODE_AHEAD on the headline)."""
+    import shutil as _sh
+
+    from cassandra_tpu.storage.sstable.compress_pool import CompressorPool
+    from cassandra_tpu.storage.table import ColumnFamilyStore
+
+    pristine = os.path.join(base_dir, "pristine")
+    cfs = ColumnFamilyStore(table, pristine, commitlog=None)
+    build_inputs(cfs.directory, table, 3, cfg)
+    out = {}
+    # discarded warm-up leg: the first measured leg must not pay the
+    # cold page-cache read of the pristine fixture that later legs
+    # copy from warm
+    warm_dir = os.path.join(base_dir, "warmup")
+    _sh.copytree(pristine, warm_dir)
+    _compact_dir(warm_dir, table, compress_pool=0, decode_ahead=False)
+    _sh.rmtree(warm_dir, ignore_errors=True)
+    for w in (0,) + tuple(workers):
+        leg_dir = os.path.join(base_dir, f"w{w}")
+        _sh.copytree(pristine, leg_dir)
+        pool = CompressorPool(w) if w > 0 else 0
+        stats = _compact_dir(leg_dir, table, compress_pool=pool,
+                             decode_ahead=False)
+        if w > 0:
+            pool.shutdown(timeout=5.0)
+        mib_s = stats["bytes_read"] / 2**20 / stats["wall"]
+        key = "serial" if w == 0 else f"workers_{w}"
+        out[key] = {"mib_s": round(mib_s, 2),
+                    "wall_s": round(stats["wall"], 3),
+                    "compress_s": stats["profile"].get("compress", 0.0)}
+        _sh.rmtree(leg_dir, ignore_errors=True)
+    return out
+
+
+def run_codec_bench():
+    """compress_iov micro-benchmark: the native zero-copy FFI path vs
+    the generic Python fallback (now also staging-copy-free on the
+    input side) — codec regressions on either path are visible here."""
+    from cassandra_tpu.ops.codec import Compressor, get_compressor
+
+    rng = np.random.default_rng(11)
+    frame_kib = 256
+    frames = [rng.integers(97, 122, frame_kib * 1024, dtype=np.uint8)
+              for _ in range(48)]
+    total_mib = sum(f.nbytes for f in frames) / 2**20
+    lz4 = get_compressor("LZ4Compressor")
+    out = {"frames": len(frames), "frame_kib": frame_kib}
+    for tag, fn in (
+            ("iov_native", lambda: lz4.compress_iov(frames)),
+            # the base-class fallback bound to the same codec: one
+            # compress() FFI call per frame, zero-copy input views
+            ("iov_fallback", lambda: Compressor.compress_iov(lz4, frames))):
+        fn()   # warm
+        t0 = time.perf_counter()
+        fn()
+        out[f"{tag}_mib_s"] = round(total_mib /
+                                    (time.perf_counter() - t0), 1)
+    return out
 
 
 # ----------------------------------------------------------- write bench --
@@ -626,7 +747,21 @@ def main():
                 "bytes_written": stats["bytes_written"],
                 "seconds": round(stats["wall"], 3),
                 "phases": stats["profile"],
+                # per-stage capacity (input MiB over phase seconds);
+                # stages run on different threads so these overlap —
+                # the smallest one is the pipeline's current wall
+                "phase_mib_s": stats["phase_mib_s"],
             },
+            # parallel-compress worker sweep on one fixture: serial
+            # compress vs pinned pools — scaling flattens where the
+            # compress stage stops being the wall (docs/compaction-
+            # executor.md; byte-identity across legs is CI-checked by
+            # scripts/check_compaction_ab.py)
+            "compressor_sweep": run_compressor_sweep(
+                os.path.join(base, "sweep"), table, cfg),
+            # compress_iov micro-benchmark: native FFI vs the generic
+            # fallback — codec regressions are visible here
+            "codec": run_codec_bench(),
             # decayed (windowed) latency snapshot + the Prometheus
             # exposition the exporter serves (nodetool exportmetrics)
             "metrics": {

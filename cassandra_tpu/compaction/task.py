@@ -58,14 +58,22 @@ class _Cursor:
     buffered whole — acceptable for round 1; the reference streams within
     partitions via its row index.
 
-    (A background decode-prefetch thread was tried here and measured a net
-    LOSS on both engines: segment parsing is numpy-bound, so the extra
-    thread just contends for the GIL with pack/gather — the overlap that
-    pays is the device pipeline + the writer thread.)"""
+    (A background decode-prefetch thread was tried here early on and
+    measured a net LOSS: the serial compress leg monopolized the GIL's
+    contended windows, so the extra decode thread only fought pack/
+    gather for them. With the compress leg on the GIL-releasing worker
+    pool that contention is gone, and CompactionTask.decode_ahead now
+    runs exactly that prefetch — the task's helper thread fills these
+    buffers between rounds via fill_to, never concurrently with the
+    round's own cursor access.)"""
 
     def __init__(self, reader: SSTableReader, prof: dict | None = None):
         self._it = reader.scanner()
         self.prof = prof
+        # which phase bucket _fetch bills: the decode-ahead thread bills
+        # its overlapped fills to 'decode_ahead' so 'io_decode' keeps
+        # meaning time the MERGE thread stalled waiting on decode
+        self.prof_key = "io_decode"
         self.bufs: list[cb.CellBatch] = []
         self.exhausted = False
         self._fetch()
@@ -80,7 +88,8 @@ class _Cursor:
             return False
         finally:
             if self.prof is not None:
-                self.prof["io_decode"] = self.prof.get("io_decode", 0.0) \
+                key = self.prof_key
+                self.prof[key] = self.prof.get(key, 0.0) \
                     + (time.perf_counter() - t0)
 
     @property
@@ -205,7 +214,9 @@ class CompactionTask:
                  round_cells: int | None = None,
                  engine: str | None = None,
                  limiter=None, progress=None,
-                 pipelined_io: bool = True):
+                 pipelined_io: bool = True,
+                 compress_pool=None,
+                 decode_ahead: bool | None = None):
         """engine: 'device' (TPU kernel), 'native' (C++ streaming merge),
         'numpy' (reference path). All three are tested bit-identical.
         Default (engine=None, use_device unset): the native engine when
@@ -221,8 +232,21 @@ class CompactionTask:
         compactions_in_progress virtual table).
         pipelined_io: thread the output's disk writes behind the
         compress stage (SSTableWriter threaded_io) — the write leg of
-        the decode→merge→compress→write pipeline. Output bytes are
-        identical either way; disable to keep everything on two threads.
+        the decode→merge→pack→compress→io_write pipeline. Output bytes
+        are identical either way; disable to keep everything on two
+        threads.
+        compress_pool: the compressor-worker pool for the writers'
+        parallel-compress leg. None (default) = the shared process
+        pool sized by compaction_compressor_threads; 0 = keep the
+        serial compress thread; a compress_pool.CompressorPool pins an
+        explicit pool (bench sweeps, tests). Output bytes identical for
+        every choice.
+        decode_ahead: prefetch-decode round k+1's input segments on a
+        helper thread while round k merges and the pool compresses —
+        profitable now that the compress leg no longer contends for
+        the GIL (an earlier prefetch attempt lost to exactly that, see
+        _Cursor). None = on for the host engines under pipelined_io;
+        the device engine keeps its own submit/collect pipelining.
         """
         self.cfs = cfs
         self.inputs = inputs
@@ -241,6 +265,24 @@ class CompactionTask:
                 from ..ops import host_merge
                 engine = "native" if host_merge.available() else "numpy"
         self.engine = engine
+        if compress_pool is None:
+            from ..storage.sstable.compress_pool import get_pool
+            self.compress_pool = get_pool() if pipelined_io else None
+        elif isinstance(compress_pool, int):
+            if compress_pool != 0:
+                # a worker COUNT belongs on the knob or an explicit
+                # CompressorPool — silently running serial instead
+                # would be an invisible perf misconfiguration
+                raise ValueError(
+                    "compress_pool takes a CompressorPool, None (shared "
+                    "pool) or 0 (serial compress); to pin a worker "
+                    "count pass CompressorPool(n)")
+            self.compress_pool = None      # 0: serial compress
+        else:
+            self.compress_pool = compress_pool
+        if decode_ahead is None:
+            decode_ahead = pipelined_io and self.engine != "device"
+        self.decode_ahead = decode_ahead
         self.round_cells = round_cells or (
             self.ROUND_CELLS_DEVICE if self.engine == "device"
             else self.ROUND_CELLS_HOST)
@@ -303,7 +345,9 @@ class CompactionTask:
             w = SSTableWriter(desc, table,
                               estimated_partitions=max(
                                   sum(r.n_partitions for r in self.inputs), 16),
-                              prof=prof, threaded_io=self.pipelined_io)
+                              prof=prof, threaded_io=self.pipelined_io,
+                              compress_pool=self.compress_pool,
+                              metrics_group="compaction")
             w.level = self.level
             # outputs carry the MINIMUM repairedAt of the inputs
             # (CompactionTask.getMinRepairedAt): mixing repaired with
@@ -321,36 +365,52 @@ class CompactionTask:
 
         wq: queue.Queue = queue.Queue(maxsize=2)
         werr: list[BaseException] = []
-        wstate = {"writer": None, "cells": 0}
+        # credited: bytes of the CURRENT writer already added to
+        # progress — in parallel-compress mode data_offset() trails
+        # appends, so finish()'s pool drain must credit the tail too
+        wstate = {"writer": None, "cells": 0, "credited": 0}
 
         progress = self.progress
 
         def write_loop():
-            # compress stage of the pipeline: writer.append cuts
-            # segments and compresses them; the disk write itself runs
-            # on the writer's own I/O thread (pipelined_io) so the
-            # three stages decode+merge / compress / io_write overlap.
-            # Phase timings land in prof as 'compress' and 'io_write'
-            # (the former single 'write' phase, split).
+            # pack/compress stage of the pipeline: writer.append cuts
+            # segments, serializes their blocks and (parallel-compress
+            # mode) fans them out to the compressor pool, whose results
+            # re-sequence through the writer's ordered completion queue
+            # onto its I/O thread — the stages decode+merge / pack /
+            # compress-pool / io_write all overlap. Phase timings land
+            # in prof as 'serialize', 'compress' and 'io_write'.
+            # Progress + the output-size cut-over read the writer's
+            # PUBLISHED offset (data_offset()), never private state
+            # another thread is mutating.
             try:
                 while True:
                     merged = wq.get()
                     if merged is None:
                         return
                     w = wstate["writer"]
-                    off0 = w._data_off
                     w.append(merged)
                     if progress is not None:
-                        progress.add_written(w._data_off - off0)
+                        off = w.data_offset()
+                        progress.add_written(off - wstate["credited"])
+                        wstate["credited"] = off
                     wstate["cells"] += len(merged)
                     if self.max_output_bytes and \
-                            wstate["writer"]._data_off >= \
+                            wstate["writer"].data_offset() >= \
                             self.max_output_bytes:
-                        # roll the output (MaxSSTableSizeWriter role)
-                        wstate["writer"].finish()
-                        new_readers.append(
-                            SSTableReader(wstate["writer"].desc, table))
+                        # roll the output (MaxSSTableSizeWriter role).
+                        # In parallel mode the published offset trails
+                        # in-flight segments, so the roll lands late by
+                        # a bounded amount — finish() drains the pool
+                        # (and the drained tail is credited below).
+                        w = wstate["writer"]
+                        w.finish()
+                        if progress is not None:
+                            progress.add_written(
+                                w.data_offset() - wstate["credited"])
+                        new_readers.append(SSTableReader(w.desc, table))
                         wstate["writer"] = new_writer()
+                        wstate["credited"] = 0
             except BaseException as e:   # surfaced after join
                 werr.append(e)
                 while True:              # drain so the producer never blocks
@@ -375,6 +435,42 @@ class CompactionTask:
         # progress.bytes_read converges on total_bytes exactly
         bytes_per_cell = bytes_read / max(cells_read, 1)
 
+        # decode-ahead stage (LUDA's overlap of decode k+1 with merge k):
+        # a helper thread refills the cursors' segment buffers while the
+        # merge engine reconciles the current round and the pool
+        # compresses its output. Strictly handshaked — the helper only
+        # touches cursors between pf_done.clear() and pf_done.set(), and
+        # the main loop waits on pf_done before every cursor access — so
+        # round boundaries (and output bytes) are identical either way.
+        pf_q = None
+        pf_thread = None
+        pf_done = threading.Event()
+        pf_done.set()
+        pf_err: list[BaseException] = []
+
+        def prefetch_loop():
+            while True:
+                per = pf_q.get()
+                if per is None:
+                    return
+                try:
+                    for c in cursors:
+                        if not c.exhausted:
+                            c.prof_key = "decode_ahead"
+                            try:
+                                c.fill_to(per)
+                            finally:
+                                c.prof_key = "io_decode"
+                except BaseException as e:   # surfaced next round
+                    pf_err.append(e)
+                finally:
+                    pf_done.set()
+
+        def stop_prefetch():
+            if pf_thread is not None:
+                pf_q.put(None)
+                pf_thread.join(timeout=30.0)
+
         wthread = None
         try:
             if progress is not None:
@@ -383,6 +479,12 @@ class CompactionTask:
             wthread = threading.Thread(target=write_loop, name="compact-w")
             wthread.start()
             cursors = [_Cursor(r, prof) for r in self.inputs]
+            if self.decode_ahead:
+                pf_q = queue.Queue()
+                pf_thread = threading.Thread(target=prefetch_loop,
+                                             name="compact-prefetch",
+                                             daemon=True)
+                pf_thread.start()
             while True:
                 if werr:       # writer died: fail fast, don't keep merging
                     break
@@ -397,6 +499,11 @@ class CompactionTask:
                     # crash-safe path
                     raise RuntimeError(
                         "compaction stopped by operator request")
+                # cursors are shared with the decode-ahead helper: wait
+                # out any in-flight prefetch before touching them
+                pf_done.wait()
+                if pf_err:
+                    raise pf_err[0]
                 active = [c for c in cursors if c.has_data]
                 if not active:
                     break
@@ -420,6 +527,12 @@ class CompactionTask:
                         slices.append(s)
                 if not slices:
                     continue
+                if pf_thread is not None and \
+                        any(not c.exhausted for c in cursors):
+                    # round k's inputs are sliced off: decode round
+                    # k+1's segments while k merges + compresses
+                    pf_done.clear()
+                    pf_q.put(per_cursor)
                 round_bytes = int(sum(len(s) for s in slices)
                                   * bytes_per_cell)
                 if progress is not None:
@@ -439,6 +552,8 @@ class CompactionTask:
                                       purgeable_ts_fn=controller.purgeable_ts_fn)
                     if len(merged):
                         wq.put(merged)
+            stop_prefetch()
+            pf_thread = None
             while pending:
                 collect_oldest()
             wq.put(None)
@@ -453,6 +568,11 @@ class CompactionTask:
             writer.finish()
             prof["seal"] = prof.get("seal", 0.0) + \
                 (time.perf_counter() - tw)
+            if progress is not None:
+                # the final pool drain's tail (write_loop is joined,
+                # so "credited" is stable here)
+                progress.add_written(
+                    writer.data_offset() - wstate["credited"])
             new_readers.append(SSTableReader(writer.desc, table))
             for r in self.inputs:
                 txn.track_obsolete(r.desc.generation)
@@ -480,6 +600,7 @@ class CompactionTask:
                 r.release()
         except BaseException as exc:
             pending.clear()
+            stop_prefetch()
             if wthread is not None and wthread.is_alive():
                 # blocking put is safe: the consumer is either processing
                 # or draining toward the sentinel — put_nowait could drop

@@ -123,6 +123,12 @@ class Config:
 
     # compaction (cassandra.yaml:1217-1250)
     concurrent_compactors: int = mut(1)
+    # compressor-worker pool for the bulk write path (compaction +
+    # flush share it; storage/sstable/compress_pool.py): segments
+    # compress concurrently and re-sequence through an ordered
+    # completion queue, so output bytes are identical for any size.
+    # 0 = auto (one worker per core, capped); hot-resizable.
+    compaction_compressor_threads: int = mut(0)
     compaction_throughput: float = spec("rate", 64.0, mutable=True)
     # modern-yaml name for the same throttle (DataRateSpec
     # compaction_throughput_mib_per_sec). Negative = unset: the engine

@@ -19,6 +19,7 @@ compaction write's chunks across the FFI in one call.
 from __future__ import annotations
 
 import ctypes
+import threading
 import zlib
 
 import numpy as np
@@ -47,15 +48,31 @@ class Compressor:
                          lengths: list[int]) -> list[bytes]:
         return [self.uncompress(c, n) for c, n in zip(chunks, lengths)]
 
+    @staticmethod
+    def _frame_view(f):
+        """Zero-copy read view of a buffer-protocol frame. bytes pass
+        through; numpy arrays / memoryviews become flat byte views —
+        no staging copy unless the frame is non-contiguous."""
+        if isinstance(f, (bytes, bytearray)):
+            return f
+        if isinstance(f, np.ndarray):
+            return memoryview(np.ascontiguousarray(f)).cast("B")
+        return memoryview(f).cast("B")
+
     def compress_iov(self, frames: list) -> tuple:
         """Compress buffer-protocol frames (numpy arrays / memoryviews)
         without staging copies. Returns (dst_uint8_array, offsets, sizes):
         frame i's compressed bytes are dst[offsets[i]:offsets[i]+sizes[i]].
         Generic fallback; the native codecs override with a zero-copy
-        FFI path."""
-        outs = [self.compress(bytes(f)) for f in frames]
+        FFI path. The pure-Python codecs (zlib, zstandard) accept any
+        buffer object, so frames go in as views — the per-frame
+        bytes(f) copy this used to make was a measured cost on the
+        encrypted-table write path (bench.py codec section)."""
+        outs = [self.compress(self._frame_view(f)) for f in frames]
         offs = np.zeros(len(outs) + 1, dtype=np.int64)
         np.cumsum([len(o) for o in outs], out=offs[1:])
+        # b"".join is the single unavoidable gather of the compressed
+        # output; frombuffer wraps it without another copy
         dst = np.frombuffer(b"".join(outs), dtype=np.uint8)
         return dst, offs[:-1], np.diff(offs)
 
@@ -342,7 +359,17 @@ class SegmentPacker:
         self._u8p = ctypes.POINTER(ctypes.c_uint8)
         self._i64p = ctypes.POINTER(ctypes.c_int64)
         self._u32p = ctypes.POINTER(ctypes.c_uint32)
-        self._scratch = np.zeros(0, dtype=np.uint8)
+        # per-THREAD shuffle scratch: one packer instance serves every
+        # worker of the parallel compress pool concurrently (the native
+        # zstd level is already thread-local on the C side)
+        self._tls = threading.local()
+
+    def _scratch_for(self, need: int) -> np.ndarray:
+        buf = getattr(self._tls, "scratch", None)
+        if buf is None or buf.nbytes < need:
+            buf = np.empty(need, dtype=np.uint8)
+            self._tls.scratch = buf
+        return buf
 
     def pack(self, blocks: list[np.ndarray], attempt: list[bool],
              max_compressed_length: int, shuffle_block: int,
@@ -354,10 +381,8 @@ class SegmentPacker:
         arrs = [np.ascontiguousarray(b.reshape(-1).view(np.uint8))
                 for b in blocks]
         lens = np.array([a.nbytes for a in arrs], dtype=np.int64)
-        if shuffle_block >= 0 and \
-                self._scratch.nbytes < int(lens[shuffle_block]):
-            self._scratch = np.empty(int(lens[shuffle_block]),
-                                     dtype=np.uint8)
+        scratch = self._scratch_for(int(lens[shuffle_block])
+                                    if shuffle_block >= 0 else 0)
         sizes = np.zeros(n, dtype=np.int64)
         raws = np.zeros(n, dtype=np.uint8)
         crcs = np.zeros(n, dtype=np.uint32)
@@ -370,7 +395,7 @@ class SegmentPacker:
             self._cid, ptrs, lens.ctypes.data_as(self._i64p), n,
             att.ctypes.data_as(self._u8p), max_compressed_length,
             shuffle_block, lane_width,
-            self._scratch.ctypes.data_as(self._u8p),
+            scratch.ctypes.data_as(self._u8p),
             out.ctypes.data_as(self._u8p), out.nbytes,
             sizes.ctypes.data_as(self._i64p),
             raws.ctypes.data_as(self._u8p),

@@ -131,6 +131,16 @@ class StorageEngine:
             self.compactions.set_concurrent_compactors
         self.settings.on_change("concurrent_compactors",
                                 self._compactor_listener)
+        # compressor pool (compaction + flush write legs): apply the
+        # configured size now and hot-resize on knob changes — mid-
+        # flight compactions pick the new worker count up immediately
+        # (the pool is shared process state, like the row cache)
+        from .sstable import compress_pool as _compress_pool
+        self._compressor_listener = _compress_pool.configure
+        self.settings.on_change("compaction_compressor_threads",
+                                self._compressor_listener)
+        _compress_pool.configure(
+            self.settings.get("compaction_compressor_threads"))
 
         # group-commit window hot-reload (nodetool/settings vtable)
         def _resolve_group_window(v):
@@ -420,6 +430,8 @@ class StorageEngine:
                                       self._throttle_listener)
         self.settings.remove_listener("concurrent_compactors",
                                       self._compactor_listener)
+        self.settings.remove_listener("compaction_compressor_threads",
+                                      self._compressor_listener)
         self.settings.remove_listener("commitlog_sync_group_window",
                                       self._group_window_listener)
         self.settings.remove_listener("row_cache_size",

@@ -8,12 +8,28 @@ The writer consumes *sorted* batches (flush output or merge-kernel output),
 cuts fixed-size segments, compresses each segment's three blocks through
 the table codec's batch API (one FFI crossing per segment), and maintains
 the bloom filter / partition directory / stats as it goes.
+
+Write-leg staging (docs/compaction-executor.md):
+
+  serial        compress + write on the caller thread.
+  threaded_io   compressed segments stage through a bounded queue to a
+                dedicated I/O thread (compress k+1 overlaps write k).
+  parallel      compress_pool= set: segments compress CONCURRENTLY on a
+                shared worker pool (ops/codec.py native calls release
+                the GIL) and re-sequence through the ordered completion
+                queue drained by the I/O thread — file bytes identical
+                to the serial path for ANY pool size (the adaptive-skip
+                decisions run on a fixed SKIP_DECISION_LAG outcome
+                stream, see _decide_attempt). Requires the fused native
+                packer; encrypted tables / codecs without a native id
+                silently keep the serial per-block chain.
 """
 from __future__ import annotations
 
 import json
 import mmap
 import os
+import queue
 import struct
 import threading
 import time
@@ -26,6 +42,45 @@ from ...schema import TableMetadata
 from ...utils import bloom, faultfs
 from ..cellbatch import CellBatch
 from .format import SEGMENT_CELLS, Component, Descriptor
+
+
+# test seam: per-segment delay hook run by pool workers before packing
+# (tests/test_parallel_compress.py forces adversarial completion order
+# to prove the ordered queue re-sequences); None in production.
+_TEST_SEGMENT_DELAY = None
+
+# sentinel on the outcome stream: the completion stage died — wake a
+# producer parked in _decide_attempt so it surfaces the error
+_ACCT_FAILED = object()
+
+
+class _PackJob:
+    """One segment's compress work in flight between the producer, a
+    CompressorPool worker and the writer's ordered completion (I/O)
+    thread. The worker fills total/sizes/crcs (or error) and sets
+    ready; the completion thread consumes jobs in submit order."""
+
+    __slots__ = ("seq", "blocks", "attempt", "buf", "n", "raw_lens",
+                 "lane_head", "lane_tail", "total", "sizes", "crcs",
+                 "compress_s", "error", "ready")
+
+    def __init__(self, seq: int, blocks: list, attempt: list[bool],
+                 buf: "np.ndarray", n: int, lane_head: bytes,
+                 lane_tail: bytes):
+        self.seq = seq
+        self.blocks = blocks
+        self.attempt = attempt
+        self.buf = buf
+        self.n = n
+        self.raw_lens = [b.nbytes for b in blocks]
+        self.lane_head = lane_head
+        self.lane_tail = lane_tail
+        self.total = 0
+        self.sizes = None
+        self.crcs = None
+        self.compress_s = 0.0
+        self.error: BaseException | None = None
+        self.ready = threading.Event()
 
 
 def _part_starts(lanes_c: "np.ndarray", n: int) -> "np.ndarray":
@@ -71,35 +126,43 @@ class SSTableWriter:
     # segment k+1 overlaps the disk write of segment k; 4 buffers bound
     # the memory held and give backpressure when the disk falls behind
     IO_QUEUE_DEPTH = 4
+    # parallel-compress mode: up to this many segments in flight through
+    # the pool + ordered completion queue (each holds one pack buffer —
+    # the memory bound — and gives the pool its concurrency headroom)
+    PARALLEL_QUEUE_DEPTH = 8
+    # the adaptive-compression-skip machine decides segment k's attempt
+    # flags from the outcomes of segments <= k - LAG (both serial and
+    # parallel paths): a FIXED lag makes the decision sequence — and so
+    # every stored byte — identical for any compressor pool size, while
+    # letting the pool keep LAG segments in flight without stalling.
+    SKIP_DECISION_LAG = 8
 
     def __init__(self, descriptor: Descriptor, table: TableMetadata,
                  estimated_partitions: int = 1024,
                  segment_cells: int = SEGMENT_CELLS,
                  prof: dict | None = None,
-                 threaded_io: bool = False):
+                 threaded_io: bool = False,
+                 compress_pool=None,
+                 metrics_group: str | None = None):
         """prof: optional dict accumulating per-phase wall seconds
-        ('compress' = serialize+compress+CRC, 'io_write' = fd writes).
+        ('compress' = compress+CRC — plus serialization when no pool;
+        'serialize' = block prep when a pool carries the compress leg;
+        'io_write' = fd writes).
         threaded_io: stage compressed segments through a bounded queue
         drained by a dedicated I/O thread, so compression of the next
         segment overlaps the previous segment's disk write (the write
-        stage of the compaction pipeline; see compaction/executor.py)."""
+        stage of the compaction pipeline; see compaction/executor.py).
+        compress_pool: a compress_pool.CompressorPool — segments
+        compress concurrently on its workers and re-sequence through
+        the ordered completion queue (implies threaded_io). Output is
+        byte-identical to the serial path for any worker count. Falls
+        back to the serial chain when the fused native packer is
+        unavailable (encrypted tables, codecs without a native id).
+        metrics_group: service/metrics group prefix ('compaction',
+        'flush') for the compress-stage queue-depth/stall metrics."""
         self.desc = descriptor
         self.table = table
         self.prof = prof
-        self._threaded_io = threaded_io
-        self._io_thread: threading.Thread | None = None
-        self._io_error: list[BaseException] = []
-        self._wq = None
-        if threaded_io:
-            import queue
-            self._wq = queue.Queue(maxsize=self.IO_QUEUE_DEPTH)
-            # double-buffered pack scratch: the compress stage packs
-            # segment k+1 into one buffer while the I/O thread drains
-            # segment k from the other — ZERO copies between stages
-            # (ownership travels through the queue and returns here)
-            self._pack_free: queue.Queue = queue.Queue()
-            for _ in range(2):
-                self._pack_free.put(np.empty(0, dtype=np.uint8))
         self.params: CompressionParams = table.params.compression
         self.compressor = self.params.compressor_or_noop()
         self.segment_cells = segment_cells
@@ -111,6 +174,31 @@ class SSTableWriter:
         self._packer = None if getattr(table.params, "encryption", False) \
             else SegmentPacker.create(self.compressor)
         self._pack_out: np.ndarray | None = None
+        self._cpool = compress_pool if self._packer is not None else None
+        if self._cpool is not None:
+            threaded_io = True
+        self._threaded_io = threaded_io
+        self._io_thread: threading.Thread | None = None
+        self._io_error: list[BaseException] = []
+        self._wq = None
+        self._metrics = None
+        if metrics_group:
+            from ...service.metrics import GLOBAL as _METRICS
+            self._metrics = _METRICS.group(metrics_group)
+        if threaded_io:
+            # pack-buffer pool: the compress stage packs segment k+1
+            # into a free buffer while the I/O thread drains segment k
+            # — ZERO copies between stages (ownership travels through
+            # the queue and returns here). 2 buffers double-buffer the
+            # serial compress thread; parallel mode carries one per
+            # in-flight segment plus the one being written.
+            depth = self.PARALLEL_QUEUE_DEPTH if self._cpool is not None \
+                else self.IO_QUEUE_DEPTH
+            self._wq = queue.Queue(maxsize=depth)
+            self._pack_free: queue.Queue = queue.Queue()
+            n_bufs = depth + 1 if self._cpool is not None else 2
+            for _ in range(n_bufs):
+                self._pack_free.put(np.empty(0, dtype=np.uint8))
 
         os.makedirs(descriptor.directory, exist_ok=True)
         data_path = descriptor.tmp_path(Component.DATA)
@@ -151,9 +239,17 @@ class SSTableWriter:
         # blob values (the stress default) store ~every payload block raw,
         # so attempting LZ4 on them was pure CPU waste; compressible
         # streams never enter skip mode. Chunk-granular analog of lz4's
-        # own acceleration heuristic.
+        # own acceleration heuristic. Decisions consume the outcome
+        # stream with a fixed SKIP_DECISION_LAG (see _decide_attempt).
         self._raw_streak = [0, 0, 0]
         self._skip_left = [0, 0, 0]
+        self._acct_outcomes: queue.SimpleQueue = queue.SimpleQueue()
+        self._seq_submitted = 0   # segments whose attempt flags are decided
+        self._seq_applied = 0     # outcomes folded into the skip machine
+        # monotonic published copy of _data_off: safe to read from any
+        # thread (compaction's output-roll check) regardless of which
+        # thread owns the real cursor in the current mode
+        self._published_off = 0
         self._ck_fits = True   # AND over appended batches' ck_fits_prefix
         # TDE: encrypted tables XOR the on-disk stream with an AES-CTR
         # keystream at its file offset; CRCs/digest cover the CIPHERTEXT
@@ -209,6 +305,15 @@ class SSTableWriter:
         self._pending_cells += len(batch)
         while self._pending_cells >= self.segment_cells:
             self._cut_segment(self.segment_cells)
+
+    def data_offset(self) -> int:
+        """Data.db bytes committed by the write pipeline so far — the
+        cross-thread-safe progress/roll-check surface (compaction's
+        output-size cut-over reads this from its merge-feed thread
+        while another thread advances the file). Monotonic; in
+        parallel-compress mode it trails appends by the in-flight
+        segments, so size-based rolls land a bounded overshoot late."""
+        return self._published_off
 
     def finish(self) -> dict:
         """Flush remaining cells, write all components, atomically rename.
@@ -332,15 +437,195 @@ class SSTableWriter:
         self._acct("io_write", time.perf_counter() - t0)
 
     def _take_pack_buf(self, need: int) -> "np.ndarray":
-        """Borrow a pack scratch buffer from the free pool (blocks when
-        both are in flight — the pipeline's backpressure), growing it if
-        this segment needs more room."""
-        buf = self._pack_free.get()
+        """Borrow a pack buffer from the free pool (blocks when all are
+        in flight — the pipeline's backpressure), growing it if this
+        segment needs more room. An empty pool means the producer
+        outran compress+disk: counted as a compress-stage stall."""
+        try:
+            buf = self._pack_free.get_nowait()
+        except queue.Empty:
+            if self._metrics is not None:
+                self._metrics.incr("compress_stalls")
+                t0 = time.perf_counter()
+                buf = self._pack_free.get()
+                self._metrics.hist("compress_stall").update_us(
+                    (time.perf_counter() - t0) * 1e6)
+            else:
+                buf = self._pack_free.get()
         if buf.nbytes < need:
             buf = np.empty(need, dtype=np.uint8)
         return buf
 
+    # ------------------------------------------- adaptive-skip decisions --
+
+    def _decide_attempt(self) -> list[bool]:
+        """Attempt-compression flags for the next segment's three block
+        streams. The skip machine folds in COMPLETED outcomes strictly
+        lagged SKIP_DECISION_LAG segments behind the decision point —
+        in serial mode every outcome is long since available; in
+        parallel mode the lag is exactly the pipeline depth the pool
+        may run ahead. Because both modes fold the same (decision_k,
+        outcome_{k-LAG}) sequence, the decisions — and therefore the
+        stored bytes — are identical for any pool size."""
+        k = self._seq_submitted
+        if self._seq_applied <= k - self.SKIP_DECISION_LAG \
+                and self._metrics is not None \
+                and self._acct_outcomes.empty():
+            # genuine stall: LAG segments in flight, oldest not done
+            self._metrics.incr("compress_stalls")
+        while self._seq_applied <= k - self.SKIP_DECISION_LAG:
+            if self._io_error:
+                raise self._io_error[0]
+            out = self._acct_outcomes.get()
+            if out is _ACCT_FAILED:
+                raise self._io_error[0] if self._io_error else \
+                    RuntimeError("compress pipeline failed")
+            self._apply_outcome(out)
+            self._seq_applied += 1
+        attempt = []
+        for i in range(3):
+            if self._skip_left[i] > 0:
+                self._skip_left[i] -= 1
+                attempt.append(False)
+            else:
+                attempt.append(True)
+        self._seq_submitted += 1
+        return attempt
+
+    def _apply_outcome(self, outcome) -> None:
+        """Fold one segment's (stored, raw_len, attempted) per-stream
+        outcome into the skip machine. A POOR ratio counts toward the
+        skip streak — e.g. zstd squeezes 4.5% out of random framed
+        blobs at ~155 MiB/s; 26ms per segment to save 4.5% is a bad
+        trade. A raw store always satisfies the ratio test."""
+        for i, (stored, raw_len, attempted) in enumerate(outcome):
+            if not attempted:
+                continue
+            if stored * 10 > raw_len * 9:
+                self._raw_streak[i] += 1
+                if self._raw_streak[i] >= 4:
+                    self._skip_left[i] = 15
+            else:
+                self._raw_streak[i] = 0
+
+    def _fold_block(self, stored: int, raw_len: int, crc: int) -> bytes:
+        """Per-block sequential bookkeeping: the index-entry triple and
+        the digest fold (digest = crc32 over the per-block crc words —
+        every byte is covered via its block crc without a second full
+        pass). Runs on whichever single thread owns segment order in
+        the current mode (producer, or the ordered completion loop)."""
+        self._data_crc = zlib.crc32(struct.pack("<I", crc),
+                                    self._data_crc)
+        return struct.pack("<QQI", stored, raw_len, crc)
+
+    # --------------------------------------------- parallel compress leg --
+
+    def _submit_pack(self, blocks: list, attempt: list[bool],
+                     need: int, n: int, lane_head: bytes,
+                     lane_tail: bytes) -> None:
+        """Hand one segment to the compressor pool; its index entry,
+        digest fold and disk write happen on the ordered completion
+        thread when its turn comes."""
+        if self._io_error:
+            raise self._io_error[0]   # fail the producer fast
+        if self._io_thread is None:
+            self._io_thread = threading.Thread(
+                target=self._io_loop, name="sstable-io", daemon=True)
+            self._io_thread.start()
+        buf = self._take_pack_buf(need)
+        job = _PackJob(self._seq_submitted - 1, blocks, attempt, buf,
+                       n, lane_head, lane_tail)
+        if self._metrics is not None:
+            # per-consumer segment counter + stall hist live here;
+            # queue depth is the POOL's gauge (compress_pool.queue_depth)
+            # — a histogram of a dimensionless depth would come out
+            # log2-quantized under a _us unit
+            self._metrics.incr("compress_segments")
+        self._cpool.submit(lambda: self._run_pack_job(job))
+        self._wq.put(job)   # single producer: queue order == seq order
+
+    def _run_pack_job(self, job: _PackJob) -> None:
+        """Pool-worker side: pack (delta + compress-or-raw + CRC) one
+        segment into its buffer. Errors land in the job and surface on
+        the completion thread exactly like a serial compress error."""
+        try:
+            hook = _TEST_SEGMENT_DELAY
+            if hook is not None:
+                hook(job.seq)
+            if faultfs.GLOBAL.active:
+                # sstable.compress checkpoint: an injected EIO here must
+                # fail the writer like a real compressor/allocator fault
+                faultfs.GLOBAL.check("sstable.compress", self._data_path)
+            t0 = time.perf_counter()
+            total, sizes, _raws, crcs = self._packer.pack(
+                job.blocks, job.attempt, self.params.max_compressed_length,
+                shuffle_block=1, lane_width=self.K, out=job.buf)
+            job.total = total
+            job.sizes = sizes
+            job.crcs = crcs
+            job.compress_s = time.perf_counter() - t0
+        except BaseException as e:
+            job.error = e
+        finally:
+            job.blocks = None   # drop ndarray refs as soon as packed
+            job.ready.set()
+
+    def _io_loop_ordered(self) -> None:
+        """Ordered completion stage of the parallel-compress pipeline:
+        jobs leave the pool in ANY order; this thread consumes them in
+        SUBMIT order, so every sequential piece of writer state — file
+        offsets, index entries, the digest fold, the skip-machine
+        outcome stream — sees segments exactly as the serial writer
+        would. Byte-identity for any pool size follows."""
+        job = None
+        try:
+            while True:
+                job = self._wq.get()
+                if job is None:
+                    return
+                job.ready.wait()
+                if job.error is not None:
+                    raise job.error
+                entry = struct.pack("<QI", self._data_off, job.n)
+                outcome = []
+                for i in range(3):
+                    stored = int(job.sizes[i])
+                    entry += self._fold_block(stored, job.raw_lens[i],
+                                              int(job.crcs[i]))
+                    outcome.append((stored, job.raw_lens[i],
+                                    job.attempt[i]))
+                entry += job.lane_head + job.lane_tail
+                self._index_entries.append(entry)
+                self._acct_outcomes.put(tuple(outcome))
+                self._acct("compress", job.compress_s)
+                t0 = time.perf_counter()
+                self._write_sync(memoryview(job.buf)[:job.total])
+                self._acct("io_write", time.perf_counter() - t0)
+                self._data_off += job.total
+                self._published_off = self._data_off
+                self._pack_free.put(job.buf)
+                job = None
+        except BaseException as e:
+            self._io_error.append(e)
+            # wake a producer parked on the outcome stream, then return
+            # every pack buffer (the failed job's included) and drain:
+            # the producer must block on neither the pool nor the queue
+            # — it surfaces the error at its next submit or at finish()
+            self._acct_outcomes.put(_ACCT_FAILED)
+            if job is not None:
+                job.ready.wait()
+                self._pack_free.put(job.buf)
+            while True:
+                job = self._wq.get()
+                if job is None:
+                    return
+                job.ready.wait()
+                self._pack_free.put(job.buf)
+
     def _io_loop(self) -> None:
+        if self._cpool is not None:
+            self._io_loop_ordered()
+            return
         item = None
         try:
             while True:
@@ -600,36 +885,10 @@ class SSTableWriter:
             meta[pos:end] = np.ascontiguousarray(arr).view(np.uint8)
             pos = end
         payload_b = np.ascontiguousarray(seg.payload)
-        attempt = []
-        for i in range(3):
-            if self._skip_left[i] > 0:
-                self._skip_left[i] -= 1
-                attempt.append(False)
-            else:
-                attempt.append(True)
+        attempt = self._decide_attempt()
         maxlen = self.params.max_compressed_length
-        entry = struct.pack("<QI", self._data_off, n)
-
-        def account(i: int, stored: int, raw_len: int, crc: int,
-                    attempted: bool) -> bytes:
-            """Shared per-block bookkeeping for both write paths: the
-            poor-ratio skip streak (a raw store always satisfies the
-            ratio test), the index-entry triple, and the digest fold
-            (digest = crc32 over the per-block crc words — every byte is
-            covered via its block crc without a second full pass)."""
-            if attempted:
-                # e.g. zstd squeezes 4.5% out of random framed blobs at
-                # ~155 MiB/s — 26ms per segment to save 4.5% is a bad
-                # trade, so a POOR ratio counts toward the skip streak
-                if stored * 10 > raw_len * 9:
-                    self._raw_streak[i] += 1
-                    if self._raw_streak[i] >= 4:
-                        self._skip_left[i] = 15
-                else:
-                    self._raw_streak[i] = 0
-            self._data_crc = zlib.crc32(struct.pack("<I", crc),
-                                        self._data_crc)
-            return struct.pack("<QQI", stored, raw_len, crc)
+        lane_head = seg.lanes[0].astype("<u4").tobytes()
+        lane_tail = seg.lanes[-1].astype("<u4").tobytes()
 
         if self._packer is not None:
             # fused native path: delta + order check + compress-or-raw +
@@ -637,6 +896,19 @@ class SSTableWriter:
             lanes_b = lanes_c
             blocks = [meta, lanes_b, payload_b]
             need = sum(b.nbytes for b in blocks)
+            if self._cpool is not None:
+                # parallel leg: the pool compresses this segment while
+                # this thread packs the NEXT one's lanes; the ordered
+                # completion thread does entry/digest/write in seq
+                # order (index entry + _total_cells stay consistent:
+                # entries append in seq order over there, cells here)
+                self._acct("serialize", time.perf_counter() - t_ser)
+                self._submit_pack(blocks, attempt, need, n,
+                                  lane_head, lane_tail)
+                self._total_cells += n
+                self._last_lane_end = seg.lanes[-1].astype(">u4").tobytes()
+                return
+            entry = struct.pack("<QI", self._data_off, n)
             if self._threaded_io:
                 out = self._take_pack_buf(need)
             else:
@@ -646,17 +918,23 @@ class SSTableWriter:
             total, sizes, raws, crcs = self._packer.pack(
                 blocks, attempt, maxlen, shuffle_block=1,
                 lane_width=seg.n_lanes, out=out)
+            outcome = []
             for i in range(3):
-                entry += account(i, int(sizes[i]), blocks[i].nbytes,
-                                 int(crcs[i]), attempt[i])
+                stored = int(sizes[i])
+                entry += self._fold_block(stored, blocks[i].nbytes,
+                                          int(crcs[i]))
+                outcome.append((stored, blocks[i].nbytes, attempt[i]))
+            self._acct_outcomes.put(tuple(outcome))
             self._acct("compress", time.perf_counter() - t_ser)
             self._write_all(memoryview(out)[:total],
                             reclaim=out if self._threaded_io else None)
             self._data_off += total
+            self._published_off = self._data_off
         else:
             # per-block fallback (encrypted tables / codecs without a
             # native id). Lanes are still byte-plane shuffled — the
             # on-disk format is identical either way.
+            entry = struct.pack("<QI", self._data_off, n)
             lanes_b = lanes_shuffle(
                 seg.lanes.astype(np.uint32, copy=False))
             blocks = [meta, lanes_b, payload_b]
@@ -666,6 +944,7 @@ class SSTableWriter:
             # min_compress_ratio fallback: store uncompressed when too
             # poor (CompressedSequentialWriter.java:160-175 semantics)
             ti = 0
+            outcome = []
             for i, raw in enumerate(blocks):
                 if attempt[i]:
                     c = dst[int(dst_offs[ti]):
@@ -681,11 +960,14 @@ class SSTableWriter:
                     mv = memoryview(ctx.xor_at(kid, nonces[Component.DATA],
                                                self._data_off, mv))
                 crc = zlib.crc32(mv)
-                entry += account(i, c.nbytes, raw.nbytes, crc, attempt[i])
+                entry += self._fold_block(c.nbytes, raw.nbytes, crc)
+                outcome.append((c.nbytes, raw.nbytes, attempt[i]))
                 self._write_all(mv)
                 self._data_off += c.nbytes
-        entry += seg.lanes[0].astype("<u4").tobytes()
-        entry += seg.lanes[-1].astype("<u4").tobytes()
+            self._acct_outcomes.put(tuple(outcome))
+            self._published_off = self._data_off
+        entry += lane_head
+        entry += lane_tail
         self._index_entries.append(entry)
         self._total_cells += n
         self._last_lane_end = seg.lanes[-1].astype(">u4").tobytes()

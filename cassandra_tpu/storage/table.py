@@ -371,13 +371,15 @@ class ColumnFamilyStore:
 
         Fast lane (CTPU_WRITE_FASTPATH): the retired memtable drains
         SHARD BY SHARD — each shard's drain+sort (numpy, GIL-releasing)
-        overlaps the previous shard's compress (native packer) and the
-        one before that's disk write (the SSTableWriter's threaded-I/O
-        double buffer from the compaction pipeline) — a 3-stage flush
-        pipeline whose output is bit-identical to the serial
-        sort-everything-then-write path (shards are disjoint ascending
-        token ranges, so per-shard sorted runs concatenate in global
-        order; proven by scripts/check_writepath_ab.py)."""
+        overlaps the previous shard's serialization, the shared
+        compressor pool's parallel compress of earlier segments
+        (storage/sstable/compress_pool.py; ordered completion keeps
+        bytes identical for any pool size) and the writer I/O thread's
+        disk writes — a 4-stage flush pipeline whose output is
+        bit-identical to the serial sort-everything-then-write path
+        (shards are disjoint ascending token ranges, so per-shard
+        sorted runs concatenate in global order; proven by
+        scripts/check_writepath_ab.py and check_compaction_ab.py)."""
         with self._flush_lock:
             with self._barrier.exclusive():
                 old = self.memtable
@@ -390,10 +392,16 @@ class ColumnFamilyStore:
             fast = write_fastpath_enabled()
             gen = self.next_generation()
             desc = Descriptor(self.directory, gen)
+            if fast:
+                from .sstable.compress_pool import get_pool
+                pool = get_pool()
+            else:
+                pool = None
             writer = SSTableWriter(
                 desc, self.table,
                 estimated_partitions=old.partition_count(),
-                threaded_io=fast)
+                threaded_io=fast, compress_pool=pool,
+                metrics_group="flush")
             try:
                 if fast:
                     self._append_pipelined(old, writer)

@@ -12,6 +12,9 @@ Failure points wired into the codebase (docs/fault-tolerance.md):
 
     sstable.open      component reads at SSTableReader open
     sstable.read      the Data.db segment pread in _decode_segment
+    sstable.compress  the parallel-compress pool worker's pack job
+                      (SSTableWriter._run_pack_job) — a worker EIO must
+                      fail the writer like a serial compress error
     flush.write       SSTableWriter's data-write funnel (_write_sync) —
                       covers memtable flush AND compaction output
     commitlog.fsync   the fsync inside CommitLog._do_sync

@@ -224,6 +224,62 @@ def test_writer_bounded_queue_backpressure(tmp_path, monkeypatch):
     assert written, "io thread never wrote"
 
 
+def test_parallel_compress_bounded_inflight(tmp_path, monkeypatch):
+    """Parallel-compress mode must bound in-flight segments too: with
+    the disk stalled, the producer blocks once PARALLEL_QUEUE_DEPTH
+    jobs + pack buffers are out, no matter how many pool workers have
+    finished compressing ahead."""
+    import numpy as np
+
+    from cassandra_tpu.schema import TableParams, make_table
+    from cassandra_tpu.storage import cellbatch as cb
+    from cassandra_tpu.storage.sstable import Descriptor, SSTableWriter
+    from cassandra_tpu.storage.sstable.compress_pool import CompressorPool
+    from cassandra_tpu.tools import bulk
+
+    table = make_table("ks", "bpp", pk=["id"], ck=["c"],
+                       cols={"id": "int", "c": "int", "v": "blob"},
+                       params=TableParams())
+    pool = CompressorPool(4)
+    w = SSTableWriter(Descriptor(str(tmp_path), 1), table,
+                      segment_cells=256, compress_pool=pool)
+    stall = threading.Event()
+    orig = SSTableWriter._write_sync
+
+    def stalled_write(self, mv):
+        stall.wait(30.0)
+        return orig(self, mv)
+
+    monkeypatch.setattr(SSTableWriter, "_write_sync", stalled_write)
+
+    n = 256 * 40   # segments >> queue depth + buffer pool
+    rng = np.random.default_rng(3)
+    big = cb.merge_sorted([bulk.build_int_batch(
+        table, rng.integers(0, 64, n), np.arange(n),
+        np.zeros((n, 64), dtype=np.uint8),
+        np.full(n, 1000, dtype=np.int64))])
+
+    producer_done = threading.Event()
+
+    def produce():
+        for i in range(40):
+            w.append(big.slice_range(i * 256, (i + 1) * 256))
+        producer_done.set()
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    try:
+        assert not producer_done.wait(0.7), \
+            "producer ran unboundedly ahead of a stalled disk"
+        stall.set()
+        assert producer_done.wait(30.0)
+        t.join(timeout=30.0)
+        w.finish()
+    finally:
+        stall.set()
+        pool.shutdown(timeout=5.0)
+
+
 # ------------------------------------------- pipelined == inline outputs --
 
 def _build_store(tmp_path, tag, n_runs=3, cells=4000):

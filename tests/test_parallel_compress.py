@@ -1,0 +1,490 @@
+"""Parallel compression pipeline (storage/sstable/compress_pool.py +
+SSTableWriter parallel-compress mode): ordered handoff under adversarial
+completion order, byte-identity for any pool size, worker-EIO unwind
+matching the serial compress error path, hot-resize mid-compaction,
+decode-ahead equivalence, and sim determinism."""
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cassandra_tpu.schema import TableParams, make_table
+from cassandra_tpu.storage import cellbatch as cb
+from cassandra_tpu.storage.sstable import (Descriptor, SSTableReader,
+                                           SSTableWriter)
+from cassandra_tpu.storage.sstable import writer as writer_mod
+from cassandra_tpu.storage.sstable.compress_pool import (CompressorPool,
+                                                         auto_workers,
+                                                         get_pool)
+from cassandra_tpu.tools import bulk
+from cassandra_tpu.utils import faultfs
+
+
+def _table(name="t"):
+    return make_table("pk", name, pk=["id"], ck=["c"],
+                      cols={"id": "int", "c": "int", "v": "blob"},
+                      params=TableParams())
+
+
+def _mixed_batch(table, seed=1, n=60_000, width=48):
+    """Alternating compressible/incompressible partitions: crosses the
+    adaptive-skip machine's engage/probe/disengage transitions, the
+    case where decision order affects bytes."""
+    rng = np.random.default_rng(seed)
+    pk = rng.integers(0, 128, n)
+    ck = rng.integers(0, 100_000, n)
+    text = rng.integers(97, 122, (n, width), dtype=np.uint8)
+    blob = rng.integers(0, 256, (n, width), dtype=np.uint8)
+    vals = np.where((pk % 2 == 0)[:, None], text, blob)
+    ts = rng.integers(1, 1 << 40, n).astype(np.int64)
+    return cb.merge_sorted([bulk.build_int_batch(table, pk, ck, vals, ts)])
+
+
+def _write(tmp_path, table, batch, tag, segment_cells=4096, **kw):
+    d = str(tmp_path / tag)
+    w = SSTableWriter(Descriptor(d, 1), table,
+                      segment_cells=segment_cells, **kw)
+    step = segment_cells + 123   # appends never align with segment cuts
+    for i in range(0, len(batch), step):
+        w.append(batch.slice_range(i, min(i + step, len(batch))))
+    w.finish()
+    return Descriptor(d, 1)
+
+
+def _file_hashes(desc) -> dict:
+    out = {}
+    for comp in ("Data.db", "Index.db", "Partitions.db", "Digest.crc32"):
+        with open(desc.path(comp), "rb") as f:
+            out[comp] = hashlib.sha256(f.read()).hexdigest()
+    return out
+
+
+# ------------------------------------------------- ordered completion --
+
+def test_adversarial_completion_order_resequenced(tmp_path, monkeypatch):
+    """Workers finishing OUT of order (even segments delayed) must not
+    change a byte: the ordered completion queue re-sequences them."""
+    table = _table()
+    batch = _mixed_batch(table)
+    ref = _file_hashes(_write(tmp_path, table, batch, "ref"))
+
+    def delay(seq):
+        if seq % 2 == 0:
+            time.sleep(0.02)   # odd successors complete first
+
+    monkeypatch.setattr(writer_mod, "_TEST_SEGMENT_DELAY", delay)
+    pool = CompressorPool(4)
+    try:
+        got = _file_hashes(_write(tmp_path, table, batch, "adv",
+                                  compress_pool=pool))
+    finally:
+        pool.shutdown(timeout=5.0)
+    assert got == ref
+
+
+def test_pool_sizes_and_serial_byte_identical(tmp_path):
+    """Serial, threaded, 1-worker and 3-worker pools: identical files
+    (the fast inline version of scripts/check_compaction_ab.py)."""
+    table = _table()
+    batch = _mixed_batch(table)
+    ref = _file_hashes(_write(tmp_path, table, batch, "serial"))
+    assert _file_hashes(_write(tmp_path, table, batch, "thr",
+                               threaded_io=True)) == ref
+    for w in (1, 3):
+        pool = CompressorPool(w)
+        try:
+            got = _file_hashes(_write(tmp_path, table, batch, f"p{w}",
+                                      compress_pool=pool))
+        finally:
+            pool.shutdown(timeout=5.0)
+        assert got == ref, f"pool size {w} diverged from serial bytes"
+
+
+def test_parallel_output_readable_roundtrip(tmp_path):
+    table = _table()
+    batch = _mixed_batch(table, n=20_000)
+    pool = CompressorPool(2)
+    try:
+        desc = _write(tmp_path, table, batch, "rt", compress_pool=pool)
+    finally:
+        pool.shutdown(timeout=5.0)
+    r = SSTableReader(desc, table)
+    got = cb.CellBatch.concat(list(r.scanner()))
+    assert cb.content_digest(got) == cb.content_digest(batch)
+    r.close()
+
+
+# --------------------------------------------------------- EIO unwind --
+
+def test_worker_eio_fails_writer_and_abort_cleans(tmp_path):
+    """An injected EIO inside a pool worker must fail the writer
+    exactly like a serial compress error: finish() raises, abort()
+    leaves no tmp components behind."""
+    table = _table()
+    batch = _mixed_batch(table, n=30_000)
+    pool = CompressorPool(2)
+    d = str(tmp_path / "eio")
+    w = SSTableWriter(Descriptor(d, 1), table, segment_cells=2048,
+                      compress_pool=pool)
+    try:
+        with faultfs.inject("sstable.compress", "error", after=2):
+            with pytest.raises(OSError):
+                # the async error lands at a later append or at finish
+                for i in range(0, len(batch), 2048):
+                    w.append(batch.slice_range(i, min(i + 2048,
+                                                      len(batch))))
+                w.finish()
+        w.abort()
+    finally:
+        pool.shutdown(timeout=5.0)
+    leftovers = [f for f in os.listdir(d) if "tmp" in f]
+    assert leftovers == []
+
+
+def test_worker_eio_aborts_compaction_inputs_stay_live(tmp_path):
+    """Worker EIO mid-compaction: the task aborts, the lifecycle txn
+    rolls back, inputs keep serving (the PR-5 abort semantics hold
+    through the parallel leg)."""
+    from cassandra_tpu.compaction.task import CompactionTask
+    from cassandra_tpu.storage.table import ColumnFamilyStore
+
+    table = _table()
+    cfs = ColumnFamilyStore(table, str(tmp_path / "cfs"), commitlog=None)
+    for gen in (1, 2):
+        w = SSTableWriter(Descriptor(cfs.directory, gen), table)
+        w.append(_mixed_batch(table, seed=gen, n=30_000))
+        w.finish()
+    cfs.reload_sstables()
+    inputs = cfs.tracker.view()
+    in_gens = {r.desc.generation for r in inputs}
+    pool = CompressorPool(2)
+    task = CompactionTask(cfs, inputs, compress_pool=pool,
+                          round_cells=8192)
+    try:
+        with faultfs.inject("sstable.compress", "error"):
+            with pytest.raises(OSError):
+                task.execute()
+    finally:
+        pool.shutdown(timeout=5.0)
+    live = {r.desc.generation for r in cfs.live_sstables()}
+    assert live == in_gens, "rollback must keep exactly the inputs live"
+    assert cb.content_digest(cfs.scan_all()) is not None  # still serves
+
+
+def test_worker_eio_flush_restores_memtable(tmp_path, monkeypatch):
+    """Worker EIO during a fast-lane flush: the flush fails through the
+    failure policy funnel, the memtable is REINSTATED (acked writes
+    stay readable), and a retry after the fault clears succeeds — the
+    PR-5 flush-EIO recovery holds through the parallel compress leg."""
+    from cassandra_tpu.schema import COL_ROW_LIVENESS
+    from cassandra_tpu.storage.cellbatch import FLAG_ROW_LIVENESS
+    from cassandra_tpu.storage.mutation import Mutation
+    from cassandra_tpu.storage.table import ColumnFamilyStore
+
+    monkeypatch.setenv("CTPU_WRITE_FASTPATH", "1")
+    table = _table()
+    cfs = ColumnFamilyStore(table, str(tmp_path / "f"), commitlog=None)
+    vcol = table.columns["v"].column_id
+    for k in range(50):
+        m = Mutation(table.id, table.serialize_partition_key([k]))
+        ck = table.serialize_clustering([0])
+        m.add(ck, COL_ROW_LIVENESS, b"", b"", 1000, flags=FLAG_ROW_LIVENESS)
+        m.add(ck, vcol, b"", b"v%d" % k, 1000)
+        cfs.apply(m)
+    with faultfs.inject("sstable.compress", "error"):
+        with pytest.raises(OSError):
+            cfs.flush()
+    assert not cfs.memtable.is_empty, "failed flush must restore memtable"
+    assert len(cfs.read_partition(table.serialize_partition_key([7]))) > 0
+    reader = cfs.flush()   # fault cleared: retry drains the same data
+    assert reader is not None and reader.n_cells > 0
+    assert len(cfs.read_partition(table.serialize_partition_key([7]))) > 0
+
+
+def test_corrupt_input_quarantine_with_parallel_compress(tmp_path):
+    """PR-5 corrupt-input handling through the parallel write leg: the
+    task aborts itself and best_effort quarantines the rotten input."""
+    from cassandra_tpu.compaction.task import CompactionTask
+    from cassandra_tpu.storage.sstable.reader import CorruptSSTableError
+    from cassandra_tpu.storage.table import ColumnFamilyStore
+
+    table = _table()
+    cfs = ColumnFamilyStore(table, str(tmp_path / "cfs"), commitlog=None)
+    for gen in (1, 2):
+        w = SSTableWriter(Descriptor(cfs.directory, gen), table)
+        w.append(_mixed_batch(table, seed=gen, n=30_000))
+        w.finish()
+    cfs.reload_sstables()
+    inputs = cfs.tracker.view()
+    pool = CompressorPool(2)
+    task = CompactionTask(cfs, inputs, compress_pool=pool,
+                          round_cells=8192)
+    bad_path = inputs[0].desc.path("Data.db")
+    try:
+        with faultfs.inject("sstable.read", "bitflip",
+                            path_substr=bad_path):
+            with pytest.raises(CorruptSSTableError):
+                task.execute()
+    finally:
+        pool.shutdown(timeout=5.0)
+    live = {r.desc.generation for r in cfs.live_sstables()}
+    assert inputs[0].desc.generation not in live, "bad input quarantined"
+    qdir = os.path.join(cfs.directory, "quarantine")
+    assert os.path.isdir(qdir) and os.listdir(qdir)
+
+
+# ---------------------------------------------------------- hot-resize --
+
+def test_hot_resize_mid_compaction(tmp_path):
+    """Growing and shrinking the pool WHILE a compaction drains through
+    it must neither wedge nor change the output bytes."""
+    from cassandra_tpu.compaction.task import CompactionTask
+    from cassandra_tpu.storage.table import ColumnFamilyStore
+
+    table = _table()
+
+    def build(tag):
+        cfs = ColumnFamilyStore(table, str(tmp_path / tag),
+                                commitlog=None)
+        for gen in (1, 2, 3):
+            w = SSTableWriter(Descriptor(cfs.directory, gen), table)
+            w.append(_mixed_batch(table, seed=gen, n=40_000))
+            w.finish()
+        cfs.reload_sstables()
+        return cfs
+
+    def digests(cfs):
+        out = {}
+        for s in cfs.live_sstables():
+            with open(s.desc.path("Digest.crc32")) as f:
+                out[s.n_cells] = f.read().strip()
+        return out
+
+    ref_cfs = build("ref")
+    CompactionTask(ref_cfs, ref_cfs.tracker.view(), compress_pool=0,
+                   round_cells=8192).execute()
+    ref = digests(ref_cfs)
+
+    cfs = build("resized")
+    pool = CompressorPool(1)
+    task = CompactionTask(cfs, cfs.tracker.view(), compress_pool=pool,
+                          round_cells=8192)
+    err = []
+
+    def run():
+        try:
+            task.execute()
+        except BaseException as e:   # pragma: no cover - fails the test
+            err.append(e)
+
+    t = threading.Thread(target=run)
+    t.start()
+    deadline = time.monotonic() + 30.0
+    sizes = [4, 2, 6, 1]
+    while t.is_alive() and time.monotonic() < deadline:
+        if sizes:
+            pool.set_workers(sizes.pop(0))
+        time.sleep(0.01)
+    t.join(timeout=60.0)
+    pool.shutdown(timeout=5.0)
+    assert not t.is_alive(), "compaction wedged during pool resize"
+    assert not err, err
+    assert digests(cfs) == ref
+
+
+def test_settings_knob_resizes_global_pool(tmp_path):
+    """compaction_compressor_threads hot-applies to the shared pool via
+    the engine's settings listener (0 = auto)."""
+    from cassandra_tpu.schema import Schema
+    from cassandra_tpu.storage.engine import StorageEngine
+
+    schema = Schema()
+    schema.create_keyspace("pk")
+    schema.add_table(_table("knob"))
+    eng = StorageEngine(str(tmp_path / "data"), schema,
+                        durable_writes=False)
+    try:
+        pool = get_pool()
+        eng.settings.set("compaction_compressor_threads", 3)
+        assert pool.workers == 3
+        eng.settings.set("compaction_compressor_threads", 0)
+        assert pool.workers == auto_workers()
+    finally:
+        eng.close()
+
+
+def test_pool_shutdown_completes_queued_jobs():
+    """shutdown() must never strand a queued job: a stranded pack job
+    would park its writer's ordered completion thread on ready.wait()
+    forever. Never-started jobs run inline on the shutdown caller."""
+    pool = CompressorPool(1)
+    gate = threading.Event()
+    started = threading.Event()
+    ran = []
+
+    def job1():
+        started.set()
+        gate.wait(10.0)
+
+    pool.submit(job1)
+    assert started.wait(5.0), "worker never picked up job 1"
+    pool.submit(lambda: ran.append(1))   # queued behind the busy worker
+    t = threading.Thread(target=lambda: pool.shutdown(timeout=10.0))
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while not ran and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert ran, "queued job stranded by shutdown"
+    gate.set()
+    t.join(timeout=15.0)
+    assert not t.is_alive()
+
+
+# -------------------------------------------- decode-ahead + drive-bys --
+
+def test_decode_ahead_outputs_identical(tmp_path):
+    from cassandra_tpu.compaction.task import CompactionTask
+    from cassandra_tpu.storage.table import ColumnFamilyStore
+
+    table = _table()
+
+    def leg(tag, da):
+        cfs = ColumnFamilyStore(table, str(tmp_path / tag),
+                                commitlog=None)
+        for gen in (1, 2):
+            # small input segments so rounds have something to prefetch
+            w = SSTableWriter(Descriptor(cfs.directory, gen), table,
+                              segment_cells=4096)
+            w.append(_mixed_batch(table, seed=gen, n=40_000))
+            w.finish()
+        cfs.reload_sstables()
+        task = CompactionTask(cfs, cfs.tracker.view(), compress_pool=0,
+                              decode_ahead=da, round_cells=8192)
+        task.execute()
+        [s] = cfs.live_sstables()
+        with open(s.desc.path("Digest.crc32")) as f:
+            return f.read().strip(), task.profile
+
+    ref, _ = leg("noda", False)
+    got, prof = leg("da", True)
+    assert got == ref
+    assert "decode_ahead" in prof, "prefetch thread never decoded"
+
+
+def test_data_offset_published(tmp_path):
+    """The cross-thread roll-check surface: equals the final Data.db
+    payload size after finish, and trails appends monotonically."""
+    table = _table()
+    batch = _mixed_batch(table, n=20_000)
+    pool = CompressorPool(2)
+    d = str(tmp_path / "off")
+    w = SSTableWriter(Descriptor(d, 1), table, segment_cells=2048,
+                      compress_pool=pool)
+    try:
+        seen = [0]
+        for i in range(0, len(batch), 2048):
+            w.append(batch.slice_range(i, min(i + 2048, len(batch))))
+            off = w.data_offset()
+            assert off >= seen[0], "published offset went backwards"
+            seen[0] = off
+        w.finish()
+    finally:
+        pool.shutdown(timeout=5.0)
+    assert w.data_offset() == w._data_off > 0
+
+
+def test_compress_metrics_move(tmp_path):
+    from cassandra_tpu.compaction.task import CompactionTask
+    from cassandra_tpu.service.metrics import GLOBAL
+    from cassandra_tpu.storage.table import ColumnFamilyStore
+
+    table = _table()
+    cfs = ColumnFamilyStore(table, str(tmp_path / "m"), commitlog=None)
+    for gen in (1, 2):
+        w = SSTableWriter(Descriptor(cfs.directory, gen), table)
+        w.append(_mixed_batch(table, seed=gen, n=20_000))
+        w.finish()
+    cfs.reload_sstables()
+    before = GLOBAL.counter("compaction.compress_segments")
+    pool = CompressorPool(2)
+    try:
+        CompactionTask(cfs, cfs.tracker.view(), compress_pool=pool,
+                       round_cells=8192).execute()
+    finally:
+        pool.shutdown(timeout=5.0)
+    assert GLOBAL.counter("compaction.compress_segments") > before
+
+
+def test_fallback_compress_iov_zero_copy_equivalent():
+    """The generic compress_iov must accept numpy/memoryview frames
+    without bytes() staging and round-trip identically."""
+    from cassandra_tpu.ops.codec import Compressor, get_compressor
+
+    rng = np.random.default_rng(3)
+    frames = [rng.integers(97, 122, 4096, dtype=np.uint8),
+              rng.integers(0, 256, 1000, dtype=np.uint8),
+              np.zeros(0, dtype=np.uint8)]
+    lz4 = get_compressor("LZ4Compressor")
+    dst, offs, sizes = Compressor.compress_iov(lz4, frames)
+    for i, f in enumerate(frames):
+        c = bytes(dst[int(offs[i]):int(offs[i]) + int(sizes[i])])
+        assert lz4.uncompress(c, f.nbytes) == f.tobytes()
+
+
+# -------------------------------------------------------------- sim --
+
+def test_parallel_compress_deterministic_under_sim(tmp_path):
+    """Same seed, pool-compressed compaction under the sim scheduler:
+    identical sstable digests across runs — worker scheduling cannot
+    leak into bytes (the property that keeps the write leg simulable)."""
+    from cassandra_tpu.compaction.task import CompactionTask
+    from cassandra_tpu.sim.scheduler import simulated
+    from cassandra_tpu.storage.table import ColumnFamilyStore
+
+    table = _table()
+
+    def run(tag):
+        with simulated(99):
+            cfs = ColumnFamilyStore(table, str(tmp_path / tag),
+                                    commitlog=None)
+            for gen in (1, 2):
+                w = SSTableWriter(Descriptor(cfs.directory, gen), table)
+                w.append(_mixed_batch(table, seed=gen, n=30_000))
+                w.finish()
+            cfs.reload_sstables()
+            pool = CompressorPool(3)
+            try:
+                CompactionTask(cfs, cfs.tracker.view(),
+                               compress_pool=pool,
+                               round_cells=8192).execute()
+            finally:
+                pool.shutdown(timeout=5.0)
+            [s] = cfs.live_sstables()
+            with open(s.desc.path("Digest.crc32")) as f:
+                return f.read().strip()
+
+    assert run("a") == run("b")
+
+
+# ------------------------------------------------------- A/B harness --
+
+@pytest.mark.slow
+def test_compaction_ab_harness(tmp_path):
+    """Full tier-2 drill: scripts/check_compaction_ab.py — serial vs
+    threaded vs pool-1 vs pool-4 compaction and serial vs pooled flush,
+    sha256 component identity + merged-view digests."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "check_compaction_ab",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts",
+            "check_compaction_ab.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    diverged = mod.run_check(str(tmp_path))
+    assert diverged == [], "\n".join(diverged)
