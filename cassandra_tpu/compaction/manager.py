@@ -47,6 +47,12 @@ class CompactionManager:
         self._pending_cfs: set = set()
         self._lock = threading.Lock()
         self._cfs_locks: dict = {}   # table_id -> rewrite mutex
+        # mesh-width source for the gauges: the owning engine points
+        # this at ITS settings knob (the fanout global is process-wide
+        # last-writer-wins state — a co-hosted engine's knob must not
+        # leak into this engine's engine-scoped metrics vtable)
+        from ..parallel import fanout
+        self.mesh_devices_fn = fanout.mesh_devices
         self._compacting: dict = {}  # table_id -> set of claimed gens
         self._stop = threading.Event()
         # programmatic kill switch wired onto every registered store as
@@ -80,6 +86,7 @@ class CompactionManager:
             "compaction.active_tasks": float(len(self.active)),
             "compaction.pending_tasks": float(self.pending_tasks()),
             "compaction.throughput_mib_per_sec": self.limiter.mib_per_s,
+            "compaction.mesh_devices": float(self.mesh_devices_fn()),
         }
 
     def set_concurrent_compactors(self, n: int) -> None:

@@ -216,7 +216,8 @@ class CompactionTask:
                  limiter=None, progress=None,
                  pipelined_io: bool = True,
                  compress_pool=None,
-                 decode_ahead: bool | None = None):
+                 decode_ahead: bool | None = None,
+                 mesh_devices: int | None = None):
         """engine: 'device' (TPU kernel), 'native' (C++ streaming merge),
         'numpy' (reference path). All three are tested bit-identical.
         Default (engine=None, use_device unset): the native engine when
@@ -247,6 +248,18 @@ class CompactionTask:
         the GIL (an earlier prefetch attempt lost to exactly that, see
         _Cursor). None = on for the host engines under pipelined_io;
         the device engine keeps its own submit/collect pipelining.
+        mesh_devices: the mesh execution mode (docs/multichip.md) —
+        the compaction is token-range sharded by count-weighted
+        boundaries planned from the input sstables' partition indexes
+        and the per-shard decode->merge fans across N mesh lanes
+        (engine='device': each shard's kernel committed to its own
+        jax device; host engines: one GIL-releasing worker thread per
+        lane). Shard results drain IN TOKEN ORDER through the same
+        compress-pool/threaded-io writer, so output bytes are
+        identical to the serial path for every N (token-range shard
+        order IS identity-lane order — no reshuffle). None = inherit
+        the `compaction_mesh_devices` knob (parallel/fanout.py);
+        0 = force serial.
         """
         self.cfs = cfs
         self.inputs = inputs
@@ -283,12 +296,247 @@ class CompactionTask:
         if decode_ahead is None:
             decode_ahead = pipelined_io and self.engine != "device"
         self.decode_ahead = decode_ahead
+        self.mesh_devices = mesh_devices
         self.round_cells = round_cells or (
             self.ROUND_CELLS_DEVICE if self.engine == "device"
             else self.ROUND_CELLS_HOST)
         # per-phase wall seconds, accumulated across rounds (published by
         # bench.py -- the breakdown the perf work navigates by)
         self.profile: dict = {}
+
+    def _effective_mesh_devices(self) -> int:
+        """The mesh width this task runs at: the explicit mesh_devices=
+        argument wins; None inherits the owning ENGINE's hot-reloadable
+        `compaction_mesh_devices` knob via the store (0 = serial) —
+        never a co-hosted engine's — falling back to the process demand
+        for standalone stores."""
+        if self.mesh_devices is not None:
+            return max(int(self.mesh_devices), 0)
+        fn = getattr(self.cfs, "mesh_devices_fn", None)
+        if fn is not None:
+            return max(int(fn()), 0)
+        from ..parallel import fanout
+        return fanout.mesh_devices()
+
+    def _engine_merge_fn(self, prof: dict | None):
+        """The host-merge closure for this task's engine — the ONE place
+        the native/numpy dispatch lives, shared by the serial round loop
+        and the mesh lanes so the two paths can never diverge on merge
+        semantics. Returns None for the device engine (its rounds go
+        through submit/collect). prof: where the native merge bills its
+        phase timings — run() passes the task profile, the mesh lanes
+        pass a per-shard dict (folded under a lock; concurrent lanes
+        must not race on the shared profile)."""
+        if self.engine == "device":
+            return None
+        if self.engine == "native":
+            from ..ops.host_merge import merge_sorted_native
+
+            def merge_fn(slices, **kw):
+                return merge_sorted_native(slices, prof=prof, **kw)
+            return merge_fn
+        return cb.merge_sorted
+
+    # in-flight shard window beyond the mesh width: one extra so the
+    # drain thread always has a completed shard to feed the writer
+    # while every lane computes
+    MESH_WINDOW_SLACK = 1
+
+    def _mesh_produce(self, n_devices: int, wq, controller,
+                      gc_before: int, now: int, werr,
+                      bytes_per_cell: float) -> bool:
+        """Mesh execution mode: token-range shard the whole rewrite by
+        count-weighted boundaries planned from the input sstables'
+        partition indexes, fan per-shard decode->merge across
+        n_devices mesh lanes, and drain the merged shards IN TOKEN
+        ORDER into the writer queue. Token-range shard order is
+        identity-lane order, so the drained stream — and therefore
+        every output byte — is identical to the serial round loop.
+        bytes_per_cell: run()'s on-disk byte/cell ratio (throttle +
+        progress accounting). Returns False (caller runs the serial
+        path) when the inputs expose no index samples to plan from."""
+        from ..parallel import fanout as fanout_mod
+        from ..parallel.boundaries import (boundaries_from_indexes,
+                                           boundaries_to_ranges,
+                                           record_shard_metrics)
+
+        prof = self.profile
+        cfs = self.cfs
+        progress = self.progress
+        t_plan = time.perf_counter()
+        cells_read = sum(r.n_cells for r in self.inputs)
+        # shard count: at least one per lane, sized so a shard is about
+        # one serial round (bounded memory per in-flight shard)
+        n_shards = max(n_devices, -(-cells_read // self.round_cells))
+        n_shards = min(int(n_shards), 4096)
+        bounds = boundaries_from_indexes(self.inputs, n_shards)
+        if bounds is None:
+            return False
+        ranges = boundaries_to_ranges(bounds, n_shards)
+        # exact per-shard INPUT cells from the partition directories
+        # (throttle + progress accounting in on-disk byte terms)
+        shard_in_cells = np.zeros(n_shards, dtype=np.int64)
+        signed_bounds = np.array([hi for (_lo, hi) in ranges[:-1]],
+                                 dtype=np.int64)
+        for r in self.inputs:
+            if r.n_partitions == 0:
+                continue
+            part_cells = np.diff(np.append(r._part_cell0, r.n_cells))
+            ps = np.searchsorted(signed_bounds, r.partition_tokens,
+                                 side="left")
+            np.add.at(shard_in_cells, ps, part_cells)
+        prof["mesh_plan"] = prof.get("mesh_plan", 0.0) \
+            + (time.perf_counter() - t_plan)
+
+        devices = None
+        if self.engine == "device":
+            import jax
+            devs = jax.devices()
+            devices = [devs[i % len(devs)] for i in range(n_devices)]
+
+        def merge_shard(slices, shard_prof):
+            # the same per-engine dispatch run() uses — one source of
+            # merge semantics for both paths (byte identity depends on
+            # it); only the prof sink differs (per-shard, lock-folded)
+            fn = self._engine_merge_fn(shard_prof)
+            return fn(slices, gc_before=gc_before, now=now,
+                      purgeable_ts_fn=controller.purgeable_ts_fn)
+
+        import queue as _queue
+
+        slots: list = [None] * n_shards
+        evs = [threading.Event() for _ in range(n_shards)]
+        errs: list = [None] * n_shards
+        walls = [0.0] * n_shards
+        busy = [0.0] * n_shards
+        decoded_cells = [0] * n_shards
+        stop = threading.Event()
+        # plain Semaphore: a worker that bails between claim and acquire
+        # during an abort may leave the drain's release unmatched —
+        # harmless here, but BoundedSemaphore would raise and mask the
+        # real error
+        sem = threading.Semaphore(n_devices + self.MESH_WINDOW_SLACK)
+        shard_q: _queue.Queue = _queue.Queue()
+        for s in range(n_shards):
+            shard_q.put(s)
+        prof_lock = threading.Lock()
+        self._mesh_completion_order: list[int] = []
+
+        def run_shard(s: int) -> None:
+            shard_prof: dict = {}
+            try:
+                delay = fanout_mod._TEST_SHARD_DELAY
+                if delay:
+                    time.sleep(delay.get(s, 0.0))
+                if self.limiter is not None:
+                    # stop cuts the throttle sleep short AND refunds the
+                    # debit: an aborted task's debt must not throttle
+                    # the re-planned replacement
+                    self.limiter.acquire(
+                        int(shard_in_cells[s] * bytes_per_cell),
+                        cancel=stop)
+                if stop.is_set():   # abort: drop the shard, exit fast
+                    return
+                lo, hi = ranges[s]
+                t0 = time.perf_counter()
+                slices = []
+                for r in self.inputs:
+                    if stop.is_set():
+                        return
+                    w = r.scan_tokens(lo, hi)
+                    if w is not None and len(w):
+                        slices.append(w)
+                t1 = time.perf_counter()
+                shard_prof["mesh_decode"] = t1 - t0
+                decoded_cells[s] = sum(len(x) for x in slices)
+                merged = None
+                if slices and not stop.is_set():
+                    if devices is not None:
+                        h = dmerge.submit_merge(
+                            slices, gc_before=gc_before, now=now,
+                            purgeable_ts_fn=controller.purgeable_ts_fn,
+                            device=devices[s % n_devices])
+                        merged = dmerge.collect_merge(h)
+                    else:
+                        merged = merge_shard(slices, shard_prof)
+                walls[s] = time.perf_counter() - t1
+                shard_prof["mesh_merge"] = walls[s]
+                # busy = decode + merge, throttle sleeps excluded: the
+                # lane-exclusive work an overlap measure sums
+                busy[s] = time.perf_counter() - t0
+                slots[s] = merged
+            except BaseException as e:
+                errs[s] = e
+                stop.set()
+            finally:
+                with prof_lock:
+                    for k, v in shard_prof.items():
+                        prof[k] = prof.get(k, 0.0) + v
+                    self._mesh_completion_order.append(s)
+                evs[s].set()
+
+        def work_loop() -> None:
+            while not stop.is_set():
+                try:
+                    s = shard_q.get_nowait()
+                except _queue.Empty:
+                    return
+                acquired = False
+                while not stop.is_set():
+                    if sem.acquire(timeout=0.1):
+                        acquired = True
+                        break
+                if not acquired:   # stopping: settle the shard's event
+                    evs[s].set()
+                    return
+                run_shard(s)
+
+        # daemon: lanes only read inputs and merge in memory (the
+        # writer owns every on-disk mutation), so a straggler must not
+        # block process exit after an abort already abandoned it
+        workers = [threading.Thread(target=work_loop,
+                                    name=f"compact-mesh-{i}",
+                                    daemon=True)
+                   for i in range(min(n_devices, n_shards))]
+        t_fan = time.perf_counter()
+        for t in workers:
+            t.start()
+        try:
+            for s in range(n_shards):
+                if werr:     # writer died: fail fast
+                    break
+                abort = getattr(cfs, "compaction_abort", None)
+                if (abort is not None and abort.is_set()) or \
+                        (progress is not None and progress.stop_requested):
+                    raise RuntimeError(
+                        "compaction stopped by operator request")
+                evs[s].wait()
+                if errs[s] is not None:
+                    raise errs[s]
+                merged = slots[s]
+                slots[s] = None
+                sem.release()
+                if progress is not None:
+                    progress.set_phase("merge")
+                    progress.add_read(
+                        int(shard_in_cells[s] * bytes_per_cell))
+                if merged is not None and len(merged):
+                    wq.put(merged)
+        finally:
+            stop.set()
+            for t in workers:
+                t.join(timeout=30.0)
+        record_shard_metrics(decoded_cells, walls)
+        # per-shard forensics for bench.py / the multichip entry:
+        # sum(busy)/produce_seconds > 1 proves the lanes actually
+        # overlapped (busy is lane-EXCLUSIVE decode+merge work; a
+        # 1-lane run measures ~1 by construction), the cell spread is
+        # the planner's balance
+        self.mesh_shard_walls = walls
+        self.mesh_shard_busy = busy
+        self.mesh_produce_seconds = time.perf_counter() - t_fan
+        self.mesh_shard_cells = decoded_cells
+        return True
 
     def _handle_corrupt_input(self, exc: BaseException) -> None:
         """Corruption surfacing mid-compaction aborts ONLY this task
@@ -321,15 +569,9 @@ class CompactionTask:
         now = timeutil.now_seconds()
         controller = CompactionController(cfs, self.inputs)
         prof = self.profile
-        if self.engine == "device":
-            merge_fn = None   # device rounds go through submit/collect
-        elif self.engine == "native":
-            from ..ops.host_merge import merge_sorted_native
-
-            def merge_fn(slices, **kw):
-                return merge_sorted_native(slices, prof=prof, **kw)
-        else:
-            merge_fn = cb.merge_sorted
+        # None for the device engine: its rounds go through
+        # submit/collect
+        merge_fn = self._engine_merge_fn(prof)
 
         txn = LifecycleTransaction(cfs.directory)
         writers: list[SSTableWriter] = []
@@ -478,8 +720,21 @@ class CompactionTask:
             wstate["writer"] = new_writer()
             wthread = threading.Thread(target=write_loop, name="compact-w")
             wthread.start()
-            cursors = [_Cursor(r, prof) for r in self.inputs]
-            if self.decode_ahead:
+            # mesh execution mode: shard the rewrite by token range and
+            # fan decode+merge across the mesh lanes; the serial round
+            # loop below is skipped (its cursor list stays empty). Falls
+            # back to the serial path when no boundaries can be planned.
+            mesh_done = False
+            mesh_n = self._effective_mesh_devices()
+            if mesh_n >= 1:
+                if progress is not None:
+                    progress.set_phase("mesh_plan")
+                mesh_done = self._mesh_produce(mesh_n, wq, controller,
+                                               gc_before, now, werr,
+                                               bytes_per_cell)
+            cursors = [] if mesh_done \
+                else [_Cursor(r, prof) for r in self.inputs]
+            if self.decode_ahead and not mesh_done:
                 pf_q = queue.Queue()
                 pf_thread = threading.Thread(target=prefetch_loop,
                                              name="compact-prefetch",

@@ -129,6 +129,13 @@ class Config:
     # completion queue, so output bytes are identical for any size.
     # 0 = auto (one worker per core, capped); hot-resizable.
     compaction_compressor_threads: int = mut(0)
+    # mesh execution mode of the data plane (docs/multichip.md):
+    # compaction tasks and large batched/range reads shard by
+    # count-weighted token-range boundaries and fan across N mesh
+    # lanes (jax devices for the device engine, GIL-releasing host
+    # threads for the native/numpy engines). Output bytes are
+    # identical to the serial paths for any N. 0 = off; hot-reloadable.
+    compaction_mesh_devices: int = mut(0)
     compaction_throughput: float = spec("rate", 64.0, mutable=True)
     # modern-yaml name for the same throttle (DataRateSpec
     # compaction_throughput_mib_per_sec). Negative = unset: the engine

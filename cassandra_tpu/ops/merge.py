@@ -739,10 +739,15 @@ class DeviceMergeHandle:
 
 def submit_merge(batches: list[CellBatch], gc_before: int = 0,
                  now: int = 0, purgeable_ts_fn=None,
-                 prof: dict | None = None) -> DeviceMergeHandle:
+                 prof: dict | None = None,
+                 device=None) -> DeviceMergeHandle:
     """Pack one merge round and dispatch it to the device (async). Rounds
     that can't run on-device (range tombstones, huge partitions) compute
-    synchronously on the host instead."""
+    synchronously on the host instead.
+
+    device: an explicit jax.Device to commit the operands to (the mesh
+    compaction path places shard s's round on mesh device s); None =
+    the default device."""
     import time as _time
     from ..storage.cellbatch import merge_sorted as cb_merge_fallback
 
@@ -769,7 +774,7 @@ def submit_merge(batches: list[CellBatch], gc_before: int = 0,
     if fast is not None:
         buf, cfg, meta = fast
         t2 = _time.perf_counter()
-        h.fut = _plane_program_fast(jax.device_put(buf), cfg)
+        h.fut = _plane_program_fast(jax.device_put(buf, device), cfg)
         # jit compiles synchronously inside the dispatch call: the first
         # call per (kernel, padded-shape, cfg) IS the compile — the
         # profiler splits compile vs warm dispatch on exactly that key
@@ -796,7 +801,7 @@ def submit_merge(batches: list[CellBatch], gc_before: int = 0,
         return h
     planes, cfg = packed_v2
     t2 = _time.perf_counter()
-    planes_d = {k: jax.device_put(v) for k, v in planes.items()}
+    planes_d = {k: jax.device_put(v, device) for k, v in planes.items()}
     h.fut = _plane_program(planes_d, cfg)
     _kprof.record_dispatch("merge.plane_v2",
                            (int(planes["rank"].shape[0]), cfg),

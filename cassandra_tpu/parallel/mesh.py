@@ -1,29 +1,57 @@
-"""Multi-chip compaction: token-range sharding over a jax.sharding.Mesh.
+"""Multi-chip data plane: token-range sharding over a jax device mesh.
 
 Design (SURVEY.md section 5.7): the reference parallelises compaction
 within a node via UCS's ShardManager (db/compaction/ShardManager.java:33 —
 token-range shards compacted independently) and across the cluster by
 ownership. The TPU formulation is the same idea on a device mesh: the
 token ring is split into one contiguous range per device, each device
-runs the merge/reconcile kernel on its shard (shard_map; no cross-device
-traffic for the merge itself — shards are disjoint), and per-shard stats
-are combined with psum over ICI.
+runs the merge/reconcile kernel on its shard, and the shard outputs
+concatenate — in token order — into exactly the single-device merge.
 
-The same step doubles as the driver's multichip dry run: it is the full
-"training step" of this framework — one round of the LSM data plane.
+Two execution paths share the boundary planner:
+
+  per-device dispatch (_run_sharded, the data-plane path): each shard's
+      operands are committed to its own mesh device and the jitted merge
+      program is driven from a dedicated host thread, so the S
+      executions genuinely overlap (measured: the PJRT CPU client
+      serializes executions dispatched from ONE thread even across
+      devices — ready-times walk up linearly; driven from S threads
+      they overlap). Each shard pads to its own power-of-two bucket,
+      so a skewed shard no longer inflates every other shard's padded
+      program the way the old [S, N_max] layout did.
+  shard_map (sharded_merge_step, the one-program demo kernel): the
+      original SPMD formulation, kept as the driver's jittable
+      multi-chip step and for deployments where one fused program
+      beats S dispatches.
+
+Boundary planning (the ShardManager.computeBoundaries role) lives in
+the jax-free sibling module `boundaries.py` — count-weighted over
+DISTINCT cells (see its docstring for the why) — and is re-exported
+here so existing `parallel.mesh` imports keep working; host-engine
+mesh paths import from `parallel.boundaries` directly to avoid this
+module's jax import.
+
+The per-shard stats every path records land in the `mesh.*` metrics
+group (service/metrics.py -> Prometheus): shard cells, device wall
+time, shard imbalance.
 """
 from __future__ import annotations
 
-from functools import partial
+import threading
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.merge import merge_reconcile_kernel
 from ..storage.cellbatch import (DEATH_FLAGS, FLAG_COMPLEX_DEL,
                                  FLAG_EXPIRING, CellBatch)
+from .boundaries import (_BIAS, batch_tokens_u64,  # noqa: F401
+                         boundaries_from_indexes, boundaries_to_ranges,
+                         distinct_token_weights, plan_token_boundaries,
+                         record_shard_metrics, shard_imbalance)
 
 
 def make_mesh(n_devices: int | None = None) -> Mesh:
@@ -37,62 +65,42 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.array(devs), ("shard",))
 
 
+# ---------------------------------------------------- boundary planning --
+# (planners live in boundaries.py — jax-free — and are re-exported
+# above; the split below is the mesh-side consumer)
+
+def compute_shards(cat: CellBatch, n_shards: int, boundaries=None):
+    """Assign every cell to its token-range shard. Returns (bounds,
+    shard_of, pos_in_shard, members). boundaries=None plans
+    distinct-weighted ones from the batch itself."""
+    n = len(cat)
+    tok = batch_tokens_u64(cat)
+    if boundaries is None:
+        uniq, w = distinct_token_weights(cat)
+        boundaries = plan_token_boundaries(uniq, w, n_shards)
+    bounds = np.asarray(boundaries, dtype=np.uint64)
+    shard_of = np.searchsorted(bounds, tok, side="left").astype(np.int32)
+    pos_in_shard = np.zeros(n, dtype=np.int64)
+    members: list[np.ndarray] = []
+    for s in range(n_shards):
+        idx = np.flatnonzero(shard_of == s)
+        members.append(idx)
+        pos_in_shard[idx] = np.arange(len(idx))
+    return bounds, shard_of, pos_in_shard, members
+
+
 # ------------------------------------------------------------- host split --
 
 def shard_batch(cat: CellBatch, n_shards: int, gc_before: int = 0,
-                now: int = 0) -> tuple[dict, np.ndarray, np.ndarray]:
+                now: int = 0, boundaries=None):
     """Split a concatenated (unsorted) batch into n token-range shards of
     equal padded size and build the [S, N] operand arrays for
-    sharded_merge_step. Returns (operands, shard_of_cell, position_in_shard)
-    so the host can map kernel outputs back to cells.
-
-    Shard boundaries are count-balanced quantiles of the token distribution
-    (ShardManager.computeBoundaries role), weighted by per-token cell
-    counts: boundaries land between DISTINCT tokens and each one is chosen
-    greedily against the cells still unassigned, so a hot partition that
-    overshoots its shard's target makes the remaining shards re-balance
-    around it instead of starving (the naive positional quantile gave
-    130k-vs-6.2k shards on the skewed multichip sweep)."""
+    sharded_merge_step (the one-program shard_map path). Returns
+    (operands, shard_of, position_in_shard, shard_members) so the host
+    can map kernel outputs back to cells."""
     n = len(cat)
-    with np.errstate(over="ignore"):
-        tok = (cat.lanes[:, 0].astype(np.uint64) << np.uint64(32)) \
-            | cat.lanes[:, 1].astype(np.uint64)
-    uniq, counts = np.unique(tok, return_counts=True)
-    cum = np.cumsum(counts)
-    bounds = np.empty(n_shards - 1, dtype=np.uint64)
-    taken = 0          # distinct tokens already assigned
-    assigned = 0       # cells already assigned
-    for s in range(n_shards - 1):
-        ideal = (n - assigned) / (n_shards - s)
-        target = assigned + ideal
-        k = taken + int(np.searchsorted(cum[taken:], target, side="left"))
-        if k >= len(cum):
-            take = len(cum)
-        else:
-            below = (int(cum[k - 1]) if k > 0 else 0) - assigned
-            above = int(cum[k]) - assigned
-            # split by RELATIVE deviation from the ideal shard size: a
-            # hot token right after a small remainder must be absorbed
-            # (overshoot) rather than leave a starved sliver shard —
-            # absolute distance picks the sliver when the hot token is
-            # more than 2x the ideal
-
-            def dev(sz):
-                return max(sz / ideal, ideal / sz) if sz > 0 \
-                    else float("inf")
-
-            take = k + 1 if dev(above) <= dev(below) else k
-        if taken < len(cum):
-            take = max(take, taken + 1)   # a shard never goes empty
-            # while distinct tokens remain
-        take = min(take, len(cum))
-        # bounds[s] = LAST token of shard s; equal tokens stay together
-        # on the left side (side='left' assignment below)
-        bounds[s] = uniq[take - 1] if take > 0 else uniq[0]
-        assigned = int(cum[take - 1]) if take > 0 else 0
-        taken = take
-    shard_of = np.searchsorted(bounds, tok, side="left").astype(np.int32)
-
+    _bounds, shard_of, pos_in_shard, shard_members = compute_shards(
+        cat, n_shards, boundaries)
     counts = np.bincount(shard_of, minlength=n_shards)
     N = max(1024, int(1 << int(np.ceil(np.log2(max(counts.max(), 1))))))
 
@@ -110,13 +118,9 @@ def shard_batch(cat: CellBatch, n_shards: int, gc_before: int = 0,
 
     with np.errstate(over="ignore"):
         uts = cat.ts.astype(np.uint64) ^ np.uint64(1 << 63)
-    pos_in_shard = np.zeros(n, dtype=np.int64)
-    shard_members: list[np.ndarray] = []
     for s in range(S):
-        idx = np.flatnonzero(shard_of == s)
-        shard_members.append(idx)
+        idx = shard_members[s]
         c = len(idx)
-        pos_in_shard[idx] = np.arange(c)
         lanes[s, :c] = cat.lanes[idx]
         valid[s, :c] = 0
         ts_h[s, :c] = (uts[idx] >> np.uint64(32)).astype(np.uint32)
@@ -133,17 +137,6 @@ def shard_batch(cat: CellBatch, n_shards: int, gc_before: int = 0,
         "gc_before": np.int32(gc_before), "now": np.int32(now),
     }
     return operands, shard_of, pos_in_shard, shard_members
-
-
-def shard_imbalance(sizes) -> float:
-    """max/mean shard-size factor (1.0 = perfectly balanced) — the skew
-    health metric the multichip sweep reports per case. Unsplittable hot
-    partitions lower-bound it at hot_cells / mean."""
-    sizes = list(sizes)
-    total = sum(sizes)
-    if not sizes or total == 0:
-        return 1.0
-    return max(sizes) / (total / len(sizes))
 
 
 # ----------------------------------------------------------- device step --
@@ -189,72 +182,188 @@ def sharded_merge_step(mesh: Mesh):
     return step
 
 
-def _run_sharded(cat: CellBatch, mesh: Mesh, gc_before: int, now: int):
-    """split -> device step -> host tie-break. Returns the full per-shard
-    state (keep/perm/masks in shard-padded [S, N] layout, member index
-    lists, psum'd stats)."""
+# ------------------------------------------------ per-device dispatch --
+
+def _shard_bucket(n: int) -> int:
+    b = 1024
+    while b < n:
+        b <<= 1
+    return b
+
+
+@jax.jit
+def _shard_merge_program(operands):
+    """One shard's whole merge as ONE program (traced LSD sort +
+    reconcile): jit caches per (shapes, device), so S same-shaped
+    shards on S devices compile once per device and stay warm across
+    rounds."""
+    return merge_reconcile_kernel(operands)
+
+
+def _pack_shard_operands(cat: CellBatch, idx: np.ndarray,
+                         gc_before: int, now: int) -> dict:
+    """Kernel operand arrays for one shard, padded to the shard's OWN
+    power-of-two bucket (the [S, N_max] layout paid every shard the
+    skew of the largest one)."""
+    c = len(idx)
+    N = _shard_bucket(c)
+    K = cat.n_lanes
+    lanes = np.full((N, K), 0xFFFFFFFF, dtype=np.uint32)
+    lanes[:c] = cat.lanes[idx]
+    valid = np.ones(N, dtype=np.uint32)
+    valid[:c] = 0
+    with np.errstate(over="ignore"):
+        uts = cat.ts[idx].astype(np.uint64) ^ np.uint64(1 << 63)
+    ts_h = np.zeros(N, dtype=np.uint32)
+    ts_l = np.zeros(N, dtype=np.uint32)
+    ts_h[:c] = (uts >> np.uint64(32)).astype(np.uint32)
+    ts_l[:c] = (uts & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    death = np.zeros(N, dtype=np.uint32)
+    death[:c] = (cat.flags[idx] & DEATH_FLAGS) != 0
+    cdel = np.zeros(N, dtype=np.uint32)
+    cdel[:c] = (cat.flags[idx] & FLAG_COMPLEX_DEL) != 0
+    ldt = np.zeros(N, dtype=np.int32)
+    ldt[:c] = cat.ldt[idx]
+    expiring = np.zeros(N, dtype=np.uint32)
+    expiring[:c] = (cat.flags[idx] & FLAG_EXPIRING) != 0
+    purge = np.full(N, 0xFFFFFFFF, dtype=np.uint32)
+    return {
+        "lanes": lanes, "valid": valid, "ts_h": ts_h, "ts_l": ts_l,
+        "death": death, "cdel": cdel, "ldt": ldt, "expiring": expiring,
+        "purge_h": purge, "purge_l": purge.copy(),
+        "gc_before": np.int32(gc_before), "now": np.int32(now),
+    }
+
+
+def _run_sharded(cat: CellBatch, mesh: Mesh, gc_before: int, now: int,
+                 boundaries=None):
+    """split -> per-device dispatch -> host tie-break. Each shard's
+    program is committed to its own mesh device and DRIVEN FROM ITS OWN
+    HOST THREAD: the PJRT client serializes executions dispatched from
+    one thread even across devices (measured: ready-times walk up
+    linearly), while thread-driven executions overlap. Returns the full
+    per-shard state (keep/perm/masks in shard-padded [S, N] layout,
+    member index lists, (kept, dropped) stats) plus per-shard device
+    wall seconds."""
     from ..ops.merge import host_tiebreak, unpack_masks
 
     n_shards = mesh.devices.size
-    operands, shard_of, pos, members = shard_batch(cat, n_shards,
-                                                   gc_before, now)
-    step = sharded_merge_step(mesh)
-    jop = {k: jnp.asarray(v) for k, v in operands.items()}
-    import time as _time
+    devices = list(mesh.devices.flat)
+    _bounds, shard_of, pos, members = compute_shards(cat, n_shards,
+                                                     boundaries)
+    results: list = [None] * n_shards
+    walls = [0.0] * n_shards
+    errors: list[BaseException] = []
+
+    def run_shard(s: int) -> None:
+        idx = members[s]
+        if len(idx) == 0:
+            return
+        try:
+            ops_np = _pack_shard_operands(cat, idx, gc_before, now)
+            t0 = time.perf_counter()
+            jop = {k: jax.device_put(v, devices[s])
+                   for k, v in ops_np.items()}
+            perm_d, packed_d = _shard_merge_program(jop)
+            perm = np.asarray(perm_d)
+            packed = np.asarray(packed_d)
+            walls[s] = time.perf_counter() - t0
+            results[s] = (perm, packed)
+        except BaseException as e:   # surfaced after join
+            errors.append(e)
 
     from ..service.profiling import GLOBAL as _kprof
-    t0 = _time.perf_counter()
-    perm, packed, stats = step(jop)
+    t_all = time.perf_counter()
+    live = [s for s in range(n_shards) if len(members[s])]
+    if len(live) <= 1:
+        for s in live:
+            run_shard(s)
+    else:
+        threads = [threading.Thread(target=run_shard, args=(s,),
+                                    name=f"mesh-shard-{s}")
+                   for s in live]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    if errors:
+        raise errors[0]
+    dispatch_s = time.perf_counter() - t_all
     _kprof.record_dispatch(
         "merge.sharded_step",
-        (mesh.devices.size, tuple(jop["lanes"].shape)),
-        _time.perf_counter() - t0)
-    t0 = _time.perf_counter()
-    perm = np.asarray(perm)
-    _kprof.record_execute("merge.sharded_step",
-                          _time.perf_counter() - t0)
-    keep, amb, expired, shadowed = unpack_masks(np.asarray(packed))
+        (n_shards, (len(cat), cat.n_lanes)),
+        dispatch_s)
+    _kprof.record_execute("merge.sharded_step", max(walls) if walls
+                          else 0.0)
+
+    # assemble the shard-padded [S, N] view (N = largest shard bucket)
+    N = max((_shard_bucket(len(members[s])) for s in live), default=1024)
+    keep = np.zeros((n_shards, N), dtype=bool)
+    amb = np.zeros((n_shards, N), dtype=bool)
+    expired = np.zeros((n_shards, N), dtype=bool)
+    shadowed = np.zeros((n_shards, N), dtype=bool)
+    perm = np.zeros((n_shards, N), dtype=np.int32)
+    for s in live:
+        p, packed = results[s]
+        k, a, e, sh = unpack_masks(packed)
+        w = len(p)
+        keep[s, :w] = k
+        amb[s, :w] = a
+        expired[s, :w] = e
+        shadowed[s, :w] = sh
+        perm[s, :w] = p
     # equal-(identity, ts) winners need the exact death/value rules — per
     # shard, map sorted positions back into cat and resolve on host.
-    # The device stats (psum over the mesh) are adjusted by the (rare)
-    # tie-break keep-count delta instead of being recomputed.
-    delta = 0
-    for s in range(n_shards):
+    for s in live:
         c = len(members[s])
         if c == 0 or not amb[s, :c].any():
             continue
-        before = int(keep[s, :c].sum())
         perm_real = members[s][perm[s, :c]]
         host_tiebreak(cat, perm_real, keep[s, :c], amb[s, :c],
                       shadowed[s, :c], expired[s, :c], gc_before, None)
-        delta += int(keep[s, :c].sum()) - before
-    stats = np.asarray(stats) + np.array([delta, -delta])
-    return (keep, perm, expired, shadowed, stats, shard_of, pos, members)
+    kept = sum(int(keep[s, :len(members[s])].sum()) for s in live)
+    stats = np.array([kept, len(cat) - kept], dtype=np.int64)
+    record_shard_metrics([len(members[s]) for s in range(n_shards)],
+                         walls)
+    return (keep, perm, expired, shadowed, stats, shard_of, pos, members,
+            walls, dispatch_s)
 
 
 def run_sharded_merge(cat: CellBatch, mesh: Mesh, gc_before: int = 0,
-                      now: int = 0):
-    """Host orchestration: split -> device step -> host tie-break ->
+                      now: int = 0, boundaries=None):
+    """Host orchestration: split -> per-device step -> host tie-break ->
     per-shard outputs. Returns (keep [S,N] numpy, perm [S,N],
     stats (kept, dropped), shard_of, pos_in_shard)."""
-    keep, perm, _, _, stats, shard_of, pos, _ = _run_sharded(
-        cat, mesh, gc_before, now)
+    keep, perm, _, _, stats, shard_of, pos, _, _, _ = _run_sharded(
+        cat, mesh, gc_before, now, boundaries)
     return keep, perm, stats, shard_of, pos
 
 
 def materialize_sharded_merge(cat: CellBatch, mesh: Mesh,
-                              gc_before: int = 0,
-                              now: int = 0) -> list[CellBatch]:
+                              gc_before: int = 0, now: int = 0,
+                              boundaries=None,
+                              walls_out: list | None = None,
+                              dispatch_out: list | None = None
+                              ) -> list[CellBatch]:
     """Per-shard merged CellBatches, token-ordered: shard s holds exactly
     the cells whose token falls in its range, reconciled, sorted. The
     concatenation equals the single-device merge output bit-for-bit, and
     each element can feed its own SSTableWriter — the ShardManager model
     (db/compaction/ShardManager.java:33: disjoint token shards feed
-    independent writers)."""
+    independent writers). walls_out (optional list) receives the
+    per-shard device wall seconds; dispatch_out receives the one-element
+    [elapsed seconds] of the whole concurrent dispatch (first thread
+    start to last join) — the denominator an overlap proof needs (the
+    per-shard walls alone cannot distinguish overlap from a sequential
+    loop)."""
     from ..ops.merge import finalize_merged
 
-    keep, perm, expired, shadowed, _, _, _, members = _run_sharded(
-        cat, mesh, gc_before, now)
+    (keep, perm, expired, shadowed, _, _, _, members, walls,
+     dispatch_s) = _run_sharded(cat, mesh, gc_before, now, boundaries)
+    if walls_out is not None:
+        walls_out[:] = walls
+    if dispatch_out is not None:
+        dispatch_out[:] = [dispatch_s]
     out: list[CellBatch] = []
     for s in range(len(members)):
         c = len(members[s])

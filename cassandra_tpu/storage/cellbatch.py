@@ -514,7 +514,14 @@ class CellBatch:
         off = np.concatenate(offs)
         val_start = np.concatenate(vstarts)
         pk_map: dict[bytes, bytes] = {}
+        seen_maps: set[int] = set()
         for b in batches:
+            # slices share their parent's pk_map OBJECT: a many-slice
+            # concat (the batched-read shard merge) would re-walk the
+            # same full map once per slice — merge each dict once
+            if id(b.pk_map) in seen_maps:
+                continue
+            seen_maps.add(id(b.pk_map))
             for k, v in b.pk_map.items():
                 prev = pk_map.get(k)
                 if prev is not None and prev != v:

@@ -141,6 +141,22 @@ class StorageEngine:
                                 self._compressor_listener)
         _compress_pool.configure(
             self.settings.get("compaction_compressor_threads"))
+        # mesh execution mode (compaction shards + batched/range read
+        # fan-out): the worker POOL is process-global like the
+        # compressor pool, but the demand is ENGINE-OWNED — the pool
+        # sizes to the max across co-hosted engines and each engine's
+        # stores/tasks route by THIS engine's knob (mesh_devices_fn),
+        # so one node's knob never flips a co-hosted node's data plane.
+        # Hot-reloadable; in-flight compactions pick the new width up
+        # on their next task.
+        from ..parallel import fanout as _mesh_fanout
+        self._mesh_listener = \
+            lambda n: _mesh_fanout.configure(n, owner=self)
+        self.settings.on_change("compaction_mesh_devices",
+                                self._mesh_listener)
+        _mesh_fanout.configure(
+            self.settings.get("compaction_mesh_devices"), owner=self)
+        self.compactions.mesh_devices_fn = self._mesh_devices
 
         # group-commit window hot-reload (nodetool/settings vtable)
         def _resolve_group_window(v):
@@ -195,6 +211,12 @@ class StorageEngine:
         # TRACING ON sessions and trace_probability-sampled ones
         from ..service.tracing import TraceStore
         self.trace_store = TraceStore()
+
+    def _mesh_devices(self) -> int:
+        """This engine's mesh width (its knob, not the shared pool's —
+        the pool sizes to the max across co-hosted engines; routing is
+        always by the owning engine's own setting)."""
+        return max(int(self.settings.get("compaction_mesh_devices")), 0)
 
     @property
     def _schema_path(self) -> str:
@@ -257,6 +279,7 @@ class StorageEngine:
                                     "memtable_shards") or None,
                                 failures=self.failures)
         cfs.backup_enabled = lambda: self.incremental_backup
+        cfs.mesh_devices_fn = self._mesh_devices
         self.compactions.register(cfs)
         self.stores[t.id] = cfs
         return cfs
@@ -432,6 +455,12 @@ class StorageEngine:
                                       self._compactor_listener)
         self.settings.remove_listener("compaction_compressor_threads",
                                       self._compressor_listener)
+        self.settings.remove_listener("compaction_mesh_devices",
+                                      self._mesh_listener)
+        # a closing engine's lane demand must not keep the shared pool
+        # sized for it (or keep mesh mode on for nobody)
+        from ..parallel import fanout as _mesh_fanout
+        _mesh_fanout.configure(0, owner=self)
         self.settings.remove_listener("commitlog_sync_group_window",
                                       self._group_window_listener)
         self.settings.remove_listener("row_cache_size",

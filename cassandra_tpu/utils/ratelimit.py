@@ -78,9 +78,14 @@ class RateLimiter:
             self.bytes_acquired += n
             return True
 
-    def acquire(self, nbytes: int) -> float:
+    def acquire(self, nbytes: int, cancel=None) -> float:
         """Debit nbytes tokens, sleeping until the bucket allows them.
-        Returns seconds slept (0.0 on the unthrottled fast path)."""
+        Returns seconds slept (0.0 on the unthrottled fast path).
+
+        cancel: an optional threading.Event — a set event cuts the
+        sleep short and REFUNDS the debit (the caller is abandoning the
+        work the tokens were for, so its debt must not throttle the
+        task that replaces it)."""
         if self.rate <= 0:
             return 0.0
         with self._lock:
@@ -103,5 +108,31 @@ class RateLimiter:
         # sleep OUTSIDE the lock: a throttled task must not block other
         # compactors' token accounting
         if wait > 0.0:
-            self._sleep(wait)
+            if cancel is not None and cancel.is_set():
+                # cancelled before sleeping at all: full refund
+                with self._lock:
+                    self._allowance = min(self.rate,
+                                          self._allowance + nbytes)
+                    self.bytes_acquired -= nbytes
+                    self.seconds_throttled -= wait
+                return 0.0
+            if cancel is not None and self._sleep is time.sleep:
+                t0 = self._clock()
+                if cancel.wait(wait):
+                    slept = min(max(self._clock() - t0, 0.0), wait)
+                    with self._lock:
+                        self._allowance = min(self.rate,
+                                              self._allowance + nbytes)
+                        self.bytes_acquired -= nbytes
+                        # the refund covers the TIME too: the portion of
+                        # the projected wait the cancel cut short never
+                        # throttled anything
+                        self.seconds_throttled -= wait - slept
+                    return slept
+            else:
+                # injected sleep/clock (tests, simulation): keep the
+                # 'testable without real sleeps' contract — the virtual
+                # sleep runs in full and cancellation is observed at
+                # the call boundaries above, never via a real-time wait
+                self._sleep(wait)
         return wait

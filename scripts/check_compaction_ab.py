@@ -18,9 +18,11 @@ transitions (alternating compressible text and incompressible random
 partitions — the payload stream enters and leaves skip mode):
 
   1. the same input sstables major-compacted with the serial compress
-     thread, a 1-worker pool and a 4-worker pool (+ decode-ahead) must
-     produce sha256-identical components AND equal merged-view
-     content_digests;
+     thread, a 1-worker pool and a 4-worker pool (+ decode-ahead), and
+     under the mesh execution mode (2 lanes, and 4 lanes combined with
+     a 2-worker pool — docs/multichip.md: token-range shards drained in
+     token order) must produce sha256-identical components AND equal
+     merged-view content_digests;
   2. the same mutation set flushed with CTPU_WRITE_FASTPATH=0 (serial
      sort-and-write) and =1 over 1- and 4-worker shared pools must
      produce identical sstable bytes and read-back digests.
@@ -138,6 +140,15 @@ def check_compaction(base: str) -> list[str]:
                       decode_ahead=True),
         "pool4": dict(pipelined_io=True, compress_pool=CompressorPool(4),
                       decode_ahead=True),
+        # mesh execution mode (docs/multichip.md): token-range-sharded
+        # decode->merge fanned across mesh lanes, drained in token
+        # order — bytes must match serial for any lane count, including
+        # combined with the parallel compress pool
+        "mesh2": dict(pipelined_io=True, compress_pool=0,
+                      decode_ahead=False, mesh_devices=2),
+        "mesh4_pool2": dict(pipelined_io=True,
+                            compress_pool=CompressorPool(2),
+                            decode_ahead=False, mesh_devices=4),
     }
     results = {tag: _compaction_leg(base, pristine, table, tag, **kw)
                for tag, kw in legs.items()}
@@ -271,7 +282,8 @@ def main() -> int:
             print(f"  {d}", file=sys.stderr)
         return 1
     print("compaction/flush parallel-compression A/B: zero divergence "
-          "(serial vs threaded vs pool-1 vs pool-4)")
+          "(serial vs threaded vs pool-1 vs pool-4 vs mesh-2 vs "
+          "mesh-4+pool-2)")
     return 0
 
 

@@ -15,6 +15,12 @@ values are the caller's contract.
 Names passed to a *group* facade (cfs.latency.hist("read_latency")) are
 single components: the group prefix supplies the rest.
 
+Beyond structure, every dotted name's TOP-LEVEL group must be one of
+the documented groups (KNOWN_GROUPS — the "Established groups" list in
+docs/observability.md plus the mesh.* data-plane group from
+docs/multichip.md): a typo'd or undocumented group fails the check, so
+new groups land in the docs the same commit they land in code.
+
 Exit 0 = clean; exit 1 prints each violating file:line and name.
 """
 from __future__ import annotations
@@ -37,6 +43,15 @@ FULL_RE = re.compile(rf"^{COMPONENT}(\.{ANY_COMPONENT})+$")
 PREFIX_RE = re.compile(rf"^{COMPONENT}(\.{ANY_COMPONENT})*$")
 SINGLE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 
+# the documented top-level groups (docs/observability.md "Established
+# groups" + the mesh.* group from docs/multichip.md)
+KNOWN_GROUPS = {
+    "client_requests", "clients", "commitlog", "compaction",
+    "compress_pool", "cql", "flush", "hints", "mesh",
+    "prepared_statements", "reads", "request", "storage", "system",
+    "table", "verb",
+}
+
 
 def _collapse_placeholders(name: str) -> str:
     return re.sub(r"\{[^{}]*\}", "X", name)
@@ -45,11 +60,22 @@ def _collapse_placeholders(name: str) -> str:
 def check_name(method: str, raw: str) -> bool:
     name = _collapse_placeholders(raw)
     if method == "group":
-        return PREFIX_RE.match(name) is not None
+        # dotless prefixes are indistinguishable from re.Match.group()
+        # captures — only dotted prefixes get the group check
+        return (PREFIX_RE.match(name) is not None
+                and ("." not in name or _known_group(name)))
     if "." in name:
-        return FULL_RE.match(name) is not None
-    # dotless: a group-member name (one component)
+        return (FULL_RE.match(name) is not None
+                and _known_group(name))
+    # dotless: a group-member name (one component) — the group facade
+    # supplied (and already validated) the prefix
     return SINGLE_RE.match(name) is not None
+
+
+def _known_group(name: str) -> bool:
+    top = name.split(".", 1)[0]
+    # an f-placeholder top group is the caller's contract, not ours
+    return top == "X" or top in KNOWN_GROUPS
 
 
 def scan(paths=None) -> list[tuple[str, int, str, str]]:
