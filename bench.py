@@ -438,6 +438,66 @@ def run_mesh_bench(base_dir: str, table, cfg) -> dict:
     }
 
 
+def run_pipeline_bench(base_dir: str, table, cfg) -> dict:
+    """Pipeline-ledger section (docs/observability.md): the unified
+    per-stage accounting table — busy/stall/idle seconds, items/bytes
+    and queue high-water — for one compaction, one pipelined flush and
+    one mesh (2-lane) compaction, plus a reconciliation of the ledger's
+    write-leg busy seconds against the task profile's phase split
+    (write-phase stall attribution: the phases overlap on different
+    threads, so the ledger's per-stage numbers are the capacities and
+    the stalls say which stage the wall actually waited on). This is
+    the where-did-the-wall-go table ROADMAP item 1 navigates by."""
+    from cassandra_tpu.storage.table import ColumnFamilyStore
+    from cassandra_tpu.utils import pipeline_ledger
+
+    small = {k: v for k, v in cfg.items() if k != "l1_runs"}
+    small["runs"] = [131_072] * 3
+    pipeline_ledger.reset_all()
+
+    # --- compaction leg (serial data plane, pipelined write leg)
+    cdir = os.path.join(base_dir, "compact")
+    cfs = ColumnFamilyStore(table, cdir, commitlog=None)
+    build_inputs(cfs.directory, table, 7, small)
+    stats = _compact_dir(cdir, table, cfs=cfs, **_task_knobs())
+    compaction_stages = pipeline_ledger.ledger("compaction").snapshot()
+    pool_stage = pipeline_ledger.ledger("compress_pool").snapshot()
+
+    # reconcile ledger vs the profile phase split: same clock, same
+    # boundaries — they must agree within noise for the serialize/
+    # compress/io_write stages the writer accounts to both
+    prof = stats["profile"]
+    reconcile = {}
+    for stage in ("serialize", "compress", "io_write"):
+        led_s = compaction_stages.get(stage, {}).get("busy_s", 0.0)
+        reconcile[stage] = {
+            "profile_s": round(prof.get(stage, 0.0), 3),
+            "ledger_busy_s": round(led_s, 3),
+        }
+
+    # --- mesh leg: 2 lanes through the same ledger (decode/merge)
+    mdir = os.path.join(base_dir, "mesh")
+    mcfs = ColumnFamilyStore(table, mdir, commitlog=None)
+    build_inputs(mcfs.directory, table, 8, small)
+    _compact_dir(mdir, table, cfs=mcfs, mesh_devices=2, **_task_knobs())
+    mesh_stages = pipeline_ledger.ledger("mesh").snapshot()
+
+    # --- flush leg: drain -> serialize -> compress -> io_write
+    flush_stats = _flush_leg(os.path.join(base_dir, "flush"), True,
+                             2048, 16)
+    flush_stages = pipeline_ledger.ledger("flush").snapshot()
+
+    return {
+        "compaction": compaction_stages,
+        "flush": flush_stages,
+        "mesh": mesh_stages,
+        "compress_pool": pool_stage,
+        "reconcile_write_phase": reconcile,
+        "flush_leg": flush_stats,
+        "compaction_wall_s": round(stats["wall"], 3),
+    }
+
+
 def run_codec_bench():
     """compress_iov micro-benchmark: the native zero-copy FFI path vs
     the generic Python fallback (now also staging-copy-free on the
@@ -944,6 +1004,11 @@ def main():
             # compress_iov micro-benchmark: native FFI vs the generic
             # fallback — codec regressions are visible here
             "codec": run_codec_bench(),
+            # unified pipeline ledger (docs/observability.md): per-stage
+            # busy/stall/queue-occupancy for compaction, flush and mesh
+            # lanes + reconciliation against the profile phase split
+            "pipeline": run_pipeline_bench(
+                os.path.join(base, "pipeline"), table, cfg),
             # decayed (windowed) latency snapshot + the Prometheus
             # exposition the exporter serves (nodetool exportmetrics)
             "metrics": {

@@ -12,6 +12,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from ..service import diagnostics
 from .messaging import MessagingService, Verb
 from .ring import Endpoint
 
@@ -131,6 +132,10 @@ class Gossiper:
                     if not st.alive and (not st.forced_down or gen_advance):
                         st.alive = True
                         st.forced_down = False
+                        diagnostics.publish("gossip.status",
+                                            endpoint=ep.name,
+                                            alive=True,
+                                            source=self.ep.name)
                         if self.on_alive:
                             self.on_alive(ep)
         if self.on_app_state:
@@ -174,10 +179,16 @@ class Gossiper:
                 alive = self.detector.is_alive(st, now)
                 if st.alive and not alive:
                     st.alive = False
+                    diagnostics.publish("gossip.status",
+                                        endpoint=ep.name, alive=False,
+                                        source=self.ep.name)
                     if self.on_dead:
                         self.on_dead(ep)
                 elif not st.alive and alive and not st.forced_down:
                     st.alive = True
+                    diagnostics.publish("gossip.status",
+                                        endpoint=ep.name, alive=True,
+                                        source=self.ep.name)
                     if self.on_alive:
                         self.on_alive(ep)
 
@@ -211,6 +222,9 @@ class Gossiper:
             st.forced_down = True
             st.arrival_intervals.clear()
             st.last_heartbeat = self.clock() - 1e9
+        diagnostics.publish("gossip.status", endpoint=ep.name,
+                            alive=False, forced=True,
+                            source=self.ep.name)
 
     # ------------------------------------------------------------ lifecycle
 

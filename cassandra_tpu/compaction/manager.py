@@ -185,14 +185,33 @@ class CompactionManager:
         task.limiter = self.limiter
         task.progress = info
         self.active.begin(info)
+        from ..service import diagnostics
+        diagnostics.publish("compaction.start",
+                            keyspace=cfs.table.keyspace,
+                            table=cfs.table.name, kind=kind,
+                            inputs=len(task.inputs),
+                            bytes=info.total_bytes)
         t0 = time.monotonic()
+        stats = None
         try:
             stats = task.execute()
+        except BaseException as e:
+            diagnostics.publish("compaction.abort",
+                                keyspace=cfs.table.keyspace,
+                                table=cfs.table.name, kind=kind,
+                                error=repr(e))
+            raise
         finally:
             self.active.finish(info)
             self._release(cfs, task.inputs)
         record_completion(stats, time.monotonic() - t0)
         self.completed.append(stats)
+        diagnostics.publish("compaction.finish",
+                            keyspace=cfs.table.keyspace,
+                            table=cfs.table.name, kind=kind,
+                            bytes_read=stats.get("bytes_read", 0),
+                            bytes_written=stats.get("bytes_written", 0),
+                            seconds=round(stats.get("seconds", 0.0), 3))
         return stats
 
     def _maybe_compact(self, cfs, locked: bool = False) -> int:

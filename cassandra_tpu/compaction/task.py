@@ -404,6 +404,16 @@ class CompactionTask:
 
         import queue as _queue
 
+        from ..service import tracing
+        from ..utils import pipeline_ledger
+
+        mesh_led = pipeline_ledger.ledger("mesh")
+        led_decode = mesh_led.stage("decode")
+        led_merge = mesh_led.stage("merge")
+        # shard dispatch/completion under the active trace session (the
+        # thread driving the compaction; lanes have no contextvar)
+        trace_st = tracing.active()
+
         slots: list = [None] * n_shards
         evs = [threading.Event() for _ in range(n_shards)]
         errs: list = [None] * n_shards
@@ -421,6 +431,10 @@ class CompactionTask:
             shard_q.put(s)
         prof_lock = threading.Lock()
         self._mesh_completion_order: list[int] = []
+        # merged-but-undrained shards: the mesh pipeline's inbound
+        # queue to the writer drain (high-water = how far lanes ran
+        # ahead of the token-order drain)
+        ready_count = [0]
 
         def run_shard(s: int) -> None:
             shard_prof: dict = {}
@@ -428,13 +442,20 @@ class CompactionTask:
                 delay = fanout_mod._TEST_SHARD_DELAY
                 if delay:
                     time.sleep(delay.get(s, 0.0))
+                if trace_st is not None:
+                    trace_st.add(f"Mesh shard {s} dispatched "
+                                 f"({int(shard_in_cells[s])} cell(s))")
                 if self.limiter is not None:
                     # stop cuts the throttle sleep short AND refunds the
                     # debit: an aborted task's debt must not throttle
                     # the re-planned replacement
+                    t_thr = time.perf_counter()
                     self.limiter.acquire(
                         int(shard_in_cells[s] * bytes_per_cell),
                         cancel=stop)
+                    # throttle sleeps are decode-stage stalls in the
+                    # ledger (paid before the lane touches data)
+                    led_decode.add_stall(time.perf_counter() - t_thr)
                 if stop.is_set():   # abort: drop the shard, exit fast
                     return
                 lo, hi = ranges[s]
@@ -465,6 +486,17 @@ class CompactionTask:
                 # lane-exclusive work an overlap measure sums
                 busy[s] = time.perf_counter() - t0
                 slots[s] = merged
+                # per-stage ledger accounting (the same numbers the
+                # shard_prof folds into the task profile, accumulated
+                # process-wide under pipeline `mesh`)
+                led_decode.add_busy(shard_prof.get("mesh_decode", 0.0))
+                led_decode.add_items(
+                    1, int(shard_in_cells[s] * bytes_per_cell))
+                led_merge.add_busy(walls[s])
+                led_merge.add_items(decoded_cells[s])
+                if trace_st is not None:
+                    trace_st.add(f"Mesh shard {s} complete "
+                                 f"({decoded_cells[s]} cell(s) merged)")
             except BaseException as e:
                 errs[s] = e
                 stop.set()
@@ -473,6 +505,8 @@ class CompactionTask:
                     for k, v in shard_prof.items():
                         prof[k] = prof.get(k, 0.0) + v
                     self._mesh_completion_order.append(s)
+                    ready_count[0] += 1
+                    led_merge.note_queue(ready_count[0])
                 evs[s].set()
 
         def work_loop() -> None:
@@ -515,6 +549,8 @@ class CompactionTask:
                     raise errs[s]
                 merged = slots[s]
                 slots[s] = None
+                with prof_lock:
+                    ready_count[0] -= 1
                 sem.release()
                 if progress is not None:
                     progress.set_phase("merge")

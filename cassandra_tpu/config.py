@@ -241,6 +241,16 @@ class Config:
     column_index_size: int = spec("storage", 64 * 1024)
     trace_probability: float = mut(0.0)
     slow_query_log_timeout: float = spec("duration", 0.5, mutable=True)
+    # bounded ring of slow-query entries kept for the
+    # system_views.slow_queries vtable (service/monitoring.py); the
+    # capacity is hot-reloadable like the threshold
+    slow_query_log_entries: int = mut(100)
+    # diagnostic event bus (service/diagnostics.py,
+    # DiagnosticEventService role): OFF by default like the reference's
+    # diagnostic_events_enabled — publish sites cost one branch while
+    # disabled. Hot-reloadable; the flight recorder folds published
+    # events regardless of when the knob flips.
+    diagnostic_events_enabled: bool = mut(False)
 
     # guardrail overrides (db/guardrails/GuardrailsOptions.java) — passed
     # through to storage/guardrails.py field-for-field
@@ -342,6 +352,12 @@ class Settings:
             listeners = list(self._listeners.get(name, []))
         for cb in listeners:
             cb(coerced)
+        # hot knob reloads are diagnostic events (the flight recorder
+        # wants "what changed right before it broke"); no-op while the
+        # bus is disabled
+        from .service import diagnostics
+        diagnostics.publish("config.reload", name=name,
+                            value=repr(coerced))
 
     def on_change(self, name: str, cb: Callable) -> None:
         if name not in self._fields:

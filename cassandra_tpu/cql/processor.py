@@ -157,7 +157,13 @@ class QueryProcessor:
         import time as time_mod
 
         from ..service.metrics import GLOBAL
+        # per-phase walls for the slow-query log: parse / execute /
+        # serialize (result assembly after the executor returns) — a
+        # slow entry says WHERE it was slow, not just how slow
+        t0 = time_mod.perf_counter()
+        phases: dict = {}
         stmt = parse(query)
+        phases["parse"] = time_mod.perf_counter() - t0
         kind = type(stmt).__name__.removesuffix("Statement").lower()
         GLOBAL.incr(f"cql.{kind}")
         audit = getattr(self.executor.backend, "audit_log", None)
@@ -168,18 +174,34 @@ class QueryProcessor:
         if fql is not None:
             fql.log(type(stmt).__name__, query, user, keyspace,
                     params=params)
-        t0 = time_mod.perf_counter()
         try:
+            t_exec = time_mod.perf_counter()
             sync = self._ddl_sync_for(stmt)
             if sync is not None:
                 self._check_ddl_auth(stmt, keyspace, user)
                 with GLOBAL.timer("cql.request"):
-                    return sync.coordinate(query, keyspace, stmt)
+                    try:
+                        return sync.coordinate(query, keyspace, stmt)
+                    finally:
+                        # recorded on the raise path too: a timed-out
+                        # statement must attribute its wall to execute
+                        phases["execute"] = \
+                            time_mod.perf_counter() - t_exec
             with GLOBAL.timer("cql.request"):
-                return self.executor.execute(stmt, params, keyspace,
-                                             user=user,
-                                             page_size=page_size,
-                                             paging_state=paging_state)
+                try:
+                    rs = self.executor.execute(
+                        stmt, params, keyspace, user=user,
+                        page_size=page_size,
+                        paging_state=paging_state)
+                finally:
+                    t_ser = time_mod.perf_counter()
+                    phases["execute"] = t_ser - t_exec
+                # result materialization cost (rows already decoded by
+                # the executor; anything lazy the ResultSet does to
+                # render row tuples lands here)
+                _ = getattr(rs, "rows", None)
+                phases["serialize"] = time_mod.perf_counter() - t_ser
+                return rs
         finally:
             mon = getattr(self.executor.backend, "monitor", None)
             if mon is not None:
@@ -188,7 +210,8 @@ class QueryProcessor:
                 # (system_views.slow_queries.trace_session)
                 mon.record(query, time_mod.perf_counter() - t0,
                            keyspace,
-                           trace_session=tracing.current_id())
+                           trace_session=tracing.current_id(),
+                           phases=phases)
 
 
 class Session:

@@ -18,6 +18,12 @@ import threading
 import time
 
 _PASSWORD_RE = re.compile(r"(password\s*=\s*)'(?:[^']|'')*'", re.I)
+# prepared-statement form: the credential arrives as a BIND VALUE
+# ("... WITH password = ?"), so scrubbing the statement text alone
+# leaks it through the params list — any statement matching this
+# pattern gets EVERY bind value redacted (cheap and safe: password-
+# bearing statements are DCL, never data-path hot)
+_PASSWORD_BIND_RE = re.compile(r"password\s*=\s*(\?|:\w+)", re.I)
 
 CATEGORY_OF = {
     "SelectStatement": "QUERY",
@@ -55,14 +61,30 @@ class AuditLog:
                "type": stmt_type, "user": user, "keyspace": keyspace,
                "query": query}
         if params:
-            rec["params"] = [p.hex() if isinstance(p, (bytes, bytearray))
-                             else repr(p) for p in
-                             (params.values() if isinstance(params, dict)
-                              else params)]
+            if _PASSWORD_BIND_RE.search(query):
+                # a prepared EXECUTE carries the credential as a bind
+                # value — redact them all, mirroring the text scrub
+                rec["params"] = ["***"] * len(params)
+            else:
+                rec["params"] = [p.hex()
+                                 if isinstance(p, (bytes, bytearray))
+                                 else repr(p) for p in
+                                 (params.values()
+                                  if isinstance(params, dict)
+                                  else params)]
         line = json.dumps(rec) + "\n"
-        with self._lock:
-            self._f.write(line)
-            self._f.flush()
+        from .metrics import GLOBAL
+        try:
+            with self._lock:
+                self._f.write(line)
+                self._f.flush()
+        except (OSError, ValueError):
+            # a wedged/closed log file must be OBSERVABLE, not fatal to
+            # the request: audit.dropped vs audit.records is the gap an
+            # operator alerts on
+            GLOBAL.incr("audit.dropped")
+            return
+        GLOBAL.incr("audit.records")
 
     def close(self) -> None:
         with self._lock:

@@ -240,6 +240,15 @@ def _prom_name(name: str) -> str:
     return "ctpu_" + re.sub(r"[^a-zA-Z0-9_]", "_", name)
 
 
+def _escape_label(value: str) -> str:
+    """Prometheus exposition label-value escaping (backslash, quote,
+    newline — in that order, so the escapes themselves survive). A
+    hostile metric/label value must never be able to inject extra
+    labels or lines into the scrape."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def prometheus_text(registry: "MetricsRegistry" = None,
                     extra_gauges: dict | None = None) -> str:
     """Render the registry in Prometheus exposition format: counters as
@@ -264,7 +273,8 @@ def prometheus_text(registry: "MetricsRegistry" = None,
         lines.append(f"# TYPE {pn} summary")
         for q, key in (("0.5", "p50_us"), ("0.95", "p95_us"),
                        ("0.99", "p99_us")):
-            lines.append(f'{pn}{{quantile="{q}"}} {s[key]}')
+            lines.append(
+                f'{pn}{{quantile="{_escape_label(q)}"}} {s[key]}')
         lines.append(f"{pn}_count {s['count']}")
         lines.append(f"{pn}_sum {float(s['total_us'])}")
     for name, fn in gauges:

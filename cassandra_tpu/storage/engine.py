@@ -206,11 +206,49 @@ class StorageEngine:
         self.guardrails = Guardrails.from_config(
             self.settings.config.guardrails)
         from ..service.monitoring import QueryMonitor
-        self.monitor = QueryMonitor()
+        self.monitor = QueryMonitor(
+            threshold_ms=self.settings.get("slow_query_log_timeout")
+            * 1000.0,
+            capacity=self.settings.get("slow_query_log_entries"))
+        # slow-query ring capacity AND threshold are live knobs now,
+        # not constructor constants (nodetool / settings vtable)
+        self._slowlog_listener = self.monitor.set_capacity
+        self.settings.on_change("slow_query_log_entries",
+                                self._slowlog_listener)
+        self._slowlog_threshold_listener = \
+            lambda v: setattr(self.monitor, "threshold_ms",
+                              float(v) * 1000.0)
+        self.settings.on_change("slow_query_log_timeout",
+                                self._slowlog_threshold_listener)
         # completed request traces (system_traces role): explicit
         # TRACING ON sessions and trace_probability-sampled ones
         from ..service.tracing import TraceStore
         self.trace_store = TraceStore()
+        # diagnostic event bus + flight recorder
+        # (service/diagnostics.py): the bus is process-global like the
+        # metrics registry and gated by the mutable
+        # diagnostic_events_enabled knob; the recorder is engine-scoped
+        # and dumps its black-box bundle on terminal failure-policy
+        # transitions and quarantines (storage/failures.py wiring).
+        from ..service import diagnostics
+        # per-ENGINE demand on the process-global bus (the mesh-knob
+        # demand pattern): this engine's knob flipping off withdraws
+        # only ITS demand — a co-hosted engine whose knob is still on
+        # keeps the bus (and its own black box) running
+        self._diag_listener = \
+            lambda v: diagnostics.GLOBAL.set_demand(id(self), v)
+        self.settings.on_change("diagnostic_events_enabled",
+                                self._diag_listener)
+        diagnostics.GLOBAL.set_demand(
+            id(self), self.settings.get("diagnostic_events_enabled"))
+        self.flight_recorder = diagnostics.FlightRecorder(engine=self)
+        self.failures.flight_recorder = self.flight_recorder
+        # schema changes are diagnostic events too (the listener list
+        # already fires on every DDL mutation)
+        self._schema_diag_listener = lambda s: diagnostics.publish(
+            "schema.change",
+            keyspaces=len(getattr(s, "keyspaces", {})))
+        self.schema.listeners.append(self._schema_diag_listener)
 
     def _mesh_devices(self) -> int:
         """This engine's mesh width (its knob, not the shared pool's —
@@ -447,6 +485,21 @@ class StorageEngine:
             self.schema.listeners.remove(self._schema_listener)
         except ValueError:
             pass
+        try:
+            self.schema.listeners.remove(self._schema_diag_listener)
+        except ValueError:
+            pass
+        self.settings.remove_listener("slow_query_log_entries",
+                                      self._slowlog_listener)
+        self.settings.remove_listener("slow_query_log_timeout",
+                                      self._slowlog_threshold_listener)
+        self.settings.remove_listener("diagnostic_events_enabled",
+                                      self._diag_listener)
+        # withdraw this engine's bus demand (a closed engine must not
+        # keep the process bus enabled for nobody)
+        from ..service import diagnostics
+        diagnostics.GLOBAL.set_demand(id(self), False)
+        self.flight_recorder.close()
         self.settings.remove_listener("compaction_throughput",
                                       self._throttle_listener)
         self.settings.remove_listener("compaction_throughput_mib_per_sec",

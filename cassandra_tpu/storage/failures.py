@@ -86,6 +86,10 @@ class FailureHandler:
         self._stop_listeners: list = []
         self._die_listeners: list = []
         self.errors: list[dict] = []   # bounded recent tail (diagnostics)
+        # black box (service/diagnostics.FlightRecorder), wired by the
+        # engine: terminal policy transitions (stop/die/stop_commit)
+        # and quarantines dump a post-incident bundle through it
+        self.flight_recorder = None
 
     # ------------------------------------------------------------- config
 
@@ -167,7 +171,8 @@ class FailureHandler:
                 _log.error("commit_failure_policy=stop_commit: halting "
                            "writes after commitlog failure (%s); reads "
                            "continue", err)
-            self.commits_stopped = True
+                self.commits_stopped = True
+                self._dump("stop_commit", err)
         return policy
 
     def _apply_disk(self, err, path, kind: str) -> str:
@@ -185,6 +190,37 @@ class FailureHandler:
                                 "error": repr(err), "path": path,
                                 "at": time.time()})
             del self.errors[:-self.RECENT_ERRORS]
+        # failure-policy trigger on the diagnostic bus (no-op while the
+        # knob is off): the event the flight-recorder bundle anchors on
+        from ..service import diagnostics
+        diagnostics.publish("failure.policy", kind=kind, policy=policy,
+                            path=path, error=repr(err))
+
+    def _dump(self, reason: str, err) -> None:
+        """Flight-recorder bundle for a terminal policy transition;
+        never raises (the failure being recorded wins)."""
+        rec = self.flight_recorder
+        if rec is not None:
+            rec.trigger(f"failure_policy_{reason}", error=repr(err))
+
+    def notify_quarantine(self, entry: dict) -> None:
+        """An sstable left the live set for quarantine/: publish the
+        diagnostic event and dump a black-box bundle (the reference's
+        post-corruption forensics moment). Called by
+        ColumnFamilyStore.quarantine_sstable after the move."""
+        from ..service import diagnostics
+        diagnostics.publish("sstable.quarantine",
+                            keyspace=entry.get("keyspace", ""),
+                            table=entry.get("table", ""),
+                            generation=entry.get("generation"),
+                            reason=str(entry.get("reason", ""))[:200],
+                            path=entry.get("path", ""),
+                            bytes=entry.get("bytes", 0))
+        rec = self.flight_recorder
+        if rec is not None:
+            rec.trigger("sstable_quarantine",
+                        generation=entry.get("generation"),
+                        path=entry.get("path", ""))
 
     def _stop(self, err) -> None:
         with self._lock:
@@ -194,6 +230,7 @@ class FailureHandler:
             listeners = list(self._stop_listeners)
         _log.error("failure policy `stop`: taking the node out of "
                    "service after %r", err)
+        self._dump("die" if self.dead else "stop", err)
         for cb in listeners:
             try:
                 cb(err)

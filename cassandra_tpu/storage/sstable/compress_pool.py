@@ -29,6 +29,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 
 
 def auto_workers() -> int:
@@ -63,6 +64,13 @@ class CompressorPool:
         self._target = max(int(workers), 1)
         self._shutdown = False
         self._jobs = 0
+        # unified pipeline ledger stage: worker-side busy seconds +
+        # jobs, inbound-queue high-water at submit. Every pool
+        # (shared or pinned) accumulates into the one process stage —
+        # they share the physical cores anyway.
+        from ...utils import pipeline_ledger
+        self._stage = pipeline_ledger.ledger("compress_pool") \
+            .stage("pack")
 
     # ---------------------------------------------------------- sizing --
 
@@ -100,6 +108,7 @@ class CompressorPool:
             if self._shutdown:
                 raise RuntimeError("compressor pool is shut down")
             self._q.put(fn)
+            self._stage.note_queue(self._q.qsize())
             self._spawn_locked()
 
     def queue_depth(self) -> int:
@@ -121,6 +130,7 @@ class CompressorPool:
                 fn = self._q.get(timeout=self.POLL_SECONDS)
             except queue.Empty:
                 continue
+            t0 = time.perf_counter()
             try:
                 fn()
             except BaseException:
@@ -128,6 +138,8 @@ class CompressorPool:
                 # bug, and one bad job must not retire a shared worker
                 pass
             finally:
+                self._stage.add_busy(time.perf_counter() - t0)
+                self._stage.add_items(1)
                 with self._lock:
                     self._jobs += 1
 

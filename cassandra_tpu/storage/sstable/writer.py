@@ -62,7 +62,7 @@ class _PackJob:
 
     __slots__ = ("seq", "blocks", "attempt", "buf", "n", "raw_lens",
                  "lane_head", "lane_tail", "total", "sizes", "crcs",
-                 "compress_s", "error", "ready")
+                 "compress_s", "error", "ready", "trace")
 
     def __init__(self, seq: int, blocks: list, attempt: list[bool],
                  buf: "np.ndarray", n: int, lane_head: bytes,
@@ -81,6 +81,7 @@ class _PackJob:
         self.compress_s = 0.0
         self.error: BaseException | None = None
         self.ready = threading.Event()
+        self.trace = None   # active TraceState at submit, if any
 
 
 def _part_starts(lanes_c: "np.ndarray", n: int) -> "np.ndarray":
@@ -182,9 +183,22 @@ class SSTableWriter:
         self._io_error: list[BaseException] = []
         self._wq = None
         self._metrics = None
+        self._ledger = None
         if metrics_group:
             from ...service.metrics import GLOBAL as _METRICS
             self._metrics = _METRICS.group(metrics_group)
+            # unified pipeline ledger (utils/pipeline_ledger.py): the
+            # write leg's stages accumulate process-wide under the
+            # pipeline named after the metrics group — serialize /
+            # compress / io_write busy seconds, producer stalls and the
+            # staging-queue high-water all land there
+            from ...utils import pipeline_ledger
+            led = pipeline_ledger.ledger(metrics_group)
+            self._ledger = {
+                "serialize": led.stage("serialize"),
+                "compress": led.stage("compress"),
+                "io_write": led.stage("io_write"),
+            }
         if threaded_io:
             # pack-buffer pool: the compress stage packs segment k+1
             # into a free buffer while the I/O thread drains segment k
@@ -415,6 +429,10 @@ class SSTableWriter:
     def _acct(self, key: str, dt: float) -> None:
         if self.prof is not None:
             self.prof[key] = self.prof.get(key, 0.0) + dt
+        if self._ledger is not None:
+            st = self._ledger.get(key)
+            if st is not None:
+                st.add_busy(dt)
 
     def _write_all(self, mv: memoryview, reclaim=None) -> None:
         """Hand a compressed run of bytes to the data file. In threaded
@@ -431,6 +449,8 @@ class SSTableWriter:
                 self._io_thread.start()
             self._wq.put((mv if reclaim is not None else bytes(mv),
                           reclaim))
+            if self._ledger is not None:
+                self._ledger["io_write"].note_queue(self._wq.qsize())
             return
         t0 = time.perf_counter()
         self._write_sync(mv)
@@ -448,8 +468,13 @@ class SSTableWriter:
                 self._metrics.incr("compress_stalls")
                 t0 = time.perf_counter()
                 buf = self._pack_free.get()
-                self._metrics.hist("compress_stall").update_us(
-                    (time.perf_counter() - t0) * 1e6)
+                dt = time.perf_counter() - t0
+                self._metrics.hist("compress_stall").update_us(dt * 1e6)
+                if self._ledger is not None:
+                    # producer blocked on the compress+io stages: the
+                    # backpressure seconds the ledger attributes to the
+                    # stage being waited ON
+                    self._ledger["compress"].add_stall(dt)
             else:
                 buf = self._pack_free.get()
         if buf.nbytes < need:
@@ -468,11 +493,13 @@ class SSTableWriter:
         outcome_{k-LAG}) sequence, the decisions — and therefore the
         stored bytes — are identical for any pool size."""
         k = self._seq_submitted
+        stalled_at = None
         if self._seq_applied <= k - self.SKIP_DECISION_LAG \
                 and self._metrics is not None \
                 and self._acct_outcomes.empty():
             # genuine stall: LAG segments in flight, oldest not done
             self._metrics.incr("compress_stalls")
+            stalled_at = time.perf_counter()
         while self._seq_applied <= k - self.SKIP_DECISION_LAG:
             if self._io_error:
                 raise self._io_error[0]
@@ -482,6 +509,9 @@ class SSTableWriter:
                     RuntimeError("compress pipeline failed")
             self._apply_outcome(out)
             self._seq_applied += 1
+        if stalled_at is not None and self._ledger is not None:
+            self._ledger["compress"].add_stall(
+                time.perf_counter() - stalled_at)
         attempt = []
         for i in range(3):
             if self._skip_left[i] > 0:
@@ -533,6 +563,8 @@ class SSTableWriter:
                 target=self._io_loop, name="sstable-io", daemon=True)
             self._io_thread.start()
         buf = self._take_pack_buf(need)
+        if self._ledger is not None:
+            self._ledger["compress"].add_items(1, need)
         job = _PackJob(self._seq_submitted - 1, blocks, attempt, buf,
                        n, lane_head, lane_tail)
         if self._metrics is not None:
@@ -541,8 +573,18 @@ class SSTableWriter:
             # — a histogram of a dimensionless depth would come out
             # log2-quantized under a _us unit
             self._metrics.incr("compress_segments")
+        # pack jobs become trace events when the producing statement is
+        # traced (an inline threshold flush under a traced write): the
+        # submit lands here, the completion on the ordered I/O thread
+        from ...service import tracing
+        job.trace = tracing.active()
+        if job.trace is not None:
+            job.trace.add(f"Compress pool: segment {job.seq} submitted "
+                          f"({job.n} cells)")
         self._cpool.submit(lambda: self._run_pack_job(job))
         self._wq.put(job)   # single producer: queue order == seq order
+        if self._ledger is not None:
+            self._ledger["compress"].note_queue(self._wq.qsize())
 
     def _run_pack_job(self, job: _PackJob) -> None:
         """Pool-worker side: pack (delta + compress-or-raw + CRC) one
@@ -598,6 +640,11 @@ class SSTableWriter:
                 self._index_entries.append(entry)
                 self._acct_outcomes.put(tuple(outcome))
                 self._acct("compress", job.compress_s)
+                if job.trace is not None:
+                    job.trace.add(
+                        f"Compress pool: segment {job.seq} packed "
+                        f"({job.total} bytes, "
+                        f"{job.compress_s * 1e3:.1f} ms)")
                 t0 = time.perf_counter()
                 self._write_sync(memoryview(job.buf)[:job.total])
                 self._acct("io_write", time.perf_counter() - t0)
@@ -673,6 +720,8 @@ class SSTableWriter:
             mv, fault_after = faultfs.GLOBAL.on_write(
                 "flush.write", self._data_path, mv)
         total = mv.nbytes
+        if self._ledger is not None:
+            self._ledger["io_write"].add_items(1, total)
         self._ensure_alloc(self._written_off + total)
         self._written_off += total
         if self._direct:
@@ -926,6 +975,8 @@ class SSTableWriter:
                 outcome.append((stored, blocks[i].nbytes, attempt[i]))
             self._acct_outcomes.put(tuple(outcome))
             self._acct("compress", time.perf_counter() - t_ser)
+            if self._ledger is not None:
+                self._ledger["compress"].add_items(1, need)
             self._write_all(memoryview(out)[:total],
                             reclaim=out if self._threaded_io else None)
             self._data_off += total

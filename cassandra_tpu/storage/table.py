@@ -308,6 +308,11 @@ class ColumnFamilyStore:
             # cached merges were computed over a source set that
             # included the quarantined sstable
             self.row_cache.clear()
+        # diagnostic event + flight-recorder bundle: quarantine is an
+        # irreversible decision the black box must have context for
+        self.failures.notify_quarantine(
+            {**entry, "keyspace": self.table.keyspace,
+             "table": self.table.name})
         return entry
 
     def _degrade_on_corruption(self, sst: SSTableReader,
@@ -435,11 +440,22 @@ class ColumnFamilyStore:
                 # data stays readable and a later flush can retry; the
                 # commitlog segments stay dirty (no discard_completed)
                 self._restore_memtable(old)
+                from ..service import diagnostics
+                diagnostics.publish("flush.abort",
+                                    keyspace=self.table.keyspace,
+                                    table=self.table.name,
+                                    error=repr(e))
                 if isinstance(e, (OSError, CorruptSSTableError)):
                     self.failures.handle(
                         e, getattr(writer, "_data_path", ""))
                 raise
             self.tracker.add(reader)
+            from ..service import diagnostics
+            diagnostics.publish("flush", keyspace=self.table.keyspace,
+                                table=self.table.name,
+                                generation=gen,
+                                cells=stats.get("n_cells", 0),
+                                bytes=reader.data_size)
             if self.row_cache is not None:
                 # sstable-set change: cached merges must never outlive
                 # the generation they were computed from (also closes
@@ -473,15 +489,30 @@ class ColumnFamilyStore:
         thread runs the memtable's shard sort generator into a bounded
         queue (backpressure: two runs in flight), the flush thread packs
         each run through the writer's native compressor, and the
-        writer's own I/O thread lands bytes on disk."""
+        writer's own I/O thread lands bytes on disk. The drain stage
+        reports into the `flush` pipeline ledger: busy = shard
+        drain+sort seconds, stall = seconds parked on the full queue
+        (downstream backpressure)."""
         import queue
+
+        from ..utils import pipeline_ledger
+        drain_led = pipeline_ledger.ledger("flush").stage("drain")
         q: queue.Queue = queue.Queue(maxsize=2)
         err: list[BaseException] = []
 
         def _drain():
             try:
+                t_prev = time.perf_counter()
                 for run in old.flush_shards():
+                    t1 = time.perf_counter()
+                    drain_led.add_busy(t1 - t_prev)
+                    drain_led.add_items(
+                        1, getattr(getattr(run, "payload", None),
+                                   "nbytes", 0))
+                    drain_led.note_queue(q.qsize())
                     q.put(run)
+                    t_prev = time.perf_counter()
+                    drain_led.add_stall(t_prev - t1)
             except BaseException as e:   # surfaced on the flush thread
                 err.append(e)
             finally:
@@ -852,11 +883,25 @@ class ColumnFamilyStore:
                 from ..service.metrics import GLOBAL as _MESH_M
                 _MESH_M.incr("mesh.batch_reads")
                 _MESH_M.incr("mesh.read_keys", len(pending))
-                outs = fan.map_shards(
-                    lambda s: self._batched_merge(shard_lists[s], now,
-                                                  shard_merge=True,
-                                                  lane_map=lane_map),
-                    len(shard_lists))
+                # shard dispatch/completion under the active trace:
+                # lanes run on fanout worker threads (no contextvar),
+                # so the coordinator's TraceState is captured here and
+                # appended to directly — PR 8's lanes were invisible in
+                # system_traces.events without this
+                _tr = active()
+
+                def _run_shard(s):
+                    if _tr is not None:
+                        _tr.add(f"Mesh read shard {s} dispatched "
+                                f"({len(shard_lists[s])} key(s))")
+                    out = self._batched_merge(shard_lists[s], now,
+                                              shard_merge=True,
+                                              lane_map=lane_map)
+                    if _tr is not None:
+                        _tr.add(f"Mesh read shard {s} complete")
+                    return out
+
+                outs = fan.map_shards(_run_shard, len(shard_lists))
                 merged_map: dict[bytes, CellBatch] = {}
                 consulted: dict[bytes, int] = {}
                 for m_map, cons in outs:

@@ -163,7 +163,11 @@ def build_engine_virtuals(engine) -> VirtualSchema:
     t_slow = make_table("system_views", "slow_queries", pk=["id"],
                         cols={"id": "int", "query": "text",
                               "keyspace_name": "text",
-                              "duration_ms": "double", "at": "bigint",
+                              "duration_ms": "double",
+                              "parse_ms": "double",
+                              "execute_ms": "double",
+                              "serialize_ms": "double",
+                              "at": "bigint",
                               "trace_session": "text"})
 
     def slow_rows():
@@ -171,9 +175,60 @@ def build_engine_virtuals(engine) -> VirtualSchema:
         for e in (mon.entries() if mon else []):
             yield {"id": e["id"], "query": e["query"],
                    "keyspace_name": e["keyspace"],
-                   "duration_ms": e["duration_ms"], "at": e["at"],
+                   "duration_ms": e["duration_ms"],
+                   "parse_ms": e.get("parse_ms", 0.0),
+                   "execute_ms": e.get("execute_ms", 0.0),
+                   "serialize_ms": e.get("serialize_ms", 0.0),
+                   "at": e["at"],
                    "trace_session": e.get("trace_session") or ""}
     vs.register(VirtualTable(t_slow, slow_rows))
+
+    # --- diagnostic_events (diag/DiagnosticEventService vtable role):
+    # the typed event bus's recent rings, publication-ordered. Empty
+    # until the diagnostic_events_enabled knob flips on.
+    t_diag = make_table("system_views", "diagnostic_events", pk=["seq"],
+                        cols={"seq": "bigint", "at": "bigint",
+                              "type": "text", "fields": "text"})
+
+    def diag_rows():
+        import json as _json
+        from ..service import diagnostics
+        for ev in diagnostics.GLOBAL.events():
+            # truncate VALUES, never the serialized document — the
+            # fields cell must stay parseable JSON however long a
+            # reason/path field came in
+            fields = {k: (v[:200] if isinstance(v, str) else v)
+                      for k, v in ev.fields.items()}
+            yield {"seq": ev.seq, "at": int(ev.at * 1000),
+                   "type": ev.type,
+                   "fields": _json.dumps(fields, default=repr,
+                                         sort_keys=True)}
+    vs.register(VirtualTable(t_diag, diag_rows))
+
+    # --- pipelines (utils/pipeline_ledger.py): per-stage busy/stall/
+    # idle accounting for every multi-stage pipeline — the
+    # where-did-the-wall-go surface (TPIE-style per-stage profiling)
+    t_pipe = make_table("system_views", "pipelines", pk=["pipeline"],
+                        ck=["stage"],
+                        cols={"pipeline": "text", "stage": "text",
+                              "busy_seconds": "double",
+                              "stall_seconds": "double",
+                              "idle_seconds": "double",
+                              "items": "bigint", "bytes": "bigint",
+                              "queue_high_water": "int"})
+
+    def pipe_rows():
+        from ..utils import pipeline_ledger
+        for pname, stages in sorted(pipeline_ledger.snapshot_all()
+                                    .items()):
+            for sname, s in stages.items():
+                yield {"pipeline": pname, "stage": sname,
+                       "busy_seconds": s["busy_s"],
+                       "stall_seconds": s["stall_s"],
+                       "idle_seconds": s["idle_s"],
+                       "items": s["items"], "bytes": s["bytes"],
+                       "queue_high_water": s["queue_hwm"]}
+    vs.register(VirtualTable(t_pipe, pipe_rows))
 
     # --- system_traces (tracing/TraceKeys role): completed sessions
     # (explicit TRACING ON + trace_probability-sampled) and their merged

@@ -669,6 +669,45 @@ def exportmetrics(engine) -> str:
     return prometheus_text(extra_gauges=engine.compactions.gauges())
 
 
+def diagnostics(engine, limit: int = 50,
+                event_type: str | None = None) -> dict:
+    """nodetool diagnostics: recent typed diagnostic events from the
+    bus (diag/DiagnosticEventService role). Empty until the mutable
+    `diagnostic_events_enabled` knob flips on."""
+    from ..service import diagnostics as diag
+    return {"enabled": diag.GLOBAL.enabled,
+            "types": diag.GLOBAL.types(),
+            "events": [e.to_dict() for e in
+                       diag.GLOBAL.events(event_type,
+                                          limit=int(limit))]}
+
+
+def flightrecorder(engine, action: str = "dump") -> dict:
+    """nodetool flightrecorder [dump|status]: the black box. `dump`
+    writes a self-contained JSON bundle (diagnostic events, metric +
+    tpstats snapshot ring, recent traces, failure state, settings)
+    under <data_dir>/diagnostics/ — the same bundle a failure policy
+    (stop/die/stop_commit) or a quarantine dumps automatically."""
+    rec = engine.flight_recorder
+    if action == "status":
+        return {"events_buffered": len(rec._events),
+                "snapshots_buffered": len(rec._snapshots),
+                "dumps": list(rec.dumps)}
+    if action != "dump":
+        raise ValueError(f"unknown flightrecorder action {action!r}")
+    path = rec.dump("on_demand")
+    return {"bundle": path}
+
+
+def pipelinestats(engine) -> dict:
+    """nodetool pipelinestats: the unified pipeline ledger — per-stage
+    busy/stall/idle seconds, items/bytes and queue high-water for every
+    multi-stage pipeline (utils/pipeline_ledger.py; the
+    system_views.pipelines vtable serves the same rows)."""
+    from ..utils import pipeline_ledger
+    return pipeline_ledger.snapshot_all()
+
+
 def disableautocompaction(engine) -> dict:
     """nodetool disableautocompaction (pauses the background worker's
     submissions; running tasks finish)."""
@@ -1572,6 +1611,8 @@ for _name, _target in [
         ("gettraceprobability", "engine"),
         ("settraceprobability", "engine"),
         ("gettraces", "engine"), ("exportmetrics", "engine"),
+        ("diagnostics", "engine"), ("flightrecorder", "engine"),
+        ("pipelinestats", "engine"),
         ("disableautocompaction", "engine"),
         ("enableautocompaction", "engine"),
         ("statusautocompaction", "engine"),

@@ -43,6 +43,7 @@ import socket
 import ssl
 import struct
 import threading
+import time
 
 from ..cql.processor import QueryProcessor
 from ..service.metrics import GLOBAL as METRICS
@@ -207,6 +208,10 @@ class Connection:
             return False
         if slow:
             METRICS.incr("clients.slow_consumer_disconnects")
+            from ..service import diagnostics
+            diagnostics.publish("transport.slow_consumer",
+                                address=self.peer,
+                                backlog=self._event_backlog)
             self.loop.call(lambda: self.loop.close_conn(self))
             return False
         if pause:
@@ -501,6 +506,13 @@ class _Dispatcher:
     def __init__(self, server: "CQLServer", n_threads: int):
         self.server = server
         self.queue: queue_mod.Queue = queue_mod.Queue()
+        # unified pipeline ledger stage (utils/pipeline_ledger.py):
+        # busy = request execution, idle = workers parked on an empty
+        # queue, queue_hwm = dispatch backlog high-water — the
+        # front-door leg of the where-did-the-wall-go table
+        from ..utils import pipeline_ledger
+        self._stage = pipeline_ledger.ledger("transport") \
+            .stage("dispatch")
         self.threads = [
             threading.Thread(target=self._work, daemon=True,
                              name=f"cql-exec-{server.port}-{i}")
@@ -511,6 +523,7 @@ class _Dispatcher:
     def submit(self, conn: Connection, stream: int, opcode: int,
                body: bytes) -> None:
         self.queue.put((conn, stream, opcode, body))
+        self._stage.note_queue(self.queue.qsize())
 
     def shutdown(self) -> None:
         for _ in self.threads:
@@ -519,7 +532,10 @@ class _Dispatcher:
     def _work(self) -> None:
         srv = self.server
         while True:
+            t_idle = time.monotonic()
             item = self.queue.get()
+            t0 = time.monotonic()
+            self._stage.add_idle(t0 - t_idle)
             if item is None:
                 return
             conn, stream, opcode, body = item
@@ -542,6 +558,8 @@ class _Dispatcher:
                     conn.loop.call(
                         lambda c=conn: c.loop.close_conn(c))
             finally:
+                self._stage.add_busy(time.monotonic() - t0)
+                self._stage.add_items(1, len(body))
                 with conn.wlock:
                     conn.in_flight -= 1
                 srv.permits.release()
@@ -902,9 +920,13 @@ class CQLServer:
         """All three admission gates, on the event loop. A request that
         cannot be admitted is answered OVERLOADED right now — bounded
         buffers all the way down, no unbounded queueing."""
+        from ..service import diagnostics
         if self.rate_limit_ops > 0 and not conn.limiter.try_acquire(1):
             conn.rate_limited += 1
             METRICS.incr("clients.rate_limited_requests")
+            diagnostics.publish("transport.overload_shed",
+                                reason="rate_limited",
+                                address=conn.peer)
             conn.send_error(stream, ERR_OVERLOADED,
                             "Request rate limited "
                             "(native_transport_rate_limit_ops)")
@@ -912,10 +934,16 @@ class CQLServer:
         reason = self.overload.reason()
         if reason is not None:
             METRICS.incr("clients.overload_shed")
+            diagnostics.publish("transport.overload_shed",
+                                reason=reason[:120],
+                                address=conn.peer)
             conn.send_error(stream, ERR_OVERLOADED, reason)
             return
         if not self.permits.try_acquire():
             METRICS.incr("clients.overload_shed")
+            diagnostics.publish("transport.overload_shed",
+                                reason="permit_cap",
+                                address=conn.peer)
             conn.send_error(
                 stream, ERR_OVERLOADED,
                 f"Maximum concurrent requests "

@@ -21,7 +21,24 @@ docs/observability.md plus the mesh.* data-plane group from
 docs/multichip.md): a typo'd or undocumented group fails the check, so
 new groups land in the docs the same commit they land in code.
 
-Exit 0 = clean; exit 1 prints each violating file:line and name.
+Beyond the static scan, `main()` DIFFS THE DOCS AGAINST REALITY: a
+deterministic engine-level smoke run (writes, flush, mesh compaction,
+batched reads, slow query, audit, a fault) collects every metric name
+actually emitted and compares it — both directions — against the
+"Metric catalog" table in docs/observability.md:
+
+  - emitted but undocumented        -> FAIL (document it)
+  - documented but never emitted    -> FAIL (dead entry; delete it or
+                                      mark it `(conditional)` if the
+                                      smoke cannot deterministically
+                                      reach it)
+
+Catalog entries whose notes contain `(conditional)` or whose scope
+column says `cluster`/`transport` are exempt from the dead-entry
+direction (the engine smoke has no peers or wire clients) but still
+participate in the undocumented direction.
+
+Exit 0 = clean; exit 1 prints each violation.
 """
 from __future__ import annotations
 
@@ -46,8 +63,8 @@ SINGLE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 # the documented top-level groups (docs/observability.md "Established
 # groups" + the mesh.* group from docs/multichip.md)
 KNOWN_GROUPS = {
-    "client_requests", "clients", "commitlog", "compaction",
-    "compress_pool", "cql", "flush", "hints", "mesh",
+    "audit", "client_requests", "clients", "commitlog", "compaction",
+    "compress_pool", "cql", "flush", "hints", "mesh", "pipeline",
     "prepared_statements", "reads", "request", "storage", "system",
     "table", "verb",
 }
@@ -101,6 +118,162 @@ def scan(paths=None) -> list[tuple[str, int, str, str]]:
     return bad
 
 
+# ------------------------------------------------------- docs <-> smoke --
+
+# histogram snapshot suffixes collapse onto the base hist name
+_HIST_SUFFIXES = (".count", ".mean_us", ".p50_us", ".p95_us",
+                  ".p99_us", ".max_us")
+# components replaced by X during normalization: the smoke run's
+# keyspace/table names and any `<placeholder>` from the docs
+_SMOKE_DYNAMIC = {"smoke", "t"}
+
+
+def normalize_name(name: str) -> str:
+    """Collapse an EMITTED metric name to its documented pattern:
+    hist-snapshot suffixes stripped, dynamic components (the smoke
+    fixture's keyspace/table, per-statement cql kinds, per-verb names,
+    pipeline/stage names) replaced by X."""
+    for suf in _HIST_SUFFIXES:
+        if name.endswith(suf):
+            name = name[: -len(suf)]
+            break
+    parts = [("X" if p in _SMOKE_DYNAMIC else p)
+             for p in name.split(".")]
+    # per-statement counters (`cql.{kind}`) and per-verb counters
+    # (`verb.{verb}.received`) are open-ended families: one catalog row
+    if parts[0] == "cql" and len(parts) == 2 \
+            and parts[1] not in ("request", "slow_queries"):
+        parts[1] = "X"
+    if parts[0] == "verb" and len(parts) == 3:
+        parts[1] = "X"
+    # pipeline stats: `pipeline.<pipeline>.<stage>.<stat>` — the
+    # pipeline/stage catalog lives in the ledger doc section; the
+    # metric catalog carries one row per STAT
+    if parts[0] == "pipeline" and len(parts) == 4:
+        parts[1] = parts[2] = "X"
+    return ".".join(parts)
+
+
+def normalize_doc(name: str) -> str:
+    """Collapse a DOCUMENTED metric name: `<ks>`-style placeholders
+    become X."""
+    return re.sub(r"<[^>]+>", "X", name)
+
+
+def documented_catalog() -> dict[str, dict]:
+    """Parse the docs/observability.md Metric catalog table:
+    {normalized name: {raw, scope, notes}}. The table rows look like
+    `| `storage.writes` | engine | counter; ... |`."""
+    path = os.path.join(REPO, "docs", "observability.md")
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    m = re.search(r"## Metric catalog\n(.*?)(?:\n## |\Z)", text, re.S)
+    if not m:
+        return {}
+    out: dict[str, dict] = {}
+    for row in re.finditer(
+            r"^\|\s*`([^`]+)`\s*\|\s*([a-z]+)\s*\|\s*(.*?)\s*\|\s*$",
+            m.group(1), re.M):
+        raw, scope, notes = row.group(1), row.group(2), row.group(3)
+        out[normalize_doc(raw)] = {"raw": raw, "scope": scope,
+                                   "notes": notes}
+    return out
+
+
+def smoke_emitted() -> set[str]:
+    """Run the deterministic engine-level smoke workload and return the
+    NORMALIZED set of metric names it emitted (registry snapshot +
+    engine-scoped gauges + per-table counter dict)."""
+    import tempfile
+
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from cassandra_tpu.config import Config, Settings
+    from cassandra_tpu.cql import Session
+    from cassandra_tpu.schema import Schema
+    from cassandra_tpu.service import diagnostics
+    from cassandra_tpu.service.metrics import GLOBAL
+    from cassandra_tpu.storage.engine import StorageEngine
+    from cassandra_tpu.utils import pipeline_ledger
+
+    with tempfile.TemporaryDirectory() as base:
+        settings = Settings(Config.load({
+            "diagnostic_events_enabled": True,
+            "compaction_mesh_devices": 2,
+            "disk_failure_policy": "best_effort",
+            "row_cache_size_mib": 4}))
+        eng = StorageEngine(
+            base, Schema(), commitlog_sync="batch",
+            settings=settings,
+            audit_log_path=os.path.join(base, "audit.jsonl"))
+        try:
+            s = Session(eng)
+            s.execute("CREATE KEYSPACE smoke WITH replication = "
+                      "{'class': 'SimpleStrategy', "
+                      "'replication_factor': 1}")
+            s.execute("USE smoke")
+            s.execute("CREATE TABLE t (k int PRIMARY KEY, v text) "
+                      "WITH caching = "
+                      "{'rows_per_partition': 'ALL'}")
+            cfs = eng.store("smoke", "t")
+            # two generations so the major compaction + the batched
+            # mesh read both have real work
+            for gen in range(2):
+                for i in range(64):
+                    s.execute(f"INSERT INTO t (k, v) VALUES "
+                              f"({i}, 'v{gen}-{i}')")
+                cfs.flush()
+            eng.compactions.major_compaction(cfs)
+            # point + batched (mesh-fanned, >= 16 keys) + cached reads
+            s.execute("SELECT v FROM t WHERE k = 1")
+            s.execute("SELECT v FROM t WHERE k = 1")   # row-cache hit
+            keys = ", ".join(str(i) for i in range(32))
+            s.execute(f"SELECT v FROM t WHERE k IN ({keys})")
+            # slow-query path (threshold 0: everything is slow)
+            eng.monitor.threshold_ms = 0.0
+            s.execute("SELECT v FROM t WHERE k = 2")
+            # audit drop path: a wedged (closed) log file must count,
+            # not raise
+            eng.audit_log.close()
+            s.execute("SELECT v FROM t WHERE k = 3")
+            # one counted disk failure through the policy funnel
+            # (best_effort: nothing stops)
+            eng.failures.handle_disk(OSError(5, "smoke"), "smoke-path")
+            emitted = set(GLOBAL.snapshot())
+            emitted |= set(eng.compactions.gauges())
+            for st in eng.stores.values():
+                basek = f"table.{st.table.keyspace}.{st.table.name}"
+                emitted |= {f"{basek}.{k}" for k in st.metrics}
+        finally:
+            eng.close()
+            diagnostics.GLOBAL.reset()
+            pipeline_ledger.reset_all()
+    return {normalize_name(n) for n in emitted}
+
+
+def diff_docs(emitted: set[str] | None = None) -> list[str]:
+    """Both-direction diff of the docs catalog vs the smoke run;
+    returns violation strings (empty = clean)."""
+    catalog = documented_catalog()
+    if not catalog:
+        return ["docs/observability.md has no Metric catalog section"]
+    if emitted is None:
+        emitted = smoke_emitted()
+    problems = []
+    for name in sorted(emitted - set(catalog)):
+        problems.append(f"emitted but not in the docs catalog: {name}")
+    for name, meta in sorted(catalog.items()):
+        if name in emitted:
+            continue
+        if "(conditional)" in meta["notes"] \
+                or meta["scope"] in ("cluster", "transport"):
+            continue   # unreachable from an engine-only smoke run
+        problems.append(
+            f"documented but never emitted (dead entry?): "
+            f"{meta['raw']}")
+    return problems
+
+
 def main() -> int:
     bad = scan()
     if bad:
@@ -110,6 +283,16 @@ def main() -> int:
             print(f"  {path}:{lineno}  .{method}({name!r})",
                   file=sys.stderr)
         return 1
+    if "--scan-only" not in sys.argv:
+        problems = diff_docs()
+        if problems:
+            print("docs/observability.md Metric catalog out of sync "
+                  "with the smoke run:", file=sys.stderr)
+            for p in problems:
+                print(f"  - {p}", file=sys.stderr)
+            return 1
+        print("metric names OK; docs catalog matches the smoke run")
+        return 0
     print("metric names OK")
     return 0
 

@@ -1,0 +1,688 @@
+"""Diagnostics layer: typed event bus, flight recorder, pipeline
+ledger, slow-query phases, audit counters/redaction, Prometheus
+exposition edge cases.
+
+Covers the ISSUE 9 acceptance surface: events publish (only) when the
+mutable knob is on, the flight recorder dumps a self-contained bundle
+on terminal failure policies / quarantine / demand, every hand-rolled
+pipeline reports through the unified ledger, and the satellite
+hardening (monitor capacity knob, audit bind redaction, exporter
+robustness) holds.
+"""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from cassandra_tpu.config import Config, Settings
+from cassandra_tpu.cql import Session
+from cassandra_tpu.schema import COL_ROW_LIVENESS, Schema, make_table
+from cassandra_tpu.service import diagnostics
+from cassandra_tpu.service.metrics import (GLOBAL as METRICS,
+                                           LatencyHistogram,
+                                           MetricsRegistry,
+                                           prometheus_text)
+from cassandra_tpu.storage.engine import StorageEngine
+from cassandra_tpu.storage.mutation import Mutation
+from cassandra_tpu.tools import nodetool
+from cassandra_tpu.utils import faultfs, pipeline_ledger
+
+
+@pytest.fixture(autouse=True)
+def _diag_isolation():
+    """The bus is process-global: every test starts disabled+empty,
+    with every enable demand (anonymous or a leaked engine's)
+    withdrawn."""
+    diagnostics.GLOBAL.reset()
+    yield
+    diagnostics.GLOBAL.reset()
+
+
+def _engine(tmp_path, **cfg):
+    settings = Settings(Config.load(cfg)) if cfg else None
+    schema = Schema()
+    schema.create_keyspace("ks")
+    t = make_table("ks", "t", pk=["id"], ck=["c"],
+                   cols={"id": "int", "c": "int", "v": "text"})
+    schema.add_table(t)
+    eng = StorageEngine(str(tmp_path / "d"), schema,
+                        commitlog_sync="batch", settings=settings)
+    return eng, t
+
+
+def _put(eng, t, pk, c, v, ts):
+    m = Mutation(t.id, t.columns["id"].cql_type.serialize(pk))
+    ck = t.serialize_clustering([c])
+    m.add(ck, COL_ROW_LIVENESS, b"", b"", ts)
+    m.add(ck, t.columns["v"].column_id, b"",
+          t.columns["v"].cql_type.serialize(v), ts)
+    eng.apply(m)
+
+
+# ------------------------------------------------------------- event bus --
+
+
+def test_bus_disabled_by_default_and_zero_publish(tmp_path):
+    eng, t = _engine(tmp_path)
+    try:
+        assert not diagnostics.enabled()
+        _put(eng, t, 1, 0, "x", 1000)
+        eng.store("ks", "t").flush()
+        assert diagnostics.GLOBAL.events() == []
+    finally:
+        eng.close()
+
+
+def test_knob_enables_bus_and_flush_compaction_events(tmp_path):
+    eng, t = _engine(tmp_path)
+    try:
+        eng.settings.set("diagnostic_events_enabled", True)
+        assert diagnostics.enabled()
+        cfs = eng.store("ks", "t")
+        for gen in range(2):
+            for i in range(8):
+                _put(eng, t, i, 0, f"g{gen}-{i}", 1000 + gen * 100 + i)
+            cfs.flush()
+        eng.compactions.major_compaction(cfs)
+        types = [e.type for e in diagnostics.GLOBAL.events()]
+        # the knob flip itself is an event too (config.reload)
+        assert "config.reload" in types
+        assert types.count("flush") == 2
+        assert "compaction.start" in types
+        assert "compaction.finish" in types
+        start = next(e for e in diagnostics.GLOBAL.events()
+                     if e.type == "compaction.start")
+        assert start.fields["keyspace"] == "ks"
+        assert start.fields["inputs"] == 2
+        # vtable serves the same rows
+        rows = list(eng.virtual_tables.get(
+            "system_views", "diagnostic_events").rows())
+        assert [r["type"] for r in rows] == types
+        # nodetool surface
+        out = nodetool.diagnostics(eng, limit=100)
+        assert out["enabled"] is True
+        assert [e["type"] for e in out["events"]] == types
+    finally:
+        eng.close()
+
+
+def test_bus_demand_is_per_engine(tmp_path):
+    """One co-hosted engine's knob flipping off must not silence the
+    bus for a peer whose knob is still on (the mesh-knob demand
+    pattern): the bus runs while ANY engine demands it."""
+    eng_a, _ = _engine(tmp_path / "a")
+    eng_b, _ = _engine(tmp_path / "b")
+    try:
+        eng_a.settings.set("diagnostic_events_enabled", True)
+        assert diagnostics.enabled()
+        eng_b.settings.set("diagnostic_events_enabled", True)
+        eng_b.settings.set("diagnostic_events_enabled", False)
+        assert diagnostics.enabled()          # A still demands
+        eng_a.settings.set("diagnostic_events_enabled", False)
+        assert not diagnostics.enabled()      # last demand withdrawn
+        eng_a.settings.set("diagnostic_events_enabled", True)
+    finally:
+        eng_a.close()                         # close withdraws A's demand
+        assert not diagnostics.enabled()
+        eng_b.close()
+
+
+def test_slow_query_threshold_knob_hot_reloads(tmp_path):
+    eng, _ = _engine(tmp_path)
+    try:
+        eng.settings.set("slow_query_log_timeout", "100ms")
+        assert eng.monitor.threshold_ms == 100.0
+    finally:
+        eng.close()
+
+
+def test_ring_bounded_per_type():
+    diagnostics.GLOBAL.set_enabled(True)
+    for i in range(diagnostics.RING_PER_TYPE + 50):
+        diagnostics.publish("flush", n=i)
+    evs = diagnostics.GLOBAL.events("flush")
+    assert len(evs) == diagnostics.RING_PER_TYPE
+    assert evs[-1].fields["n"] == diagnostics.RING_PER_TYPE + 49
+
+
+def test_subscriber_exception_does_not_lose_event():
+    diagnostics.GLOBAL.set_enabled(True)
+
+    def bad(_ev):
+        raise RuntimeError("boom")
+    diagnostics.GLOBAL.subscribe(bad)
+    try:
+        diagnostics.publish("flush", n=1)
+        assert len(diagnostics.GLOBAL.events("flush")) == 1
+    finally:
+        diagnostics.GLOBAL.unsubscribe(bad)
+
+
+def test_gossip_and_schema_and_knob_events(tmp_path):
+    eng, t = _engine(tmp_path)
+    try:
+        eng.settings.set("diagnostic_events_enabled", True)
+        t2 = make_table("ks", "t2", pk=["id"], cols={"id": "int"})
+        eng.add_table(t2)
+        eng.settings.set("concurrent_compactors", 2)
+        types = [e.type for e in diagnostics.GLOBAL.events()]
+        assert "schema.change" in types
+        assert types.count("config.reload") == 2
+        reloads = diagnostics.GLOBAL.events("config.reload")
+        assert reloads[-1].fields["name"] == "concurrent_compactors"
+    finally:
+        eng.close()
+
+
+# -------------------------------------------------------- flight recorder --
+
+
+def test_flight_recorder_dumps_on_stop_policy(tmp_path):
+    eng, t = _engine(tmp_path, disk_failure_policy="stop",
+                     diagnostic_events_enabled=True)
+    try:
+        cfs = eng.store("ks", "t")
+        for i in range(8):
+            _put(eng, t, i, 0, f"a{i}", 1000 + i)
+        cfs.flush()
+        for i in range(8):
+            _put(eng, t, i, 1, f"b{i}", 2000 + i)
+        with faultfs.inject("flush.write", "error", times=1):
+            with pytest.raises(OSError):
+                cfs.flush()
+        assert eng.failures.storage_stopped
+        assert len(eng.flight_recorder.dumps) == 1
+        path = eng.flight_recorder.dumps[0]
+        with open(path) as f:
+            bundle = json.load(f)
+        assert bundle["reason"] == "failure_policy_stop"
+        ev_types = [e["type"] for e in bundle["events"]]
+        assert "failure.policy" in ev_types
+        # the preceding context made it into the black box
+        assert "flush" in ev_types[:ev_types.index("failure.policy")]
+        assert bundle["final"]["metrics"]["storage.disk_failures"] >= 1
+        assert any(p["pool"] == "CompactionExecutor"
+                   for p in bundle["final"]["tpstats"])
+        assert bundle["failure_state"]["storage_stopped"] is True
+        assert any(r["kind"] == "disk" for r in bundle["recent_errors"])
+    finally:
+        eng.close()
+
+
+def test_flight_recorder_on_demand_and_status(tmp_path):
+    eng, t = _engine(tmp_path)
+    try:
+        _put(eng, t, 1, 0, "x", 1000)
+        out = nodetool.flightrecorder(eng)
+        assert os.path.exists(out["bundle"])
+        with open(out["bundle"]) as f:
+            bundle = json.load(f)
+        assert bundle["reason"] == "on_demand"
+        assert bundle["final"]["metrics"]["storage.writes"] >= 1
+        assert any(s["name"] == "disk_failure_policy"
+                   for s in bundle["settings"])
+        st = nodetool.flightrecorder(eng, action="status")
+        assert out["bundle"] in st["dumps"]
+        with pytest.raises(ValueError):
+            nodetool.flightrecorder(eng, action="nope")
+    finally:
+        eng.close()
+
+
+def test_flight_recorder_trigger_dedup(tmp_path):
+    eng, _t = _engine(tmp_path)
+    try:
+        rec = eng.flight_recorder
+        p1 = rec.trigger("failure_policy_stop", error="e1")
+        p2 = rec.trigger("failure_policy_stop", error="e2")
+        assert p1 is not None and p2 is None   # coalesced in-window
+        p3 = rec.trigger("sstable_quarantine", path="x")
+        assert p3 is not None                  # different reason dumps
+    finally:
+        eng.close()
+
+
+def test_stop_commit_policy_dumps(tmp_path):
+    eng, t = _engine(tmp_path, commit_failure_policy="stop_commit")
+    try:
+        _put(eng, t, 1, 0, "x", 1000)
+        eng.failures.handle_commit(OSError(5, "sync eio"))
+        assert eng.failures.commits_stopped
+        assert any("stop_commit" in p for p in eng.flight_recorder.dumps)
+    finally:
+        eng.close()
+
+
+# -------------------------------------------------------- pipeline ledger --
+
+
+def test_stage_accounting_primitives():
+    led = pipeline_ledger.ledger("compaction")
+    st = led.stage("io_write")
+    before = st.snapshot()
+    st.add_busy(0.5)
+    st.add_stall(0.25)
+    st.add_idle(0.125)
+    st.add_items(3, 4096)
+    st.note_queue(7)
+    st.note_queue(2)   # lower than hwm: ignored
+    s = st.snapshot()
+    assert s["busy_s"] >= before["busy_s"] + 0.5
+    assert s["stall_s"] >= before["stall_s"] + 0.25
+    assert s["idle_s"] >= before["idle_s"] + 0.125
+    assert s["items"] == before["items"] + 3
+    assert s["bytes"] == before["bytes"] + 4096
+    assert s["queue_hwm"] >= 7
+    with st.busy():
+        time.sleep(0.01)
+    assert st.snapshot()["busy_s"] >= s["busy_s"] + 0.009
+    # same (pipeline, stage) resolves to the same object
+    assert pipeline_ledger.ledger("compaction").stage("io_write") is st
+
+
+def test_flush_populates_ledger_and_vtable(tmp_path):
+    eng, t = _engine(tmp_path)
+    try:
+        pipeline_ledger.reset_all()
+        cfs = eng.store("ks", "t")
+        for i in range(64):
+            _put(eng, t, i, 0, "v" * 64, 1000 + i)
+        cfs.flush()
+        snap = pipeline_ledger.snapshot_all()
+        assert snap["flush"]["io_write"]["bytes"] > 0
+        assert snap["flush"]["io_write"]["items"] >= 1
+        assert snap["flush"]["compress"]["busy_s"] > 0
+        # the fast-path flush ran the drain stage
+        assert snap["flush"]["drain"]["items"] >= 1
+        # gauges surface through the registry
+        reg = METRICS.snapshot()
+        assert reg["pipeline.flush.io_write.bytes"] == \
+            snap["flush"]["io_write"]["bytes"]
+        # vtable + nodetool agree
+        rows = {(r["pipeline"], r["stage"]): r
+                for r in eng.virtual_tables.get(
+                    "system_views", "pipelines").rows()}
+        assert rows[("flush", "io_write")]["bytes"] == \
+            snap["flush"]["io_write"]["bytes"]
+        assert nodetool.pipelinestats(eng)["flush"]["io_write"][
+            "bytes"] == snap["flush"]["io_write"]["bytes"]
+    finally:
+        eng.close()
+
+
+def test_compaction_ledger_matches_profile(tmp_path):
+    """The ledger's write-leg busy seconds and the task profile's phase
+    split are the same measurements — they must reconcile exactly."""
+    from cassandra_tpu.compaction.task import CompactionTask
+    from cassandra_tpu.storage.table import ColumnFamilyStore
+    table = make_table("b", "t", pk=["id"], ck=["c"],
+                       cols={"id": "int", "c": "int", "v": "text"})
+    cfs = ColumnFamilyStore(table, str(tmp_path), commitlog=None)
+    vcol = table.columns["v"].column_id
+    for gen in range(2):
+        for i in range(512):
+            m = Mutation(table.id, table.serialize_partition_key([i]))
+            m.add(table.serialize_clustering([0]), vcol, b"",
+                  f"g{gen}-{i}".encode(), 1000 + gen * 10000 + i)
+            cfs.apply(m)
+        cfs.flush()
+    pipeline_ledger.reset_all()
+    task = CompactionTask(cfs, cfs.tracker.view(), mesh_devices=0)
+    task.execute()
+    led = pipeline_ledger.ledger("compaction").snapshot()
+    for stage in ("compress", "io_write"):
+        prof_s = task.profile.get(stage, 0.0)
+        assert led[stage]["busy_s"] == pytest.approx(prof_s, abs=1e-6)
+    assert led["io_write"]["bytes"] > 0
+    for s in cfs.live_sstables():
+        s.close()
+
+
+def test_mesh_ledger_stages(tmp_path):
+    from cassandra_tpu.compaction.task import CompactionTask
+    from cassandra_tpu.storage.table import ColumnFamilyStore
+    table = make_table("b", "tm", pk=["id"], ck=["c"],
+                       cols={"id": "int", "c": "int", "v": "text"})
+    cfs = ColumnFamilyStore(table, str(tmp_path), commitlog=None)
+    vcol = table.columns["v"].column_id
+    for gen in range(2):
+        for i in range(512):
+            m = Mutation(table.id, table.serialize_partition_key([i]))
+            m.add(table.serialize_clustering([0]), vcol, b"",
+                  f"g{gen}-{i}".encode(), 1000 + gen * 10000 + i)
+            cfs.apply(m)
+        cfs.flush()
+    pipeline_ledger.reset_all()
+    task = CompactionTask(cfs, cfs.tracker.view(), mesh_devices=2)
+    task.execute()
+    led = pipeline_ledger.ledger("mesh").snapshot()
+    assert led["decode"]["items"] >= 1        # shards decoded
+    assert led["merge"]["items"] >= 1         # cells merged
+    assert led["merge"]["busy_s"] > 0
+    for s in cfs.live_sstables():
+        s.close()
+
+
+def test_transport_dispatch_ledger(tmp_path):
+    from cassandra_tpu.transport.server import CQLServer
+    eng, t = _engine(tmp_path)
+    pipeline_ledger.reset_all()
+    srv = CQLServer(eng)
+    try:
+        import socket
+        import struct
+
+        from cassandra_tpu.transport.frame import (encode_envelope,
+                                                   _read_string)
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        body = struct.pack(">H", 1) + \
+            struct.pack(">H", len("CQL_VERSION")) + b"CQL_VERSION" + \
+            struct.pack(">H", len("3.4.5")) + b"3.4.5"
+        s.sendall(encode_envelope(0x04, 0, 0x01, body))   # STARTUP
+        s.recv(4096)
+        q = b"SELECT * FROM system.local"
+        qbody = struct.pack(">i", len(q)) + q + \
+            struct.pack(">H", 1) + b"\x00"
+        s.sendall(encode_envelope(0x04, 1, 0x07, qbody))  # QUERY
+        s.recv(65536)
+        s.close()
+        snap = pipeline_ledger.ledger("transport").snapshot()
+        assert snap["dispatch"]["items"] >= 1
+        assert snap["dispatch"]["busy_s"] > 0
+    finally:
+        srv.close()
+        eng.close()
+
+
+# --------------------------------------------------- slow-query satellite --
+
+
+def test_monitor_capacity_knob_and_phases(tmp_path):
+    eng, _t = _engine(tmp_path)
+    try:
+        assert eng.monitor.capacity == \
+            eng.settings.get("slow_query_log_entries")
+        eng.settings.set("slow_query_log_entries", 3)
+        assert eng.monitor.capacity == 3
+        eng.monitor.threshold_ms = 0.0
+        for i in range(6):
+            eng.monitor.record(f"q{i}", 0.01, "ks",
+                               phases={"parse": 0.001,
+                                       "execute": 0.008,
+                                       "serialize": 0.001})
+        entries = eng.monitor.entries()
+        assert len(entries) == 3                  # shrunk ring holds 3
+        assert entries[-1]["query"] == "q5"       # newest survive
+        assert entries[-1]["parse_ms"] == 1.0
+        assert entries[-1]["execute_ms"] == 8.0
+        assert entries[-1]["serialize_ms"] == 1.0
+    finally:
+        eng.close()
+
+
+def test_slow_query_phase_breakdown_end_to_end(tmp_path):
+    eng, _t = _engine(tmp_path)
+    try:
+        eng.monitor.threshold_ms = 0.0
+        s = Session(eng)
+        s.execute("CREATE TABLE ks.kv (k int PRIMARY KEY, v text)")
+        s.execute("INSERT INTO ks.kv (k, v) VALUES (1, 'x')")
+        s.execute("SELECT v FROM ks.kv WHERE k = 1")
+        entry = eng.monitor.entries()[-1]
+        assert entry["query"].startswith("SELECT")
+        # the phases reconcile with (never exceed) the total
+        assert 0.0 <= entry["parse_ms"] <= entry["duration_ms"]
+        assert 0.0 < entry["execute_ms"] <= entry["duration_ms"]
+        rows = list(eng.virtual_tables.get(
+            "system_views", "slow_queries").rows())
+        assert rows[-1]["execute_ms"] == entry["execute_ms"]
+        assert rows[-1]["parse_ms"] == entry["parse_ms"]
+    finally:
+        eng.close()
+
+
+# --------------------------------------------------------- audit satellite --
+
+
+def test_audit_counters_and_bind_redaction(tmp_path):
+    from cassandra_tpu.service.audit import AuditLog
+    path = str(tmp_path / "audit.jsonl")
+    log = AuditLog(path)
+    before_rec = METRICS.counter("audit.records")
+    before_drop = METRICS.counter("audit.dropped")
+    # literal passwords scrub (pre-existing) and binds redact (new)
+    log.log("RoleStatement",
+            "CREATE ROLE r WITH password = 'hunter2'", "admin", None)
+    log.log("RoleStatement",
+            "ALTER ROLE r WITH password = ?", "admin", None,
+            params=[b"hunter2"])
+    log.log("SelectStatement", "SELECT * FROM t WHERE k = ?",
+            None, "ks", params=[b"\x01"])
+    log.close()
+    recs = [json.loads(line) for line in open(path)]
+    assert "hunter2" not in recs[0]["query"]
+    assert recs[1]["params"] == ["***"]          # bind value redacted
+    assert "68756e74657232" not in json.dumps(recs[1])   # hex leak
+    assert recs[2]["params"] == ["01"]           # normal binds intact
+    assert METRICS.counter("audit.records") == before_rec + 3
+    # wedged (closed) file: dropped counts, the request survives
+    log.log("SelectStatement", "SELECT 1", None, None)
+    assert METRICS.counter("audit.dropped") == before_drop + 1
+    assert METRICS.counter("audit.records") == before_rec + 3
+
+
+# ------------------------------------------------- exporter edge cases --
+
+
+def test_prometheus_raising_gauge_skipped():
+    reg = MetricsRegistry()
+    reg.incr("cql.request")
+    reg.register_gauge("storage.good_gauge", lambda: 7.0)
+    reg.register_gauge("storage.bad_gauge",
+                       lambda: (_ for _ in ()).throw(RuntimeError()))
+    snap = reg.snapshot()
+    assert snap["storage.good_gauge"] == 7.0
+    assert "storage.bad_gauge" not in snap
+    text = prometheus_text(reg)
+    assert "ctpu_storage_good_gauge 7.0" in text
+    assert "bad_gauge" not in text
+    assert "ctpu_cql_request 1" in text
+
+
+def test_prometheus_name_sanitization_no_injection():
+    """A hostile registered name cannot inject lines/labels into the
+    exposition: every exported name collapses to [a-zA-Z0-9_]."""
+    reg = MetricsRegistry()
+    hostile = 'evil.name"} 1\nfake_metric{x="'
+    reg.incr(hostile)
+    reg.register_gauge('g.a"b\nc\\d', lambda: 1.0)
+    text = prometheus_text(reg)
+    for line in text.splitlines():
+        name = line.split("{")[0].split(" ")[1] \
+            if line.startswith("#") else line.split("{")[0].split(" ")[0]
+        assert all(c.isalnum() or c == "_" for c in name), line
+    assert '"} 1' not in text.replace('quantile="', "")
+    # and exposition stays line-parseable: name SP value
+    for line in text.splitlines():
+        if not line.startswith("#"):
+            assert len(line.split()) == 2
+
+
+def test_escape_label_value():
+    from cassandra_tpu.service.metrics import _escape_label
+    assert _escape_label('a"b') == 'a\\"b'
+    assert _escape_label("a\\b") == "a\\\\b"
+    assert _escape_label("a\nb") == "a\\nb"
+    # order: backslashes first, so escapes survive escaping
+    assert _escape_label('\\"') == '\\\\\\"'
+
+
+def test_histogram_summary_under_concurrent_updates():
+    """A scrape racing a recording storm must stay internally
+    consistent: count monotone, total >= count (each sample >= 1us
+    here), percentiles within the recorded range, no exception."""
+    h = LatencyHistogram(window_s=60.0)
+    stop = threading.Event()
+    errs = []
+
+    def hammer():
+        i = 0
+        try:
+            while not stop.is_set():
+                h.update_us(1 + (i % 1000))
+                i += 1
+        except Exception as e:   # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    last_count = 0
+    try:
+        for _ in range(200):
+            s = h.summary()
+            assert s["count"] >= last_count
+            last_count = s["count"]
+            if s["count"]:
+                assert s["total_us"] >= s["count"]
+                assert 0 < s["p50_us"] <= s["max_us"] * 2
+                assert s["p50_us"] <= s["p99_us"]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errs
+
+
+# ------------------------------------------------------ trace coverage --
+
+
+def test_mesh_read_shards_traced(tmp_path):
+    from cassandra_tpu.parallel import fanout
+    from cassandra_tpu.service import tracing
+    from cassandra_tpu.storage.table import ColumnFamilyStore
+    table = make_table("b", "tr", pk=["id"], ck=["c"],
+                       cols={"id": "int", "c": "int", "v": "text"})
+    cfs = ColumnFamilyStore(table, str(tmp_path), commitlog=None)
+    vcol = table.columns["v"].column_id
+    for gen in range(2):
+        for i in range(64):
+            m = Mutation(table.id, table.serialize_partition_key([i]))
+            m.add(table.serialize_clustering([0]), vcol, b"",
+                  f"g{gen}-{i}".encode(), 1000 + gen * 10000 + i)
+            cfs.apply(m)
+        cfs.flush()
+    fanout.configure(2)
+    try:
+        pks = [table.serialize_partition_key([i]) for i in range(32)]
+        st = tracing.begin(request="mesh batched read")
+        try:
+            cfs.read_partitions(pks)
+        finally:
+            tracing.end()
+        activities = [a for _us, _src, a in st.events]
+        dispatched = [a for a in activities
+                      if a.startswith("Mesh read shard")
+                      and "dispatched" in a]
+        completed = [a for a in activities
+                     if a.startswith("Mesh read shard")
+                     and "complete" in a]
+        assert len(dispatched) >= 2
+        assert len(completed) == len(dispatched)
+    finally:
+        fanout.reset()
+        for s in cfs.live_sstables():
+            s.close()
+
+
+def test_compress_pool_jobs_traced(tmp_path):
+    """A traced statement that pays an inline flush sees the pool's
+    pack jobs on its timeline (submit on the producer, packed on the
+    ordered completion thread)."""
+    from cassandra_tpu.service import tracing
+    from cassandra_tpu.storage.table import ColumnFamilyStore
+    table = make_table("b", "tp", pk=["id"], ck=["c"],
+                       cols={"id": "int", "c": "int", "v": "text"})
+    cfs = ColumnFamilyStore(table, str(tmp_path), commitlog=None)
+    vcol = table.columns["v"].column_id
+    for i in range(256):
+        m = Mutation(table.id, table.serialize_partition_key([i]))
+        m.add(table.serialize_clustering([0]), vcol, b"",
+              ("v" * 200).encode(), 1000 + i)
+        cfs.apply(m)
+    st = tracing.begin(request="traced flush")
+    try:
+        cfs.flush()
+    finally:
+        tracing.end()
+    activities = [a for _us, _src, a in st.events]
+    assert any(a.startswith("Compress pool: segment")
+               and "submitted" in a for a in activities)
+    assert any(a.startswith("Compress pool: segment")
+               and "packed" in a for a in activities)
+    for s in cfs.live_sstables():
+        s.close()
+
+
+def test_mesh_compaction_shards_traced(tmp_path):
+    from cassandra_tpu.compaction.task import CompactionTask
+    from cassandra_tpu.service import tracing
+    from cassandra_tpu.storage.table import ColumnFamilyStore
+    table = make_table("b", "tc", pk=["id"], ck=["c"],
+                       cols={"id": "int", "c": "int", "v": "text"})
+    cfs = ColumnFamilyStore(table, str(tmp_path), commitlog=None)
+    vcol = table.columns["v"].column_id
+    for gen in range(2):
+        for i in range(256):
+            m = Mutation(table.id, table.serialize_partition_key([i]))
+            m.add(table.serialize_clustering([0]), vcol, b"",
+                  f"g{gen}-{i}".encode(), 1000 + gen * 10000 + i)
+            cfs.apply(m)
+        cfs.flush()
+    st = tracing.begin(request="traced mesh compaction")
+    try:
+        CompactionTask(cfs, cfs.tracker.view(), mesh_devices=2).execute()
+    finally:
+        tracing.end()
+    activities = [a for _us, _src, a in st.events]
+    assert any(a.startswith("Mesh shard") and "dispatched" in a
+               for a in activities)
+    assert any(a.startswith("Mesh shard") and "complete" in a
+               for a in activities)
+    for s in cfs.live_sstables():
+        s.close()
+
+
+# ------------------------------------------------------- quarantine path --
+
+
+def test_quarantine_publishes_and_dumps(tmp_path):
+    eng, t = _engine(tmp_path, diagnostic_events_enabled=True)
+    try:
+        cfs = eng.store("ks", "t")
+        for i in range(16):
+            _put(eng, t, i, 0, f"v{i}", 1000 + i)
+        cfs.flush()
+        sst = cfs.live_sstables()[0]
+        data = sst.desc.path("Data.db")
+        with open(data, "r+b") as f:
+            f.seek(50)
+            b = f.read(1)
+            f.seek(50)
+            f.write(bytes([b[0] ^ 0xFF]))
+        from cassandra_tpu.storage import chunk_cache
+        chunk_cache.GLOBAL.clear()
+        try:
+            cfs.read_partition(t.columns["id"].cql_type.serialize(0))
+        except Exception:
+            pass
+        if not cfs.quarantined:
+            pytest.skip("bit flip landed in slack; no quarantine")
+        evs = diagnostics.GLOBAL.events("sstable.quarantine")
+        assert len(evs) == 1
+        assert evs[0].fields["keyspace"] == "ks"
+        assert any("quarantine" in p for p in eng.flight_recorder.dumps)
+    finally:
+        eng.close()
