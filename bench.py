@@ -141,15 +141,17 @@ def _task_knobs():
     CTPU_BENCH_PIPELINED=0 disables the threaded compress->io_write
     split; CTPU_BENCH_COMPRESSORS=0 keeps the serial compress thread,
     =N pins a private N-worker pool, unset = the shared auto-sized
-    pool; CTPU_BENCH_DECODE_AHEAD=0 disables the round-k+1 decode
-    prefetch. Output bytes are identical for every combination
+    pool. Decode-ahead follows the `compaction_decode_ahead` config
+    knob (its default — on — for the bench's standalone stores; the
+    old CTPU_BENCH_DECODE_AHEAD env gate is gone, the knob is the only
+    switch); legs that must isolate it pass decode_ahead=False
+    explicitly. Output bytes are identical for every combination
     (scripts/check_compaction_ab.py proves it)."""
     pipelined = os.environ.get("CTPU_BENCH_PIPELINED", "1") != "0"
-    da_env = os.environ.get("CTPU_BENCH_DECODE_AHEAD")
-    # None = the task's own default (on for host engines, off for the
-    # device engine's submit/collect pipelining) — only an explicit
-    # env value overrides it
-    decode_ahead = None if da_env is None else da_env != "0"
+    # None = knob-inherited: the bench's standalone stores resolve it
+    # through ColumnFamilyStore.decode_ahead_fn, which reads the
+    # `compaction_decode_ahead` config default
+    decode_ahead = None
     comp = os.environ.get("CTPU_BENCH_COMPRESSORS")
     pool = None
     if not pipelined:
@@ -241,8 +243,8 @@ def run_compressor_sweep(base_dir, table, cfg, workers=(1, 2, 4)):
     the compress stage stops being the wall — scaling flattens once
     the pipeline is bounded by decode/merge CPU or the disk.
     decode_ahead is held OFF on every leg so the sweep isolates
-    compress-pool scaling (the prefetch win is a separate lever,
-    A/B'd via CTPU_BENCH_DECODE_AHEAD on the headline)."""
+    compress-pool scaling (the prefetch is a separate lever, on by
+    default via the `compaction_decode_ahead` knob)."""
     import shutil as _sh
 
     from cassandra_tpu.storage.sstable.compress_pool import CompressorPool
@@ -474,6 +476,15 @@ def run_pipeline_bench(base_dir: str, table, cfg) -> dict:
             "profile_s": round(prof.get(stage, 0.0), 3),
             "ledger_busy_s": round(led_s, 3),
         }
+    # the decode stage bills the SAME dt to the profile (io_decode +
+    # decode_ahead) and to its ledger busy at every cursor fetch, so
+    # these reconcile exactly, not just within noise
+    reconcile["decode"] = {
+        "profile_s": round(prof.get("io_decode", 0.0)
+                           + prof.get("decode_ahead", 0.0), 3),
+        "ledger_busy_s": round(
+            compaction_stages.get("decode", {}).get("busy_s", 0.0), 3),
+    }
 
     # --- mesh leg: 2 lanes through the same ledger (decode/merge)
     mdir = os.path.join(base_dir, "mesh")
@@ -976,6 +987,27 @@ def main():
             # compile-vs-execute split even for host-engine benches
         mib = stats["bytes_read"] / 2**20
         mib_s = mib / stats["wall"]
+        prof_h = stats["profile"]
+        # write-phase attribution for the headline: per-stage busy
+        # seconds (stages overlap on different threads — they are
+        # capacities, not additive wall shares) plus the two numbers
+        # that ARE wall: the producer's genuine write-leg backpressure
+        # (write_stall) and the terminal seal drain. Their share of
+        # wall is the fraction of the compaction the write leg actually
+        # gated — the "where did the wall go" answer ROADMAP item 1
+        # asks for (an io_write-bound profile would show it again, as
+        # io stalls).
+        write_phase = {
+            "serialize_s": prof_h.get("serialize", 0.0),
+            "compress_s": prof_h.get("compress", 0.0),
+            "io_write_s": prof_h.get("io_write", 0.0),
+            "seal_s": prof_h.get("seal", 0.0),
+            "producer_stall_s": prof_h.get("write_stall", 0.0),
+            "blocked_share_of_wall": round(
+                (prof_h.get("write_stall", 0.0)
+                 + prof_h.get("seal", 0.0)) / max(stats["wall"], 1e-9),
+                3),
+        }
         result = {
             "metric": "compaction MiB/s (%s, %s engine)"
                       % (cfg["desc"], engine),
@@ -989,6 +1021,11 @@ def main():
                 "bytes_written": stats["bytes_written"],
                 "seconds": round(stats["wall"], 3),
                 "phases": stats["profile"],
+                # the write leg split out (serialize / compress /
+                # io_write / seal + producer stall), replacing the old
+                # aggregated `write` number — BENCH_r06+ can attribute
+                # the wall per stage
+                "write_phase": write_phase,
                 # per-stage capacity (input MiB over phase seconds);
                 # stages run on different threads so these overlap —
                 # the smallest one is the pipeline's current wall

@@ -67,9 +67,15 @@ class _Cursor:
     buffers between rounds via fill_to, never concurrently with the
     round's own cursor access.)"""
 
-    def __init__(self, reader: SSTableReader, prof: dict | None = None):
+    def __init__(self, reader: SSTableReader, prof: dict | None = None,
+                 led=None):
         self._it = reader.scanner()
         self.prof = prof
+        # pipeline ledger `compaction`/`decode` stage (led): every
+        # fetch bills the SAME dt to the profile and to the stage's
+        # busy seconds, so bench.py's reconcile proves them equal by
+        # construction
+        self.led = led
         # which phase bucket _fetch bills: the decode-ahead thread bills
         # its overlapped fills to 'decode_ahead' so 'io_decode' keeps
         # meaning time the MERGE thread stalled waiting on decode
@@ -87,10 +93,16 @@ class _Cursor:
             self.exhausted = True
             return False
         finally:
+            dt = time.perf_counter() - t0
             if self.prof is not None:
                 key = self.prof_key
-                self.prof[key] = self.prof.get(key, 0.0) \
-                    + (time.perf_counter() - t0)
+                self.prof[key] = self.prof.get(key, 0.0) + dt
+            if self.led is not None:
+                self.led.add_busy(dt)
+                if self.bufs and not self.exhausted:
+                    b = self.bufs[-1]
+                    self.led.add_items(
+                        1, b.payload.nbytes + b.lanes.nbytes)
 
     @property
     def has_data(self) -> bool:
@@ -217,7 +229,8 @@ class CompactionTask:
                  pipelined_io: bool = True,
                  compress_pool=None,
                  decode_ahead: bool | None = None,
-                 mesh_devices: int | None = None):
+                 mesh_devices: int | None = None,
+                 device_resident: bool | None = None):
         """engine: 'device' (TPU kernel), 'native' (C++ streaming merge),
         'numpy' (reference path). All three are tested bit-identical.
         Default (engine=None, use_device unset): the native engine when
@@ -246,8 +259,12 @@ class CompactionTask:
         helper thread while round k merges and the pool compresses —
         profitable now that the compress leg no longer contends for
         the GIL (an earlier prefetch attempt lost to exactly that, see
-        _Cursor). None = on for the host engines under pipelined_io;
-        the device engine keeps its own submit/collect pipelining.
+        _Cursor). None = inherit the owning ENGINE's hot-reloadable
+        `compaction_decode_ahead` knob (default on), re-read EVERY
+        ROUND so a mid-compaction flip stops or restarts the prefetch
+        thread at the next round boundary; an explicit True/False pins
+        it for this task. Host engines under pipelined_io only — the
+        device engine keeps its own submit/collect pipelining.
         mesh_devices: the mesh execution mode (docs/multichip.md) —
         the compaction is token-range sharded by count-weighted
         boundaries planned from the input sstables' partition indexes
@@ -260,6 +277,19 @@ class CompactionTask:
         order IS identity-lane order — no reshuffle). None = inherit
         the `compaction_mesh_devices` knob (parallel/fanout.py);
         0 = force serial.
+        device_resident: device-engine rounds stay END-TO-END on the
+        jax device (ops/device_write.py): one fused program runs sort +
+        reconcile + purge + kept-cell compaction, the columns stay in a
+        device pending buffer across rounds, segments cut on-device and
+        a second fused kernel serializes each META block — the host
+        receives only finished blocks (plus the ragged payload, which
+        never leaves it). Rounds the device cannot reproduce exactly
+        (equal-ts ties, kept expired cells, counters, range bounds)
+        fall back per round to the pinned host materialization, so
+        output bytes are identical to the serial host path always
+        (scripts/check_compaction_ab.py device legs). None = on for
+        engine='device'; ignored for host engines and under the mesh
+        execution mode (mesh shards drain through the host writer).
         """
         self.cfs = cfs
         self.inputs = inputs
@@ -293,10 +323,13 @@ class CompactionTask:
             self.compress_pool = None      # 0: serial compress
         else:
             self.compress_pool = compress_pool
-        if decode_ahead is None:
-            decode_ahead = pipelined_io and self.engine != "device"
+        # tri-state: None = knob-inherited (resolved per round by
+        # _decode_ahead_enabled), True/False = pinned for this task
         self.decode_ahead = decode_ahead
         self.mesh_devices = mesh_devices
+        if device_resident is None:
+            device_resident = self.engine == "device"
+        self.device_resident = device_resident
         self.round_cells = round_cells or (
             self.ROUND_CELLS_DEVICE if self.engine == "device"
             else self.ROUND_CELLS_HOST)
@@ -318,7 +351,25 @@ class CompactionTask:
         from ..parallel import fanout
         return fanout.mesh_devices()
 
-    def _engine_merge_fn(self, prof: dict | None):
+    def _decode_ahead_enabled(self) -> bool:
+        """Whether the decode-ahead prefetch should be running RIGHT
+        NOW: the explicit decode_ahead= argument wins; None inherits
+        the owning engine's hot-reloadable `compaction_decode_ahead`
+        knob via the store (never a co-hosted engine's), defaulting on
+        for standalone stores. The serial round loop re-reads this
+        every round, so a mid-compaction knob flip stops or restarts
+        the helper thread at the next round boundary — round
+        boundaries and output bytes are identical either way (the
+        pf_done handshake guarantees it)."""
+        if self.decode_ahead is not None:
+            return bool(self.decode_ahead)
+        if not self.pipelined_io or self.engine == "device":
+            return False
+        fn = getattr(self.cfs, "decode_ahead_fn", None)
+        return bool(fn()) if fn is not None else True
+
+    def _engine_merge_fn(self, prof: dict | None,
+                         defer_gather: bool = False):
         """The host-merge closure for this task's engine — the ONE place
         the native/numpy dispatch lives, shared by the serial round loop
         and the mesh lanes so the two paths can never diverge on merge
@@ -326,14 +377,20 @@ class CompactionTask:
         through submit/collect). prof: where the native merge bills its
         phase timings — run() passes the task profile, the mesh lanes
         pass a per-shard dict (folded under a lock; concurrent lanes
-        must not race on the shared profile)."""
+        must not race on the shared profile). defer_gather: the serial
+        round loop defers the native merge's output gather to the
+        writer thread (host_merge.LazyMergedBatch) so it overlaps the
+        next round's decode + merge; mesh lanes keep it in-lane (their
+        parallelism already covers it)."""
         if self.engine == "device":
             return None
         if self.engine == "native":
             from ..ops.host_merge import merge_sorted_native
 
             def merge_fn(slices, **kw):
-                return merge_sorted_native(slices, prof=prof, **kw)
+                return merge_sorted_native(slices, prof=prof,
+                                           defer_gather=defer_gather,
+                                           **kw)
             return merge_fn
         return cb.merge_sorted
 
@@ -605,9 +662,18 @@ class CompactionTask:
         now = timeutil.now_seconds()
         controller = CompactionController(cfs, self.inputs)
         prof = self.profile
+        # pipeline `compaction` gains a `decode` stage: cursor fetches
+        # (inline AND decode-ahead) bill busy, the merge thread's
+        # prefetch waits bill stall, the prefetch thread's parked time
+        # bills idle, and queue_hwm records how many segments decode
+        # ran ahead of the merge (docs/observability.md)
+        from ..utils import pipeline_ledger
+        led_decode = pipeline_ledger.ledger("compaction").stage("decode")
         # None for the device engine: its rounds go through
-        # submit/collect
-        merge_fn = self._engine_merge_fn(prof)
+        # submit/collect. The serial loop defers the output gather to
+        # the writer thread (it drains the wq FIFO on one thread, so
+        # materialization order — and output bytes — are unchanged).
+        merge_fn = self._engine_merge_fn(prof, defer_gather=True)
 
         txn = LifecycleTransaction(cfs.directory)
         writers: list[SSTableWriter] = []
@@ -645,10 +711,19 @@ class CompactionTask:
         werr: list[BaseException] = []
         # credited: bytes of the CURRENT writer already added to
         # progress — in parallel-compress mode data_offset() trails
-        # appends, so finish()'s pool drain must credit the tail too
-        wstate = {"writer": None, "cells": 0, "credited": 0}
+        # appends, so finish()'s pool drain must credit the tail too.
+        # resident: device-resident rounds flow as DeviceRound objects
+        # through a DeviceWriteLane instead of writer.append ("lane").
+        wstate = {"writer": None, "cells": 0, "credited": 0,
+                  "resident": False, "lane": None}
 
         progress = self.progress
+
+        def flush_lane():
+            lane = wstate["lane"]
+            if lane is not None:
+                lane.flush()
+                wstate["lane"] = None
 
         def write_loop():
             # pack/compress stage of the pipeline: writer.append cuts
@@ -656,18 +731,42 @@ class CompactionTask:
             # mode) fans them out to the compressor pool, whose results
             # re-sequence through the writer's ordered completion queue
             # onto its I/O thread — the stages decode+merge / pack /
-            # compress-pool / io_write all overlap. Phase timings land
-            # in prof as 'serialize', 'compress' and 'io_write'.
-            # Progress + the output-size cut-over read the writer's
-            # PUBLISHED offset (data_offset()), never private state
-            # another thread is mutating.
+            # compress-pool / io_write all overlap. In device-resident
+            # mode the rounds arrive as DeviceRound column sets and the
+            # segment cut + META serialize happen ON DEVICE through the
+            # write lane; the writer sees only finished blocks. Phase
+            # timings land in prof as 'serialize', 'compress' and
+            # 'io_write'. Progress + the output-size cut-over read the
+            # writer's PUBLISHED offset (data_offset()), never private
+            # state another thread is mutating.
             try:
                 while True:
                     merged = wq.get()
                     if merged is None:
+                        # the sentinel is already consumed: a raise out
+                        # of the lane flush must land in werr and
+                        # RETURN (the generic except below drains the
+                        # queue waiting for a sentinel that will never
+                        # come — the producer already sent it)
+                        try:
+                            flush_lane()
+                        except BaseException as e:
+                            werr.append(e)
                         return
+                    if hasattr(merged, "materialize"):
+                        # deferred native-merge gather: runs HERE, on
+                        # the writer thread, overlapping the producer's
+                        # next round (host_merge.LazyMergedBatch)
+                        merged = merged.materialize()
                     w = wstate["writer"]
-                    w.append(merged)
+                    if wstate["resident"]:
+                        lane = wstate["lane"]
+                        if lane is None:
+                            from ..ops.device_write import DeviceWriteLane
+                            lane = wstate["lane"] = DeviceWriteLane(w)
+                        lane.append(merged)
+                    else:
+                        w.append(merged)
                     if progress is not None:
                         off = w.data_offset()
                         progress.add_written(off - wstate["credited"])
@@ -681,7 +780,11 @@ class CompactionTask:
                         # in-flight segments, so the roll lands late by
                         # a bounded amount — finish() drains the pool
                         # (and the drained tail is credited below).
+                        # The lane's pending partial flushes into the
+                        # finishing writer first — exactly the cells
+                        # finish() would cut from host pending.
                         w = wstate["writer"]
+                        flush_lane()
                         w.finish()
                         if progress is not None:
                             progress.add_written(
@@ -691,6 +794,7 @@ class CompactionTask:
                         wstate["credited"] = 0
             except BaseException as e:   # surfaced after join
                 werr.append(e)
+                wstate["lane"] = None
                 while True:              # drain so the producer never blocks
                     if wq.get() is None:
                         return
@@ -702,7 +806,11 @@ class CompactionTask:
         pending: deque = deque()
 
         def collect_oldest():
-            merged = dmerge.collect_merge(pending.popleft())
+            if wstate["resident"]:
+                from ..ops.device_write import collect_merge_resident
+                merged = collect_merge_resident(pending.popleft())
+            else:
+                merged = dmerge.collect_merge(pending.popleft())
             if len(merged):
                 wq.put(merged)
 
@@ -728,7 +836,8 @@ class CompactionTask:
 
         def prefetch_loop():
             while True:
-                per = pf_q.get()
+                with led_decode.idle():   # parked between prefetches
+                    per = pf_q.get()
                 if per is None:
                     return
                 try:
@@ -742,6 +851,10 @@ class CompactionTask:
                 except BaseException as e:   # surfaced next round
                     pf_err.append(e)
                 finally:
+                    # prefetch-queue high water: segments buffered
+                    # ahead of the merge (how far decode ran ahead)
+                    led_decode.note_queue(
+                        sum(len(c.bufs) for c in cursors))
                     pf_done.set()
 
         def stop_prefetch():
@@ -768,14 +881,17 @@ class CompactionTask:
                 mesh_done = self._mesh_produce(mesh_n, wq, controller,
                                                gc_before, now, werr,
                                                bytes_per_cell)
+            # device-resident rounds only make sense for the serial
+            # device round loop: mesh shards drain host CellBatches
+            # through the unchanged writer (token-order contract)
+            wstate["resident"] = (self.engine == "device"
+                                  and self.device_resident
+                                  and not mesh_done)
             cursors = [] if mesh_done \
-                else [_Cursor(r, prof) for r in self.inputs]
-            if self.decode_ahead and not mesh_done:
-                pf_q = queue.Queue()
-                pf_thread = threading.Thread(target=prefetch_loop,
-                                             name="compact-prefetch",
-                                             daemon=True)
-                pf_thread.start()
+                else [_Cursor(r, prof, led=led_decode)
+                      for r in self.inputs]
+            # the decode-ahead thread starts (and stops, and restarts)
+            # from the knob check at the top of each round — see below
             while True:
                 if werr:       # writer died: fail fast, don't keep merging
                     break
@@ -791,10 +907,32 @@ class CompactionTask:
                     raise RuntimeError(
                         "compaction stopped by operator request")
                 # cursors are shared with the decode-ahead helper: wait
-                # out any in-flight prefetch before touching them
+                # out any in-flight prefetch before touching them (the
+                # wait is the merge thread BLOCKED ON decode — the
+                # ledger bills it as a decode-stage stall)
+                t_pf = time.perf_counter()
                 pf_done.wait()
+                if pf_thread is not None:
+                    led_decode.add_stall(time.perf_counter() - t_pf)
                 if pf_err:
                     raise pf_err[0]
+                # hot-reloadable `compaction_decode_ahead`: re-resolved
+                # every round, so a mid-compaction flip OFF retires the
+                # helper thread here (the prefetch in flight already
+                # handshook out above) and a flip ON starts it — round
+                # boundaries, and therefore output bytes, are identical
+                # under any flip sequence
+                if not mesh_done:
+                    want_da = self._decode_ahead_enabled()
+                    if pf_thread is not None and not want_da:
+                        stop_prefetch()
+                        pf_thread = None
+                    elif pf_thread is None and want_da:
+                        pf_q = queue.Queue()
+                        pf_thread = threading.Thread(
+                            target=prefetch_loop,
+                            name="compact-prefetch", daemon=True)
+                        pf_thread.start()
                 active = [c for c in cursors if c.has_data]
                 if not active:
                     break
@@ -832,10 +970,18 @@ class CompactionTask:
                 if self.limiter is not None:
                     self.limiter.acquire(round_bytes)
                 if self.engine == "device":
-                    pending.append(dmerge.submit_merge(
-                        slices, gc_before=gc_before, now=now,
-                        purgeable_ts_fn=controller.purgeable_ts_fn,
-                        prof=prof))
+                    if wstate["resident"]:
+                        from ..ops.device_write import \
+                            submit_merge_resident
+                        pending.append(submit_merge_resident(
+                            slices, gc_before=gc_before, now=now,
+                            purgeable_ts_fn=controller.purgeable_ts_fn,
+                            prof=prof))
+                    else:
+                        pending.append(dmerge.submit_merge(
+                            slices, gc_before=gc_before, now=now,
+                            purgeable_ts_fn=controller.purgeable_ts_fn,
+                            prof=prof))
                     while len(pending) >= self.PIPELINE_DEPTH:
                         collect_oldest()
                 else:

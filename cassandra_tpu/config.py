@@ -136,6 +136,17 @@ class Config:
     # threads for the native/numpy engines). Output bytes are
     # identical to the serial paths for any N. 0 = off; hot-reloadable.
     compaction_mesh_devices: int = mut(0)
+    # decode-ahead prefetch: a compaction helper thread decodes round
+    # k+1's input segments while round k merges and its output
+    # compresses (the LUDA decode/merge overlap; compaction/task.py).
+    # Strictly handshaked, so round boundaries — and output bytes —
+    # are identical either way. Engine-scoped like
+    # compaction_mesh_devices and hot-reloadable: tasks re-read it
+    # every round, so a mid-compaction flip stops (or restarts) the
+    # prefetch thread at the next round boundary. Default on; the
+    # device engine's serial round loop keeps its own submit/collect
+    # pipelining instead.
+    compaction_decode_ahead: bool = mut(True)
     compaction_throughput: float = spec("rate", 64.0, mutable=True)
     # modern-yaml name for the same throttle (DataRateSpec
     # compaction_throughput_mib_per_sec). Negative = unset: the engine

@@ -1,0 +1,492 @@
+"""Device-resident compaction rounds: merge → purge → segment-cut →
+serialize without bouncing cell columns through the host.
+
+LUDA (PAPERS.md, arxiv 2004.03054) gets its GPU-LSM win by keeping cell
+data accelerator-resident across decode → merge → pack instead of
+round-tripping the host per stage. This module is that mode for the
+device merge engine: one fused program per round runs the LSD sort, the
+reconcile/purge masks AND the kept-cell compaction (stable partition +
+column gather) on the device, so the CellBatch's fixed-width columns
+(lanes / ts / ldt / ttl / flags / frame offsets) never come back to the
+host as columns. They stay resident in a device-side pending buffer
+across rounds; segment cuts slice them on-device; and a second fused
+kernel serializes each full segment's META block (including the "ce"
+ts-delta pre-transform, format.py) byte-identically to the host
+serializer (storage/sstable/writer.py build_meta_block). The host
+receives only the FINISHED blocks the compress pool consumes — the
+META bytes and the row-major LANES matrix `segment_pack` wants — plus
+the variable-length payload, which never went to the device (ragged
+bytes gather through the native C++ path, storage/cellbatch.py).
+
+Byte identity with the serial host path is absolute, not statistical:
+rounds the device cannot reproduce exactly fall back to the host
+materialization path per ROUND —
+
+  * equal-(identity, ts) duplicate runs (the device sort does not order
+    the Cells.resolveRegular tie-break lanes; the host resolves them
+    with full values),
+  * kept expired-TTL cells (tombstone conversion rewrites flags AND
+    drops the value bytes — a payload rewrite),
+  * counter cells / range-tombstone bounds (host-only reconcile),
+
+and `scripts/check_compaction_ab.py`'s device legs pin the whole-file
+sha256 equality. Scalar counts of those conditions are computed in the
+same fused program, so the decision costs three tiny transfers.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..storage.cellbatch import (DEATH_FLAGS, FLAG_COUNTER,
+                                 FLAG_RANGE_BOUND, CellBatch)
+from . import merge as dmerge
+
+_U32 = jnp.uint32
+_BIAS_H = 0x80000000  # high u32 word of the 2^63 timestamp bias
+
+
+# ------------------------------------------------------------- operands --
+
+def build_resident_operands(cat: CellBatch, gc_before: int, now: int,
+                            purgeable_ts_fn):
+    """The v1 packed operands (merge.build_operands) extended with the
+    serialize-side columns: full flags byte, ttl, u32 frame lengths and
+    value offsets. Returns (operands, pts_host) or None when a frame
+    exceeds the u32 lanes (the host path raises its loud error
+    instead)."""
+    n = len(cat)
+    N = dmerge._bucket(n)
+    lens64 = cat.off[1:] - cat.off[:-1]
+    vrel64 = cat.val_start - cat.off[:-1]
+    if n and (int(lens64.max()) >= 1 << 32
+              or int(vrel64.max()) >= 1 << 32):
+        return None
+    pts_host = None
+    if purgeable_ts_fn is not None:
+        pts_host = purgeable_ts_fn(cat).astype(np.int64)
+        fn = lambda _c: pts_host
+    else:
+        fn = None
+    operands = dmerge.build_operands(cat, gc_before=gc_before, now=now,
+                                     purgeable_ts_fn=fn, bucket=N)
+    flags8 = np.zeros(N, dtype=np.uint8)
+    flags8[:n] = cat.flags
+    ttl = np.zeros(N, dtype=np.int32)
+    ttl[:n] = cat.ttl
+    fl = np.zeros(N, dtype=np.uint32)
+    fl[:n] = lens64.astype(np.uint32)
+    vr = np.zeros(N, dtype=np.uint32)
+    vr[:n] = vrel64.astype(np.uint32)
+    operands["flags8"] = jnp.asarray(flags8)
+    operands["ttl"] = jnp.asarray(ttl)
+    operands["fl"] = jnp.asarray(fl)
+    operands["vr"] = jnp.asarray(vr)
+    return operands, pts_host
+
+
+RESIDENT_COLS = ("lanes", "ts_h", "ts_l", "ldt", "ttl", "flags8",
+                 "fl", "vr")
+
+
+@jax.jit
+def _resident_program(operands):
+    """One dispatch: LSD sort, reconcile+purge, kept-cell compaction and
+    column gather — the merged round stays on the device, in output
+    order, kept cells first. Returns (n_keep, n_amb, n_exp_kept,
+    perm_out, cols, perm, packed); the last two feed the host fallback
+    when the scalar counts demand it."""
+    perm = dmerge.device_sort_perm(operands)
+    packed = dmerge.reconcile_kernel(operands, perm)
+    keep = (packed & 1) != 0
+    amb = (packed & 2) != 0
+    expired = (packed & 4) != 0
+    n_keep = jnp.sum(keep).astype(jnp.int32)
+    n_amb = jnp.sum(amb).astype(jnp.int32)
+    n_exp_kept = jnp.sum(expired & keep).astype(jnp.int32)
+    N = keep.shape[0]
+    # stable partition: kept cells to the front, SORTED ORDER preserved
+    # (stability) — the device-side analog of np.flatnonzero(keep)
+    _, ord_ = jax.lax.sort(
+        (jnp.where(keep, jnp.uint32(0), jnp.uint32(1)),
+         jnp.arange(N, dtype=jnp.int32)), num_keys=1, is_stable=True)
+    perm_out = perm[ord_]
+    cols = {k: operands[k][perm_out] for k in RESIDENT_COLS}
+    return n_keep, n_amb, n_exp_kept, perm_out, cols, perm, packed
+
+
+# ------------------------------------------------------ serialize kernel --
+
+@jax.jit
+def _meta_block_kernel(ts_h, ts_l, ldt, ttl, flags8, fl, vr):
+    """Fused META-block serialize for one FULL segment: the "ce"
+    ts-delta pre-transform + the 25 B/cell section layout emitted as
+    one u8 buffer, plus the segment's stats reductions — all in a
+    single device program, byte-identical to the host
+    build_meta_block (pinned by test).
+
+    ts planes arrive BIASED (uts = ts + 2^63 mod 2^64, the sort form);
+    bias cancels in differences, so the wraparound deltas of the u32
+    pairs ARE the i64 deltas, and cell 0's absolute stamp is its uts
+    minus the bias — one XOR on the high word."""
+    n = ts_h.shape[0]
+    prev_h = jnp.concatenate(
+        [jnp.full((1,), _BIAS_H, dtype=jnp.uint32), ts_h[:-1]])
+    prev_l = jnp.concatenate([jnp.zeros(1, dtype=jnp.uint32), ts_l[:-1]])
+    d_l = ts_l - prev_l
+    borrow = (ts_l < prev_l).astype(jnp.uint32)
+    d_h = ts_h - prev_h - borrow
+
+    def u32_bytes(a):
+        return jax.lax.bitcast_convert_type(a, jnp.uint8).reshape(-1)
+
+    # (n, 2) u32 little-endian pair -> the 8 LE bytes of each i64 delta
+    ts_b = jax.lax.bitcast_convert_type(
+        jnp.stack([d_l, d_h], axis=1), jnp.uint8).reshape(-1)
+    meta = jnp.concatenate([
+        ts_b, u32_bytes(ldt), u32_bytes(ttl), flags8,
+        u32_bytes(fl), u32_bytes(vr)])
+
+    # stats reductions (biased-pair lexicographic min/max for ts)
+    max_h = jnp.max(ts_h)
+    max_l = jnp.max(jnp.where(ts_h == max_h, ts_l, jnp.uint32(0)))
+    min_h = jnp.min(ts_h)
+    min_l = jnp.min(jnp.where(ts_h == min_h, ts_l, _U32(0xFFFFFFFF)))
+    tombs = jnp.sum((flags8 & jnp.uint8(DEATH_FLAGS)) != 0)
+    return meta, (min_h, min_l, max_h, max_l,
+                  jnp.min(ldt), jnp.max(ldt), tombs)
+
+
+def _uts_pair_to_i64(h: int, l: int) -> int:
+    return int(np.int64(np.uint64((int(h) << 32) | int(l))
+                        ^ np.uint64(1 << 63)))
+
+
+# --------------------------------------------------------------- rounds --
+
+class DeviceRound:
+    """One merged round whose fixed-width columns live on the device
+    (padded; `n` is the kept length). The payload side — the only
+    ragged data — stays host-resident: gathering variable-length frames
+    is exactly what the native C++ gather does well and what device
+    memory layouts do badly."""
+
+    __slots__ = ("n", "cols", "payload", "off", "val_start", "pk_map",
+                 "ck_fits_prefix")
+
+    def __init__(self, n, cols, payload, off, val_start, pk_map,
+                 ck_fits_prefix):
+        self.n = n
+        self.cols = cols
+        self.payload = payload
+        self.off = off
+        self.val_start = val_start
+        self.pk_map = pk_map
+        self.ck_fits_prefix = ck_fits_prefix
+
+    def __len__(self) -> int:
+        return self.n
+
+
+class ResidentHandle:
+    __slots__ = ("mode", "result", "cat", "n", "out", "pts",
+                 "gc_before", "now", "prof", "fallback")
+
+
+# test seam: {round_seq: seconds} delay applied at collect time BEFORE
+# the device result is consumed — reverses the completion order of
+# in-flight rounds (tests/test_device_resident.py); None in production.
+_TEST_COLLECT_DELAY = None
+_collect_seq = 0
+
+
+def submit_merge_resident(batches: list[CellBatch], gc_before: int = 0,
+                          now: int = 0, purgeable_ts_fn=None,
+                          prof: dict | None = None,
+                          device=None) -> ResidentHandle:
+    """Dispatch one device-resident round (async). Rounds the resident
+    formulation cannot encode (counters, range bounds, oversized
+    frames) dispatch through the regular submit_merge path instead —
+    collect_merge_resident returns a host CellBatch for those."""
+    import time as _time
+
+    h = ResidentHandle()
+    h.gc_before, h.now, h.prof = gc_before, now, prof
+    h.fallback = None
+    cat = CellBatch.concat(batches)
+    h.cat, h.n = cat, len(cat)
+    if h.n == 0:
+        h.mode, h.result = "done", cat
+        return h
+    if ((cat.flags & (FLAG_RANGE_BOUND | FLAG_COUNTER)) != 0).any():
+        h.mode = "host"
+        h.fallback = dmerge.submit_merge(batches, gc_before, now,
+                                         purgeable_ts_fn, prof=prof)
+        return h
+    t0 = _time.perf_counter()
+    built = build_resident_operands(cat, gc_before, now, purgeable_ts_fn)
+    if built is None:   # >= 4 GiB frame: let the host path fail loudly
+        h.mode = "host"
+        h.fallback = dmerge.submit_merge(batches, gc_before, now,
+                                         purgeable_ts_fn, prof=prof)
+        return h
+    operands, h.pts = built
+    if device is not None:
+        operands = {k: jax.device_put(v, device)
+                    for k, v in operands.items()}
+    t1 = _time.perf_counter()
+    h.out = _resident_program(operands)
+    from ..service.profiling import GLOBAL as _kprof
+    _kprof.record_dispatch(
+        "merge.resident",
+        (int(operands["lanes"].shape[0]), int(operands["lanes"].shape[1])),
+        _time.perf_counter() - t1)
+    h.mode = "resident"
+    if prof is not None:
+        prof["pack"] = prof.get("pack", 0.0) + (t1 - t0)
+    return h
+
+
+def collect_merge_resident(h: ResidentHandle):
+    """Block on a resident round. Returns a DeviceRound (columns still
+    on device) for rounds the device reproduced exactly, else a host
+    CellBatch computed through the pinned byte-identical fallback."""
+    import time as _time
+
+    global _collect_seq
+    if _TEST_COLLECT_DELAY is not None:
+        _time.sleep(_TEST_COLLECT_DELAY.get(_collect_seq, 0.0))
+    _collect_seq += 1
+    if h.mode == "done":
+        return promote_round(h.result)
+    if h.mode == "host":
+        return promote_round(dmerge.collect_merge(h.fallback))
+    cat, prof = h.cat, h.prof
+    n_keep_d, n_amb_d, n_exp_d, perm_out_d, cols, perm_d, packed_d = h.out
+    t0 = _time.perf_counter()
+    n_keep = int(n_keep_d)          # blocks until the program finishes
+    n_amb = int(n_amb_d)
+    n_exp_kept = int(n_exp_d)
+    t1 = _time.perf_counter()
+    from ..service.profiling import GLOBAL as _kprof
+    _kprof.record_execute("merge.resident", t1 - t0)
+    if prof is not None:
+        prof["device"] = prof.get("device", 0.0) + (t1 - t0)
+
+    if n_amb or n_exp_kept:
+        # exact-resolution round: equal-(identity, ts) runs need the
+        # host's full-value tie-break, kept expired cells need the
+        # tombstone conversion's payload rewrite — materialize on the
+        # host exactly like ops/merge.py's v1/v2 collect
+        n = h.n
+        perm = np.asarray(perm_d).astype(np.int64)[:n]
+        keep, amb, expired, shadowed = dmerge.unpack_masks(
+            np.asarray(packed_d)[:n])
+        pts_sorted = h.pts[perm] if h.pts is not None else None
+        if amb.any():
+            dmerge.host_tiebreak(cat, perm, keep, amb, shadowed,
+                                 expired, h.gc_before, pts_sorted)
+        out = dmerge.finalize_merged(cat, perm, keep, expired, shadowed)
+        if prof is not None:
+            prof["gather"] = prof.get("gather", 0.0) \
+                + (_time.perf_counter() - t1)
+        return promote_round(out)
+
+    # resident round: pull ONLY the kept permutation (the payload
+    # gather's index vector) — the columns stay on the device
+    perm_kept = np.asarray(perm_out_d).astype(np.int64)[:n_keep]
+    payload, off, val_start = _gather_payload(cat, perm_kept)
+    if prof is not None:
+        prof["gather"] = prof.get("gather", 0.0) \
+            + (_time.perf_counter() - t1)
+    return DeviceRound(n_keep, cols, payload, off, val_start,
+                       dict(cat.pk_map), cat.ck_fits_prefix)
+
+
+def promote_round(batch: CellBatch) -> DeviceRound:
+    """Lift a host-materialized round (fallback rounds: ties, expired
+    conversions, counters, range bounds) onto the device so the write
+    lane consumes ONE ordered stream — interleaving host appends with
+    device-pending cells would cut segments out of order. Values are
+    copied verbatim, so the serialized bytes are identical to feeding
+    the batch through the host writer."""
+    n = len(batch)
+    lens64 = batch.off[1:] - batch.off[:-1]
+    vrel64 = batch.val_start - batch.off[:-1]
+    if n and (int(lens64.max()) >= 1 << 32
+              or int(vrel64.max()) >= 1 << 32):
+        # mirror the host serializer's loud failure (writer._cut_segment)
+        raise ValueError(
+            f"cell frame exceeds the u32 offset lane "
+            f"(max frame {int(lens64.max())} bytes)")
+    with np.errstate(over="ignore"):
+        uts = batch.ts.astype(np.uint64) ^ np.uint64(1 << 63)
+    cols = {
+        "lanes": jnp.asarray(np.ascontiguousarray(batch.lanes)),
+        "ts_h": jnp.asarray((uts >> np.uint64(32)).astype(np.uint32)),
+        "ts_l": jnp.asarray((uts & np.uint64(0xFFFFFFFF))
+                            .astype(np.uint32)),
+        "ldt": jnp.asarray(batch.ldt.astype(np.int32, copy=False)),
+        "ttl": jnp.asarray(batch.ttl.astype(np.int32, copy=False)),
+        "flags8": jnp.asarray(batch.flags.astype(np.uint8, copy=False)),
+        "fl": jnp.asarray(lens64.astype(np.uint32)),
+        "vr": jnp.asarray(vrel64.astype(np.uint32)),
+    }
+    return DeviceRound(n, cols, np.asarray(batch.payload),
+                       np.asarray(batch.off, dtype=np.int64),
+                       np.asarray(batch.val_start, dtype=np.int64),
+                       dict(batch.pk_map), batch.ck_fits_prefix)
+
+
+def _gather_payload(cat: CellBatch, perm: np.ndarray):
+    """Host-side ragged payload gather (the one part of the round that
+    never went to the device) — same native path apply_permutation
+    uses, without touching the fixed-width columns."""
+    from ..storage.cellbatch import _native_gather
+    n = len(perm)
+    starts = cat.off[:-1][perm]
+    lens = (cat.off[1:] - cat.off[:-1])[perm]
+    new_off = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(lens, out=new_off[1:])
+    total = int(new_off[-1])
+    if total:
+        payload = _native_gather(cat.payload, cat.off, perm, new_off)
+        if payload is None:
+            pos_in_cell = np.arange(total, dtype=np.int64) - \
+                np.repeat(new_off[:-1], lens)
+            payload = cat.payload[np.repeat(starts, lens) + pos_in_cell]
+    else:
+        payload = np.zeros(0, dtype=np.uint8)
+    val_start = new_off[:-1] + (cat.val_start - cat.off[:-1])[perm]
+    return payload, new_off, val_start
+
+
+# ----------------------------------------------------------- write lane --
+
+class DeviceWriteLane:
+    """The device-resident write stage: accumulates rounds' columns in
+    a device pending buffer, cuts segments on-device, serializes each
+    full segment's META block with the fused kernel and hands the
+    writer only finished blocks (writer._emit_segment — the exact tail
+    the host path runs after its own serialize). The final partial
+    segment assembles through the host build_meta_block on pulled
+    column slices: one segment per output, and bit-equality with the
+    kernel is the pinned contract, not an optimization target."""
+
+    def __init__(self, writer):
+        from ..storage.sstable.format import SEGMENT_CELLS
+        self.writer = writer
+        self.seg_cells = writer.segment_cells or SEGMENT_CELLS
+        self.cols: dict | None = None     # device pending columns
+        self.pending = 0                  # valid cells in self.cols
+        self.payloads: list = []          # (payload, off, val_start)
+        self.payload_cells = 0
+        self.pk_map: dict = {}
+
+    def append(self, r: DeviceRound) -> None:
+        import time as _time
+        t0 = _time.perf_counter()
+        w = self.writer
+        if w.K is None:
+            w.K = int(r.cols["lanes"].shape[1])
+        w._ck_fits = w._ck_fits and r.ck_fits_prefix
+        take = {k: v[:r.n] for k, v in r.cols.items()}
+        if self.cols is None or self.pending == 0:
+            self.cols = take
+        else:
+            self.cols = {k: jnp.concatenate([self.cols[k][:self.pending],
+                                             take[k]])
+                         for k in RESIDENT_COLS}
+        self.pending += r.n
+        self.payloads.append((r.payload, r.off, r.val_start))
+        self.payload_cells += r.n
+        for k, v in r.pk_map.items():
+            self.pk_map[k] = v
+        w._acct("serialize", _time.perf_counter() - t0)
+        while self.pending >= self.seg_cells:
+            self._cut(self.seg_cells)
+
+    def flush(self) -> None:
+        """Cut everything left (the final partial segment) — the
+        device-mode analog of finish()'s pending drain; call before
+        writer.finish()/roll."""
+        while self.pending >= self.seg_cells:
+            self._cut(self.seg_cells)
+        if self.pending:
+            self._cut(self.pending)
+
+    # ------------------------------------------------------------ internals
+
+    def _take_payload(self, n: int):
+        """Pop n cells' worth of payload frames (host side), mirroring
+        SSTableWriter._take's slicing."""
+        outs, got = [], 0
+        while got < n:
+            payload, off, val_start = self.payloads[0]
+            avail = len(off) - 1
+            need = n - got
+            if avail <= need:
+                outs.append((payload, off, val_start))
+                self.payloads.pop(0)
+                got += avail
+            else:
+                base = int(off[need])
+                outs.append((payload[:base], off[:need + 1],
+                             val_start[:need]))
+                self.payloads[0] = (payload[base:], off[need:] - base,
+                                    val_start[need:] - base)
+                got = n
+        self.payload_cells -= n
+        if len(outs) == 1:
+            payload, off, _vs = outs[0]
+            return np.ascontiguousarray(payload[:int(off[-1])])
+        return np.concatenate([payload[:int(off[-1])]
+                               for payload, off, _vs in outs])
+
+    def _cut(self, n: int) -> None:
+        import time as _time
+        w = self.writer
+        t0 = _time.perf_counter()
+        seg = {k: self.cols[k][:n] for k in RESIDENT_COLS}
+        self.cols = {k: self.cols[k][n:] for k in RESIDENT_COLS}
+        self.pending -= n
+        lanes_np = np.ascontiguousarray(np.asarray(seg["lanes"]))
+        if n == self.seg_cells:
+            # full segment: the fused kernel serializes + reduces stats
+            # in one device program; the host sees finished bytes
+            t_k = _time.perf_counter()
+            meta_d, st = _meta_block_kernel(
+                seg["ts_h"], seg["ts_l"], seg["ldt"], seg["ttl"],
+                seg["flags8"], seg["fl"], seg["vr"])
+            from ..service.profiling import GLOBAL as _kprof
+            _kprof.record_dispatch("write.serialize", (n,),
+                                   _time.perf_counter() - t_k)
+            t_k = _time.perf_counter()
+            meta = np.asarray(meta_d)
+            _kprof.record_execute("write.serialize",
+                                  _time.perf_counter() - t_k)
+            stats = (_uts_pair_to_i64(st[0], st[1]),
+                     _uts_pair_to_i64(st[2], st[3]),
+                     int(st[4]), int(st[5]), int(st[6]))
+        else:
+            # final partial segment: host assembly through the one
+            # shared META builder (byte-identical layout by definition)
+            from ..storage.sstable.writer import build_meta_block
+            h = np.asarray(seg["ts_h"]).astype(np.uint64)
+            l = np.asarray(seg["ts_l"]).astype(np.uint64)
+            ts = ((h << np.uint64(32)) | l) ^ np.uint64(1 << 63)
+            ts = ts.astype(np.int64)
+            ldt = np.asarray(seg["ldt"])
+            ttl = np.asarray(seg["ttl"])
+            flags = np.asarray(seg["flags8"])
+            meta = build_meta_block(ts, ldt, ttl, flags,
+                                    np.asarray(seg["fl"]).astype("<u4"),
+                                    np.asarray(seg["vr"]).astype("<u4"))
+            stats = (int(ts.min()), int(ts.max()),
+                     int(ldt.min()), int(ldt.max()),
+                     int(((flags & DEATH_FLAGS) != 0).sum()))
+        payload_np = self._take_payload(n)
+        w._acct("serialize", _time.perf_counter() - t0)
+        w._emit_segment(n, meta, lanes_np, payload_np, self.pk_map,
+                        stats)

@@ -39,12 +39,54 @@ def available() -> bool:
     return _lib is not None
 
 
+class LazyMergedBatch:
+    """A native merge result whose GATHER (permutation materialization
+    — the biggest single producer-thread cost after decode) has not run
+    yet. The compaction write loop materializes it on the WRITER
+    thread, so round k's gather overlaps round k+1's decode + merge —
+    a pipeline rebalance, not a semantic change: the wq drains FIFO on
+    one thread, so materialization order equals merge order and output
+    bytes are untouched."""
+
+    __slots__ = ("cat", "out_idx", "out_exp", "n_out", "prof")
+
+    def __init__(self, cat, out_idx, out_exp, n_out, prof):
+        self.cat = cat
+        self.out_idx = out_idx
+        self.out_exp = out_exp
+        self.n_out = n_out
+        self.prof = prof
+
+    def __len__(self) -> int:
+        return self.n_out
+
+    def materialize(self) -> CellBatch:
+        import time as _time
+        t0 = _time.perf_counter()
+        out = self.cat.apply_permutation(self.out_idx[:self.n_out])
+        out.sorted = True
+        converted = self.out_exp[:self.n_out].astype(bool)
+        if converted.any():
+            out.flags[converted] |= FLAG_TOMBSTONE
+            out = out.drop_values(converted)
+        if self.prof is not None:
+            # single-writer key: only the materializing thread bills
+            # 'gather' once deferral is on
+            self.prof["gather"] = self.prof.get("gather", 0.0) \
+                + (_time.perf_counter() - t0)
+        self.cat = None   # drop the concat refs as soon as gathered
+        return out
+
+
 def merge_sorted_native(batches: list[CellBatch], gc_before: int = 0,
                         now: int = 0, purgeable_ts_fn=None,
-                        prof: dict | None = None) -> CellBatch:
+                        prof: dict | None = None,
+                        defer_gather: bool = False) -> CellBatch:
     """Drop-in equivalent of storage.cellbatch.merge_sorted running the
     merge/reconcile in C++. Requires every batch sorted; counter tables
-    fall back to numpy."""
+    fall back to numpy. defer_gather=True returns a LazyMergedBatch
+    (same length) whose materialize() runs the output gather — the
+    compaction pipeline calls it from the writer thread."""
     import time as _time
 
     batches = [b for b in batches if len(b)]
@@ -96,17 +138,12 @@ def merge_sorted_native(batches: list[CellBatch], gc_before: int = 0,
     if n_out < 0:
         raise RuntimeError("native merge_reconcile failed")
     t3 = _time.perf_counter()
-
-    out = cat.apply_permutation(out_idx[:n_out])
-    out.sorted = True
-    converted = out_exp[:n_out].astype(bool)
-    if converted.any():
-        out.flags[converted] |= FLAG_TOMBSTONE
-        out = out.drop_values(converted)
-    t4 = _time.perf_counter()
     if prof is not None:
         prof["purge_fn"] = prof.get("purge_fn", 0.0) + (t2 - t1)
         prof["pack"] = prof.get("pack", 0.0) + (t1 - t0)
         prof["native_merge"] = prof.get("native_merge", 0.0) + (t3 - t2)
-        prof["gather"] = prof.get("gather", 0.0) + (t4 - t3)
-    return out
+
+    lazy = LazyMergedBatch(cat, out_idx, out_exp, int(n_out), prof)
+    if defer_gather:
+        return lazy
+    return lazy.materialize()

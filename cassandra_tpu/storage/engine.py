@@ -256,6 +256,14 @@ class StorageEngine:
         always by the owning engine's own setting)."""
         return max(int(self.settings.get("compaction_mesh_devices")), 0)
 
+    def _decode_ahead(self) -> bool:
+        """This engine's `compaction_decode_ahead` knob — read by its
+        tasks EVERY ROUND (compaction/task.py), so the hot reload needs
+        no listener and a mid-compaction flip takes effect at the next
+        round boundary. Engine-scoped like the mesh knob: a co-hosted
+        engine's setting never flips this engine's prefetch."""
+        return bool(self.settings.get("compaction_decode_ahead"))
+
     @property
     def _schema_path(self) -> str:
         return os.path.join(self.data_dir, "schema.json")
@@ -318,6 +326,7 @@ class StorageEngine:
                                 failures=self.failures)
         cfs.backup_enabled = lambda: self.incremental_backup
         cfs.mesh_devices_fn = self._mesh_devices
+        cfs.decode_ahead_fn = self._decode_ahead
         self.compactions.register(cfs)
         self.stores[t.id] = cfs
         return cfs

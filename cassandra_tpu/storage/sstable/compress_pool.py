@@ -114,6 +114,33 @@ class CompressorPool:
     def queue_depth(self) -> int:
         return self._q.qsize()
 
+    def try_run_one(self) -> bool:
+        """Caller work-stealing: pop ONE queued job and run it on the
+        calling thread; False when the queue is empty. A producer
+        blocked on the pipeline's backpressure (exhausted pack-buffer
+        pool, outcome-stream lag, the finish() drain) is an idle core
+        standing next to a queue of compress work — stealing turns that
+        stall into throughput with ZERO oversubscription, because the
+        thread was provably not doing anything else. Output bytes are
+        unaffected: jobs produce the same result on any thread and the
+        writer's ordered completion queue re-sequences them regardless
+        of who ran them."""
+        try:
+            fn = self._q.get_nowait()
+        except queue.Empty:
+            return False
+        t0 = time.perf_counter()
+        try:
+            fn()
+        except BaseException:
+            pass   # jobs own their error channel (see _work_loop)
+        finally:
+            self._stage.add_busy(time.perf_counter() - t0)
+            self._stage.add_items(1)
+            with self._lock:
+                self._jobs += 1
+        return True
+
     @property
     def jobs_completed(self) -> int:
         return self._jobs

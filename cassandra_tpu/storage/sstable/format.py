@@ -33,8 +33,15 @@ SEGMENT_CELLS = 65536  # cells per segment (device batch granularity)
 # on lz4 and zstd both; readers transpose back); "cd": the meta block's
 # absolute i64 off/val_start pair (16 B/cell) is replaced by u32
 # frame-length deltas + u32 value offsets (8 B/cell) — readers rebuild
-# the absolute offsets with one cumsum
-FORMAT_VERSION = "cd"
+# the absolute offsets with one cumsum; "ce": the meta block's ts lane
+# is stored as per-segment wraparound deltas (first cell absolute) —
+# a delta pre-transform ahead of the codec, the meta-lane analog of the
+# lanes shuffle: identity-sorted neighbours share timestamp locality on
+# real workloads (time-series especially), and mod-2^64 arithmetic
+# makes the cumsum rebuild exact for any i64 values. Both the host
+# serializer and the device fused-serialize kernel (ops/device_write.py)
+# emit the identical transform.
+FORMAT_VERSION = "ce"
 
 
 class Component:

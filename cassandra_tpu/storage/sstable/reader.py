@@ -347,6 +347,12 @@ class SSTableReader:
             lanes_unshuffle(lanes_store, lanes)
 
         ts = meta[:8 * n].view("<i8")
+        if self.desc.version >= "ce":
+            # "ce" stores the ts lane as per-segment wraparound deltas
+            # (format.py): one cumsum rebuilds the absolute stamps —
+            # exact for any i64 values because both directions run in
+            # mod-2^64 arithmetic
+            ts = np.cumsum(ts, dtype=np.int64)
         o = 8 * n
         ldt = meta[o:o + 4 * n].view("<i4")
         o += 4 * n

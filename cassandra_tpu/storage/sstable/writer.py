@@ -84,6 +84,35 @@ class _PackJob:
         self.trace = None   # active TraceState at submit, if any
 
 
+def build_meta_block(ts: "np.ndarray", ldt: "np.ndarray",
+                     ttl: "np.ndarray", flags: "np.ndarray",
+                     frame_len: "np.ndarray", val_rel: "np.ndarray"
+                     ) -> "np.ndarray":
+    """The "ce" META block: ts-delta 8 + ldt 4 + ttl 4 + flags 1 +
+    frame_len u32 + val_rel u32 = 25 B/cell. The ts lane is stored as
+    per-segment wraparound deltas (first cell absolute; format.py "ce")
+    — mod-2^64 arithmetic, so the reader's cumsum rebuild is exact for
+    any i64 timestamps. ONE definition of the layout: the host write
+    path serializes through here and the device fused-serialize kernel
+    (ops/device_write.py) is pinned byte-identical to it by test."""
+    n = len(ts)
+    tsd = np.empty(n, dtype=np.int64)
+    if n:
+        tsd[0] = ts[0]
+        np.subtract(ts[1:], ts[:-1], out=tsd[1:])
+    meta = np.empty(n * 25, dtype=np.uint8)
+    pos = 0
+    for arr, width in ((tsd, 8),
+                       (ldt.astype("<i4", copy=False), 4),
+                       (ttl.astype("<i4", copy=False), 4),
+                       (flags.astype("u1", copy=False), 1),
+                       (frame_len, 4), (val_rel, 4)):
+        end = pos + n * width
+        meta[pos:end] = np.ascontiguousarray(arr).view(np.uint8)
+        pos = end
+    return meta
+
+
 def _part_starts(lanes_c: "np.ndarray", n: int) -> "np.ndarray":
     """Row indices where the partition (first 4 lanes) changes — native
     single pass with a numpy fallback."""
@@ -456,27 +485,58 @@ class SSTableWriter:
         self._write_sync(mv)
         self._acct("io_write", time.perf_counter() - t0)
 
+    def _steal_wait(self, take_nowait, take_blocking):
+        """Producer-side wait with caller work-stealing: while the
+        wanted resource is unavailable, run queued pack jobs inline
+        (CompressorPool.try_run_one) instead of sleeping — the blocked
+        producer is an idle core and the jobs it runs are exactly what
+        unblocks it. Returns (value, genuine_stall_seconds): time spent
+        stealing is compress BUSY work (billed by the pool's pack
+        stage), not backpressure, so only the blocking remainder counts
+        as stall."""
+        stall = 0.0
+        while True:
+            try:
+                return take_nowait(), stall
+            except queue.Empty:
+                pass
+            if self._io_error:
+                raise self._io_error[0]
+            if self._cpool is not None and self._cpool.try_run_one():
+                continue
+            t0 = time.perf_counter()
+            try:
+                return take_blocking(), \
+                    stall + time.perf_counter() - t0
+            except queue.Empty:
+                stall += time.perf_counter() - t0
+
     def _take_pack_buf(self, need: int) -> "np.ndarray":
         """Borrow a pack buffer from the free pool (blocks when all are
         in flight — the pipeline's backpressure), growing it if this
         segment needs more room. An empty pool means the producer
-        outran compress+disk: counted as a compress-stage stall."""
+        outran compress+disk: it steals queued pack jobs while waiting
+        and the un-stolen remainder counts as a compress-stage stall."""
         try:
             buf = self._pack_free.get_nowait()
         except queue.Empty:
             if self._metrics is not None:
                 self._metrics.incr("compress_stalls")
-                t0 = time.perf_counter()
-                buf = self._pack_free.get()
-                dt = time.perf_counter() - t0
+            buf, dt = self._steal_wait(
+                self._pack_free.get_nowait,
+                lambda: self._pack_free.get(timeout=0.05))
+            if dt > 0 and self.prof is not None:
+                # producer wall genuinely blocked on the write leg —
+                # bench.py's write_phase attribution reads this
+                self.prof["write_stall"] = \
+                    self.prof.get("write_stall", 0.0) + dt
+            if self._metrics is not None and dt > 0:
                 self._metrics.hist("compress_stall").update_us(dt * 1e6)
                 if self._ledger is not None:
                     # producer blocked on the compress+io stages: the
                     # backpressure seconds the ledger attributes to the
                     # stage being waited ON
                     self._ledger["compress"].add_stall(dt)
-            else:
-                buf = self._pack_free.get()
         if buf.nbytes < need:
             buf = np.empty(need, dtype=np.uint8)
         return buf
@@ -493,25 +553,36 @@ class SSTableWriter:
         outcome_{k-LAG}) sequence, the decisions — and therefore the
         stored bytes — are identical for any pool size."""
         k = self._seq_submitted
-        stalled_at = None
-        if self._seq_applied <= k - self.SKIP_DECISION_LAG \
-                and self._metrics is not None \
-                and self._acct_outcomes.empty():
-            # genuine stall: LAG segments in flight, oldest not done
-            self._metrics.incr("compress_stalls")
-            stalled_at = time.perf_counter()
+        stall_s = 0.0
+        stalled = False
         while self._seq_applied <= k - self.SKIP_DECISION_LAG:
             if self._io_error:
                 raise self._io_error[0]
-            out = self._acct_outcomes.get()
+            try:
+                out = self._acct_outcomes.get_nowait()
+            except queue.Empty:
+                # genuine lag: LAG segments in flight, oldest not done —
+                # steal queued pack jobs while waiting (the oldest job
+                # may be sitting un-started in the pool queue)
+                if not stalled:
+                    stalled = True
+                    if self._metrics is not None:
+                        self._metrics.incr("compress_stalls")
+                out, dt = self._steal_wait(
+                    self._acct_outcomes.get_nowait,
+                    lambda: self._acct_outcomes.get(timeout=0.05))
+                stall_s += dt
             if out is _ACCT_FAILED:
                 raise self._io_error[0] if self._io_error else \
                     RuntimeError("compress pipeline failed")
             self._apply_outcome(out)
             self._seq_applied += 1
-        if stalled_at is not None and self._ledger is not None:
-            self._ledger["compress"].add_stall(
-                time.perf_counter() - stalled_at)
+        if stall_s > 0:
+            if self.prof is not None:
+                self.prof["write_stall"] = \
+                    self.prof.get("write_stall", 0.0) + stall_s
+            if self._ledger is not None:
+                self._ledger["compress"].add_stall(stall_s)
         attempt = []
         for i in range(3):
             if self._skip_left[i] > 0:
@@ -625,7 +696,15 @@ class SSTableWriter:
                 job = self._wq.get()
                 if job is None:
                     return
-                job.ready.wait()
+                # waiting on the head job means compress is the
+                # bottleneck RIGHT NOW — this otherwise-idle thread
+                # steals queued pack jobs (possibly the very one it
+                # waits on) instead of sleeping; the disk never idles
+                # behind a ready job because stealing only happens
+                # while the head is NOT ready
+                while not job.ready.is_set():
+                    if not self._cpool.try_run_one():
+                        job.ready.wait(0.02)
                 if job.error is not None:
                     raise job.error
                 entry = struct.pack("<QI", self._data_off, job.n)
@@ -705,6 +784,17 @@ class SSTableWriter:
         if self._io_thread is None:
             return
         self._wq.put(None)
+        if self._cpool is not None:
+            # seal drain: the producer is done producing and about to
+            # park in join() — steal queued pack jobs instead (the
+            # un-overlapped end of the pipeline was a measured chunk of
+            # the `seal` phase; two threads drain it in half the wall).
+            # Bounded by OUR io thread's lifetime: it exits right after
+            # this writer's tail completes, so a busy co-tenant's job
+            # stream can extend this loop by at most one stolen job —
+            # never unboundedly.
+            while self._io_thread.is_alive() and self._cpool.try_run_one():
+                pass
         self._io_thread.join()
         self._io_thread = None
         if self._io_error:
@@ -848,14 +938,62 @@ class SSTableWriter:
 
     def _cut_segment(self, n: int) -> None:
         seg = self._take(n)
+        # --- blocks: vectorized serialization into one scratch buffer,
+        # then zero-copy scatter-gather compression (the previous
+        # tobytes/join/ctypes staging copied every byte ~4x — measured as
+        # the dominant write-path cost)
+        # "ce" meta layout (build_meta_block): ts-delta 8 + ldt 4 +
+        # ttl 4 + flags 1 + frame_len u32 + val_rel u32 = 25 B/cell.
+        # Frame lengths are the off deltas and val_rel the value offset
+        # inside each frame — half the bytes of the absolute i64 pair
+        # they replace, and far more compressible (small near-constant
+        # integers); the ts lane is delta'd per segment for the same
+        # reason (format.py "ce")
+        t_ser = time.perf_counter()
+        deltas = seg.off[1:] - seg.off[:-1]
+        vrel64 = seg.val_start - seg.off[:-1]
+        if len(deltas) and (int(deltas.max()) >= 1 << 32
+                            or int(vrel64.max()) >= 1 << 32):
+            # u32 lanes cannot hold a >=4GiB frame — fail loudly
+            # instead of wrapping into silent corruption
+            raise ValueError(
+                f"cell frame exceeds the u32 offset lane "
+                f"(max frame {int(deltas.max())} bytes)")
+        meta = build_meta_block(seg.ts.astype(np.int64, copy=False),
+                                seg.ldt, seg.ttl, seg.flags,
+                                deltas.astype("<u4"),
+                                vrel64.astype("<u4"))
+        payload_b = np.ascontiguousarray(seg.payload)
+        lanes_c = np.ascontiguousarray(seg.lanes)
+        from ..cellbatch import DEATH_FLAGS
+        seg_stats = (int(seg.ts.min()), int(seg.ts.max()),
+                     int(seg.ldt.min()), int(seg.ldt.max()),
+                     int(((seg.flags & DEATH_FLAGS) != 0).sum()))
+        self._acct("serialize", time.perf_counter() - t_ser)
+        self._emit_segment(n, meta, lanes_c, payload_b, seg.pk_map,
+                           seg_stats)
+
+    def _emit_segment(self, n: int, meta: "np.ndarray",
+                      lanes_c: "np.ndarray", payload_b: "np.ndarray",
+                      pk_map: dict, seg_stats: tuple) -> None:
+        """Everything downstream of block serialization for ONE segment:
+        ordering guards, partition directory + bloom, stats fold,
+        adaptive-skip attempt decision, compress (pool / serial / the
+        per-block fallback), index entry and digest bookkeeping. The
+        host path enters from _cut_segment with blocks it built in
+        numpy; the device-resident lane (ops/device_write.py) enters
+        with blocks its fused kernel built from device arrays — one
+        tail, so the two paths cannot diverge on any sequential writer
+        state. seg_stats: (min_ts, max_ts, min_ldt, max_ldt,
+        tombstones) computed by whichever side owned the columns."""
         # cross-segment ordering guard; the intra-segment check runs
         # inside segment_pack's delta loop (fast path) or the numpy
         # comparison below (fallback path)
-        first = seg.lanes[0].astype(">u4").tobytes()
+        first = lanes_c[0].astype(">u4").tobytes()
         if self._last_lane_end is not None and first < self._last_lane_end:
             raise ValueError("appended cells out of order")
         if n > 1 and self._packer is None:
-            a, b = seg.lanes[:-1], seg.lanes[1:]
+            a, b = lanes_c[:-1], lanes_c[1:]
             neq = a != b
             anyneq = neq.any(axis=1)
             if anyneq.any():
@@ -868,14 +1006,13 @@ class SSTableWriter:
         # lanes finds the rows where the 4 pk lanes change (the numpy
         # strided slice-copy + row-compare this replaces was a measured
         # write-leg hotspot)
-        lanes_c = np.ascontiguousarray(seg.lanes)
         starts = _part_starts(lanes_c, n)
         new_keys = []
         for s in starts:
             l4 = lanes_c[s, :4].astype(">u4").tobytes()
             if l4 == self._last_lane4:
                 continue  # partition continues from previous segment
-            pk = seg.pk_map.get(l4)
+            pk = pk_map.get(l4)
             if pk is None:
                 raise ValueError("pk_map missing partition key")
             self._part_lane4.append(l4)
@@ -894,56 +1031,23 @@ class SSTableWriter:
         def _hi(key, v):
             st[key] = v if st[key] is None else max(st[key], v)
 
-        _lo("min_ts", int(seg.ts.min()))
-        _hi("max_ts", int(seg.ts.max()))
-        _lo("min_ldt", int(seg.ldt.min()))
-        _hi("max_ldt", int(seg.ldt.max()))
-        from ..cellbatch import DEATH_FLAGS
-        self._stats["tombstones"] += int(
-            ((seg.flags & DEATH_FLAGS) != 0).sum())
+        mn_ts, mx_ts, mn_ldt, mx_ldt, tombs = seg_stats
+        _lo("min_ts", mn_ts)
+        _hi("max_ts", mx_ts)
+        _lo("min_ldt", mn_ldt)
+        _hi("max_ldt", mx_ldt)
+        self._stats["tombstones"] += tombs
 
-        # --- blocks: vectorized serialization into one scratch buffer,
-        # then zero-copy scatter-gather compression (the previous
-        # tobytes/join/ctypes staging copied every byte ~4x — measured as
-        # the dominant write-path cost)
-        # "cd" meta layout: ts 8 + ldt 4 + ttl 4 + flags 1 +
-        # frame_len u32 + val_rel u32 = 25 B/cell. Frame lengths are
-        # the off deltas and val_rel the value offset inside each frame
-        # — half the bytes of the absolute i64 pair they replace, and
-        # far more compressible (small near-constant integers)
-        t_ser = time.perf_counter()
-        deltas = seg.off[1:] - seg.off[:-1]
-        vrel64 = seg.val_start - seg.off[:-1]
-        if len(deltas) and (int(deltas.max()) >= 1 << 32
-                            or int(vrel64.max()) >= 1 << 32):
-            # u32 lanes cannot hold a >=4GiB frame — fail loudly
-            # instead of wrapping into silent corruption
-            raise ValueError(
-                f"cell frame exceeds the u32 offset lane "
-                f"(max frame {int(deltas.max())} bytes)")
-        frame_len = deltas.astype("<u4")
-        val_rel = vrel64.astype("<u4")
-        meta = np.empty(n * 25, dtype=np.uint8)
-        pos = 0
-        for arr, width in ((seg.ts.astype("<i8", copy=False), 8),
-                           (seg.ldt.astype("<i4", copy=False), 4),
-                           (seg.ttl.astype("<i4", copy=False), 4),
-                           (seg.flags.astype("u1", copy=False), 1),
-                           (frame_len, 4), (val_rel, 4)):
-            end = pos + n * width
-            meta[pos:end] = np.ascontiguousarray(arr).view(np.uint8)
-            pos = end
-        payload_b = np.ascontiguousarray(seg.payload)
         attempt = self._decide_attempt()
         maxlen = self.params.max_compressed_length
-        lane_head = seg.lanes[0].astype("<u4").tobytes()
-        lane_tail = seg.lanes[-1].astype("<u4").tobytes()
+        lane_head = lanes_c[0].astype("<u4").tobytes()
+        lane_tail = lanes_c[-1].astype("<u4").tobytes()
+        t_pack = time.perf_counter()
 
         if self._packer is not None:
             # fused native path: delta + order check + compress-or-raw +
             # CRC + sequential placement, one GIL-released call
-            lanes_b = lanes_c
-            blocks = [meta, lanes_b, payload_b]
+            blocks = [meta, lanes_c, payload_b]
             need = sum(b.nbytes for b in blocks)
             if self._cpool is not None:
                 # parallel leg: the pool compresses this segment while
@@ -951,11 +1055,10 @@ class SSTableWriter:
                 # completion thread does entry/digest/write in seq
                 # order (index entry + _total_cells stay consistent:
                 # entries append in seq order over there, cells here)
-                self._acct("serialize", time.perf_counter() - t_ser)
                 self._submit_pack(blocks, attempt, need, n,
                                   lane_head, lane_tail)
                 self._total_cells += n
-                self._last_lane_end = seg.lanes[-1].astype(">u4").tobytes()
+                self._last_lane_end = lanes_c[-1].astype(">u4").tobytes()
                 return
             entry = struct.pack("<QI", self._data_off, n)
             if self._threaded_io:
@@ -966,7 +1069,7 @@ class SSTableWriter:
                 out = self._pack_out
             total, sizes, raws, crcs = self._packer.pack(
                 blocks, attempt, maxlen, shuffle_block=1,
-                lane_width=seg.n_lanes, out=out)
+                lane_width=lanes_c.shape[1], out=out)
             outcome = []
             for i in range(3):
                 stored = int(sizes[i])
@@ -974,7 +1077,7 @@ class SSTableWriter:
                                           int(crcs[i]))
                 outcome.append((stored, blocks[i].nbytes, attempt[i]))
             self._acct_outcomes.put(tuple(outcome))
-            self._acct("compress", time.perf_counter() - t_ser)
+            self._acct("compress", time.perf_counter() - t_pack)
             if self._ledger is not None:
                 self._ledger["compress"].add_items(1, need)
             self._write_all(memoryview(out)[:total],
@@ -987,11 +1090,11 @@ class SSTableWriter:
             # on-disk format is identical either way.
             entry = struct.pack("<QI", self._data_off, n)
             lanes_b = lanes_shuffle(
-                seg.lanes.astype(np.uint32, copy=False))
+                lanes_c.astype(np.uint32, copy=False))
             blocks = [meta, lanes_b, payload_b]
             tried = [b for b, a in zip(blocks, attempt) if a]
             dst, dst_offs, sizes = self.compressor.compress_iov(tried)
-            self._acct("compress", time.perf_counter() - t_ser)
+            self._acct("compress", time.perf_counter() - t_pack)
             # min_compress_ratio fallback: store uncompressed when too
             # poor (CompressedSequentialWriter.java:160-175 semantics)
             ti = 0
@@ -1021,7 +1124,7 @@ class SSTableWriter:
         entry += lane_tail
         self._index_entries.append(entry)
         self._total_cells += n
-        self._last_lane_end = seg.lanes[-1].astype(">u4").tobytes()
+        self._last_lane_end = lanes_c[-1].astype(">u4").tobytes()
 
     _last_lane_end: bytes | None = None
 

@@ -225,6 +225,13 @@ class ColumnFamilyStore:
         # standalone store follows the anonymous process demand
         from ..parallel import fanout as _fanout_mod
         self.mesh_devices_fn = _fanout_mod.mesh_devices
+        # decode-ahead routing mirrors the mesh knob: a StorageEngine
+        # points this at ITS `compaction_decode_ahead` setting; a
+        # standalone store reads the knob's config DEFAULT (so a
+        # default change propagates here without a second edit)
+        from ..config import Config as _Config
+        self.decode_ahead_fn = \
+            lambda: bool(_Config().compaction_decode_ahead)
         # planned mesh boundaries, keyed (live generations, n_shards):
         # planning walks every live sstable's partition directory
         # (O(P log P) in total partitions) and only changes when the

@@ -18,11 +18,14 @@ transitions (alternating compressible text and incompressible random
 partitions — the payload stream enters and leaves skip mode):
 
   1. the same input sstables major-compacted with the serial compress
-     thread, a 1-worker pool and a 4-worker pool (+ decode-ahead), and
+     thread, a 1-worker pool and a 4-worker pool (+ decode-ahead),
      under the mesh execution mode (2 lanes, and 4 lanes combined with
      a 2-worker pool — docs/multichip.md: token-range shards drained in
-     token order) must produce sha256-identical components AND equal
-     merged-view content_digests;
+     token order), and under the DEVICE engine (device-resident rounds,
+     ops/device_write.py — fused sort/reconcile/purge/serialize on the
+     jax device incl. its per-round host fallbacks, plus the
+     device+mesh-2 cross) must produce sha256-identical components AND
+     equal merged-view content_digests;
   2. the same mutation set flushed with CTPU_WRITE_FASTPATH=0 (serial
      sort-and-write) and =1 over 1- and 4-worker shared pools must
      produce identical sstable bytes and read-back digests.
@@ -149,6 +152,19 @@ def check_compaction(base: str) -> list[str]:
         "mesh4_pool2": dict(pipelined_io=True,
                             compress_pool=CompressorPool(2),
                             decode_ahead=False, mesh_devices=4),
+        # device engine, device-resident rounds (ops/device_write.py):
+        # merge + purge + segment-cut + META serialize run on the jax
+        # device; the mixed fixture's equal-ts duplicates also push
+        # rounds through the per-round host fallback — both sides of
+        # the residency decision must land the same bytes
+        "device": dict(pipelined_io=True, compress_pool=0,
+                       decode_ahead=False, engine="device",
+                       use_device=True),
+        # device engine crossed with the mesh execution mode: shards
+        # fan across jax devices and drain host-side in token order
+        "device_mesh2": dict(pipelined_io=True, compress_pool=0,
+                             decode_ahead=False, engine="device",
+                             use_device=True, mesh_devices=2),
     }
     results = {tag: _compaction_leg(base, pristine, table, tag, **kw)
                for tag, kw in legs.items()}
@@ -283,7 +299,7 @@ def main() -> int:
         return 1
     print("compaction/flush parallel-compression A/B: zero divergence "
           "(serial vs threaded vs pool-1 vs pool-4 vs mesh-2 vs "
-          "mesh-4+pool-2)")
+          "mesh-4+pool-2 vs device-resident vs device+mesh-2)")
     return 0
 
 

@@ -19,6 +19,7 @@ from cassandra_tpu.storage.failures import (CommitLogStoppedError,
                                             StorageStoppedError)
 from cassandra_tpu.storage.mutation import Mutation
 from cassandra_tpu.storage.sstable import Component
+from cassandra_tpu.storage.sstable.format import FORMAT_VERSION as FMT
 from cassandra_tpu.storage.sstable.reader import CorruptSSTableError
 from cassandra_tpu.utils import faultfs, timeutil
 
@@ -135,9 +136,9 @@ def test_bitflip_data_best_effort_quarantines_and_serves(tmp_path):
     assert bad not in [s.desc.generation for s in cfs.live_sstables()]
     # forensics: the components moved into quarantine/, gone from live dir
     qdir = cfs.quarantined[0]["path"]
-    assert os.path.exists(os.path.join(qdir, f"cd-{bad}-Data.db"))
+    assert os.path.exists(os.path.join(qdir, f"{FMT}-{bad}-Data.db"))
     assert not os.path.exists(
-        os.path.join(cfs.directory, f"cd-{bad}-TOC.txt"))
+        os.path.join(cfs.directory, f"{FMT}-{bad}-TOC.txt"))
     # vtable + nodetool surfaces
     vt = eng.virtual_tables.get("system_views", "quarantined_sstables")
     assert [r["generation"] for r in vt.rows()] == [bad]
@@ -198,7 +199,7 @@ def test_corrupt_index_quarantined_at_store_open(tmp_path):
     # flip the header's lane-count field: the open-time
     # "index/stats lane mismatch" corruption check must fire
     # (mid-file index bytes carry no CRC and can rot silently)
-    flip_on_disk(os.path.join(directory, f"cd-{gens[0]}-Index.db"),
+    flip_on_disk(os.path.join(directory, f"{FMT}-{gens[0]}-Index.db"),
                  offset=4)
     c0 = METRICS.counter("storage.corruption_detected")
     eng2 = StorageEngine(str(tmp_path), Schema(), commitlog_sync="batch")
@@ -220,7 +221,7 @@ def test_corrupt_stats_quarantined_at_store_open(tmp_path):
     eng.close()
     # truncate Statistics.db to garbage: json decode error → corruption
     with open(os.path.join(directory,
-                           f"cd-{gens[1]}-Statistics.db"), "w") as f:
+                           f"{FMT}-{gens[1]}-Statistics.db"), "w") as f:
         f.write('{"n_lanes": 13, "broke')
     eng2 = StorageEngine(str(tmp_path), Schema(), commitlog_sync="batch")
     cfs2 = eng2.store("ks", "t")
@@ -241,7 +242,7 @@ def test_corrupt_digest_verify_quarantine_handoff(tmp_path):
     cfs = seeded(eng, t)
     gens = [s.desc.generation for s in cfs.live_sstables()]
     # rewrite the digest file with a wrong value
-    dpath = os.path.join(cfs.directory, f"cd-{gens[0]}-Digest.crc32")
+    dpath = os.path.join(cfs.directory, f"{FMT}-{gens[0]}-Digest.crc32")
     with open(dpath) as f:
         expected = int(f.read().strip())
     with open(dpath, "w") as f:
@@ -597,7 +598,7 @@ def test_sstableverify_offline_quarantine(tmp_path):
     directory = cfs.directory
     data_dir = eng.data_dir
     eng.close()
-    flip_on_disk(os.path.join(directory, f"cd-{gens[0]}-Data.db"))
+    flip_on_disk(os.path.join(directory, f"{FMT}-{gens[0]}-Data.db"))
     rep = sstabletools.verify(data_dir, "ks", "t", quarantine=True)
     by_gen = {r["generation"]: r for r in rep}
     assert by_gen[gens[0]]["status"] != "ok"

@@ -1,0 +1,198 @@
+"""Strategy-selection pins: LeveledCompactionStrategy level overflow,
+TimeWindowCompactionStrategy window grouping + fully-expired drop —
+the `next_background_task` behaviors ROADMAP item 3's adaptive layer
+will build on (reference models: LeveledCompactionStrategyTest,
+TimeWindowCompactionStrategyTest.testDropExpiredSSTables)."""
+import time
+
+import pytest
+
+from cassandra_tpu.compaction.strategies import (
+    LeveledCompactionStrategy, TimeWindowCompactionStrategy,
+    UnifiedCompactionStrategy, get_strategy)
+from cassandra_tpu.schema import (COL_ROW_LIVENESS, Schema, TableParams,
+                                  make_table)
+from cassandra_tpu.storage.engine import StorageEngine
+from cassandra_tpu.storage.mutation import Mutation
+from cassandra_tpu.utils import timeutil
+
+
+def new_engine(tmp_path, compaction=None, gc_grace=864000):
+    schema = Schema()
+    schema.create_keyspace("ks")
+    params = TableParams(gc_grace_seconds=gc_grace)
+    if compaction:
+        params.compaction = compaction
+    t = make_table("ks", "t", pk=["id"], ck=["c"],
+                   cols={"id": "int", "c": "int", "v": "text"},
+                   params=params)
+    schema.add_table(t)
+    eng = StorageEngine(str(tmp_path / "data"), schema,
+                        commitlog_sync="batch")
+    return eng, t, eng.store("ks", "t")
+
+
+def put(eng, t, p, c, v, ts=None):
+    m = Mutation(t.id, t.columns["id"].cql_type.serialize(p))
+    ck = t.serialize_clustering([c])
+    ts = ts or timeutil.now_micros()
+    m.add(ck, COL_ROW_LIVENESS, b"", b"", ts)
+    m.add(ck, t.columns["v"].column_id, b"",
+          t.columns["v"].cql_type.serialize(v), ts)
+    eng.apply(m)
+
+
+def put_dead(eng, t, p, c, ts, ldt):
+    """A cell tombstone (the shape a TTL'd cell takes once a merge past
+    its expiry converted it)."""
+    from cassandra_tpu.storage.cellbatch import FLAG_TOMBSTONE
+    m = Mutation(t.id, t.columns["id"].cql_type.serialize(p))
+    ck = t.serialize_clustering([c])
+    m.add(ck, t.columns["v"].column_id, b"", b"", ts, ldt=ldt,
+          flags=FLAG_TOMBSTONE)
+    eng.apply(m)
+
+
+def test_lcs_level_overflow_promotes_one_victim(tmp_path):
+    """A level above its byte target pushes its LARGEST sstable into
+    the next level, merged with every overlapping run there — the
+    LeveledManifest overflow rule (no L0 backlog involved)."""
+    eng, t, cfs = new_engine(
+        tmp_path,
+        compaction={"class": "LeveledCompactionStrategy",
+                    # tiny level target so two small flushes overflow L1
+                    "sstable_size_in_mb": 0.001, "fanout_size": 2,
+                    "l0_threshold": 4})
+    for gen in range(3):
+        for p in range(40):
+            put(eng, t, p + gen * 40, 0, "x" * 120)
+        cfs.flush()
+    # pin the flushed sstables to L1/L2 by rewriting their level stats
+    ssts = sorted(cfs.live_sstables(), key=lambda s: s.desc.generation)
+    for s, lvl in zip(ssts, (1, 1, 2)):
+        s.stats["level"] = lvl
+    strat = LeveledCompactionStrategy(
+        cfs, {"sstable_size_in_mb": 0.001, "fanout_size": 2,
+              "l0_threshold": 4})
+    task = strat.next_background_task()
+    assert task is not None
+    # the victim is the LARGEST L1 sstable; every overlapping L2 run
+    # rides along; the output lands one level down
+    victim = max((s for s in ssts if s.level == 1),
+                 key=lambda s: s.data_size)
+    assert victim in task.inputs
+    assert task.level == 2
+    assert all(s.level in (1, 2) for s in task.inputs)
+    l2 = [s for s in ssts if s.level == 2]
+    overlapping = [s for s in l2
+                   if s.min_token() <= victim.max_token()
+                   and victim.min_token() <= s.max_token()]
+    assert set(overlapping) <= set(task.inputs)
+    # output-size cap carries the strategy's shard target
+    assert task.max_output_bytes == strat.max_sstable_bytes
+    eng.close()
+
+
+def test_lcs_no_task_when_levels_fit(tmp_path):
+    eng, t, cfs = new_engine(
+        tmp_path,
+        compaction={"class": "LeveledCompactionStrategy",
+                    "sstable_size_in_mb": 160, "l0_threshold": 4})
+    for p in range(20):
+        put(eng, t, p, 0, "v")
+    cfs.flush()
+    assert get_strategy(cfs).next_background_task() is None
+    eng.close()
+
+
+def test_twcs_window_grouping_current_vs_old(tmp_path):
+    """One sstable per OLD window is the goal: any old window holding
+    more than one sstable is compacted first; the CURRENT window runs
+    STCS and only compacts at min_threshold."""
+    eng, t, cfs = new_engine(
+        tmp_path,
+        compaction={"class": "TimeWindowCompactionStrategy",
+                    "compaction_window_unit": "HOURS",
+                    "compaction_window_size": 1})
+    now_us = timeutil.now_micros()
+    hour = 3600 * 1_000_000
+    # current window: 3 sstables (below min_threshold=4 -> untouched)
+    for i in range(3):
+        put(eng, t, i, 0, f"cur{i}", ts=now_us + i)
+        cfs.flush()
+    strat = get_strategy(cfs)
+    assert strat.next_background_task() is None
+    # an old window accumulates 2 sstables -> grouped into one task
+    for i in range(2):
+        put(eng, t, 10 + i, 0, f"old{i}", ts=now_us - 7 * hour + i)
+        cfs.flush()
+    task = get_strategy(cfs).next_background_task()
+    assert task is not None and len(task.inputs) == 2
+    wins = {strat._window_of(s) for s in task.inputs}
+    assert len(wins) == 1
+    assert wins.pop() != strat._window_of(
+        max(cfs.live_sstables(), key=lambda s: s.max_ts or 0))
+    # current window reaches min_threshold -> STCS inside the window
+    task.execute()
+    put(eng, t, 3, 0, "cur3", ts=now_us + 3)
+    cfs.flush()
+    task2 = get_strategy(cfs).next_background_task()
+    assert task2 is not None
+    assert {strat._window_of(s) for s in task2.inputs} == {
+        strat._window_of(max(cfs.live_sstables(),
+                             key=lambda s: s.max_ts or 0))}
+    assert len(task2.inputs) >= 4
+    eng.close()
+
+
+def test_twcs_fully_expired_drop(tmp_path):
+    """SSTables whose every cell is an expired tombstone past gc grace,
+    with no overlapping older data and an empty memtable, are selected
+    for a rewrite-free DROP — before any window compaction
+    (TimeWindowCompactionStrategy.java:128)."""
+    eng, t, cfs = new_engine(
+        tmp_path,
+        compaction={"class": "TimeWindowCompactionStrategy",
+                    "compaction_window_unit": "HOURS",
+                    "compaction_window_size": 1},
+        gc_grace=0)
+    now = int(time.time())
+    # disjoint partition ranges so the expired sstable has no
+    # overlapping-older-data concern with the live one; every cell is
+    # a tombstone whose ldt is long past (gc_grace=0)
+    for p in range(5):
+        put_dead(eng, t, p, 0, ts=1_000_000 + p, ldt=now - 7200)
+    cfs.flush()
+    for p in range(100, 105):
+        put(eng, t, p, 0, "live", ts=2_000_000 + p)
+    cfs.flush()
+    strat = get_strategy(cfs)
+    expired = strat._fully_expired()
+    assert len(expired) == 1
+    task = strat.next_background_task()
+    assert task is not None and list(task.inputs) == expired
+    before = len(cfs.live_sstables())
+    stats = task.execute()
+    # everything purged: the expired sstable vanishes, no output lands
+    assert stats["outputs"] == 0
+    assert len(cfs.live_sstables()) == before - 1
+    # a hot memtable blocks the drop (purge guard consults it)
+    put_dead(eng, t, 200, 0, ts=1, ldt=now - 7200)
+    cfs.flush()
+    put(eng, t, 3, 0, "resurrect", ts=1)
+    assert strat._fully_expired() == []
+    eng.close()
+
+
+def test_strategy_registry_covers_all_four(tmp_path):
+    """get_strategy resolves every shipped class (the ROADMAP item 3
+    note that 'only STCS exists' is stale — pin the roster)."""
+    for cls_name, cls in (
+            ("LeveledCompactionStrategy", LeveledCompactionStrategy),
+            ("TimeWindowCompactionStrategy",
+             TimeWindowCompactionStrategy),
+            ("UnifiedCompactionStrategy", UnifiedCompactionStrategy)):
+        eng, t, cfs = new_engine(tmp_path / cls_name,
+                                 compaction={"class": cls_name})
+        assert isinstance(get_strategy(cfs).unrepaired, cls)
+        eng.close()
