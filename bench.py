@@ -815,6 +815,11 @@ def run_read_bench(base_dir: str) -> dict:
 
 FRONTDOOR_KEYS = 4096
 FRONTDOOR_OPS = 2048
+# saturation matrix sizing: 9 legs + hints + chaos against a 3-node
+# RF=3 cluster at QUORUM — per-op cost is a full coordination round, so
+# legs stay in the hundreds of ops
+SATURATION_CONNS = 6
+SATURATION_OPS_PER_LEG = 240
 
 
 def run_frontdoor_bench(base_dir: str) -> dict:
@@ -913,6 +918,38 @@ def run_frontdoor_bench(base_dir: str) -> dict:
     finally:
         srv.close()
         engine.close()
+
+
+def run_saturation_bench(base_dir: str) -> dict:
+    """Saturation section (ROADMAP item 5): the scenario matrix from
+    scripts/stress.py — zipf/sequential/uniform key streams crossed
+    with the workload classes (wide partitions, TTL time series on
+    TWCS, counters, LWT, logged batches, mixed RMW, kv baseline), every
+    leg through the WIRE against a 3-node RF=3 LocalCluster with hints
+    and speculative retry live and the SLO service polling. Each leg
+    reports a verdict (p99 vs target, error budget remaining); the
+    chaos leg (faultfs EIO on one replica's sstables mid-run, that
+    node's disk policy `stop`) must end in a breach-triggered
+    flight-recorder bundle carrying the `slo.breach` event and the
+    scenario id."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    import stress as stress_mod
+
+    out = stress_mod.run_matrix(
+        os.path.join(base_dir, "sat"), connections=SATURATION_CONNS,
+        ops_per_leg=SATURATION_OPS_PER_LEG, key_space=512, seed=3)
+    ch = out.get("chaos", {})
+    out["certified"] = bool(
+        len(out.get("workload_classes", [])) >= 6
+        # every leg must have actually SERVED operations and carry an
+        # SLO verdict — a workload class whose workers all failed must
+        # not certify on an empty (vacuously compliant) latency list
+        and all(leg["ok"] > 0 and "slo" in leg
+                for leg in out["legs"].values())
+        and ch.get("breached") and ch.get("bundle_has_breach_event")
+        and ch.get("scenario_id_in_bundle"))
+    return out
 
 
 def _kernel_probe(table):
@@ -1079,6 +1116,13 @@ def main():
             # OVERLOADED shedding with in-flight <= the permit cap
             "frontdoor": run_frontdoor_bench(
                 os.path.join(base, "frontdoor")),
+            # saturation matrix (docs/observability.md SLO layer,
+            # ROADMAP item 5): workload classes x key streams through
+            # the wire against a 3-node RF=3 cluster, per-leg SLO
+            # verdicts, hints + speculative retry live, chaos leg with
+            # a breach-triggered flight-recorder bundle
+            "saturation": run_saturation_bench(
+                os.path.join(base, "saturation")),
         }
         print(json.dumps(result))
     finally:

@@ -28,6 +28,22 @@ class DriverError(Exception):
     pass
 
 
+# consistency-level names -> wire codes, shared with the server side
+# (transport/frame.py is the single source of truth). The server tags
+# the per-CL client_requests hists off the declared level; coordination
+# CL policy is the backend's (cluster Node default_cl) for now.
+CONSISTENCY_CODES = ts.CONSISTENCY_CODES
+
+
+def _cl_code(consistency: str | int) -> int:
+    if isinstance(consistency, int):
+        return consistency
+    try:
+        return CONSISTENCY_CODES[consistency.upper()]
+    except KeyError:
+        raise DriverError(f"unknown consistency {consistency!r}") from None
+
+
 class Rows:
     def __init__(self, column_names, rows, paging_state=None):
         self.column_names = column_names
@@ -238,11 +254,11 @@ class ClientSession:
 
     def execute(self, query: str, params: list[bytes | None] | None = None,
                 fetch_size: int | None = None,
-                paging_state: bytes | None = None) -> Rows:
+                paging_state: bytes | None = None,
+                consistency: str | int = "ONE") -> Rows:
         body = bytearray()
         body += ts._long_string(query)
-        body += struct.pack(">H", 1)        # consistency ONE (server CL
-                                            # policy governs for now)
+        body += struct.pack(">H", _cl_code(consistency))
         flags = 0
         if params:
             flags |= 0x01
@@ -331,14 +347,15 @@ class ClientSession:
     def execute_prepared(self, qid: bytes,
                          params: list[bytes | None] | None = None,
                          fetch_size: int | None = None,
-                         paging_state: bytes | None = None) -> Rows:
+                         paging_state: bytes | None = None,
+                         consistency: str | int = "ONE") -> Rows:
         body = bytearray()
         body += struct.pack(">H", len(qid)) + qid
         if self.version >= 5:
             # v5 EXECUTE carries the result_metadata_id (server issues
             # the statement id for both)
             body += struct.pack(">H", len(qid)) + qid
-        body += struct.pack(">H", 1)
+        body += struct.pack(">H", _cl_code(consistency))
         flags = 0
         if params:
             flags |= 0x01

@@ -50,10 +50,14 @@ class _Await:
         self.failures = 0
         self._cond = threading.Condition()
 
-    def ack(self, payload=None) -> None:
+    def ack(self, payload=None) -> int:
+        """Returns the ack's RANK (1-based arrival order): a response
+        with rank <= block_for was load-bearing for the round — the
+        speculative-retry 'won' attribution reads exactly this."""
         with self._cond:
             self.responses.append(payload)
             self._cond.notify_all()
+            return len(self.responses)
 
     def fail(self) -> None:
         with self._cond:
@@ -391,7 +395,14 @@ class StorageProxy:
         t0 = time.monotonic()
         wire_limits = limits.to_wire() if limits is not None else None
 
-        def send_to(target, digest_only):
+        def _tally(rank: int, speculative: bool) -> None:
+            # the redundant request WON if its response arrived while
+            # the round was still short of blockFor — rank beyond
+            # block_for means the original straggler beat it after all
+            if speculative and rank <= handler.block_for:
+                METRICS.incr("reads.speculative_retries_won")
+
+        def send_to(target, digest_only, speculative=False):
             sent = time.monotonic()
             if target == self.node.endpoint:
                 try:
@@ -414,9 +425,10 @@ class StorageProxy:
                     else:
                         results.append((target, batch, more))
                 self._record_latency(target, time.monotonic() - sent)
-                handler.ack()
+                _tally(handler.ack(), speculative)
             else:
-                def on_rsp(m, t=target, dg=digest_only, ts=sent):
+                def on_rsp(m, t=target, dg=digest_only, ts=sent,
+                           spec=speculative):
                     with lock:
                         if dg:
                             digests.append((t, m.payload))
@@ -426,7 +438,7 @@ class StorageProxy:
                             b.ck_comp = ck_comp
                             results.append((t, b, bool(more)))
                     self._record_latency(t, time.monotonic() - ts)
-                    handler.ack()
+                    _tally(handler.ack(), spec)
 
                 def on_fail(mid, t=target):
                     # timeouts/failures must poison the snitch ranking —
@@ -453,7 +465,7 @@ class StorageProxy:
             # fail-fast wake does not latch the final wait shut while
             # the spare's response is in flight
             handler.add_target()
-            send_to(spares[0], False)
+            send_to(spares[0], False, speculative=True)
         # the read budget is self.read_timeout TOTAL, not per wait
         handler.await_(max(self.read_timeout - (time.monotonic() - t0), 0.0))
         with lock:

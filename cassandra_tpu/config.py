@@ -262,6 +262,13 @@ class Config:
     # disabled. Hot-reloadable; the flight recorder folds published
     # events regardless of when the knob flips.
     diagnostic_events_enabled: bool = mut(False)
+    # SLO layer (service/slo.py): {objective name: p99 target ms}
+    # overrides/additions for the engine's SLO registry. Hot-reloadable
+    # — the saturation matrix retargets per leg through this knob;
+    # naming a histogram with no existing objective registers a new
+    # objective over it (per-CL rows like client_requests.read.quorum).
+    slo_targets: dict = field(default_factory=dict,
+                              metadata={"mutable": True})
 
     # guardrail overrides (db/guardrails/GuardrailsOptions.java) — passed
     # through to storage/guardrails.py field-for-field

@@ -224,6 +224,15 @@ class FlightRecorder:
             self._events.append(ev)
         self.maybe_snapshot()
 
+    def fold(self, etype: str, fields: dict) -> None:
+        """Fold one event into THIS recorder's ring directly, bypassing
+        the (possibly disabled) bus: a publisher whose event must reach
+        its own black box regardless of the diagnostic_events_enabled
+        knob (the SLO breach path) records it here. seq 0 marks it as
+        bus-bypassing."""
+        self._on_event(DiagnosticEvent(etype, time.time(), 0,
+                                       dict(fields)))
+
     def maybe_snapshot(self) -> None:
         """Time-gated snapshot, taken on a short-lived helper thread:
         publish sites run on latency-critical threads (the transport

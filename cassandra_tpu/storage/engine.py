@@ -249,6 +249,15 @@ class StorageEngine:
             "schema.change",
             keyspaces=len(getattr(s, "keyspaces", {})))
         self.schema.listeners.append(self._schema_diag_listener)
+        # SLO layer (service/slo.py): p99 objectives + error budgets
+        # over the front-door latency hists, breach artifacts through
+        # the flight recorder above. Poll-driven — no background thread
+        # unless a caller start()s one; targets hot-reload through the
+        # mutable slo_targets knob.
+        from ..service.slo import default_service
+        self.slo = default_service(self)
+        self._slo_targets_listener = self.slo.set_targets
+        self.settings.on_change("slo_targets", self._slo_targets_listener)
 
     def _mesh_devices(self) -> int:
         """This engine's mesh width (its knob, not the shared pool's —
@@ -504,6 +513,9 @@ class StorageEngine:
                                       self._slowlog_threshold_listener)
         self.settings.remove_listener("diagnostic_events_enabled",
                                       self._diag_listener)
+        self.settings.remove_listener("slo_targets",
+                                      self._slo_targets_listener)
+        self.slo.stop()
         # withdraw this engine's bus demand (a closed engine must not
         # keep the process bus enabled for nobody)
         from ..service import diagnostics

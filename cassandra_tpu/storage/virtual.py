@@ -205,6 +205,31 @@ def build_engine_virtuals(engine) -> VirtualSchema:
                                          sort_keys=True)}
     vs.register(VirtualTable(t_diag, diag_rows))
 
+    # --- slos (service/slo.py): per-objective p99 vs target, error
+    # budget remaining, breach/exhaustion tallies. A pure snapshot —
+    # SELECTing this table never publishes events or dumps bundles
+    # (that's `nodetool slostats`, which runs a real check())
+    t_slo = make_table(
+        "system_views", "slos", pk=["objective"],
+        cols={"objective": "text", "metric": "text",
+              "p99_us": "double", "target_us": "double",
+              "breaching": "boolean", "breaches": "bigint",
+              "budget_s": "double", "budget_remaining_s": "double",
+              "exhausted": "boolean", "exhaustions": "bigint"})
+
+    def slo_rows():
+        svc = getattr(engine, "slo", None)
+        for v in (svc.snapshot() if svc else []):
+            yield {"objective": v["objective"], "metric": v["metric"],
+                   "p99_us": v["p99_us"], "target_us": v["target_us"],
+                   "breaching": v["breaching"],
+                   "breaches": v["breaches"],
+                   "budget_s": v["budget_s"],
+                   "budget_remaining_s": v["budget_remaining_s"],
+                   "exhausted": v["exhausted"],
+                   "exhaustions": v["exhaustions"]}
+    vs.register(VirtualTable(t_slo, slo_rows))
+
     # --- pipelines (utils/pipeline_ledger.py): per-stage busy/stall/
     # idle accounting for every multi-stage pipeline — the
     # where-did-the-wall-go surface (TPIE-style per-stage profiling)

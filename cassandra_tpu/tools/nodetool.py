@@ -67,10 +67,22 @@ def info(engine) -> dict:
                       for cfs in engine.stores.values()
                       if cfs.row_cache is not None)
     row.update({"hits": row_hits, "misses": row_miss})
+    # speculative retry visibility (the reference prints 'Speculative
+    # Retries' per table in tablestats; here the coordinator-wide
+    # fired/won pair): fired = redundant requests issued after the
+    # speculative delay, won = those whose response completed the read
+    # round (ack rank <= blockFor) — fired >> won means the delay floor
+    # is too twitchy, won ~ fired means replicas genuinely straggle
+    from ..service.metrics import GLOBAL as _METRICS
     return {"tables": tables, "caches": {
         "key": key,
         "row": _cache_line(row, entries=row_entries),
         "chunk": _cache_line(chunk_cache.GLOBAL.stats()),
+    }, "requests": {
+        "speculative_retries":
+            _METRICS.counter("reads.speculative_retries"),
+        "speculative_retries_won":
+            _METRICS.counter("reads.speculative_retries_won"),
     }}
 
 
@@ -697,6 +709,20 @@ def flightrecorder(engine, action: str = "dump") -> dict:
         raise ValueError(f"unknown flightrecorder action {action!r}")
     path = rec.dump("on_demand")
     return {"bundle": path}
+
+
+def slostats(engine) -> dict:
+    """nodetool slostats: per-objective SLO state — current p99 vs
+    target, error budget remaining, breach/exhaustion tallies. Runs a
+    REAL `check()` (budgets burn/replenish, a live breach publishes
+    `slo.breach` and dumps a deduplicated flight-recorder bundle), so
+    the operator asking for slostats gets the current verdict, not the
+    last poll's; the `system_views.slos` vtable is the side-effect-free
+    view."""
+    svc = engine.slo
+    return {"objectives": svc.check(),
+            "checks": svc.checks,
+            "recorder_dumps": list(getattr(svc.recorder, "dumps", []))}
 
 
 def pipelinestats(engine) -> dict:
@@ -1612,7 +1638,7 @@ for _name, _target in [
         ("settraceprobability", "engine"),
         ("gettraces", "engine"), ("exportmetrics", "engine"),
         ("diagnostics", "engine"), ("flightrecorder", "engine"),
-        ("pipelinestats", "engine"),
+        ("pipelinestats", "engine"), ("slostats", "engine"),
         ("disableautocompaction", "engine"),
         ("enableautocompaction", "engine"),
         ("statusautocompaction", "engine"),

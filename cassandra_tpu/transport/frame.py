@@ -51,6 +51,18 @@ ERR_UNPREPARED = 0x2500
 
 EVENT_TYPES = ("TOPOLOGY_CHANGE", "STATUS_CHANGE", "SCHEMA_CHANGE")
 
+# consistency-level wire codes (spec §3) — the ONE table both sides of
+# the wire derive from: the client encodes names through it, the server
+# tags the per-CL client_requests hists through its inverse
+CONSISTENCY_CODES = {
+    "ANY": 0x00, "ONE": 0x01, "TWO": 0x02, "THREE": 0x03,
+    "QUORUM": 0x04, "ALL": 0x05, "LOCAL_QUORUM": 0x06,
+    "EACH_QUORUM": 0x07, "SERIAL": 0x08, "LOCAL_SERIAL": 0x09,
+    "LOCAL_ONE": 0x0A,
+}
+CONSISTENCY_NAMES = {code: name.lower()
+                     for name, code in CONSISTENCY_CODES.items()}
+
 # envelope body length cap (native_transport_max_frame_size ceiling —
 # a length field larger than this is a framing error, not an allocation)
 MAX_ENVELOPE_BODY = 256 << 20

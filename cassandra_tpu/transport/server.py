@@ -49,8 +49,8 @@ from ..cql.processor import QueryProcessor
 from ..service.metrics import GLOBAL as METRICS
 from ..utils.ratelimit import RateLimiter
 from .admission import OverloadSignals, PermitGate
-from .frame import (ERR_BAD_CREDENTIALS, ERR_INVALID, ERR_OVERLOADED,
-                    ERR_PROTOCOL, ERR_SERVER, EVENT_TYPES,
+from .frame import (CONSISTENCY_NAMES, ERR_BAD_CREDENTIALS, ERR_INVALID,
+                    ERR_OVERLOADED, ERR_PROTOCOL, ERR_SERVER, EVENT_TYPES,
                     MAX_ENVELOPE_BODY, OP_AUTH_RESPONSE, OP_AUTH_SUCCESS,
                     OP_AUTHENTICATE, OP_ERROR, OP_EVENT, OP_EXECUTE,
                     OP_OPTIONS, OP_PREPARE,
@@ -1072,7 +1072,7 @@ class CQLServer:
     def _run(self, processor, conn: Connection, query, body: bytes,
              pos: int, prep=None):
         import time as time_mod
-        _consistency, = struct.unpack_from(">H", body, pos)
+        consistency, = struct.unpack_from(">H", body, pos)
         pos += 2
         if conn.version >= 0x05:          # v5 widened flags to [int]
             (flags,) = struct.unpack_from(">I", body, pos)
@@ -1112,10 +1112,17 @@ class CQLServer:
                                    user=conn.user,
                                    page_size=page_size,
                                    paging_state=paging_state)
-        METRICS.hist(
-            "client_requests.read" if is_read
-            else "client_requests.write").update_us(
-            (time_mod.perf_counter() - t0) * 1e6)
+        us = (time_mod.perf_counter() - t0) * 1e6
+        verb = "read" if is_read else "write"
+        # the per-CL tag uses the level the client DECLARED, so a
+        # saturation-matrix breach attributes to ONE vs QUORUM instead
+        # of blending them; a code outside the spec table lands in an
+        # explicit "unknown" bucket, never mis-attributed to a real CL
+        cl = CONSISTENCY_NAMES.get(consistency, "unknown")
+        # blended hist (the historical surface + default SLO objective)
+        # AND the per-CL family the matrix attributes breaches through
+        METRICS.hist(f"client_requests.{verb}").update_us(us)
+        METRICS.hist(f"client_requests.{verb}.{cl}").update_us(us)
         new_ks = getattr(rs, "keyspace", None)
         if new_ks is not None:
             conn.keyspace = new_ks

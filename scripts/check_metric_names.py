@@ -65,8 +65,8 @@ SINGLE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 KNOWN_GROUPS = {
     "audit", "client_requests", "clients", "commitlog", "compaction",
     "compress_pool", "cql", "flush", "hints", "mesh", "pipeline",
-    "prepared_statements", "reads", "request", "storage", "system",
-    "table", "verb",
+    "prepared_statements", "reads", "request", "slo", "storage",
+    "system", "table", "verb",
 }
 
 
@@ -151,6 +151,11 @@ def normalize_name(name: str) -> str:
     # metric catalog carries one row per STAT
     if parts[0] == "pipeline" and len(parts) == 4:
         parts[1] = parts[2] = "X"
+    # per-consistency-level client-request hists
+    # (`client_requests.<verb>.<cl>`) are an open-ended family: one
+    # catalog row per verb
+    if parts[0] == "client_requests" and len(parts) == 3:
+        parts[2] = "X"
     return ".".join(parts)
 
 

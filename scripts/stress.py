@@ -78,10 +78,24 @@ def _classify(msg: str) -> str:
 
 def _keys(dist: str, n: int, key_space: int, rng, worker: int,
           workers: int) -> np.ndarray:
+    """The per-worker key stream — a pure function of (dist, seed-derived
+    rng, worker, workers), so --seed makes the whole run's key/op stream
+    reproducible."""
     if dist == "sequential":
-        # disjoint per-connection ranges: deterministic coverage of
-        # [0, workers*n) — the smoke read-back check depends on it
-        return np.arange(n) + worker * n
+        # partition the KEY SPACE per connection: worker w walks its own
+        # balanced slice [w*ks//workers, (w+1)*ks//workers) and wraps
+        # within it (they used to walk [w*n, w*n+n), which ignored
+        # key_space entirely). For workers <= key_space the slices are
+        # disjoint and their union is [0, key_space) exactly — even
+        # when key_space % workers != 0 — so with per-worker ops >= the
+        # slice width the smoke read-back covers every key. With MORE
+        # workers than keys, disjointness is impossible: zero-width
+        # slices widen to one shared key.
+        ks = max(key_space, 1)
+        w = max(workers, 1)
+        lo = worker * ks // w
+        width = max((worker + 1) * ks // w - lo, 1)
+        return (lo % ks) + (np.arange(n) % width)
     if dist == "zipf":
         # zipf-skewed hot partitions clipped into the key space
         return np.minimum(rng.zipf(1.3, n), key_space) - 1
@@ -110,6 +124,11 @@ def _worker(idx: int, host: str, port: int, profile: str, n_ops: int,
     except Exception as e:
         errs["connection"] = 1
         errs["connection_detail"] = f"{type(e).__name__}: {e}"
+        if sess is not None:   # connected but a PREPARE failed: close,
+            try:               # don't leak the socket into the server
+                sess.close()
+            except Exception:
+                pass
         sess = None
     keys = _keys(dist, n_ops, key_space, rng, idx, workers)
     if profile == "mixed":
@@ -152,30 +171,18 @@ def _worker(idx: int, host: str, port: int, profile: str, n_ops: int,
     results[idx] = (lats, errs, ok)
 
 
-def run_stress(host: str, port: int, *, profile: str = "mixed",
-               connections: int = 16, ops: int = 4096,
-               dist: str = "uniform", key_space: int = 4096,
-               value_bytes: int = 64, write_ratio: float = 0.5,
-               seed: int = 1, setup: bool = True) -> dict:
-    """Drive `ops` total operations over `connections` concurrent wire
-    connections; returns ops/s + exact p50/p99 + the decaying-histogram
-    summary + error counts by class."""
-    from cassandra_tpu.client import Cluster
-    from cassandra_tpu.service.metrics import LatencyHistogram
-    if setup:
-        s = Cluster(host, port).connect()
-        for ddl in DDL:
-            s.execute(ddl)
-        s.close()
-    per_conn = max(1, ops // connections)
-    hist = LatencyHistogram()
+def _spawn_and_aggregate(connections: int, target, make_args):
+    """The shared drive loop both wire drivers use: spawn one worker
+    thread per connection, release them together through the barrier,
+    time the joined run, and merge the per-worker (lats, errs, ok)
+    triples (a worker that never reported counts as one connection
+    error; connection_detail keeps the first). Returns
+    (wall_s, lats, errors, ok)."""
     barrier = threading.Barrier(connections + 1)
     results: list = [None] * connections
     threads = [threading.Thread(
-        target=_worker, daemon=True,
-        args=(i, host, port, profile, per_conn, dist, key_space,
-              value_bytes, write_ratio, seed, connections, hist,
-              barrier, results))
+        target=target, daemon=True,
+        args=make_args(i, barrier, results))
         for i in range(connections)]
     for t in threads:
         t.start()
@@ -199,6 +206,32 @@ def run_stress(host: str, port: int, *, profile: str = "mixed",
                 errors.setdefault(k, v)
             else:
                 errors[k] = errors.get(k, 0) + v
+    return wall, lats, errors, ok
+
+
+def run_stress(host: str, port: int, *, profile: str = "mixed",
+               connections: int = 16, ops: int = 4096,
+               dist: str = "uniform", key_space: int = 4096,
+               value_bytes: int = 64, write_ratio: float = 0.5,
+               seed: int = 1, setup: bool = True) -> dict:
+    """Drive `ops` total operations over `connections` concurrent wire
+    connections; returns ops/s + exact p50/p99 + the decaying-histogram
+    summary + error counts by class."""
+    from cassandra_tpu.client import Cluster
+    from cassandra_tpu.service.metrics import LatencyHistogram
+    if setup:
+        s = Cluster(host, port).connect()
+        for ddl in DDL:
+            s.execute(ddl)
+        s.close()
+    per_conn = max(1, ops // connections)
+    hist = LatencyHistogram()
+    wall, lats, errors, ok = _spawn_and_aggregate(
+        connections, _worker,
+        lambda i, barrier, results: (
+            i, host, port, profile, per_conn, dist, key_space,
+            value_bytes, write_ratio, seed, connections, hist,
+            barrier, results))
     arr = np.array(lats) if lats else np.array([0.0])
     attempted = ok + sum(v for k, v in errors.items()
                          if isinstance(v, int))
@@ -256,7 +289,8 @@ def smoke() -> int:
         n_conns, per = 8, 40
         w = run_stress("127.0.0.1", srv.port, profile="write",
                        connections=n_conns, ops=n_conns * per,
-                       dist="sequential", value_bytes=32, seed=7)
+                       dist="sequential", key_space=n_conns * per,
+                       value_bytes=32, seed=7)
         check(w["ok"] == n_conns * per and not w["errors"],
               f"8-connection write run clean ({w['ok']} ops)")
         s = Cluster("127.0.0.1", srv.port).connect()
@@ -332,6 +366,557 @@ def smoke() -> int:
     return 0
 
 
+# ------------------------------------------------- saturation matrix -----
+#
+# ROADMAP item 5: the scenario matrix that certifies "millions of
+# users" end to end instead of implying it. Key streams
+# (zipf / sequential / uniform) crossed with the workload classes the
+# engine supports but never benched under load — wide partitions,
+# TTL-heavy time series on TWCS, counters, LWT, logged batches, mixed
+# read-modify-write — every leg driven through the WIRE (prepared
+# statements, admission control, v5 framing all on the path) against a
+# 3-node RF=3 LocalCluster with hints and speculative retry live. The
+# SLO layer (service/slo.py) polls during every leg: per-leg verdicts
+# report p99 vs target and error-budget remaining, and the chaos leg
+# (faultfs storage faults mid-run) must end with a breach-triggered
+# flight-recorder bundle carrying the `slo.breach` event and the
+# scenario id. bench.py's `saturation` section is run_matrix() output.
+
+SAT_KEYSPACE = "sat"
+
+SAT_DDL = [
+    f"CREATE KEYSPACE IF NOT EXISTS {SAT_KEYSPACE} WITH replication = "
+    "{'class': 'SimpleStrategy', 'replication_factor': 3}",
+    f"CREATE TABLE IF NOT EXISTS {SAT_KEYSPACE}.kv "
+    "(key int PRIMARY KEY, v blob)",
+    f"CREATE TABLE IF NOT EXISTS {SAT_KEYSPACE}.wide "
+    "(pk int, ck int, v blob, PRIMARY KEY (pk, ck))",
+    f"CREATE TABLE IF NOT EXISTS {SAT_KEYSPACE}.ts "
+    "(series int, at bigint, v blob, PRIMARY KEY (series, at)) "
+    "WITH compaction = {'class': 'TimeWindowCompactionStrategy'}",
+    f"CREATE TABLE IF NOT EXISTS {SAT_KEYSPACE}.cnt "
+    "(key int PRIMARY KEY, hits counter)",
+    f"CREATE TABLE IF NOT EXISTS {SAT_KEYSPACE}.lwt "
+    "(key int PRIMARY KEY, v blob)",
+    f"CREATE TABLE IF NOT EXISTS {SAT_KEYSPACE}.batched "
+    "(key int PRIMARY KEY, v text)",
+    f"CREATE TABLE IF NOT EXISTS {SAT_KEYSPACE}.rmw "
+    "(key int PRIMARY KEY, v text)",
+]
+
+
+def _sat_tables():
+    """Client-side schema mirrors for wire bind serialization."""
+    from cassandra_tpu.schema import make_table
+    ks = SAT_KEYSPACE
+    return {
+        "kv": make_table(ks, "kv", pk=["key"],
+                         cols={"key": "int", "v": "blob"}),
+        "wide": make_table(ks, "wide", pk=["pk"], ck=["ck"],
+                           cols={"pk": "int", "ck": "int", "v": "blob"}),
+        "ts": make_table(ks, "ts", pk=["series"], ck=["at"],
+                         cols={"series": "int", "at": "bigint",
+                               "v": "blob"}),
+        "cnt": make_table(ks, "cnt", pk=["key"],
+                          cols={"key": "int", "hits": "counter"}),
+        "lwt": make_table(ks, "lwt", pk=["key"],
+                          cols={"key": "int", "v": "blob"}),
+        "batch": make_table(ks, "batch", pk=["key"],
+                            cols={"key": "int", "v": "text"}),
+        "rmw": make_table(ks, "rmw", pk=["key"],
+                          cols={"key": "int", "v": "text"}),
+    }
+
+
+def _scn_kv(sess, tables):
+    from cassandra_tpu.client import serialize_params
+    t = tables["kv"]
+    wq = sess.prepare(f"INSERT INTO {SAT_KEYSPACE}.kv (key, v) "
+                      "VALUES (?, ?)")
+    rq = sess.prepare(f"SELECT v FROM {SAT_KEYSPACE}.kv WHERE key = ?")
+
+    def op(k, i, rng, is_write, worker, cl):
+        if is_write:
+            sess.execute_prepared(
+                wq, serialize_params(t, ["key", "v"],
+                                     [k, rng.bytes(32)]),
+                consistency=cl)
+        else:
+            sess.execute_prepared(
+                rq, serialize_params(t, ["key"], [k]), consistency=cl)
+    return op
+
+
+def _scn_wide(sess, tables):
+    """Wide partitions: the key stream lands on FEW partitions (k % 32)
+    with the key as clustering, so partitions grow to thousands of rows
+    and reads fetch whole wide partitions."""
+    from cassandra_tpu.client import serialize_params
+    t = tables["wide"]
+    wq = sess.prepare(f"INSERT INTO {SAT_KEYSPACE}.wide (pk, ck, v) "
+                      "VALUES (?, ?, ?)")
+    rq = sess.prepare(f"SELECT ck FROM {SAT_KEYSPACE}.wide WHERE pk = ?")
+
+    def op(k, i, rng, is_write, worker, cl):
+        pk = k % 32
+        if is_write:
+            sess.execute_prepared(
+                wq, serialize_params(t, ["pk", "ck", "v"],
+                                     [pk, k, rng.bytes(24)]),
+                consistency=cl)
+        else:
+            sess.execute_prepared(
+                rq, serialize_params(t, ["pk"], [pk]), consistency=cl)
+    return op
+
+
+def _scn_timeseries(sess, tables):
+    """TTL-heavy time series on TWCS: every cell written with a TTL,
+    appended in time order per series; reads fetch a series."""
+    from cassandra_tpu.client import serialize_params
+    t = tables["ts"]
+    wq = sess.prepare(f"INSERT INTO {SAT_KEYSPACE}.ts (series, at, v) "
+                      "VALUES (?, ?, ?) USING TTL 120")
+    rq = sess.prepare(f"SELECT at FROM {SAT_KEYSPACE}.ts "
+                      "WHERE series = ?")
+
+    def op(k, i, rng, is_write, worker, cl):
+        if is_write:
+            # per-worker disjoint time points keep appends unique and
+            # deterministic under --seed
+            sess.execute_prepared(
+                wq, serialize_params(
+                    t, ["series", "at", "v"],
+                    [int(k) % 16, worker * 1_000_000 + i,
+                     rng.bytes(24)]),
+                consistency=cl)
+        else:
+            sess.execute_prepared(
+                rq, serialize_params(t, ["series"], [int(k) % 16]),
+                consistency=cl)
+    return op
+
+
+def _scn_counter(sess, tables):
+    """Counter increments route through the counter-leader path, not
+    the plain write path — zipf hot keys contend on the leader lock."""
+    from cassandra_tpu.client import serialize_params
+    t = tables["cnt"]
+    wq = sess.prepare(f"UPDATE {SAT_KEYSPACE}.cnt SET hits = hits + 1 "
+                      "WHERE key = ?")
+    rq = sess.prepare(f"SELECT hits FROM {SAT_KEYSPACE}.cnt "
+                      "WHERE key = ?")
+
+    def op(k, i, rng, is_write, worker, cl):
+        sess.execute_prepared(
+            wq if is_write else rq,
+            serialize_params(t, ["key"], [k]), consistency=cl)
+    return op
+
+
+def _scn_lwt(sess, tables):
+    """LWT: IF NOT EXISTS through Paxos; under zipf most proposals lose
+    the race and return applied=False — still a served op."""
+    from cassandra_tpu.client import serialize_params
+    t = tables["lwt"]
+    wq = sess.prepare(f"INSERT INTO {SAT_KEYSPACE}.lwt (key, v) "
+                      "VALUES (?, ?) IF NOT EXISTS")
+    rq = sess.prepare(f"SELECT v FROM {SAT_KEYSPACE}.lwt WHERE key = ?")
+
+    def op(k, i, rng, is_write, worker, cl):
+        if is_write:
+            sess.execute_prepared(
+                wq, serialize_params(t, ["key", "v"],
+                                     [k, rng.bytes(16)]),
+                consistency=cl)
+        else:
+            sess.execute_prepared(
+                rq, serialize_params(t, ["key"], [k]), consistency=cl)
+    return op
+
+
+def _scn_batch(sess, tables):
+    """Logged batches: 4 inserts per batch through the batchlog (the
+    atomicity machinery, not just 4 writes)."""
+    from cassandra_tpu.client import serialize_params
+    t = tables["batch"]
+    rq = sess.prepare(f"SELECT v FROM {SAT_KEYSPACE}.batched "
+                      "WHERE key = ?")
+
+    def op(k, i, rng, is_write, worker, cl):
+        if is_write:
+            stmts = "; ".join(
+                f"INSERT INTO {SAT_KEYSPACE}.batched (key, v) "
+                f"VALUES ({int(k) + j}, 'w{worker}-{i}-{j}')"
+                for j in range(4))
+            sess.execute(f"BEGIN BATCH {stmts}; APPLY BATCH",
+                         consistency=cl)
+        else:
+            sess.execute_prepared(
+                rq, serialize_params(t, ["key"], [k]), consistency=cl)
+    return op
+
+
+def _scn_rmw(sess, tables):
+    """Mixed read-modify-write: every op is a SELECT followed by an
+    INSERT derived from what it read — one logical op, two round
+    trips, the latency clients actually see for app-level RMW."""
+    from cassandra_tpu.client import serialize_params
+    t = tables["rmw"]
+    wq = sess.prepare(f"INSERT INTO {SAT_KEYSPACE}.rmw (key, v) "
+                      "VALUES (?, ?)")
+    rq = sess.prepare(f"SELECT v FROM {SAT_KEYSPACE}.rmw WHERE key = ?")
+
+    def op(k, i, rng, is_write, worker, cl):
+        rows = sess.execute_prepared(
+            rq, serialize_params(t, ["key"], [k]), consistency=cl).rows
+        n = 0
+        if rows and rows[0][0]:
+            try:
+                n = int(str(rows[0][0]).rsplit("-", 1)[-1])
+            except ValueError:
+                n = 0
+        sess.execute_prepared(
+            wq, serialize_params(t, ["key", "v"],
+                                 [k, f"w{worker}-{n + 1}"]),
+            consistency=cl)
+    return op
+
+
+# scenario -> (setup factory, default write ratio). write_ratio None =
+# the op is intrinsically mixed (rmw)
+SCENARIOS = {
+    "kv": (_scn_kv, 0.5),
+    "wide": (_scn_wide, 0.5),
+    "timeseries": (_scn_timeseries, 0.8),
+    "counter": (_scn_counter, 0.7),
+    "lwt": (_scn_lwt, 0.7),
+    "batch": (_scn_batch, 0.5),
+    "rmw": (_scn_rmw, None),
+}
+
+# the default matrix: every workload class, with the kv baseline run
+# under all three key streams (the full cross is available via
+# --matrix-legs / run_matrix(legs=...))
+DEFAULT_LEGS = [
+    ("kv", "zipf"), ("kv", "uniform"), ("kv", "sequential"),
+    ("wide", "uniform"), ("timeseries", "sequential"),
+    ("counter", "zipf"), ("lwt", "zipf"), ("batch", "uniform"),
+    ("rmw", "zipf"),
+]
+
+
+def _sat_worker(idx, ports, scenario, n_ops, dist, key_space,
+                write_ratio, seed, workers, cl, barrier,
+                results) -> None:
+    from cassandra_tpu.client import Cluster, DriverError
+    rng = np.random.default_rng(seed * 100_000 + idx)
+    lats: list = []
+    errs: dict = {}
+    ok = 0
+    sess = None
+    op = None
+    try:
+        # connections round-robin across the cluster's wire endpoints:
+        # every node coordinates a share of the traffic
+        sess = Cluster("127.0.0.1", ports[idx % len(ports)]).connect()
+        op = SCENARIOS[scenario][0](sess, _sat_tables())
+    except Exception as e:
+        errs["connection"] = 1
+        errs["connection_detail"] = f"{type(e).__name__}: {e}"
+        if sess is not None:
+            # a failed PREPARE must not leak the connected socket into
+            # the server's client registry for the rest of the matrix
+            try:
+                sess.close()
+            except Exception:
+                pass
+        sess = None
+    keys = _keys(dist, n_ops, key_space, rng, idx, workers)
+    ratio = SCENARIOS[scenario][1] if write_ratio is None else write_ratio
+    if ratio is None:
+        is_write = np.zeros(n_ops, dtype=bool)   # rmw: op is both
+    else:
+        is_write = rng.random(n_ops) < ratio
+    barrier.wait()
+    if sess is not None:
+        for i in range(n_ops):
+            t0 = time.perf_counter()
+            try:
+                op(int(keys[i]), i, rng, bool(is_write[i]), idx, cl)
+                ok += 1
+            except DriverError as e:
+                kind = _classify(str(e))
+                errs[kind] = errs.get(kind, 0) + 1
+                continue
+            except Exception as e:
+                errs["connection"] = errs.get("connection", 0) + 1
+                errs.setdefault("connection_detail",
+                                f"{type(e).__name__}: {e}")
+                break
+            lats.append((time.perf_counter() - t0) * 1e6)
+        try:
+            sess.close()
+        except Exception:
+            pass
+    results[idx] = (lats, errs, ok)
+
+
+def run_scenario(ports, scenario, *, connections=6, ops=240,
+                 dist="zipf", key_space=512, write_ratio=None,
+                 cl="QUORUM", seed=1) -> dict:
+    """One matrix leg: drive `ops` scenario operations over
+    `connections` wire connections spread across `ports`. Client-side
+    percentiles come from the exact latency list; the server-side view
+    is the client_requests hists the SLO service watches."""
+    if scenario not in SCENARIOS:
+        # validate BEFORE spawning: a worker dying on the lookup after
+        # the try block would strand the start barrier forever (the
+        # same invariant _worker documents)
+        raise ValueError(f"unknown scenario {scenario!r} "
+                         f"(have: {', '.join(sorted(SCENARIOS))})")
+    if dist not in ("zipf", "uniform", "sequential"):
+        # _keys treats anything unrecognized as uniform — a typo'd leg
+        # would silently run (and be labeled) with the wrong key stream
+        raise ValueError(f"unknown key dist {dist!r} "
+                         "(zipf, uniform, sequential)")
+    per_conn = max(1, ops // connections)
+    wall, lats, errors, ok = _spawn_and_aggregate(
+        connections, _sat_worker,
+        lambda i, barrier, results: (
+            i, list(ports), scenario, per_conn, dist, key_space,
+            write_ratio, seed, connections, cl, barrier, results))
+    arr = np.array(lats) if lats else np.array([0.0])
+    return {
+        "scenario": scenario, "dist": dist, "cl": cl,
+        "connections": connections, "ok": ok,
+        "errors": {k: v for k, v in errors.items() if v},
+        "wall_s": round(wall, 3),
+        "ops_s": round(ok / wall, 1) if wall > 0 else 0.0,
+        "p50_us": round(float(np.percentile(arr, 50)), 1),
+        "p99_us": round(float(np.percentile(arr, 99)), 1),
+    }
+
+
+def run_matrix(base_dir: str, *, connections: int = 6,
+               ops_per_leg: int = 240, key_space: int = 512,
+               legs=None, chaos: bool = True, seed: int = 1,
+               target_ms: float = 250.0,
+               chaos_target_ms: float = 2.0,
+               slo_poll_s: float = 0.05) -> dict:
+    """The full saturation matrix against a 3-node RF=3 LocalCluster,
+    every leg through the wire with hints and speculative retry live,
+    the SLO service polling throughout. Returns the bench `saturation`
+    section: per-leg throughput/latency + SLO verdicts, and the chaos
+    leg's breach-triggered flight-recorder bundle."""
+    import json as json_mod
+
+    from cassandra_tpu.client import Cluster
+    from cassandra_tpu.cluster.node import LocalCluster
+    from cassandra_tpu.service import diagnostics
+    from cassandra_tpu.service.metrics import GLOBAL as METRICS
+    from cassandra_tpu.transport import CQLServer
+    from cassandra_tpu.utils import faultfs
+
+    legs = list(legs) if legs is not None else list(DEFAULT_LEGS)
+    cluster = LocalCluster(3, base_dir, rf=3)
+    servers = [CQLServer(n) for n in cluster.nodes]
+    ports = [srv.port for srv in servers]
+    n1 = cluster.node(1)
+    # the coordinator node under observation: its engine carries the
+    # SLO registry and the flight recorder the chaos bundle lands in
+    settings = n1.engine.settings
+    settings.set("diagnostic_events_enabled", True)
+    svc = n1.engine.slo
+    out: dict = {"cluster": {"nodes": 3, "rf": 3,
+                             "hinted_handoff": True,
+                             "speculative_retry": True},
+                 "legs": {}}
+    try:
+        # coordinate at the CL the legs declare on the wire (QUORUM) —
+        # digest reads, blocking read repair and speculative retry are
+        # all on the path; write rounds keep the default 2 s budget
+        # (node engines run batch commit + one inbound messaging worker,
+        # so concurrent QUORUM acks genuinely queue on this box)
+        from cassandra_tpu.cluster.replication import ConsistencyLevel
+        for nn in cluster.nodes:
+            nn.default_cl = ConsistencyLevel.QUORUM
+        s = Cluster("127.0.0.1", ports[0]).connect()
+        for ddl in SAT_DDL:
+            s.execute(ddl)
+        s.close()
+        svc.start(slo_poll_s)
+        read_objs = ("client_requests.read", "client_requests.read.quorum")
+        write_objs = ("client_requests.write",
+                      "client_requests.write.quorum")
+        for scenario, dist in legs:
+            leg_id = f"{scenario}:{dist}"
+            # leg boundary, in poller-race-safe order: stamp the new
+            # scenario FIRST, re-baseline every objective (compliant /
+            # full budget — the shared decaying hists would otherwise
+            # carry a previous leg's breaching state across), and only
+            # THEN retarget through the hot-reload knob machinery (the
+            # same path nodetool/settings vtable writes take). A poll
+            # landing anywhere in this window either sees the old
+            # generous targets or a fresh transition already carrying
+            # this leg's id.
+            svc.set_context(scenario=leg_id)
+            svc.reset()
+            settings.set("slo_targets",
+                         {name: target_ms
+                          for name in read_objs + write_objs})
+            before = {v["objective"]: v["breaches"]
+                      for v in svc.snapshot()}
+            r = run_scenario(ports, scenario, connections=connections,
+                             ops=ops_per_leg, dist=dist,
+                             key_space=key_space, cl="QUORUM",
+                             seed=seed)
+            verdicts = {v["objective"]: v for v in svc.check()}
+            slo = {}
+            breached = False
+            for name, v in verdicts.items():
+                new = v["breaches"] - before.get(name, 0)
+                if new or v["breaching"]:
+                    breached = True
+                slo[name] = {"p99_us": v["p99_us"],
+                             "target_us": v["target_us"],
+                             "breaches": new,
+                             "budget_remaining_s":
+                                 v["budget_remaining_s"]}
+            r["slo"] = slo
+            r["verdict"] = "breach" if breached else "ok"
+            out["legs"][leg_id] = r
+            svc.clear_context()
+
+        # ---- hints live: a replica's storage goes dark mid-traffic;
+        # QUORUM writes keep succeeding and the failed sends hint
+        hints_before = dict(n1.hints.metrics)
+        cluster.stop_node(3)
+        hr = run_scenario(ports[:2], "kv", connections=connections,
+                          ops=max(ops_per_leg // 2, 32), dist="uniform",
+                          key_space=key_space, write_ratio=1.0,
+                          cl="QUORUM", seed=seed + 7)
+        # failed sends to the dark node expire on the reaper after the
+        # write timeout — wait them out before counting hints
+        time.sleep(float(n1.proxy.write_timeout) + 0.3)
+        hinted = sum(nn.hints.has_hints(cluster.node(3).endpoint)
+                     for nn in cluster.nodes[:2])
+        cluster.restart_node(3)
+        for nn in cluster.nodes[:2]:
+            nn.hint_round()
+        out["hints_leg"] = {
+            "writes_ok": hr["ok"], "errors": hr["errors"],
+            "nodes_holding_hints": int(hinted),
+            "hints_written_delta":
+                n1.hints.metrics.get("written", 0)
+                - hints_before.get("written", 0),
+            "replayed_total": n1.hints.metrics.get("replayed", 0),
+        }
+
+        # ---- chaos leg: faultfs storage faults mid-run on node2's
+        # sstables + a tightened read target — must end in a
+        # breach-triggered bundle stamped with the scenario id
+        if chaos:
+            chaos_id = "chaos:kv:zipf"
+            # preload + flush so reads cross the sstable.read
+            # checkpoint on real files
+            run_scenario(ports, "kv", connections=connections,
+                         ops=ops_per_leg, dist="uniform",
+                         key_space=key_space, write_ratio=1.0,
+                         cl="QUORUM", seed=seed + 11)
+            for nn in cluster.nodes:
+                for cfs in list(nn.engine.stores.values()):
+                    try:
+                        cfs.flush()
+                    except Exception:
+                        pass
+            from cassandra_tpu.storage import chunk_cache
+            chunk_cache.GLOBAL.clear()
+            # node2 reacts to the injected EIO with disk_failure_policy
+            # `stop`: its storage goes terminal on the first fault, so
+            # for the rest of the leg it is a live-but-sick replica —
+            # every read against it fails fast, the coordinator's
+            # speculative retry fails over, and failed writes hint
+            cluster.node(2).engine.settings.set(
+                "disk_failure_policy", "stop")
+            # same poller-race-safe order as the leg loop: context,
+            # reset, THEN the tightened targets — a poll between the
+            # tighten and the reset would otherwise publish an
+            # unstamped breach whose dump dedup-suppresses the stamped
+            # one this leg must end with
+            svc.set_context(scenario=chaos_id)
+            svc.reset()   # the chaos breach must be a fresh transition
+            settings.set("slo_targets",
+                         {"client_requests.read": chaos_target_ms,
+                          "client_requests.read.quorum":
+                              chaos_target_ms})
+            spec0 = METRICS.counter("reads.speculative_retries")
+            won0 = METRICS.counter("reads.speculative_retries_won")
+            node2_dir = cluster.node(2).engine.data_dir
+            faultfs.arm("sstable.read", "error", times=256,
+                        path_substr=node2_dir)
+            try:
+                cr = run_scenario(ports, "kv", connections=connections,
+                                  ops=ops_per_leg, dist="zipf",
+                                  key_space=key_space, write_ratio=0.1,
+                                  cl="QUORUM", seed=seed + 13)
+            finally:
+                faultfs.disarm("sstable.read")
+            verdicts = {v["objective"]: v for v in svc.check()}
+            breach_evs = [e for e in
+                          diagnostics.GLOBAL.events("slo.breach")
+                          if e.fields.get("scenario") == chaos_id]
+            bundle = next((p for p in reversed(svc.recorder.dumps)
+                           if "slo_breach" in p), None)
+            bundle_has_event = scenario_in_bundle = False
+            if bundle is not None:
+                with open(bundle) as f:
+                    b = json_mod.load(f)
+                evs = [e for e in b.get("events", [])
+                       if e.get("type") == "slo.breach"]
+                bundle_has_event = bool(evs)
+                scenario_in_bundle = any(
+                    e.get("scenario") == chaos_id for e in evs)
+            ro = verdicts.get("client_requests.read", {})
+            out["chaos"] = {
+                **cr, "scenario_id": chaos_id,
+                "faults_injected":
+                    "sstable.read EIO on node2 (times<=256)",
+                "read_p99_us": ro.get("p99_us"),
+                "read_target_us": ro.get("target_us"),
+                "breach_events": len(breach_evs),
+                "breached": bool(breach_evs),
+                "budget_remaining_s": ro.get("budget_remaining_s"),
+                "bundle": bundle,
+                "bundle_has_breach_event": bundle_has_event,
+                "scenario_id_in_bundle": scenario_in_bundle,
+                "speculative_retries_fired":
+                    METRICS.counter("reads.speculative_retries") - spec0,
+                "speculative_retries_won":
+                    METRICS.counter("reads.speculative_retries_won")
+                    - won0,
+            }
+            svc.clear_context()
+        out["slo_totals"] = {
+            "checks": svc.checks,
+            "breaches": METRICS.counter("slo.breaches"),
+            "budget_exhausted": METRICS.counter("slo.budget_exhausted"),
+            "recorder_dumps": METRICS.counter("slo.recorder_dumps"),
+        }
+        out["workload_classes"] = sorted(
+            {scn for scn, _ in legs} | ({"kv"} if chaos else set()))
+        return out
+    finally:
+        svc.stop()
+        svc.clear_context()
+        for srv in servers:
+            try:
+                srv.close()
+            except Exception:
+                pass
+        cluster.shutdown()
+
+
 # -------------------------------------------------------------- CLI ------
 
 def main(argv=None) -> int:
@@ -353,9 +938,35 @@ def main(argv=None) -> int:
     p.add_argument("--smoke", action="store_true",
                    help="tier-2 drill: deterministic seconds-long "
                         "correctness + overload + rate-limit checks")
+    p.add_argument("--matrix", action="store_true",
+                   help="saturation matrix: every workload class "
+                        "through the wire against a 3-node RF=3 "
+                        "cluster with SLO verdicts + chaos leg")
+    p.add_argument("--matrix-legs", default=None,
+                   help="comma-separated scenario:dist legs "
+                        "(default: the DEFAULT_LEGS matrix; scenarios: "
+                        + ",".join(SCENARIOS) + ")")
+    p.add_argument("--no-chaos", action="store_true",
+                   help="matrix: skip the fault-injection leg")
     args = p.parse_args(argv)
     if args.smoke:
         return smoke()
+    if args.matrix:
+        import shutil
+        import tempfile
+        legs = None
+        if args.matrix_legs:
+            legs = [tuple(leg.split(":", 1))
+                    for leg in args.matrix_legs.split(",")]
+        base = tempfile.mkdtemp(prefix="ctpu-sat-")
+        try:
+            print(json.dumps(run_matrix(
+                base, connections=args.connections,
+                ops_per_leg=args.ops, key_space=args.key_space,
+                legs=legs, chaos=not args.no_chaos, seed=args.seed)))
+        finally:
+            shutil.rmtree(base, ignore_errors=True)
+        return 0
 
     srv = engine = None
     base = None
@@ -378,6 +989,7 @@ def main(argv=None) -> int:
             run_stress(host, port, profile="write",
                        connections=min(8, args.connections),
                        ops=args.key_space, dist="sequential",
+                       key_space=args.key_space,
                        value_bytes=args.value_bytes, seed=args.seed)
         out = run_stress(host, port, profile=args.profile,
                          connections=args.connections, ops=args.ops,

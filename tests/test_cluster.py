@@ -90,11 +90,18 @@ def test_hints_stored_and_replayed(cluster):
     n1 = cluster.node(1)
     n1.default_cl = ConsistencyLevel.ONE
     victim = cluster.nodes[2]
-    # victim is seen dead -> writes hint instead of sending
+    # victim is seen dead -> writes hint instead of sending. Gossip
+    # keeps running in this fixture, so mute it first: without the
+    # drops an in-flight SYN/ACK about the victim can re-mark it alive
+    # between the flag flip and the write (a real flake under full-run
+    # load).
+    cluster.filters.drop(verb=Verb.GOSSIP_SYN)
+    cluster.filters.drop(verb=Verb.GOSSIP_ACK)
     n1.gossiper.states[victim.endpoint].alive = False
     s = cluster.session(1)
     s.keyspace = "ks"
     s.execute("INSERT INTO kv (k, v) VALUES (9, 'hinted')")
+    cluster.filters.clear()
     assert n1.hints.has_hints(victim.endpoint)
     # victim had no copy
     t = cluster.schema.get_table("ks", "kv")
@@ -551,11 +558,15 @@ def test_speculative_retry_rescues_slow_replica(cluster):
     cluster.filters.drop(verb=Verb.READ_REQ, to=ep2)
     n1.proxy.timeout = 5.0
     before = GLOBAL.counter("reads.speculative_retries")
+    before_won = GLOBAL.counter("reads.speculative_retries_won")
     import time
     t0 = time.time()
     assert s.execute("SELECT v FROM kv WHERE k = 70").rows == [("spec",)]
     assert time.time() - t0 < 2.0, "speculation should beat the timeout"
     assert GLOBAL.counter("reads.speculative_retries") > before
+    # the dropped digest never answers, so the spare's response is what
+    # completed the round: the retry FIRED and WON
+    assert GLOBAL.counter("reads.speculative_retries_won") > before_won
     cluster.filters.clear()
 
 
