@@ -101,6 +101,7 @@ class StorageProxy:
         self.read_timeout = 5.0
         self.write_timeout = 2.0
         self.range_timeout = 10.0
+        self.counter_write_timeout = 5.0
         self._settings_subs = []
         settings = getattr(node.engine, "settings", None)
         if settings is not None:
@@ -108,7 +109,9 @@ class StorageProxy:
                                    ("write_request_timeout",
                                     "write_timeout"),
                                    ("range_request_timeout",
-                                    "range_timeout")):
+                                    "range_timeout"),
+                                   ("counter_write_request_timeout",
+                                    "counter_write_timeout")):
                 setattr(self, attr, settings.get(cfg_name))
                 cb_ = (lambda a: lambda v: setattr(self, a, v))(attr)
                 settings.on_change(cfg_name, cb_)
@@ -135,6 +138,7 @@ class StorageProxy:
         self.read_timeout = v
         self.write_timeout = v
         self.range_timeout = v
+        self.counter_write_timeout = v
 
     def _record_latency(self, ep: Endpoint, seconds: float) -> None:
         with self._lat_lock:

@@ -194,9 +194,12 @@ class CounterService:
             box["err"] = getattr(msg, "payload", None)
             done.set()
 
-        # leader waits up to proxy.timeout for its replication CL, so
-        # the origin waits longer than one write timeout
-        budget = self.node.proxy.timeout * 2
+        # leader waits up to the counter-write timeout for its
+        # replication CL, so the origin waits longer than one (the
+        # counter_write_request_timeout knob, hot-reloadable through
+        # the coordinator's listener; the blanket proxy.timeout setter
+        # still covers it for tests)
+        budget = self.node.proxy.counter_write_timeout * 2
         self.node.messaging.send_with_callback(
             Verb.COUNTER_REQ, (mutation.serialize(), cl), leader,
             on_response=on_rsp, on_failure=on_fail, timeout=budget)

@@ -9,6 +9,7 @@ from __future__ import annotations
 import math
 import random
 import threading
+from ..utils import lockwitness
 import time
 from dataclasses import dataclass, field
 
@@ -44,6 +45,11 @@ class FailureDetector:
     def __init__(self, default_mean: float = 1.0):
         self._states: dict[Endpoint, EndpointState] = {}
         self.default_mean = default_mean
+        # live conviction threshold: Node binds this to the mutable
+        # phi_convict_threshold knob (DatabaseDescriptor
+        # .setPhiConvictThreshold role); the module constant is only
+        # the default
+        self.threshold = PHI_CONVICT_THRESHOLD
 
     def report(self, ep: Endpoint, state: EndpointState,
                now: float) -> None:
@@ -66,7 +72,7 @@ class FailureDetector:
         return (elapsed / mean) / math.log(10)
 
     def is_alive(self, state: EndpointState, now: float) -> bool:
-        return self.phi(state, now) < PHI_CONVICT_THRESHOLD
+        return self.phi(state, now) < self.threshold
 
 
 class Gossiper:
@@ -74,16 +80,20 @@ class Gossiper:
     so tests can run accelerated rounds (the reference gossips at 1 Hz)."""
 
     def __init__(self, messaging: MessagingService, seeds: list[Endpoint],
-                 interval: float = 1.0, clock=time.monotonic):
+                 interval: float = 1.0, clock=None):
         self.messaging = messaging
         self.ep = messaging.ep
         self.seeds = [s for s in seeds if s != self.ep]
         self.interval = interval
-        self.clock = clock
+        # bound at CALL time through the module attribute, never as a
+        # default argument: the simulator patches `time` on this module,
+        # and a def-time `clock=time.monotonic` default would capture
+        # the REAL clock before the patch (ctpulint clock-discipline)
+        self.clock = clock if clock is not None else time.monotonic
         self.detector = FailureDetector(default_mean=max(interval * 3, 0.1))
         self.states: dict[Endpoint, EndpointState] = {
             self.ep: EndpointState(generation=int(time.time()))}
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("gossip.state")
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # per-instance RNG for peer selection: the deterministic

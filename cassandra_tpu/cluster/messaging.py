@@ -153,7 +153,8 @@ class MessagingService:
         self._cb_lock = threading.Lock()
         self._queue: queue.Queue = queue.Queue()
         self.closed = False
-        self.metrics = {"sent": 0, "received": 0, "dropped_timeout": 0}
+        self.metrics = {"sent": 0, "received": 0, "dropped_timeout": 0,
+                        "process_failures": 0}
         # deterministic-simulation mode: a SimTransport (sim/scheduler.py)
         # carries a scheduler; deliveries and callback timeouts become
         # virtual-time events processed inline on the pumping thread, so
@@ -246,7 +247,15 @@ class MessagingService:
                 msg = self._queue.get(timeout=0.2)
             except queue.Empty:
                 continue
-            self._process(msg)
+            try:
+                self._process(msg)
+            except Exception:
+                # a raising verb handler or response callback must cost
+                # that MESSAGE, never this node's single inbound worker
+                # — a dead worker leaves the node deaf with no trace
+                # (the PR 4/PR 6 silent-daemon-death class, ctpulint
+                # worker-loops)
+                self.metrics["process_failures"] += 1
 
     def _process(self, msg: Message) -> None:
         """Handle one inbound message: response-callback dispatch or

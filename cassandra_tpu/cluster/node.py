@@ -39,6 +39,30 @@ class Node:
                                  interval=gossip_interval)
         self.gossiper.on_alive = self._on_peer_alive
         self.gossiper.on_dead = self._on_peer_dead
+        # runtime knobs for the liveness/hints machinery (ctpulint
+        # knob-wiring): phi_convict_threshold drives the failure
+        # detector live, max_hint_window (seconds in config) feeds the
+        # ms-denominated window below, hinted_handoff_enabled follows
+        # the same gate nodetool disablehandoff flips
+        self._settings_subs: list = []
+        _settings = getattr(self.engine, "settings", None)
+        if _settings is not None:
+            det = self.gossiper.detector
+            det.threshold = float(_settings.get("phi_convict_threshold"))
+            self.max_hint_window_ms = \
+                float(_settings.get("max_hint_window")) * 1000.0
+            self.hints.enabled = bool(
+                _settings.get("hinted_handoff_enabled"))
+            for name, cb_ in (
+                    ("phi_convict_threshold",
+                     lambda v: setattr(det, "threshold", float(v))),
+                    ("max_hint_window",
+                     lambda v: setattr(self, "max_hint_window_ms",
+                                       float(v) * 1000.0)),
+                    ("hinted_handoff_enabled",
+                     lambda v: setattr(self.hints, "enabled", bool(v)))):
+                _settings.on_change(name, cb_)
+                self._settings_subs.append((name, cb_))
         # disk/commit failure policy `stop`/`die`: the engine's failure
         # handler calls back so the node leaves the ring the way the
         # reference's StorageService.stopTransports does. on_stop only:
@@ -278,7 +302,15 @@ class Node:
 
     def _hint_loop(self):
         while not self._stop_hints.wait(0.5):
-            self.hint_round()
+            try:
+                self.hint_round()
+            except Exception:
+                # replay I/O errors are handled (and counted) inside
+                # hint_round per target; anything escaping here is a
+                # bug that must not silently end hint dispatch for the
+                # node's lifetime (ctpulint worker-loops)
+                self.hints.metrics["dispatch_failures"] = \
+                    self.hints.metrics.get("dispatch_failures", 0) + 1
 
     def hint_round(self) -> None:
         """One hint-dispatch pass (extracted so the deterministic
@@ -620,6 +652,8 @@ class Node:
         self.gossiper.stop()
         self.messaging.close()
         for cfg_name, cb_ in getattr(self.proxy, "_settings_subs", []):
+            self.engine.settings.remove_listener(cfg_name, cb_)
+        for cfg_name, cb_ in getattr(self, "_settings_subs", []):
             self.engine.settings.remove_listener(cfg_name, cb_)
         self.engine.close()
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import time
 
 import numpy as np
 
@@ -373,8 +374,11 @@ class RepairService:
             # the whole token space is now consistent across the replica
             # set: anticompact everywhere so repaired data crosses the
             # boundary and future incremental repairs skip it
-            import time as _time
-            repaired_at = int(_time.time() * 1000)
+            # module-level `time`: the simulator patches this module's
+            # attribute, so repaired_at follows the virtual clock under
+            # simulation (an aliased function-level import escaped the
+            # patch — ctpulint clock-discipline)
+            repaired_at = int(time.time() * 1000)
             ranges = [(-(1 << 63), (1 << 63) - 1)]
             done = {}
             aev = threading.Event()

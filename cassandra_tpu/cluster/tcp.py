@@ -88,6 +88,10 @@ class TcpTransport:
         self._out: dict[Endpoint, _Conn] = {}
         self._lock = threading.Lock()
         self._closed = False
+        # decode/deliver bugs that cost a serving thread its connection
+        # (the broad guard in _serve_conn): counted so a silent
+        # connect/drop loop is visible, not invisible
+        self.serve_failures = 0
         self.tls = tls
         self._srv_ctx = tls.server_context() if tls else None
         self._cli_ctx = tls.client_context() if tls else None
@@ -181,8 +185,18 @@ class TcpTransport:
                 sock, _addr = self._listen.accept()
             except OSError:
                 return
-            threading.Thread(target=self._serve_conn, args=(sock,),
-                             daemon=True).start()
+            try:
+                threading.Thread(target=self._serve_conn, args=(sock,),
+                                 daemon=True).start()
+            except Exception:
+                # thread-limit exhaustion under a connection burst must
+                # drop THIS connection, not end the accept loop — the
+                # peer retries; a dead accept loop partitions the node
+                # silently (ctpulint worker-loops)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
 
     def _serve_conn(self, sock: socket.socket) -> None:
         if self._srv_ctx is not None:
@@ -229,7 +243,14 @@ class TcpTransport:
                 if svc is not None and not svc.closed:
                     svc.inbound(msg)
         except OSError:
-            pass
+            pass   # normal socket teardown: peer reset, EOF mid-frame
+        except Exception:
+            # a decode/deliver BUG also ends only this peer's
+            # connection (the finally closes it; the peer reconnects) —
+            # but unlike routine socket errors it is counted, so a
+            # silent connect/drop loop shows up in the transport stats
+            # instead of wedging invisibly (ctpulint worker-loops)
+            self.serve_failures += 1
         finally:
             try:
                 sock.close()

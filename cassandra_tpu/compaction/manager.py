@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import queue
 import threading
+from ..utils import lockwitness
 import time
 
 from ..utils.ratelimit import RateLimiter  # noqa: F401  (re-exported)
@@ -45,7 +46,7 @@ class CompactionManager:
         self.paused = False
         self._queue: queue.Queue = queue.Queue()
         self._pending_cfs: set = set()
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("compaction.manager")
         self._cfs_locks: dict = {}   # table_id -> rewrite mutex
         # mesh-width source for the gauges: the owning engine points
         # this at ITS settings knob (the fanout global is process-wide
@@ -171,7 +172,7 @@ class CompactionManager:
         must both happen under it."""
         with self._lock:
             return self._cfs_locks.setdefault(cfs.table.id,
-                                              threading.Lock())
+                                              lockwitness.make_lock("compaction.cfs_rewrite"))
 
     def _execute_task(self, cfs, task, kind: str = "Compaction"):
         """Claim inputs, run one task with progress + throttle + metrics

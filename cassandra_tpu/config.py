@@ -158,7 +158,9 @@ class Config:
                                                  50 * 1024 * 1024)
 
     # streaming / hints (cassandra.yaml / hints section)
+    # ctpulint: allow(knob-wiring, reason=sstable shipping is a single-message RPC processed on the shared messaging dispatch worker today; a blocking throttle there would stall gossip acks and reads node-wide. The limiter binds when ROADMAP item 3 re-hosts streaming on dedicated pipeline stages.)
     stream_throughput_outbound: float = spec("rate", 24.0, mutable=True)
+    # ctpulint: allow(knob-wiring, reason=same as stream_throughput_outbound; additionally no DC-aware stream path exists yet - every transfer is intra-DC)
     inter_dc_stream_throughput_outbound: float = spec("rate", 24.0,
                                                       mutable=True)
     hinted_handoff_enabled: bool = mut(True)
@@ -172,8 +174,11 @@ class Config:
     write_request_timeout: float = spec("duration", 2.0, mutable=True)
     counter_write_request_timeout: float = spec("duration", 5.0,
                                                 mutable=True)
+    # ctpulint: allow(knob-wiring, reason=paxos contention backoff is attempt-count bounded today (cluster/paxos.py); the knob binds when contention waits become deadline-based)
     cas_contention_timeout: float = spec("duration", 1.0, mutable=True)
+    # ctpulint: allow(knob-wiring, reason=TRUNCATE executes synchronously against local stores plus a fire-and-forget ring broadcast - there is no blocking wait to bound yet)
     truncate_request_timeout: float = spec("duration", 60.0, mutable=True)
+    # ctpulint: allow(knob-wiring, reason=yaml-parity blanket alias; the wired per-operation knobs (read/write/range/counter_write_request_timeout) are the operative controls and the proxy.timeout blanket setter covers test use)
     request_timeout: float = spec("duration", 10.0, mutable=True)
 
     # failure detection / gossip
@@ -184,6 +189,7 @@ class Config:
     native_transport_port: int = 9042
     native_transport_max_frame_size: int = spec("storage",
                                                 16 * 1024 * 1024)
+    # ctpulint: allow(knob-wiring, reason=the event-loop server bounds load by in-flight REQUESTS (the permit gate) not connection count; a per-connection cap adds nothing until per-IP accounting exists. Default -1 is disabled.)
     native_transport_max_concurrent_connections: int = mut(-1)
     # event-loop front door (transport/server.py): selector threads
     # multiplexing all client sockets (Netty boss/worker role) and the
@@ -219,8 +225,10 @@ class Config:
     # row_cache_size, then the built-in default; 0 disables caching
     # even for tables that opted in via WITH caching.
     row_cache_size_mib: int = mut(-1)
+    # ctpulint: allow(knob-wiring, reason=the counter-shard cache (cluster/counters.py) is unbounded-small per leader today; the byte cap binds when it grows an LRU)
     counter_cache_size: int = spec("storage", 25 * 1024 * 1024,
                                    mutable=True)
+    # ctpulint: allow(knob-wiring, reason=the engine does not own an AutoSavingCache instance - storage/saved_caches.py takes period= from whoever constructs it (tests/operators); the knob binds when the engine grows a saver)
     cache_save_period: float = spec("duration", 14400.0, mutable=True)
 
     # failure handling (cassandra.yaml disk_failure_policy /
@@ -243,8 +251,10 @@ class Config:
     incremental_backups: bool = mut(False)
     auto_snapshot: bool = True
     snapshot_before_compaction: bool = False
+    # ctpulint: allow(knob-wiring, reason=byte-denominated batch thresholds have no serialized-size checkpoint on the batch path yet; the statement-count guardrails (guardrails.batch_statements_warn/fail) are the active control)
     batch_size_warn_threshold: int = spec("storage", 5 * 1024,
                                           mutable=True)
+    # ctpulint: allow(knob-wiring, reason=same as batch_size_warn_threshold - no serialized-size checkpoint yet)
     batch_size_fail_threshold: int = spec("storage", 50 * 1024,
                                           mutable=True)
     tombstone_warn_threshold: int = mut(1000)

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import queue
 import threading
+from ..utils import lockwitness
 
 # test hook: {shard_index: seconds} delays applied before running the
 # shard's closure — forces adversarial completion orders
@@ -44,7 +45,7 @@ class ShardFanout:
     def __init__(self, workers: int = 1, name: str = "mesh-shard"):
         self.name = name
         self._q: queue.Queue = queue.Queue()
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("mesh.fanout")
         self._threads: list[threading.Thread] = []
         self._target = max(int(workers), 1)
         self._shutdown = False
@@ -177,7 +178,7 @@ class ShardFanout:
 
 # ---------------------------------------------------------- global state --
 
-_LOCK = threading.Lock()
+_LOCK = lockwitness.make_lock("mesh.fanout_registry")
 _GLOBAL: ShardFanout | None = None
 _DEVICES = 0
 # per-owner width demands: the worker POOL is process-global (like the

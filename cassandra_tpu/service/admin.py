@@ -71,8 +71,16 @@ class AdminServer:
                 # admin endpoint alive, retry after a beat
                 time.sleep(0.1)
                 continue
-            threading.Thread(target=self._serve, args=(sock, addr),
-                             daemon=True).start()
+            try:
+                threading.Thread(target=self._serve, args=(sock, addr),
+                                 daemon=True).start()
+            except Exception:
+                # thread exhaustion: shed this client, keep the admin
+                # endpoint (nodetool) alive (ctpulint worker-loops)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
 
     def _serve(self, sock: socket.socket, addr) -> None:
         from ..tools import nodetool

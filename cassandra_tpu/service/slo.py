@@ -53,6 +53,11 @@ from __future__ import annotations
 import threading
 import time
 
+# ctpulint: clock-injectable
+# the clock seam is SLOService(clock=) / SLObjective's injectable
+# percentile source; `time.monotonic` appears only as the production
+# default (a reference, never a direct call)
+
 from .metrics import GLOBAL as METRICS
 
 # default front-door objectives (generous: normal test traffic must not

@@ -324,9 +324,24 @@ def simulated(seed: int):
     Events must be SimEvents) — or use SimCluster, which does."""
     import importlib
 
+    from ..utils import lockwitness
+
     sched = SimScheduler(seed)
     fthreading = _FakeThreading(sched)
     ftime = _FakeTime(sched)
+    # the lock-order witness is armed for the scope: every witnessed
+    # lock the simulated cluster creates records acquisition edges, and
+    # a cycle-closing acquisition raises deterministically (same seed →
+    # same event order → same first-cycle edge). The graph resets at
+    # entry so a replay of the same seed sees the same empty graph —
+    # UNLESS the witness was already armed externally
+    # (CTPU_LOCK_WITNESS=1 whole-suite runs): wiping the accumulated
+    # process-global graph there would silently drop edges other tests
+    # recorded, degrading whole-suite coverage to per-scope coverage.
+    _witness_was_armed = lockwitness.armed()
+    if not _witness_was_armed:
+        lockwitness.reset()
+        lockwitness.arm()
     saved: list[tuple] = []
     for name in _PATCH_MODULES:
         mod = importlib.import_module(name)
@@ -341,6 +356,8 @@ def simulated(seed: int):
     try:
         yield sched
     finally:
+        if not _witness_was_armed:
+            lockwitness.disarm()
         for mod, attr, orig in reversed(saved):
             setattr(mod, attr, orig)
 

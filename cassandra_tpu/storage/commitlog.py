@@ -35,6 +35,7 @@ import os
 import re
 import struct
 import threading
+from ..utils import lockwitness
 import time
 import zlib
 
@@ -114,7 +115,7 @@ class CommitLog:
         if archive_dir:
             os.makedirs(archive_dir, exist_ok=True)
         os.makedirs(directory, exist_ok=True)
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("commitlog.append")
         existing = self.segment_ids()
         self._seg_id = (existing[-1] + 1) if existing else 1
         self._file = None
@@ -140,7 +141,7 @@ class CommitLog:
         # ---- group-commit sync barrier (AbstractCommitLogService role):
         # writers park in _await_sync until _synced covers their frame;
         # the syncer thread coalesces all parked writers into one fsync.
-        self._sync_cond = threading.Condition()
+        self._sync_cond = lockwitness.make_condition("commitlog.sync_barrier")
         self._synced = CommitLogPosition(0, 0)
         self._sync_req = threading.Event()   # "waiters (or dirty retired
         #                                       segments) need a sync"
@@ -150,7 +151,7 @@ class CommitLog:
         # serializes sync CYCLES (leader writer vs syncer thread): two
         # concurrent _do_sync calls could otherwise race a rotation —
         # one closing a just-retired file the other captured for fsync
-        self._sync_mutex = threading.Lock()
+        self._sync_mutex = lockwitness.make_lock("commitlog.sync_cycle")
         self._sync_failures = 0
         self._failure_logged = False
         self._last_sync = 0.0
@@ -506,7 +507,13 @@ class CommitLog:
                         return
             try:
                 self._do_sync()
-            except (OSError, ValueError) as e:
+            except Exception as e:
+                # EVERY sync failure — EIO, a closed fd (ValueError),
+                # or an outright bug — routes through the
+                # commit_failure_policy funnel; the syncer thread
+                # itself must survive, or parked writers wait forever
+                # on a durability that will never come (ctpulint
+                # worker-loops; the PR 4 _sync_loop bug class)
                 self._record_sync_failure(e)
 
     # -------------------------------------------------------------- replay
@@ -594,7 +601,10 @@ class CommitLog:
                     seg = self._archive_q.pop(0)
                 try:
                     self._archive(seg)
-                except OSError:
+                except Exception:
+                    # archiving is best-effort PITR copy; any failure
+                    # (I/O or bug) skips this segment but must not end
+                    # the archiver thread (ctpulint worker-loops)
                     pass
                 with self._lock:
                     self._archiving.discard(seg)

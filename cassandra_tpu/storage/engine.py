@@ -88,7 +88,15 @@ class StorageEngine:
         # nodetool enablebackup: flushed sstables hardlink into
         # <table>/backups/ (incremental_backups role). Set BEFORE any
         # store opens — replay at startup creates stores that read it.
-        self.incremental_backup = False
+        # Seeded from (and hot-following) the incremental_backups knob;
+        # nodetool enablebackup/disablebackup still writes the
+        # attribute directly.
+        self.incremental_backup = bool(
+            self.settings.get("incremental_backups"))
+        self._backup_listener = \
+            lambda v: setattr(self, "incremental_backup", bool(v))
+        self.settings.on_change("incremental_backups",
+                                self._backup_listener)
         # full-query log (fql/FullQueryLogger role): a second audit
         # stream capturing EVERY statement when enabled
         self.fql_log = None
@@ -179,6 +187,15 @@ class StorageEngine:
         self.settings.on_change("row_cache_size_mib",
                                 self._rowcache_listener)
         _resolve_row_cache(None)
+        # key cache capacity: the byte-denominated key_cache_size knob
+        # maps onto the shared LRU's entry capacity (KeyCache documents
+        # the per-entry estimate); process-global like the row cache
+        from .key_cache import GLOBAL as _key_cache
+        self._keycache_listener = _key_cache.set_capacity_bytes
+        self.settings.on_change("key_cache_size",
+                                self._keycache_listener)
+        _key_cache.set_capacity_bytes(
+            self.settings.get("key_cache_size"))
         self._load_schema()
         self._schema_listener = lambda s: self._save_schema()
         self.schema.listeners.append(self._schema_listener)
@@ -201,10 +218,37 @@ class StorageEngine:
         from .virtual import build_engine_virtuals
         self.virtual_tables = build_engine_virtuals(self)
         from ..service.auth import AuthService
-        self.auth = AuthService(data_dir, enabled=auth_enabled)
+        self.auth = AuthService(
+            data_dir, enabled=auth_enabled,
+            cache_validity=self.settings.get("auth_cache_validity"))
+        self._auth_validity_listener = \
+            lambda v: setattr(self.auth.cache, "validity", float(v))
+        self.settings.on_change("auth_cache_validity",
+                                self._auth_validity_listener)
         from .guardrails import Guardrails
         self.guardrails = Guardrails.from_config(
             self.settings.config.guardrails)
+        # the top-level tombstone knobs are the yaml-parity surface for
+        # the per-read tombstone guardrails (TombstoneOverwhelming
+        # thresholds): they bind initially and on hot set, UNLESS the
+        # guardrails block pinned its own values (the specific block
+        # wins over the legacy flat knob, load-time or runtime)
+        _g_raw = self.settings.config.guardrails
+
+        def _bind_tombstones(_v):
+            if "tombstones_warn_per_read" not in _g_raw:
+                self.guardrails.tombstones_warn_per_read = int(
+                    self.settings.get("tombstone_warn_threshold"))
+            if "tombstones_fail_per_read" not in _g_raw:
+                self.guardrails.tombstones_fail_per_read = int(
+                    self.settings.get("tombstone_failure_threshold"))
+
+        self._tombstone_listener = _bind_tombstones
+        self.settings.on_change("tombstone_warn_threshold",
+                                self._tombstone_listener)
+        self.settings.on_change("tombstone_failure_threshold",
+                                self._tombstone_listener)
+        _bind_tombstones(None)
         from ..service.monitoring import QueryMonitor
         self.monitor = QueryMonitor(
             threshold_ms=self.settings.get("slow_query_log_timeout")
@@ -541,6 +585,16 @@ class StorageEngine:
                                       self._rowcache_listener)
         self.settings.remove_listener("row_cache_size_mib",
                                       self._rowcache_listener)
+        self.settings.remove_listener("key_cache_size",
+                                      self._keycache_listener)
+        self.settings.remove_listener("incremental_backups",
+                                      self._backup_listener)
+        self.settings.remove_listener("auth_cache_validity",
+                                      self._auth_validity_listener)
+        self.settings.remove_listener("tombstone_warn_threshold",
+                                      self._tombstone_listener)
+        self.settings.remove_listener("tombstone_failure_threshold",
+                                      self._tombstone_listener)
         self.failures.close()
         self.compactions.close()
         if self.commitlog:

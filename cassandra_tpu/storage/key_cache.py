@@ -13,6 +13,7 @@ across restarts by storage/saved_caches.py (AutoSavingCache role).
 from __future__ import annotations
 
 import threading
+from ..utils import lockwitness
 from collections import OrderedDict
 
 
@@ -20,7 +21,7 @@ class KeyCache:
     def __init__(self, capacity: int = 100_000):
         self.capacity = capacity
         self._lru: "OrderedDict[tuple, tuple]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("storage.key_cache")
         self.hits = 0
         self.misses = 0
 
@@ -38,6 +39,22 @@ class KeyCache:
         with self._lock:
             self._lru[key] = value
             self._lru.move_to_end(key)
+            while len(self._lru) > self.capacity:
+                self._lru.popitem(last=False)
+
+    # rough per-entry footprint: the (directory, generation, pk) key
+    # strings/ints plus the location tuple. The byte-denominated
+    # `key_cache_size` knob maps onto entry capacity through this.
+    APPROX_ENTRY_BYTES = 512
+
+    def set_capacity_bytes(self, nbytes) -> None:
+        """Hot-resize from the `key_cache_size` knob (bytes); shrinking
+        evicts LRU-first immediately. 0 DISABLES the cache (the repo's
+        cache-size knob convention: puts evict instantly, every get
+        misses); positive sizes floor at 1024 entries."""
+        with self._lock:
+            self.capacity = 0 if int(nbytes) <= 0 else max(
+                1024, int(nbytes) // self.APPROX_ENTRY_BYTES)
             while len(self._lru) > self.capacity:
                 self._lru.popitem(last=False)
 

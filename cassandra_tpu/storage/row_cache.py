@@ -34,6 +34,7 @@ storage/saved_caches.py (AutoSavingCache role) alongside the key cache.
 from __future__ import annotations
 
 import threading
+from ..utils import lockwitness
 from collections import OrderedDict
 
 DEFAULT_CAPACITY = 64 << 20     # bytes; used until config wires a size
@@ -69,7 +70,7 @@ class RowCacheService:
         self._counts: dict = {}       # store key -> live entry count
         self._gens: dict = {}         # store key -> generation
         self._bytes = 0
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("storage.row_cache")
         self.hits = 0
         self.misses = 0
         self.evictions = 0

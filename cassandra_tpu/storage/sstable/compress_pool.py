@@ -29,6 +29,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+from ...utils import lockwitness
 import time
 
 
@@ -59,7 +60,7 @@ class CompressorPool:
     def __init__(self, workers: int = 1, name: str = "compress"):
         self.name = name
         self._q: queue.Queue = queue.Queue()
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("compress_pool.pool")
         self._workers: list[threading.Thread] = []
         self._target = max(int(workers), 1)
         self._shutdown = False
@@ -194,7 +195,7 @@ class CompressorPool:
 
 # ---------------------------------------------------------- global pool --
 
-_LOCK = threading.Lock()
+_LOCK = lockwitness.make_lock("compress_pool.registry")
 _GLOBAL: CompressorPool | None = None
 
 

@@ -873,11 +873,14 @@ class SSTableWriter:
                 return
             try:
                 os.fsync(self._data.fileno())
-            except OSError as e:
-                # a writeback error (EIO/ENOSPC) is reported ONCE per
+            except Exception as e:
+                # a writeback error (EIO/ENOSPC) — or a racing close
+                # (ValueError: fd already gone) — is reported ONCE per
                 # fd; swallowing it here would let finish()'s final
                 # fsync succeed and commit an sstable with lost pages.
-                # Record it — finish() re-raises before the commit point.
+                # Record it — finish() re-raises before the commit
+                # point — instead of silently ending the trickle-sync
+                # thread (ctpulint worker-loops).
                 self._sync_error = e
                 return
 

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import os
 import threading
+from ..utils import lockwitness
 import time
 
 import numpy as np
@@ -58,7 +59,7 @@ class WriteBarrier:
     __slots__ = ("_cond", "_shared", "_excl", "_excl_waiting")
 
     def __init__(self):
-        self._cond = threading.Condition()
+        self._cond = lockwitness.make_condition("table.write_barrier")
         self._shared = 0
         self._excl = False
         self._excl_waiting = 0
@@ -122,7 +123,7 @@ class Tracker:
     Mutated under a lock; readers grab a consistent snapshot list."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = lockwitness.make_rlock("table.tracker")
         self.sstables: list[SSTableReader] = []
         self._by_max_ts: list[SSTableReader] | None = None
 
@@ -177,7 +178,7 @@ class ColumnFamilyStore:
         self.flush_threshold = flush_threshold or self.DEFAULT_FLUSH_THRESHOLD
         self.tracker = Tracker()
         self.memtable = Memtable(table, shards=memtable_shards)
-        self._flush_lock = threading.Lock()
+        self._flush_lock = lockwitness.make_lock("table.flush")
         # write barrier (OpOrder role): writers shared, switch exclusive
         self._barrier = WriteBarrier()
         self.metrics = {"writes": 0, "reads": 0, "flushes": 0,
@@ -200,7 +201,7 @@ class ColumnFamilyStore:
         # corrupt-sstable quarantine (the reference's markSuspect +
         # JVMStabilityInspector routing): records survive restarts via
         # the on-disk quarantine/ directory
-        self._quarantine_lock = threading.Lock()
+        self._quarantine_lock = lockwitness.make_lock("table.quarantine")
         self.quarantined: list[dict] = list_quarantined(self.directory)
         from .lifecycle import replay_directory
         replay_directory(self.directory)
@@ -246,7 +247,7 @@ class ColumnFamilyStore:
             # entries surviving from a previous in-process store over
             # this directory predate whatever happened to it since
             self.row_cache.clear()
-        self._gen_lock = threading.Lock()
+        self._gen_lock = lockwitness.make_lock("table.gen")
         # quarantined generations count too: their files left the live
         # directory, and a restart re-minting one of their numbers
         # would make the quarantine records misreport the new sstable

@@ -100,10 +100,16 @@ def build_node(cfg: dict):
         import time as _t
         deadline = _t.monotonic() + 15.0
         while _t.monotonic() < deadline:
-            if any(node.is_alive(ep) for ep in node.ring.endpoints
-                   if ep != node.endpoint):
-                node.schema_sync.pull_from_peers(timeout=3.0)
-                return
+            try:
+                if any(node.is_alive(ep) for ep in node.ring.endpoints
+                       if ep != node.endpoint):
+                    node.schema_sync.pull_from_peers(timeout=3.0)
+                    return
+            except Exception:
+                # catch-up is best-effort bootstrap: a failed pull
+                # retries until the deadline instead of silently ending
+                # the thread (ctpulint worker-loops)
+                pass
             _t.sleep(0.2)
 
     import threading as _threading

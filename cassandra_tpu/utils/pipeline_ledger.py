@@ -31,7 +31,15 @@ noise of the un-instrumented path).
 from __future__ import annotations
 
 import threading
+from . import lockwitness
 import time
+
+# ctpulint: clock-injectable
+# patchable monotonic clock for the stage timers: tests / a simulated
+# deployment swap this for a virtual clock (the timeutil.CLOCK
+# pattern); production leaves time.perf_counter. _Timer reads it at
+# enter/exit time, so a swap takes effect immediately.
+CLOCK = time.perf_counter
 
 
 class Stage:
@@ -51,7 +59,7 @@ class Stage:
         self.items = 0
         self.bytes = 0
         self.queue_hwm = 0
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("pipeline.stage")
 
     # ------------------------------------------------------------ record --
 
@@ -115,11 +123,11 @@ class _Timer:
         self._sink = sink
 
     def __enter__(self):
-        self._t0 = time.perf_counter()
+        self._t0 = CLOCK()
         return self
 
     def __exit__(self, *exc):
-        self._sink(time.perf_counter() - self._t0)
+        self._sink(CLOCK() - self._t0)
 
 
 class PipelineLedger:
@@ -157,7 +165,7 @@ class PipelineLedger:
 
 # ---------------------------------------------------------------- registry
 
-_LOCK = threading.Lock()
+_LOCK = lockwitness.make_lock("pipeline.registry")
 _LEDGERS: dict[str, PipelineLedger] = {}
 
 

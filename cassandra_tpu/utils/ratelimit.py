@@ -28,6 +28,11 @@ from __future__ import annotations
 import threading
 import time
 
+# ctpulint: clock-injectable
+# the clock/sleep seam is the constructor's clock=/sleep= parameters;
+# `time.monotonic`/`time.sleep` appear below only as the production
+# DEFAULTS (references, never direct calls)
+
 
 class RateLimiter:
     """Thread-safe token-bucket limiter in rate×unit tokens/s

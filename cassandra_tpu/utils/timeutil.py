@@ -16,6 +16,7 @@ LIVE_DELETION = (NO_TIMESTAMP, NO_DELETION_TIME)
 # largest TTL CQL accepts: 20 years (cql3/Attributes.java MAX_TTL)
 MAX_TTL = 20 * 365 * 24 * 3600
 
+# ctpulint: clock-injectable
 # patchable wall clock (seconds, float). Tests install a virtual clock
 # here to make TTL expiry deterministic; production leaves time.time.
 CLOCK = time.time
@@ -38,6 +39,7 @@ def now_micros() -> int:
     semantics: never returns the same value twice, even across threads)."""
     global _last_micros
     with _micros_lock:
+        # ctpulint: allow(clock-discipline, reason=write timestamps must stay unique and monotonic PROCESS-wide; the sim patches CLOCK (now_seconds/TTL expiry) only — pinning micros to a virtual clock would hand equal timestamps to every write in a tick and break last-write-wins)
         t = time.time_ns() // 1000
         if t <= _last_micros:
             t = _last_micros + 1

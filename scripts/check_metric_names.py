@@ -98,13 +98,16 @@ def _known_group(name: str) -> bool:
 def scan(paths=None) -> list[tuple[str, int, str, str]]:
     """[(relpath, lineno, method, name)] violations."""
     if paths is None:
-        paths = []
-        self_py = os.path.abspath(__file__)
-        for top in ("cassandra_tpu", "scripts"):
-            for root, _dirs, files in os.walk(os.path.join(REPO, top)):
-                paths += [p for f in files if f.endswith(".py")
-                          and (p := os.path.join(root, f)) != self_py]
-        paths.append(os.path.join(REPO, "bench.py"))
+        # module discovery is the shared ctpulint walker's
+        # (cassandra_tpu/analysis/walker.py): both tools answer "what
+        # are the project's modules" identically, so a file one scans
+        # and the other misses cannot exist
+        sys.path.insert(0, REPO)
+        from cassandra_tpu.analysis.walker import project_files
+        self_rel = os.path.relpath(os.path.abspath(__file__), REPO)
+        paths = project_files(REPO, tops=("cassandra_tpu", "scripts"),
+                              extras=("bench.py",),
+                              exclude=(self_rel,))
     bad = []
     for p in sorted(paths):
         with open(p, encoding="utf-8") as f:

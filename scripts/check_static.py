@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""ctpulint tier-2 driver: the concurrency & invariant static-analysis
+suite (cassandra_tpu/analysis/) + the witness-armed engine smoke.
+
+    check_static.py            all five AST checks, then arm the
+                               runtime LockWitness over the
+                               deterministic engine smoke shared with
+                               check_metric_names.py (dynamic lock
+                               orders the AST cannot see)
+    check_static.py --fast     AST-only: no engine boot, ~1s — the
+                               pre-commit shape
+    check_static.py --explain  also print every active allowlist entry
+                               with its reason (the allowlist is
+                               documentation; this is its audit)
+    check_static.py --list     print the check catalog
+
+Exit 0 = clean. Any unallowlisted violation, any `allow()` missing its
+reason=, or a LockOrderError under the armed smoke exits 1 with
+file:line per finding. Policy: docs/static-analysis.md.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def run_ast_checks(explain: bool) -> int:
+    from cassandra_tpu.analysis import checks
+    from cassandra_tpu.analysis.report import (apply_suppressions,
+                                               reasonless)
+    from cassandra_tpu.analysis.walker import ProjectIndex
+
+    index = ProjectIndex.build()
+    violations = checks.run_all(index)
+    supps = index.suppressions()
+    meta = reasonless(supps)
+    remaining = apply_suppressions(violations, supps) + meta
+
+    rc = 0
+    if remaining:
+        print("ctpulint violations:", file=sys.stderr)
+        for v in sorted(remaining, key=lambda v: (v.path, v.line)):
+            print(f"  {v}", file=sys.stderr)
+        rc = 1
+    suppressed = [v for v in violations if v.suppressed_by is not None]
+    unused = [s for s in supps if s.reason and not s.used]
+    print(f"ctpulint: {len(checks.CHECKS)} checks, "
+          f"{len(violations) + len(meta)} findings, "
+          f"{len(suppressed)} allowlisted, "
+          f"{len(remaining)} violations")
+    if unused:
+        print("note: stale allowlist entries (matched nothing):")
+        for s in unused:
+            print(f"  {s}")
+    if explain or "--explain" in sys.argv:
+        used = [s for s in supps if s.used]
+        if used:
+            print("active allowlist:")
+            for s in sorted(used, key=lambda s: (s.path, s.line)):
+                print(f"  {s}")
+    return rc
+
+
+def run_witness_smoke() -> int:
+    """Arm the LockWitness, then drive the deterministic engine smoke
+    check_metric_names.py uses — every witnessed lock created by the
+    engine records its acquisition edges; a cycle-closing acquisition
+    raises with both stacks."""
+    from cassandra_tpu.utils import lockwitness
+
+    lockwitness.reset()
+    lockwitness.arm()
+    try:
+        import check_metric_names
+        check_metric_names.smoke_emitted()
+    except lockwitness.LockOrderError as e:
+        print(f"LockWitness cycle under the engine smoke:\n{e}",
+              file=sys.stderr)
+        return 1
+    finally:
+        lockwitness.disarm()
+    graph = lockwitness.graph_snapshot()
+    n_edges = sum(len(v) for v in graph.values())
+    print(f"LockWitness smoke OK: {len(graph)} holder locks, "
+          f"{n_edges} acquisition edges, no cycle")
+    lockwitness.reset()
+    return 0
+
+
+def main() -> int:
+    if "--list" in sys.argv:
+        from cassandra_tpu.analysis import checks
+        for name, (_mod, desc) in checks.CHECKS.items():
+            print(f"  {name:18s} {desc}")
+        return 0
+    rc = run_ast_checks("--explain" in sys.argv)
+    if "--fast" not in sys.argv:
+        rc = run_witness_smoke() or rc
+    if rc == 0:
+        print("ctpulint OK")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,433 @@
+"""ctpulint (cassandra_tpu/analysis/) + the runtime LockWitness
+(utils/lockwitness.py).
+
+Covers the ISSUE 13 test checklist: the synthetic AB/BA deadlock
+fixture is caught BOTH statically (AST lock-order cycle) and
+dynamically (armed LockWitness raise carrying both stacks);
+suppression-without-reason is rejected; the knob-wiring check catches a
+deliberately unwired `mutable=True` fixture; the witness under
+sim/scheduler.py stays deterministic; and the real tree is pinned
+green (the tier-2 gate's contract, in-suite)."""
+import threading
+
+import pytest
+
+from cassandra_tpu.analysis import checks
+from cassandra_tpu.analysis.checks import (clock_discipline, knob_wiring,
+                                           lock_order, loop_blocking,
+                                           worker_loops)
+from cassandra_tpu.analysis.report import (apply_suppressions,
+                                           parse_suppressions, reasonless)
+from cassandra_tpu.analysis.walker import ProjectIndex
+from cassandra_tpu.utils import lockwitness
+
+
+@pytest.fixture(autouse=True)
+def _witness_clean():
+    """Every test starts disarmed with an empty order graph."""
+    lockwitness.disarm()
+    lockwitness.reset()
+    yield
+    lockwitness.disarm()
+    lockwitness.reset()
+
+
+# ------------------------------------------------------------ lock-order --
+
+AB_BA = '''
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+
+    def ab(self):
+        with self._la:
+            with self._lb:
+                pass
+
+    def ba(self):
+        with self._lb:
+            with self._la:
+                pass
+'''
+
+
+def test_lock_order_detects_ab_ba_cycle():
+    idx = ProjectIndex.from_sources({"fix/mod.py": AB_BA})
+    vs = lock_order.run(idx)
+    assert len(vs) == 1
+    assert "cycle" in vs[0].message
+    assert "Box._la" in vs[0].message and "Box._lb" in vs[0].message
+
+
+def test_lock_order_interprocedural_cycle():
+    """ab holds A and CALLS a helper that takes B; ba nests the other
+    way — the edge must come through the call graph."""
+    src = '''
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+
+    def take_b(self):
+        with self._lb:
+            pass
+
+    def ab(self):
+        with self._la:
+            self.take_b()
+
+    def ba(self):
+        with self._lb:
+            with self._la:
+                pass
+'''
+    idx = ProjectIndex.from_sources({"fix/mod.py": src})
+    vs = lock_order.run(idx)
+    assert len(vs) == 1, [str(v) for v in vs]
+
+
+def test_lock_order_ordered_nesting_is_clean():
+    src = AB_BA.replace("with self._lb:\n            with self._la:",
+                        "with self._la:\n            with self._lb:")
+    idx = ProjectIndex.from_sources({"fix/mod.py": src})
+    assert lock_order.run(idx) == []
+
+
+def test_lock_order_allowlisted_edge_with_reason_is_dropped():
+    src = AB_BA.replace(
+        "        with self._lb:\n            with self._la:",
+        "        with self._lb:\n"
+        "            # ctpulint: allow(lock-order, reason=ba only runs "
+        "single-threaded at boot)\n"
+        "            with self._la:")
+    idx = ProjectIndex.from_sources({"fix/mod.py": src})
+    assert lock_order.run(idx) == []
+    # and the suppression is marked used (surfaced by --explain)
+    assert any(s.used for s in idx.suppressions())
+
+
+# ----------------------------------------------------------- LockWitness --
+
+def test_witness_ab_ba_raises_with_both_stacks():
+    lockwitness.arm()
+    la = lockwitness.make_lock("fix.la")
+    lb = lockwitness.make_lock("fix.lb")
+    with la:
+        with lb:
+            pass
+    with pytest.raises(lockwitness.LockOrderError) as ei:
+        with lb:
+            with la:
+                pass
+    msg = str(ei.value)
+    assert "fix.la" in msg and "fix.lb" in msg
+    # both stacks: the acquisition being attempted AND the recorded
+    # first-creation stack of the reverse edge
+    assert "this acquisition" in msg
+    assert "recorded 'fix.la' -> 'fix.lb'" in msg
+    # both stacks carry THIS test's frames
+    assert msg.count("test_witness_ab_ba_raises_with_both_stacks") >= 2
+
+
+def test_witness_cross_thread_cycle_detected():
+    """The classic two-thread deadlock shape: thread 1 records A->B,
+    the MAIN thread closing B->A raises even though neither thread ever
+    actually deadlocked."""
+    lockwitness.arm()
+    la = lockwitness.make_lock("fix.t.la")
+    lb = lockwitness.make_lock("fix.t.lb")
+
+    def t1():
+        with la:
+            with lb:
+                pass
+
+    th = threading.Thread(target=t1)
+    th.start()
+    th.join()
+    with pytest.raises(lockwitness.LockOrderError):
+        with lb:
+            with la:
+                pass
+
+
+def test_witness_reentrant_and_condition_wait():
+    lockwitness.arm()
+    rl = lockwitness.make_rlock("fix.re")
+    with rl:
+        with rl:          # re-entrancy adds no edge, no raise
+            pass
+    cond = lockwitness.make_condition("fix.cond")
+    other = lockwitness.make_lock("fix.other")
+    hit = []
+
+    def notifier():
+        # takes `other` WITHOUT holding the condition lock: must not
+        # record cond->other (wait released it)
+        with other:
+            hit.append(1)
+        with cond:
+            cond.notify_all()
+
+    with cond:
+        th = threading.Thread(target=notifier)
+        th.start()
+        assert cond.wait(timeout=5.0)
+        th.join()
+    assert hit == [1]
+    assert "fix.other" not in lockwitness.graph_snapshot().get(
+        "fix.cond", [])
+
+
+def test_witness_disarmed_is_raw_primitives():
+    lk = lockwitness.make_lock("fix.raw")
+    assert type(lk) is type(threading.Lock())
+    rk = lockwitness.make_rlock("fix.raw.r")
+    assert type(rk) is type(threading.RLock())
+
+
+def test_witness_under_sim_deterministic(tmp_path):
+    """Armed witness inside simulated(seed): same seed -> identical
+    event trace, no witness raise, armed state restored after."""
+    from cassandra_tpu.sim.scheduler import SimCluster, simulated
+
+    traces = []
+    for run in range(2):
+        with simulated(seed=1234) as sched:
+            assert lockwitness.armed()
+            cluster = SimCluster(sched, str(tmp_path / f"r{run}"), n=2,
+                                 gossip_interval=0.25)
+            sched.run(3.0)
+            traces.append(list(sched.trace))
+            cluster.shutdown()
+        assert not lockwitness.armed()
+        lockwitness.reset()
+    assert traces[0] == traces[1]
+
+
+# ----------------------------------------------------------- suppression --
+
+def test_suppression_without_reason_rejected():
+    src = "x = 1  # ctpulint: allow(lock-order)\n"
+    supps = parse_suppressions("fix/mod.py", src)
+    assert len(supps) == 1 and supps[0].reason is None
+    metas = reasonless(supps)
+    assert len(metas) == 1
+    assert metas[0].check == "suppression"
+    # and a reasonless allow suppresses NOTHING
+    from cassandra_tpu.analysis.report import Violation
+    v = Violation("lock-order", "fix/mod.py", 1, "boom")
+    assert apply_suppressions([v], supps) == [v]
+
+
+def test_suppression_with_reason_covers_same_and_previous_line():
+    from cassandra_tpu.analysis.report import Violation
+    src = ("# ctpulint: allow(worker-loops, reason=loop exits into the "
+           "io_error funnel)\nwhile True: pass\n")
+    supps = parse_suppressions("fix/mod.py", src)
+    v = Violation("worker-loops", "fix/mod.py", 2, "boom")
+    assert apply_suppressions([v], supps) == []
+    assert v.suppressed_by is supps[0]
+
+
+# ----------------------------------------------------------- knob-wiring --
+
+KNOB_FIXTURE = '''
+from dataclasses import dataclass, field
+
+
+def mut(default):
+    return field(default=default, metadata={"mutable": True})
+
+
+def spec(kind, default, mutable=False):
+    return field(default=default,
+                 metadata={"spec": kind, "mutable": mutable})
+
+
+@dataclass
+class Config:
+    wired_knob: int = mut(3)
+    unwired_knob: int = mut(7)
+    immutable_thing: int = spec("storage", 1)
+'''
+
+KNOB_CONSUMER = '''
+def hook(settings):
+    settings.on_change("wired_knob", lambda v: v)
+'''
+
+
+def test_knob_wiring_catches_unwired_mutable_fixture():
+    idx = ProjectIndex.from_sources({"fix/config.py": KNOB_FIXTURE,
+                                     "fix/consumer.py": KNOB_CONSUMER})
+    vs = knob_wiring.run(idx, config_mod="fix.config")
+    assert [v for v in vs if "`unwired_knob`" in v.message]
+    assert not [v for v in vs if "`wired_knob`" in v.message]
+    assert not [v for v in vs if "immutable_thing" in v.message]
+
+
+def test_knob_wiring_attribute_reread_counts():
+    consumer = "def use(cfg):\n    return cfg.unwired_knob\n"
+    idx = ProjectIndex.from_sources({"fix/config.py": KNOB_FIXTURE,
+                                     "fix/consumer.py": consumer,
+                                     "fix/consumer2.py": KNOB_CONSUMER})
+    assert knob_wiring.run(idx, config_mod="fix.config") == []
+
+
+# ---------------------------------------------------------- worker-loops --
+
+def test_worker_loops_unguarded_daemon_flagged_guarded_clean():
+    bad = '''
+import threading
+
+
+class W:
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            self.work()
+
+    def work(self):
+        raise RuntimeError("boom")
+'''
+    idx = ProjectIndex.from_sources({"fix/w.py": bad})
+    vs = worker_loops.run(idx)
+    assert len(vs) == 1 and "die silently" in vs[0].message
+
+    good = bad.replace(
+        "        while True:\n            self.work()",
+        "        while True:\n"
+        "            try:\n"
+        "                self.work()\n"
+        "            except Exception:\n"
+        "                pass")
+    idx = ProjectIndex.from_sources({"fix/w.py": good})
+    assert worker_loops.run(idx) == []
+
+
+def test_worker_loops_bare_reraise_is_not_a_guard():
+    src = '''
+import threading
+
+
+class W:
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            try:
+                self.work()
+            except Exception:
+                raise
+
+    def work(self):
+        raise RuntimeError("boom")
+'''
+    idx = ProjectIndex.from_sources({"fix/w.py": src})
+    assert len(worker_loops.run(idx)) == 1
+
+
+# ------------------------------------------------------ clock-discipline --
+
+def test_clock_discipline_marked_module_direct_call_flagged():
+    src = ("# ctpulint: clock-injectable\n"
+           "import time\n\n\n"
+           "def bad():\n"
+           "    return time.monotonic()\n\n\n"
+           "def ok(clock=time.monotonic):   # the seam itself\n"
+           "    return clock()\n")
+    idx = ProjectIndex.from_sources({"fix/clocky.py": src})
+    vs = clock_discipline.run(idx)
+    assert len(vs) == 1
+    assert vs[0].line == 6
+
+
+def test_clock_discipline_sim_patched_rules():
+    """Fixture planted AT the real sim-module path: aliased import +
+    from-import + def-time default all flagged."""
+    sched_src = '_PATCH_MODULES = ("fix.simmod",)\n'
+    sim_src = ("import time\n"
+               "import time as _t\n"
+               "from time import sleep\n\n\n"
+               "def f(clock=time.monotonic):\n"
+               "    return time.monotonic()\n")
+    idx = ProjectIndex.from_sources({
+        "cassandra_tpu/sim/scheduler.py": sched_src,
+        "fix/simmod.py": sim_src})
+    vs = clock_discipline.run(idx)
+    msgs = "\n".join(v.message for v in vs)
+    assert "import time as _t" in msgs
+    assert "from time import" in msgs
+    assert "default argument" in msgs
+    # the module-attribute call time.monotonic() inside the body is
+    # FINE in a sim-patched module (the simulator patches the attr)
+    assert len(vs) == 3
+
+
+# --------------------------------------------------------- loop-blocking --
+
+def test_loop_blocking_fixture_reachable_sleep_flagged():
+    server_src = '''
+import time
+
+
+class Helper:
+    def slow(self):
+        time.sleep(1.0)
+
+
+class _EventLoop:
+    def __init__(self, helper: "Helper"):
+        self.helper = helper
+
+    def run(self):
+        while True:
+            self._on_ready()
+
+    def _on_ready(self):
+        self.helper.slow()
+'''
+    idx = ProjectIndex.from_sources(
+        {"cassandra_tpu/transport/server.py": server_src})
+    vs = loop_blocking.run(idx)
+    assert len(vs) == 1
+    assert "sleep" in vs[0].message
+    assert "_EventLoop.run" in vs[0].message     # the chain is printed
+
+
+# --------------------------------------------------------- the real tree --
+
+def test_real_tree_is_green_and_allowlist_reasoned():
+    """The tier-2 gate's contract, pinned in-suite: all five checks
+    pass on the current tree; every active suppression carries a
+    reason."""
+    idx = ProjectIndex.build()
+    violations = checks.run_all(idx)
+    supps = idx.suppressions()
+    remaining = apply_suppressions(violations, supps) + reasonless(supps)
+    assert remaining == [], "\n".join(str(v) for v in remaining)
+    for s in supps:
+        if s.used:
+            assert s.reason and len(s.reason) > 10, str(s)
+
+
+def test_real_tree_witness_locks_declared():
+    """The walker sees lockwitness factory calls as lock declarations
+    (so converted modules keep participating in the static pass)."""
+    idx = ProjectIndex.build()
+    gossip = idx.modules["cassandra_tpu.cluster.gossip"]
+    assert gossip.classes["Gossiper"].lock_attrs.get("_lock") == "lock"
+    table = idx.modules["cassandra_tpu.storage.table"]
+    assert table.classes["WriteBarrier"].lock_attrs.get("_cond") \
+        == "condition"
