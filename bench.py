@@ -952,6 +952,95 @@ def run_saturation_bench(base_dir: str) -> dict:
     return out
 
 
+def run_observatory_bench(base_dir: str) -> dict:
+    """Observatory section (docs/observability.md layer 5): prove
+    (a) the metrics-history sampler costs < 1 % of a real
+    flush+compaction run even at a 4 Hz interval (40x the default
+    rate) with the pipeline ledger armed — the sampler's cumulative
+    capture seconds over the leg's wall, same clock both sides; and
+    (b) the per-table WA/SA gauges reconcile EXACTLY against the
+    run's actual flushed/compacted byte counters (same-source
+    arithmetic, the contract scripts/check_observatory.py gates)."""
+    from cassandra_tpu.config import Config, Settings
+    from cassandra_tpu.schema import Schema, make_table
+    from cassandra_tpu.storage.engine import StorageEngine
+    from cassandra_tpu.storage.mutation import Mutation
+
+    settings = Settings(Config.load({
+        "metrics_history_enabled": True,
+        "metrics_history_interval": "250ms",   # 40x the default rate
+        "compaction_throughput": 0}))
+    schema = Schema()
+    schema.create_keyspace("obs")
+    table = make_table("obs", "t", pk=["id"], ck=["c"],
+                       cols={"id": "int", "c": "int", "v": "blob"})
+    schema.add_table(table)
+    d = os.path.join(base_dir, "eng")
+    eng = StorageEngine(d, schema, commitlog_sync="periodic",
+                        settings=settings)
+    try:
+        cfs = eng.store("obs", "t")
+        vcol = table.columns["v"].column_id
+        rng = np.random.default_rng(9)
+        vals = rng.integers(0, 256, (4096, 256), dtype=np.uint8)
+        t0 = time.perf_counter()
+        for gen in range(4):
+            muts = []
+            for i in range(4096):
+                m = Mutation(table.id,
+                             table.serialize_partition_key([i % 512]))
+                m.add(table.serialize_clustering([gen * 4096 + i]),
+                      vcol, b"", vals[i].tobytes(), 1_000_000 + i)
+                muts.append(m)
+            eng.apply_batch(muts)
+            cfs.flush()
+        stats = eng.compactions.major_compaction(cfs)
+        wall = time.perf_counter() - t0
+        svc = eng.metrics_history
+        overhead = svc.sample_seconds / max(wall, 1e-9)
+
+        m = cfs.metrics
+        amp = cfs.amplification()
+        wa_recomputed = round(
+            (m["bytes_flushed"] + m["bytes_compacted_out"])
+            / max(m["bytes_ingested"], 1), 6)
+        live = cfs.live_sstables()
+        total_parts = sum(s.n_partitions for s in live)
+        toks = np.concatenate([np.asarray(s.partition_tokens)
+                               for s in live if s.n_partitions > 0])
+        sa_recomputed = round(total_parts
+                              / max(len(np.unique(toks)), 1), 6)
+        return {
+            "sampler": {
+                "interval_s": svc.interval_s,
+                "samples": svc.samples,
+                "sample_seconds": round(svc.sample_seconds, 4),
+                "wall_s": round(wall, 3),
+                "overhead_pct": round(overhead * 100.0, 4),
+                "overhead_ok": bool(overhead < 0.01),
+            },
+            "amplification": {
+                "write_amplification": amp["write_amplification"],
+                "space_amplification": amp["space_amplification"],
+                "wa_recomputed": wa_recomputed,
+                "sa_recomputed": sa_recomputed,
+                "bytes_ingested": m["bytes_ingested"],
+                "bytes_flushed": m["bytes_flushed"],
+                "bytes_compacted_in": m["bytes_compacted_in"],
+                "bytes_compacted_out": m["bytes_compacted_out"],
+                "reconciled": bool(
+                    amp["write_amplification"] == wa_recomputed
+                    and amp["space_amplification"] == sa_recomputed),
+            },
+            "compaction": {"inputs": stats["inputs"],
+                           "bytes_read": stats["bytes_read"],
+                           "bytes_written": stats["bytes_written"]},
+            "history_series": svc.stats()["series"],
+        }
+    finally:
+        eng.close()
+
+
 def _kernel_probe(table):
     """Two tiny merge rounds through the DEVICE path (on whatever JAX
     backend is active — the pinned CPU one for host engines): the first
@@ -1116,6 +1205,13 @@ def main():
             # OVERLOADED shedding with in-flight <= the permit cap
             "frontdoor": run_frontdoor_bench(
                 os.path.join(base, "frontdoor")),
+            # workload observatory (docs/observability.md layer 5):
+            # metrics-history sampler overhead share of a real
+            # flush+compaction run (< 1% required even at 40x the
+            # default sampling rate) + exact same-source WA/SA gauge
+            # reconciliation against the run's byte counters
+            "observatory": run_observatory_bench(
+                os.path.join(base, "observatory")),
             # saturation matrix (docs/observability.md SLO layer,
             # ROADMAP item 5): workload classes x key streams through
             # the wire against a 3-node RF=3 cluster, per-leg SLO

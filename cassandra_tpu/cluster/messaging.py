@@ -58,6 +58,10 @@ class Verb:
     TRUNCATE_RSP = "TRUNCATE_RSP"
     INDEX_REQ = "INDEX_REQ"
     INDEX_RSP = "INDEX_RSP"
+    # cluster-wide telemetry pull (the observatory): any node asks a
+    # peer for its engine-scoped metric/tpstats/SLO snapshot
+    METRICS_SNAPSHOT_REQ = "METRICS_SNAPSHOT_REQ"
+    METRICS_SNAPSHOT_RSP = "METRICS_SNAPSHOT_RSP"
 
 
 @dataclass

@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 from ..cql.execution import Executor
 from ..cql.processor import Session
@@ -72,6 +73,11 @@ class Node:
         # server-push event bus (transport EVENT role): CQL servers and
         # tests subscribe; liveness/topology/schema transitions fan out
         self._event_listeners: list = []
+        # last successful telemetry snapshot per peer (clusterstats'
+        # staleness source); created HERE, not lazily — two racing
+        # first pulls must not each mint a cache and drop the other's
+        # last-known snapshots
+        self._peer_telemetry: dict = {}
         self.proxy = StorageProxy(self)
         self._register_verbs()
         from .repair import RepairService
@@ -176,6 +182,8 @@ class Node:
         ms.register_handler(Verb.HINT_REQ, self._handle_mutation)
         ms.register_handler(Verb.TRUNCATE_REQ, self._handle_truncate)
         ms.register_handler(Verb.INDEX_REQ, self._handle_index)
+        ms.register_handler(Verb.METRICS_SNAPSHOT_REQ,
+                            self._handle_metrics_snapshot)
 
     def _handle_mutation(self, msg):
         mutation = Mutation.deserialize(msg.payload)
@@ -248,6 +256,98 @@ class Node:
         store.truncate()
         self.counters.invalidate_table(store.table.id)
         return Verb.TRUNCATE_RSP, b""
+
+    # ----------------------------------------------------- cluster telemetry
+
+    def telemetry_snapshot(self) -> dict:
+        """One node's ENGINE-scoped telemetry — the METRICS_SNAPSHOT_RSP
+        payload behind `nodetool clusterstats`: tpstats, compaction
+        gauges, per-table counters + amplification, the SLO snapshot
+        and messaging counters. Engine-scoped on purpose: in-process
+        clusters share the process-global metrics registry, so a
+        cluster view built from global counters would show every node
+        the same numbers."""
+        from ..tools.nodetool import tpstats
+        eng = self.engine
+        tables = {}
+        writes = 0
+        for cfs in list(eng.stores.values()):
+            live = cfs.live_sstables()
+            writes += cfs.metrics.get("writes", 0)
+            tables[cfs.table.full_name()] = {
+                **{k: int(v) for k, v in cfs.metrics.items()},
+                **cfs.amplification(),
+                "sstables": len(live),
+                "live_bytes": sum(s.size_bytes for s in live),
+            }
+        return {
+            "endpoint": self.endpoint.name,
+            "at_ms": int(time.time() * 1000),
+            "tpstats": tpstats(eng),
+            "compactions": eng.compactions.gauges(),
+            "tables": tables,
+            "storage_writes": writes,
+            "write_stalls": eng.write_stalls,
+            "slo": eng.slo.snapshot(),
+            "messaging": dict(self.messaging.metrics),
+        }
+
+    def _handle_metrics_snapshot(self, msg):
+        return Verb.METRICS_SNAPSHOT_RSP, self.telemetry_snapshot()
+
+    def pull_cluster_telemetry(self, timeout: float = 2.0) -> dict:
+        """Pull every peer's telemetry snapshot over the
+        METRICS_SNAPSHOT verb (the local node serves itself directly).
+        Bounded: a peer that does not answer within `timeout` is
+        reported with its LAST successfully-pulled snapshot and a
+        staleness stamp — or no snapshot at all if it was never heard
+        from — so a dark node can never hang the pull. The response
+        callbacks only record the payload and signal an event; nothing
+        blocking ever runs on the messaging dispatch worker."""
+        cache = self._peer_telemetry
+        peers = [ep for ep in list(self.ring.endpoints)
+                 if ep != self.endpoint]
+        done = threading.Event()
+        state = {"pending": len(peers)}
+        lock = threading.Lock()
+
+        def _one_done():
+            with lock:
+                state["pending"] -= 1
+                if state["pending"] <= 0:
+                    done.set()
+
+        t_pull = time.monotonic()
+        for ep in peers:
+            def on_rsp(msg, _ep=ep):
+                cache[_ep.name] = (msg.payload, time.monotonic())
+                _one_done()
+
+            def on_fail(_arg, _ep=ep):
+                _one_done()
+
+            self.messaging.send_with_callback(
+                Verb.METRICS_SNAPSHOT_REQ, b"", ep,
+                on_rsp, on_failure=on_fail, timeout=timeout)
+        if peers:
+            # margin covers the reaper's 100 ms expiry granularity
+            done.wait(timeout + 1.0)
+        rows = [{"endpoint": self.endpoint.name, "alive": True,
+                 "fresh": True, "stale_s": 0.0,
+                 "snapshot": self.telemetry_snapshot()}]
+        now = time.monotonic()
+        for ep in peers:
+            entry = cache.get(ep.name)
+            rows.append({
+                "endpoint": ep.name,
+                "alive": self.is_alive(ep),
+                "fresh": entry is not None and entry[1] >= t_pull,
+                "stale_s": (None if entry is None
+                            else round(now - entry[1], 3)),
+                "snapshot": entry[0] if entry is not None else None,
+            })
+        return {"nodes": rows,
+                "pulled_at_ms": int(time.time() * 1000)}
 
     # ---------------------------------------------------------- liveness --
 

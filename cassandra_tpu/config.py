@@ -272,6 +272,25 @@ class Config:
     # disabled. Hot-reloadable; the flight recorder folds published
     # events regardless of when the knob flips.
     diagnostic_events_enabled: bool = mut(False)
+    # metrics-history sampler (service/history.py, the workload
+    # observatory): OFF by default — while disabled no sampler thread
+    # exists and nothing is captured (the diagnostic-bus zero-cost
+    # rule). Hot-reloadable; flipping on starts the engine's sampler,
+    # flipping off stops it (retained rings survive the flip so the
+    # history up to the stop stays queryable).
+    metrics_history_enabled: bool = mut(False)
+    # fixed sampling interval for the raw ring ("10s"); hot-reloadable
+    # — the running sampler picks the new period up on its next tick.
+    # The raw ring holds 360 samples (1 h at the default) and every 30
+    # raw samples downsample into one coarse bucket (288 kept ≈ 24 h),
+    # min/max/last/sum-preserving.
+    metrics_history_interval: float = spec("duration", 10.0,
+                                           mutable=True)
+    # bound on ColumnFamilyStore.compaction_history (newest kept):
+    # the per-compaction stats ring behind compactionhistory /
+    # system_views.compaction_history. <= 0 = unbounded (the
+    # pre-bound behavior). Hot-reloadable per store.
+    compaction_history_entries: int = mut(256)
     # SLO layer (service/slo.py): {objective name: p99 target ms}
     # overrides/additions for the engine's SLO registry. Hot-reloadable
     # — the saturation matrix retargets per leg through this knob;

@@ -268,6 +268,13 @@ class FlightRecorder:
             snap["metrics"] = METRICS.snapshot()
         except Exception:
             snap["metrics"] = {}
+        try:
+            # the one busy/stall/idle primitive — the black box was
+            # the only bundle surface missing it (PR 9 gap)
+            from ..utils import pipeline_ledger
+            snap["pipelines"] = pipeline_ledger.snapshot_all()
+        except Exception:
+            snap["pipelines"] = {}
         eng = self.engine
         if eng is not None:
             try:
@@ -319,8 +326,27 @@ class FlightRecorder:
             "snapshots": snapshots,
             "final": self._capture(),
         }
+        try:
+            # explicit top-level ledger stage table (also inside every
+            # time-gated snapshot via _capture): the bundle's
+            # where-did-the-wall-go surface
+            from ..utils import pipeline_ledger
+            bundle["pipeline_ledger"] = pipeline_ledger.snapshot_all()
+        except Exception:
+            pass
         if eng is not None:
             bundle["node"] = {"data_dir": eng.data_dir}
+            # retained metrics-history window (service/history.py):
+            # what LED UP to the event, not just the moment of it. One
+            # forced sample at dump time guarantees a non-empty window
+            # even with the sampler knob off.
+            hist = getattr(eng, "metrics_history", None)
+            if hist is not None:
+                try:
+                    hist.sample()
+                    bundle["metrics_history"] = hist.recent_window()
+                except Exception:
+                    bundle["metrics_history"] = {}
             try:
                 bundle["settings"] = [
                     {"name": n, "value": v, "mutable": m}

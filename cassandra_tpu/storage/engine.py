@@ -302,6 +302,37 @@ class StorageEngine:
         self.slo = default_service(self)
         self._slo_targets_listener = self.slo.set_targets
         self.settings.on_change("slo_targets", self._slo_targets_listener)
+        # metrics-history sampler (service/history.py, the workload
+        # observatory): engine-scoped retained time series over the
+        # metrics registry + this engine's gauges. Zero-cost while the
+        # mutable metrics_history_enabled knob is off (no thread); the
+        # flight recorder still takes one on-demand sample at dump
+        # time so bundles always carry a history window.
+        from ..service.history import MetricsHistoryService
+        self.metrics_history = MetricsHistoryService(
+            engine=self,
+            interval_s=self.settings.get("metrics_history_interval"))
+        self._history_enabled_listener = self.metrics_history.set_enabled
+        self.settings.on_change("metrics_history_enabled",
+                                self._history_enabled_listener)
+        self._history_interval_listener = \
+            self.metrics_history.set_interval
+        self.settings.on_change("metrics_history_interval",
+                                self._history_interval_listener)
+        if self.settings.get("metrics_history_enabled"):
+            self.metrics_history.start()
+
+        # compaction-history ring bound: every store's per-compaction
+        # stats deque follows the mutable compaction_history_entries
+        # knob (newest kept); stores opened later inherit it in
+        # _open_store
+        def _set_ch_capacity(v):
+            for cfs in list(self.stores.values()):
+                cfs.set_compaction_history_capacity(v)
+
+        self._ch_capacity_listener = _set_ch_capacity
+        self.settings.on_change("compaction_history_entries",
+                                self._ch_capacity_listener)
 
     def _mesh_devices(self) -> int:
         """This engine's mesh width (its knob, not the shared pool's —
@@ -380,6 +411,8 @@ class StorageEngine:
         cfs.backup_enabled = lambda: self.incremental_backup
         cfs.mesh_devices_fn = self._mesh_devices
         cfs.decode_ahead_fn = self._decode_ahead
+        cfs.set_compaction_history_capacity(
+            self.settings.get("compaction_history_entries"))
         self.compactions.register(cfs)
         self.stores[t.id] = cfs
         return cfs
@@ -560,6 +593,13 @@ class StorageEngine:
         self.settings.remove_listener("slo_targets",
                                       self._slo_targets_listener)
         self.slo.stop()
+        self.settings.remove_listener("metrics_history_enabled",
+                                      self._history_enabled_listener)
+        self.settings.remove_listener("metrics_history_interval",
+                                      self._history_interval_listener)
+        self.settings.remove_listener("compaction_history_entries",
+                                      self._ch_capacity_listener)
+        self.metrics_history.stop()
         # withdraw this engine's bus demand (a closed engine must not
         # keep the process bus enabled for nobody)
         from ..service import diagnostics

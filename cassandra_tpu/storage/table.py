@@ -181,8 +181,17 @@ class ColumnFamilyStore:
         self._flush_lock = lockwitness.make_lock("table.flush")
         # write barrier (OpOrder role): writers shared, switch exclusive
         self._barrier = WriteBarrier()
+        # per-table counters (the metrics vtable merges these with the
+        # hist groups below). The byte counters are the amplification
+        # accounting's single source: bytes_ingested = mutation payload
+        # applied to the memtable, bytes_flushed = flush outputs,
+        # bytes_compacted_in/out = compaction task input/output sizes
+        # (compaction/task.py folds them from the same stats it appends
+        # to compaction_history) — amplification() derives WA from
+        # exactly these, so every surface reconciles arithmetically.
         self.metrics = {"writes": 0, "reads": 0, "flushes": 0,
-                        "bytes_flushed": 0}
+                        "bytes_flushed": 0, "bytes_ingested": 0,
+                        "bytes_compacted_in": 0, "bytes_compacted_out": 0}
         # per-table latency group (TableMetrics role): decaying
         # read/write latency hists under table.<ks>.<name>.* — counters
         # stay in the plain dict above (the metrics vtable merges both).
@@ -218,7 +227,27 @@ class ColumnFamilyStore:
                 else:
                     raise
         self.compaction_listener = None  # set by CompactionManager
-        self.compaction_history: list[dict] = []
+        # per-compaction stats ring (system_views.compaction_history /
+        # nodetool compactionhistory), newest kept: bounded by the
+        # mutable compaction_history_entries knob (a StorageEngine
+        # rebinds on knob change; standalone stores read the config
+        # default) — the engine-lifetime unbounded list this replaced
+        # grew one dict per compaction forever
+        from collections import deque as _deque
+
+        from ..config import Config as _ConfigDefaults
+        # class-attribute read: the dataclass default, no throwaway
+        # Config() instance per store (a StorageEngine rebinds from
+        # live settings right after open)
+        self.compaction_history: _deque = _deque(
+            maxlen=self._history_maxlen(
+                _ConfigDefaults.compaction_history_entries))
+        self._comp_hist_lock = lockwitness.make_lock(
+            "table.comp_history")
+        # space-amplification estimate cached per live generation set
+        # (the _mesh_bounds_cache pattern): the token-union walk is
+        # O(P log P) and only changes when the sstable set does
+        self._sa_cache: tuple | None = None
         # mesh routing width: a StorageEngine points this at ITS
         # compaction_mesh_devices knob (the fanout pool is process-
         # global, sized to the max across engines — a co-hosted
@@ -256,6 +285,89 @@ class ColumnFamilyStore:
             [d.generation for d in Descriptor.list_in(self.directory)]
             + [q["generation"] for q in self.quarantined],
             default=0)
+
+    @staticmethod
+    def _history_maxlen(n) -> int | None:
+        """compaction_history_entries knob → deque maxlen (<= 0 means
+        unbounded, the pre-bound behavior)."""
+        n = int(n)
+        return n if n > 0 else None
+
+    def set_compaction_history_capacity(self, n) -> None:
+        """Hot-apply the mutable compaction_history_entries knob:
+        rebuild the ring at the new bound, NEWEST entries kept (deque
+        maxlen cannot be resized in place). The swap and the task-side
+        append (record_compaction) share a lock — a compaction
+        finishing mid-hot-set must not land its entry on the discarded
+        ring."""
+        from collections import deque as _deque
+        maxlen = self._history_maxlen(n)
+        with self._comp_hist_lock:
+            self.compaction_history = _deque(self.compaction_history,
+                                             maxlen=maxlen)
+
+    def record_compaction(self, stats: dict) -> None:
+        """Fold one finished compaction into the store's observability
+        state: the bounded history ring (under the swap lock) and the
+        monotonic amplification counters, which survive ring
+        eviction."""
+        with self._comp_hist_lock:
+            self.compaction_history.append(stats)
+        self.metrics["bytes_compacted_in"] = \
+            self.metrics.get("bytes_compacted_in", 0) \
+            + stats.get("bytes_read", 0)
+        self.metrics["bytes_compacted_out"] = \
+            self.metrics.get("bytes_compacted_out", 0) \
+            + stats.get("bytes_written", 0)
+
+    # ------------------------------------------------------ amplification --
+
+    def amplification(self) -> dict:
+        """Observed per-table write/space amplification — the signals
+        the adaptive-compaction loop (ROADMAP item 4) tunes by, derived
+        from the SAME counters every other surface reports so bench /
+        check_observatory can reconcile them arithmetically:
+
+        - write_amplification = (bytes_flushed + bytes_compacted_out)
+          / bytes_ingested — physical bytes written per logical byte
+          the memtable absorbed (the RocksDB-style W-Amp; 0.0 until
+          anything was ingested).
+        - space_amplification = total live partition INSTANCES /
+          distinct live partitions across the sstable set's partition
+          directories (token arrays already resident — no decode). A
+          fully-compacted table reads 1.0; N overlapping copies of the
+          same keys read ≈ N. This is the live-vs-logical size ratio
+          in partition units, the overlap signal `sstables_per_read`
+          measures from the read side.
+        """
+        m = self.metrics
+        ingested = m.get("bytes_ingested", 0)
+        written = m.get("bytes_flushed", 0) \
+            + m.get("bytes_compacted_out", 0)
+        wa = (written / ingested) if ingested > 0 else 0.0
+        live = self.tracker.view()
+        # the O(P log P) token-union walk is cached per live
+        # generation set: callers include the history sampler tick and
+        # the METRICS_SNAPSHOT handler on the single messaging
+        # dispatch worker — neither may pay the sort when the sstable
+        # set has not changed
+        key = tuple(r.desc.generation for r in live)
+        cached = self._sa_cache
+        if cached is not None and cached[0] == key:
+            sa = cached[1]
+        else:
+            total_parts = sum(s.n_partitions for s in live)
+            if total_parts > 0:
+                toks = np.concatenate(
+                    [np.asarray(s.partition_tokens)
+                     for s in live if s.n_partitions > 0])
+                distinct = len(np.unique(toks))
+                sa = total_parts / max(distinct, 1)
+            else:
+                sa = 1.0
+            self._sa_cache = (key, sa)
+        return {"write_amplification": round(wa, 6),
+                "space_amplification": round(sa, 6)}
 
     def reload_sstables(self) -> None:
         """Pick up sstables written into the directory out-of-band
@@ -355,6 +467,7 @@ class ColumnFamilyStore:
                 _pos, wait_for = commitlog.append(mutation)
             self.memtable.apply(mutation)
             self.metrics["writes"] += 1
+            self.metrics["bytes_ingested"] += mutation.size
         # invalidate BEFORE the durability wait: the memtable already
         # holds the cells, and a failed sync raising past a stale cache
         # entry would leave cache-hit and memtable reads divergent
@@ -378,6 +491,8 @@ class ColumnFamilyStore:
                 _poss, wait_for = commitlog.append_batch(mutations)
             self.memtable.apply_batch(mutations)
             self.metrics["writes"] += len(mutations)
+            self.metrics["bytes_ingested"] += \
+                sum(m.size for m in mutations)
         # invalidation before the durability wait — see apply()
         if self.row_cache is not None:
             for pk in {m.pk for m in mutations}:

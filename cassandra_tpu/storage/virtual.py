@@ -85,7 +85,9 @@ def build_engine_virtuals(engine) -> VirtualSchema:
     def history_rows():
         i = 0
         for cfs in engine.stores.values():
-            for st in cfs.compaction_history:
+            # deque (bounded ring): a background compaction appending
+            # mid-iteration would raise RuntimeError — copy first
+            for st in _snapshot(cfs.compaction_history):
                 yield {"id": i, "keyspace_name": cfs.table.keyspace,
                        "table_name": cfs.table.name,
                        "cells_read": st["cells_read"],
@@ -158,7 +160,53 @@ def build_engine_virtuals(engine) -> VirtualSchema:
             base = f"table.{cfs.table.keyspace}.{cfs.table.name}"
             for k, v in cfs.metrics.items():
                 yield {"name": f"{base}.{k}", "value": float(v)}
+            # derived amplification gauges (the adaptive-compaction
+            # input signals; same-source counters, see
+            # ColumnFamilyStore.amplification)
+            for k, v in cfs.amplification().items():
+                yield {"name": f"{base}.{k}", "value": float(v)}
     vs.register(VirtualTable(t_metrics, metric_rows))
+
+    # --- metrics_history (service/history.py): the retained
+    # multi-resolution time series — raw rows are single samples,
+    # coarse rows the sealed min/max/last/sum-preserving merge
+    # buckets; rate_per_s is the counter rate between consecutive raw
+    # samples (0 on the first sample and on coarse rows)
+    t_mh = make_table("system_views", "metrics_history", pk=["name"],
+                      ck=["resolution", "at_ms"],
+                      cols={"name": "text", "resolution": "text",
+                            "at_ms": "bigint", "last": "double",
+                            "min": "double", "max": "double",
+                            "sum": "double", "n": "int",
+                            "rate_per_s": "double"})
+
+    def mh_rows():
+        svc = getattr(engine, "metrics_history", None)
+        if svc is None:
+            return
+        for name in svc.names():
+            prev = None
+            for b in svc.query(name, "raw"):
+                rate = 0.0
+                if prev is not None and b["t1"] > prev["t1"]:
+                    rate = max(b["last"] - prev["last"], 0.0) \
+                        / (b["t1"] - prev["t1"])
+                # at_ms is WALL-clock (epoch ms, via the service's
+                # sample-time offset) so rows join against telemetry
+                # snapshots and diagnostic-event timestamps
+                yield {"name": name, "resolution": "raw",
+                       "at_ms": int(svc.to_wall(b["t1"]) * 1000),
+                       "last": b["last"], "min": b["min"],
+                       "max": b["max"], "sum": b["sum"], "n": b["n"],
+                       "rate_per_s": round(rate, 6)}
+                prev = b
+            for b in svc.query(name, "coarse"):
+                yield {"name": name, "resolution": "coarse",
+                       "at_ms": int(svc.to_wall(b["t1"]) * 1000),
+                       "last": b["last"], "min": b["min"],
+                       "max": b["max"], "sum": b["sum"], "n": b["n"],
+                       "rate_per_s": 0.0}
+    vs.register(VirtualTable(t_mh, mh_rows))
 
     t_slow = make_table("system_views", "slow_queries", pk=["id"],
                         cols={"id": "int", "query": "text",

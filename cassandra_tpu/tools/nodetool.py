@@ -154,12 +154,18 @@ def commitlogstats(engine) -> dict:
 
 
 def tablestats(engine, keyspace: str | None = None) -> dict:
+    """nodetool tablestats: per-table live-set stats plus the
+    amplification accounting block — the observed byte counters
+    (ingested/flushed/compacted in+out) and the derived
+    write/space-amplification gauges the adaptive-compaction loop
+    reads (storage/table.py amplification())."""
     out = {}
     for cfs in engine.stores.values():
         t = cfs.table
         if keyspace and t.keyspace != keyspace:
             continue
         live = cfs.live_sstables()
+        amp = cfs.amplification()
         out[t.full_name()] = {
             "sstable_count": len(live),
             "space_used_bytes": sum(s.size_bytes for s in live),
@@ -170,6 +176,16 @@ def tablestats(engine, keyspace: str | None = None) -> dict:
             "reads": cfs.metrics["reads"],
             "writes": cfs.metrics["writes"],
             "flushes": cfs.metrics["flushes"],
+            "bytes_ingested": cfs.metrics.get("bytes_ingested", 0),
+            "bytes_flushed": cfs.metrics.get("bytes_flushed", 0),
+            "bytes_compacted_in":
+                cfs.metrics.get("bytes_compacted_in", 0),
+            "bytes_compacted_out":
+                cfs.metrics.get("bytes_compacted_out", 0),
+            "write_amplification": amp["write_amplification"],
+            "space_amplification": amp["space_amplification"],
+            "sstables_per_read_p99":
+                cfs.sstables_per_read.percentile(0.99),
             "row_cache": (None if cfs.row_cache is None
                           else {"hits": cfs.row_cache.hits,
                                 "misses": cfs.row_cache.misses,
@@ -574,9 +590,12 @@ def proxyhistograms(node) -> dict:
 
 def compactionhistory(engine) -> list[dict]:
     """nodetool compactionhistory."""
+    from ..storage.virtual import _snapshot
     out = []
     for cfs in engine.stores.values():
-        for st in cfs.compaction_history:
+        # bounded deque: copy before iterating (a finishing compaction
+        # appends concurrently)
+        for st in _snapshot(cfs.compaction_history):
             out.append({"table": cfs.table.full_name(), **st})
     return out
 
@@ -732,6 +751,57 @@ def pipelinestats(engine) -> dict:
     system_views.pipelines vtable serves the same rows)."""
     from ..utils import pipeline_ledger
     return pipeline_ledger.snapshot_all()
+
+
+def metricshistory(engine, name: str | None = None,
+                   resolution: str = "raw",
+                   limit: int = 50, rate: bool = False) -> dict:
+    """nodetool metricshistory [name=<metric>] [resolution=raw|coarse]
+    [limit=N] [rate=true]: the retained metrics time series
+    (service/history.py). Without `name`, lists the series and the
+    sampler state; with it, returns the newest `limit` buckets (and
+    the derived per-second counter rate when rate=true). The
+    system_views.metrics_history vtable serves the same rows."""
+    svc = engine.metrics_history
+    if name is None:
+        return {**svc.stats(), "series_names": svc.names()}
+    out = {"name": name, "resolution": resolution,
+           "buckets": svc.query(name, resolution, limit=int(limit))}
+    if rate:
+        out["rate_per_s"] = svc.rate(name, limit=int(limit))
+    return out
+
+
+def clusterstats(node, timeout: float = 2.0) -> dict:
+    """nodetool clusterstats: the one-screen RF-aware cluster view —
+    every peer's telemetry snapshot pulled over the METRICS_SNAPSHOT
+    verb (local node served directly), with per-node staleness stamps:
+    a dark node's row carries its LAST known snapshot and how stale it
+    is, never a hang (the pull is bounded by `timeout`)."""
+    pulled = node.pull_cluster_telemetry(timeout=float(timeout))
+    keyspaces = {}
+    for ksname, ks in node.schema.keyspaces.items():
+        rep = dict(getattr(ks.params, "replication", {}) or {})
+        rf = rep.get("replication_factor")
+        keyspaces[ksname] = {
+            "replication": rep,
+            "rf": int(rf) if rf is not None else None,
+        }
+    screen = []
+    for row in pulled["nodes"]:
+        snap = row.get("snapshot") or {}
+        tabs = snap.get("tables", {})
+        wa = {t: v.get("write_amplification") for t, v in tabs.items()}
+        screen.append(
+            f"{row['endpoint']:>8} "
+            f"{'UP' if row['alive'] else 'DOWN':>4} "
+            f"stale={'-' if row['stale_s'] is None else round(row['stale_s'], 2)} "
+            f"writes={snap.get('storage_writes', '-')} "
+            f"pending_compactions={snap.get('compactions', {}).get('compaction.pending_tasks', '-')} "
+            f"wa={wa}")
+    return {"nodes": pulled["nodes"], "keyspaces": keyspaces,
+            "ring_size": len(node.ring.endpoints),
+            "screen": screen}
 
 
 def disableautocompaction(engine) -> dict:
@@ -1110,13 +1180,19 @@ def gcstats(node=None, engine=None) -> dict:
             "tracked_objects": len(gc.get_objects())}
 
 
-def tablehistograms(engine, keyspace: str | None = None) -> dict:
-    """nodetool tablehistograms: per-table size/cell distributions from
-    live sstable metadata (tools/nodetool/TableHistograms.java)."""
+def tablehistograms(engine, keyspace: str | None = None,
+                    table: str | None = None) -> dict:
+    """nodetool tablehistograms [<ks> [<table>]]: per-table
+    distributions (tools/nodetool/TableHistograms.java) — reference
+    parity: read/write latency and SSTables-per-read percentiles from
+    the live decaying histograms, beside the size/cell/partition
+    distributions from sstable metadata."""
     out = {}
     for cfs in engine.stores.values():
         t = cfs.table
         if keyspace and t.keyspace != keyspace:
+            continue
+        if table and t.name != table:
             continue
         live = cfs.live_sstables()
         sizes = sorted(s.data_size for s in live)
@@ -1125,12 +1201,28 @@ def tablehistograms(engine, keyspace: str | None = None) -> dict:
 
         def pct(v, p):
             return v[min(len(v) - 1, int(len(v) * p))] if v else 0
+
+        def latency(h):
+            s = h.summary()   # one consistent read per hist
+            return {"p50_us": s["p50_us"], "p95_us": s["p95_us"],
+                    "p99_us": s["p99_us"], "max_us": s["max_us"],
+                    "count": s["count"]}
+        spr = cfs.sstables_per_read.summary()
         out[t.full_name()] = {
             "sstables": len(live),
             "data_size": {"p50": pct(sizes, 0.5), "max": pct(sizes, 1.0)},
             "cells": {"p50": pct(cells, 0.5), "max": pct(cells, 1.0)},
             "partitions": {"p50": pct(parts, 0.5),
                            "max": pct(parts, 1.0)},
+            "read_latency": latency(cfs.read_hist),
+            "write_latency": latency(cfs.write_hist),
+            # the hist records sstables CONSULTED per point read, so
+            # the "_us" summary keys are unit-less counts here
+            "sstables_per_read": {"p50": spr["p50_us"],
+                                  "p95": spr["p95_us"],
+                                  "p99": spr["p99_us"],
+                                  "max": spr["max_us"],
+                                  "count": spr["count"]},
         }
     return out
 
@@ -1639,6 +1731,7 @@ for _name, _target in [
         ("gettraces", "engine"), ("exportmetrics", "engine"),
         ("diagnostics", "engine"), ("flightrecorder", "engine"),
         ("pipelinestats", "engine"), ("slostats", "engine"),
+        ("metricshistory", "engine"), ("clusterstats", "node"),
         ("disableautocompaction", "engine"),
         ("enableautocompaction", "engine"),
         ("statusautocompaction", "engine"),

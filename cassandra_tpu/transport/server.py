@@ -554,6 +554,7 @@ class _Dispatcher:
             if item is None:
                 return
             conn, stream, opcode, body = item
+            billed = False
             try:
                 try:
                     op, rsp = srv._dispatch(srv.processor, conn,
@@ -561,6 +562,15 @@ class _Dispatcher:
                                             opcode, body)
                 except Exception as e:
                     op, rsp = _error_response(e)
+                # bill the ledger BEFORE the response leaves: a client
+                # that has already READ its response must be able to
+                # observe this request's dispatch busy/items — billing
+                # after send_envelope raced exactly that observation
+                # (the send only enqueues to the out buffer anyway;
+                # the socket write is the loop thread's work)
+                self._stage.add_busy(time.monotonic() - t0)
+                self._stage.add_items(1, len(body))
+                billed = True
                 try:
                     conn.send_envelope(0x80 | (conn.version or 0x04),
                                        stream, op, rsp)
@@ -573,8 +583,9 @@ class _Dispatcher:
                     conn.loop.call(
                         lambda c=conn: c.loop.close_conn(c))
             finally:
-                self._stage.add_busy(time.monotonic() - t0)
-                self._stage.add_items(1, len(body))
+                if not billed:   # _error_response itself raised
+                    self._stage.add_busy(time.monotonic() - t0)
+                    self._stage.add_items(1, len(body))
                 with conn.wlock:
                     conn.in_flight -= 1
                 srv.permits.release()

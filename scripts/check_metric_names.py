@@ -64,9 +64,9 @@ SINGLE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 # groups" + the mesh.* group from docs/multichip.md)
 KNOWN_GROUPS = {
     "audit", "client_requests", "clients", "commitlog", "compaction",
-    "compress_pool", "cql", "flush", "hints", "mesh", "pipeline",
-    "prepared_statements", "reads", "request", "slo", "storage",
-    "system", "table", "verb",
+    "compress_pool", "cql", "flush", "hints", "history", "mesh",
+    "pipeline", "prepared_statements", "reads", "request", "slo",
+    "storage", "system", "table", "verb",
 }
 
 
@@ -247,11 +247,18 @@ def smoke_emitted() -> set[str]:
             # one counted disk failure through the policy funnel
             # (best_effort: nothing stops)
             eng.failures.handle_disk(OSError(5, "smoke"), "smoke-path")
+            # observatory: one on-demand history sample (history.samples
+            # counter) — the retained-series layer must stay catalogued
+            eng.metrics_history.sample()
             emitted = set(GLOBAL.snapshot())
             emitted |= set(eng.compactions.gauges())
             for st in eng.stores.values():
                 basek = f"table.{st.table.keyspace}.{st.table.name}"
                 emitted |= {f"{basek}.{k}" for k in st.metrics}
+                # derived per-table amplification gauges (served by the
+                # metrics vtable beside the counter dict)
+                emitted |= {f"{basek}.{k}"
+                            for k in st.amplification()}
         finally:
             eng.close()
             diagnostics.GLOBAL.reset()

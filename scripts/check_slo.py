@@ -121,6 +121,15 @@ def run_check(base_dir: str) -> list[str]:
                  "bundle trigger lacks the scenario id")
             need("metrics" in bundle.get("final", {}),
                  "bundle lacks the final metrics capture")
+            # observatory: every bundle carries a non-empty retained
+            # metrics-history window (a dump-time sample guarantees
+            # at least the moment-of point even with the sampler off)
+            # and the pipeline-ledger stage table
+            mh = bundle.get("metrics_history", {})
+            need(bool(mh) and any(pts for pts in mh.values()),
+                 "bundle metrics-history window is empty")
+            need("pipeline_ledger" in bundle,
+                 "bundle lacks the pipeline-ledger stage table")
 
         # --- budget burn while breaching; exhaustion publishes once
         clock.t += 1.5

@@ -1,0 +1,437 @@
+"""Workload observatory (docs/observability.md layer 5): retained
+metrics history (injected-clock determinism, ring eviction edges,
+counter rates over ring wrap), per-table amplification accounting
+(same bytes -> same WA/SA across every A/B leg of the data plane),
+bounded compaction history, cluster-wide telemetry pulls (incl. the
+dark-node staleness path), and the flight-recorder bundle's history
+window + pipeline-ledger table."""
+import json
+import os
+import time
+
+import pytest
+
+from cassandra_tpu.config import Config, Settings
+from cassandra_tpu.service.history import MetricsHistoryService
+
+
+class _Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ------------------------------------------------------ history rings --
+
+
+def _svc(values: dict, clock=None, **kw):
+    """A service with an injected clock and an injected capture source
+    (the dict is read live, so tests mutate it between samples)."""
+    kw.setdefault("raw_capacity", 6)
+    kw.setdefault("raw_per_coarse", 3)
+    kw.setdefault("coarse_capacity", 2)
+    return MetricsHistoryService(clock=clock or _Clock(),
+                                 collect_fn=lambda: dict(values), **kw)
+
+
+def test_sample_downsample_query_round_trip():
+    vals = {"x.counter": 1.0}
+    clock = _Clock()
+    svc = _svc(vals, clock)
+    for v in (1.0, 5.0, 3.0):
+        vals["x.counter"] = v
+        clock.t += 10.0
+        svc.sample()
+    raw = svc.query("x.counter", "raw")
+    assert [b["last"] for b in raw] == [1.0, 5.0, 3.0]
+    assert all(b["min"] == b["max"] == b["last"] == b["sum"]
+               and b["n"] == 1 for b in raw)
+    assert [b["t1"] for b in raw] == [110.0, 120.0, 130.0]
+    # 3 raw samples == raw_per_coarse: exactly one sealed coarse
+    # bucket, min/max/last/sum/n-preserving
+    coarse = svc.query("x.counter", "coarse")
+    assert coarse == [{"t0": 110.0, "t1": 130.0, "min": 1.0,
+                       "max": 5.0, "last": 3.0, "sum": 9.0, "n": 3}]
+    assert svc.query("x.counter", "raw", limit=2) == raw[-2:]
+    assert svc.query("nope", "raw") == []
+    with pytest.raises(ValueError):
+        svc.query("x.counter", "weekly")
+
+
+def test_ring_eviction_edges_preserve_coarse_history():
+    vals = {"x.c": 0.0}
+    clock = _Clock()
+    svc = _svc(vals, clock)
+    for i in range(1, 9):   # 8 samples into a raw ring of 6
+        vals["x.c"] = float(i)
+        clock.t += 10.0
+        svc.sample()
+    raw = svc.query("x.c", "raw")
+    assert [b["last"] for b in raw] == [3.0, 4.0, 5.0, 6.0, 7.0, 8.0]
+    # coarse buckets sealed at samples 3 and 6 — the first one's raw
+    # constituents (1, 2, 3) are PARTIALLY evicted from the raw ring,
+    # yet the sealed bucket still carries them (fold-at-sample-time)
+    coarse = svc.query("x.c", "coarse")
+    assert [(b["min"], b["max"], b["sum"], b["n"]) for b in coarse] \
+        == [(1.0, 3.0, 6.0, 3), (4.0, 6.0, 15.0, 3)]
+    # coarse_capacity=2: a third sealed bucket evicts the oldest
+    for i in range(9, 12):
+        vals["x.c"] = float(i)
+        clock.t += 10.0
+        svc.sample()
+    coarse = svc.query("x.c", "coarse")
+    assert len(coarse) == 2
+    assert coarse[0]["min"] == 4.0 and coarse[-1]["max"] == 9.0
+
+
+def test_counter_rate_over_ring_wrap_and_reset():
+    vals = {"c": 0.0}
+    clock = _Clock()
+    svc = _svc(vals, clock)
+    for i in range(1, 11):   # 10 samples, ring keeps 6: wrapped
+        vals["c"] = i * 20.0
+        clock.t += 10.0
+        svc.sample()
+    rates = svc.rate("c")
+    # rates only between RETAINED consecutive samples (5 pairs in a
+    # 6-deep ring), each 20 units / 10 s = 2.0/s
+    assert len(rates) == 5
+    assert all(r["per_s"] == 2.0 for r in rates)
+    # counter reset (engine restart): negative delta clamps to 0
+    vals["c"] = 0.0
+    clock.t += 10.0
+    svc.sample()
+    assert svc.rate("c")[-1]["per_s"] == 0.0
+    assert svc.rate("nope") == []
+
+
+def test_knob_wiring_and_zero_cost_off(tmp_path):
+    from cassandra_tpu.schema import Schema
+    from cassandra_tpu.storage.engine import StorageEngine
+    settings = Settings(Config())
+    eng = StorageEngine(str(tmp_path), Schema(),
+                        commitlog_sync="periodic", settings=settings)
+    try:
+        svc = eng.metrics_history
+        # off by default: NO sampler thread exists (zero-cost rule)
+        assert not svc.enabled
+        before = [t.name for t in __import__("threading").enumerate()]
+        assert "metrics-history" not in before
+        settings.set("metrics_history_enabled", True)
+        assert svc.enabled
+        settings.set("metrics_history_interval", "50ms")
+        assert svc.interval_s == 0.05
+        deadline = time.time() + 5.0
+        while time.time() < deadline and svc.samples < 2:
+            time.sleep(0.02)
+        assert svc.samples >= 2, "running sampler took no samples"
+        settings.set("metrics_history_enabled", False)
+        assert not svc.enabled
+        # retained rings survive the disable
+        assert svc.names()
+    finally:
+        eng.close()
+
+
+# ------------------------------------------------- amplification A/B --
+
+
+def _amplification_leg(base_dir, leg: str, monkeypatch) -> tuple:
+    """One deterministic ingest->flush->compact run; returns the
+    byte-counter tuple + derived WA/SA for identity comparison across
+    data-plane legs."""
+    from cassandra_tpu.schema import Schema, make_table
+    from cassandra_tpu.storage.engine import StorageEngine
+    from cassandra_tpu.storage.mutation import Mutation
+
+    overrides = {"compaction_throughput": 0}
+    if leg == "naive":
+        monkeypatch.setenv("CTPU_WRITE_FASTPATH", "0")
+    else:
+        monkeypatch.setenv("CTPU_WRITE_FASTPATH", "1")
+    if leg == "mesh_pool":
+        overrides["compaction_mesh_devices"] = 2
+        overrides["compaction_compressor_threads"] = 2
+    schema = Schema()
+    schema.create_keyspace("amp")
+    table = make_table("amp", "t", pk=["id"], ck=["c"],
+                       cols={"id": "int", "c": "int", "v": "blob"})
+    schema.add_table(table)
+    eng = StorageEngine(os.path.join(base_dir, leg), schema,
+                        commitlog_sync="periodic",
+                        settings=Settings(Config.load(overrides)))
+    try:
+        cfs = eng.store("amp", "t")
+        vcol = table.columns["v"].column_id
+        for gen in range(3):
+            muts = []
+            for i in range(256):
+                m = Mutation(table.id,
+                             table.serialize_partition_key([i % 32]))
+                m.add(table.serialize_clustering([gen * 256 + i]),
+                      vcol, b"", bytes([i % 251]) * 64, 1_000_000 + i)
+                muts.append(m)
+            eng.apply_batch(muts)
+            cfs.flush()
+        eng.compactions.major_compaction(cfs)
+        m = cfs.metrics
+        amp = cfs.amplification()
+        return ((m["bytes_ingested"], m["bytes_flushed"],
+                 m["bytes_compacted_in"], m["bytes_compacted_out"]),
+                (amp["write_amplification"],
+                 amp["space_amplification"]))
+    finally:
+        eng.close()
+
+
+@pytest.mark.slow
+def test_amplification_identity_across_data_plane_legs(tmp_path,
+                                                       monkeypatch):
+    """Same bytes -> same WA/SA whichever leg of the data plane ran:
+    the write fastpath off (serial flush), the default fast lane, and
+    mesh-2 + compressor-pool-2. The byte counters ARE the gauges'
+    only source, so A/B byte identity must make the gauges identical."""
+    legs = {leg: _amplification_leg(str(tmp_path), leg, monkeypatch)
+            for leg in ("fast", "naive", "mesh_pool")}
+    counters = {leg: v[0] for leg, v in legs.items()}
+    gauges = {leg: v[1] for leg, v in legs.items()}
+    assert counters["fast"] == counters["naive"] == \
+        counters["mesh_pool"], f"byte counters diverged: {counters}"
+    assert gauges["fast"] == gauges["naive"] == gauges["mesh_pool"], \
+        f"WA/SA diverged: {gauges}"
+    assert gauges["fast"][0] > 0.0
+    # a single post-major-compaction sstable has no overlap
+    assert gauges["fast"][1] == 1.0
+
+
+def test_amplification_reconciles_and_overlap_reads_above_one(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("CTPU_WRITE_FASTPATH", "1")
+    from cassandra_tpu.schema import Schema, make_table
+    from cassandra_tpu.storage.engine import StorageEngine
+    from cassandra_tpu.storage.mutation import Mutation
+    schema = Schema()
+    schema.create_keyspace("amp")
+    table = make_table("amp", "t", pk=["id"], ck=["c"],
+                       cols={"id": "int", "c": "int", "v": "blob"})
+    schema.add_table(table)
+    eng = StorageEngine(str(tmp_path), schema,
+                        commitlog_sync="periodic",
+                        settings=Settings(Config()))
+    try:
+        cfs = eng.store("amp", "t")
+        vcol = table.columns["v"].column_id
+        ingested = 0
+        for gen in range(3):   # same keys every generation: overlap 3x
+            muts = []
+            for i in range(64):
+                m = Mutation(table.id,
+                             table.serialize_partition_key([i]))
+                m.add(table.serialize_clustering([i]), vcol, b"",
+                      b"x" * 32, 1_000_000 + gen)
+                muts.append(m)
+            for m in muts:
+                ingested += m.size
+            eng.apply_batch(muts)
+            cfs.flush()
+        m = cfs.metrics
+        assert m["bytes_ingested"] == ingested
+        amp = cfs.amplification()
+        # 3 sstables holding the SAME 64 partitions: SA == 3 exactly
+        assert amp["space_amplification"] == 3.0
+        # no compaction ran yet: WA is flush-only
+        assert amp["write_amplification"] == round(
+            m["bytes_flushed"] / ingested, 6)
+        assert m["bytes_compacted_in"] == 0
+        stats = eng.compactions.major_compaction(cfs)
+        assert m["bytes_compacted_in"] == stats["bytes_read"]
+        assert m["bytes_compacted_out"] == stats["bytes_written"]
+        amp = cfs.amplification()
+        assert amp["space_amplification"] == 1.0
+        assert amp["write_amplification"] == round(
+            (m["bytes_flushed"] + m["bytes_compacted_out"])
+            / ingested, 6)
+        # the metrics vtable serves the same gauges
+        rows = {r["name"]: r["value"] for r in
+                eng.virtual_tables.get("system_views",
+                                       "metrics").rows()}
+        assert rows["table.amp.t.write_amplification"] == \
+            amp["write_amplification"]
+        assert rows["table.amp.t.space_amplification"] == 1.0
+    finally:
+        eng.close()
+
+
+# ----------------------------------------- bounded compaction history --
+
+
+def test_compaction_history_bounded_newest_kept(tmp_path):
+    from cassandra_tpu.schema import Schema
+    from cassandra_tpu.storage.engine import StorageEngine
+    settings = Settings(Config.load({"compaction_history_entries": 3}))
+    eng = StorageEngine(str(tmp_path), Schema(),
+                        commitlog_sync="periodic", settings=settings)
+    try:
+        from cassandra_tpu.schema import make_table
+        eng.schema.create_keyspace("ks")
+        cfs = eng.add_table(make_table(
+            "ks", "t", pk=["k"], cols={"k": "int", "v": "text"}))
+        for i in range(5):
+            cfs.compaction_history.append({"marker": i})
+        assert len(cfs.compaction_history) == 3
+        assert [e["marker"] for e in cfs.compaction_history] \
+            == [2, 3, 4]
+        # hot-set rebinds live stores, newest kept
+        settings.set("compaction_history_entries", 2)
+        assert [e["marker"] for e in cfs.compaction_history] == [3, 4]
+        # <= 0 = unbounded (the pre-bound behavior)
+        settings.set("compaction_history_entries", 0)
+        for i in range(500):
+            cfs.compaction_history.append({"marker": i})
+        assert len(cfs.compaction_history) == 502
+    finally:
+        eng.close()
+
+
+# ------------------------------------------------- cluster telemetry --
+
+
+def test_cluster_pull_with_dark_node(tmp_path):
+    from cassandra_tpu.cluster.node import LocalCluster
+    from cassandra_tpu.cluster.replication import ConsistencyLevel
+    from cassandra_tpu.tools import nodetool
+    c = LocalCluster(3, str(tmp_path), rf=3)
+    try:
+        s = c.session(1)
+        s.execute("CREATE KEYSPACE ks WITH replication = "
+                  "{'class': 'SimpleStrategy', "
+                  "'replication_factor': 3}")
+        s.execute("CREATE TABLE ks.t (k int PRIMARY KEY, v text)")
+        c.node(1).default_cl = ConsistencyLevel.ALL
+        s.keyspace = "ks"
+        for i in range(16):
+            s.execute(f"INSERT INTO ks.t (k, v) VALUES ({i}, 'v{i}')")
+        out = nodetool.clusterstats(c.node(1), timeout=2.0)
+        assert len(out["nodes"]) == 3
+        assert out["keyspaces"]["ks"]["rf"] == 3
+        assert all(r["fresh"] and r["snapshot"] for r in out["nodes"])
+        by_ep = {r["endpoint"]: r for r in out["nodes"]}
+        # replica-side writes visible per node (engine-scoped payload)
+        assert by_ep["node3"]["snapshot"]["tables"]["ks.t"]["writes"] \
+            >= 16
+        assert by_ep["node2"]["snapshot"]["endpoint"] == "node2"
+        # --- one node goes dark: bounded pull, staleness stamp
+        c.stop_node(3)
+        t0 = time.monotonic()
+        out2 = nodetool.clusterstats(c.node(1), timeout=0.5)
+        assert time.monotonic() - t0 < 5.0, "dark-node pull hung"
+        row3 = {r["endpoint"]: r for r in out2["nodes"]}["node3"]
+        assert row3["fresh"] is False
+        assert row3["snapshot"] is not None   # last known snapshot
+        assert row3["stale_s"] is not None and row3["stale_s"] > 0
+        # the dispatch worker survived: traffic still flows (QUORUM)
+        c.node(1).default_cl = ConsistencyLevel.QUORUM
+        rs = s.execute("SELECT v FROM ks.t WHERE k = 3")
+        assert len(list(rs)) == 1
+        # and a repeat pull still answers
+        out3 = nodetool.clusterstats(c.node(1), timeout=0.5)
+        assert len(out3["nodes"]) == 3
+    finally:
+        c.shutdown()
+
+
+# ------------------------------------------------- bundles & surfaces --
+
+
+def test_flight_bundle_carries_history_window_and_ledger(tmp_path):
+    from cassandra_tpu.schema import Schema, make_table
+    from cassandra_tpu.storage.engine import StorageEngine
+    eng = StorageEngine(str(tmp_path), Schema(),
+                        commitlog_sync="periodic",
+                        settings=Settings(Config()))
+    try:
+        eng.schema.create_keyspace("ks")
+        cfs = eng.add_table(make_table(
+            "ks", "t", pk=["k"], cols={"k": "int", "v": "text"}))
+        from cassandra_tpu.storage.mutation import Mutation
+        m = Mutation(cfs.table.id,
+                     cfs.table.serialize_partition_key([1]))
+        m.add(b"", cfs.table.columns["v"].column_id, b"", b"v",
+              1_000_000)
+        eng.apply(m)
+        cfs.flush()
+        # sampler knob OFF: the dump-time sample still guarantees a
+        # non-empty window (the moment-of point)
+        path = eng.flight_recorder.dump("test")
+        with open(path) as fh:
+            bundle = json.load(fh)
+        win = bundle["metrics_history"]
+        assert win and any(win.values())
+        assert "table.ks.t.writes" in win
+        assert "pipeline_ledger" in bundle
+        # time-gated snapshots carry the ledger too
+        assert "pipelines" in bundle["final"]
+    finally:
+        eng.close()
+
+
+def test_metrics_history_vtable_and_nodetool(tmp_path):
+    from cassandra_tpu.schema import Schema
+    from cassandra_tpu.storage.engine import StorageEngine
+    from cassandra_tpu.tools import nodetool
+    eng = StorageEngine(str(tmp_path), Schema(),
+                        commitlog_sync="periodic",
+                        settings=Settings(Config()))
+    try:
+        eng.metrics_history.sample()
+        eng.metrics_history.sample()
+        vt = eng.virtual_tables.get("system_views", "metrics_history")
+        rows = vt.rows()
+        assert rows
+        raws = [r for r in rows if r["name"] == "history.samples"
+                and r["resolution"] == "raw"]
+        assert len(raws) == 2 and raws[-1]["last"] >= 1.0
+        assert all(r["rate_per_s"] >= 0.0 for r in rows)
+        st = nodetool.metricshistory(eng)
+        assert st["samples"] == 2 and "history.samples" \
+            in st["series_names"]
+        one = nodetool.metricshistory(eng, name="history.samples",
+                                      rate=True)
+        assert len(one["buckets"]) == 2 and "rate_per_s" in one
+    finally:
+        eng.close()
+
+
+def test_tablehistograms_latency_percentiles(tmp_path):
+    from cassandra_tpu.cql import Session
+    from cassandra_tpu.schema import Schema
+    from cassandra_tpu.storage.engine import StorageEngine
+    from cassandra_tpu.tools import nodetool
+    eng = StorageEngine(str(tmp_path), Schema(),
+                        commitlog_sync="periodic",
+                        settings=Settings(Config()))
+    try:
+        s = Session(eng)
+        s.execute("CREATE KEYSPACE ks WITH replication = "
+                  "{'class': 'SimpleStrategy', "
+                  "'replication_factor': 1}")
+        s.execute("USE ks")
+        s.execute("CREATE TABLE t (k int PRIMARY KEY, v text)")
+        for i in range(16):
+            s.execute(f"INSERT INTO t (k, v) VALUES ({i}, 'v{i}')")
+        eng.store("ks", "t").flush()
+        for i in range(16):
+            s.execute(f"SELECT v FROM t WHERE k = {i}")
+        th = nodetool.tablehistograms(eng, "ks", "t")["ks.t"]
+        assert th["read_latency"]["count"] >= 16
+        assert th["write_latency"]["count"] >= 16
+        assert th["read_latency"]["p99_us"] > 0
+        # sstables_per_read: every read consulted the one sstable
+        assert th["sstables_per_read"]["count"] >= 16
+        assert th["sstables_per_read"]["max"] >= 1.0
+        # table filter actually filters
+        assert nodetool.tablehistograms(eng, "ks", "nope") == {}
+    finally:
+        eng.close()
