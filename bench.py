@@ -1041,6 +1041,202 @@ def run_observatory_bench(base_dir: str) -> dict:
         eng.close()
 
 
+# ------------------------------------------------------ adaptive bench --
+
+ADAPT_PARTITIONS = 256
+ADAPT_BURSTS = 8
+ADAPT_VALUE_BYTES = 256
+ADAPT_TOMB_FLUSHES = 8
+ADAPT_TOMBS_PER_FLUSH = 2048
+ADAPT_READ_PASSES = 3
+
+ADAPT_STATICS = {
+    "stcs": {"class": "SizeTieredCompactionStrategy"},
+    "lcs": {"class": "LeveledCompactionStrategy",
+            "sstable_size_in_mb": 160, "l0_threshold": 4},
+    "twcs": {"class": "TimeWindowCompactionStrategy",
+             "compaction_window_unit": "HOURS",
+             "compaction_window_size": 1},
+}
+
+
+def _adaptive_leg(base_dir: str, compaction: dict | None,
+                  adaptive: bool) -> dict:
+    """One full 3-phase run: W (8 write bursts, each its own TWCS hour
+    window, one new clustering row per partition per burst — so an
+    unmerged layout spreads every partition over 8 sstables), T (8
+    flushes of already-expired tombstones on a disjoint LOW-timestamp
+    partition range: TWCS drops them rewrite-free, merge strategies pay
+    the decode), R (point partition reads — cost tracks sstables per
+    partition). Static legs pin `compaction`; the adaptive leg starts
+    on default STCS with the controller ON (parked thread, explicit
+    deterministic ticks between chunks). Returns per-phase walls + a
+    workload-constant MiB/s score (higher = better)."""
+    from cassandra_tpu.config import Config, Settings
+    from cassandra_tpu.schema import Schema, TableParams, make_table
+    from cassandra_tpu.storage.cellbatch import FLAG_TOMBSTONE
+    from cassandra_tpu.storage.engine import StorageEngine
+    from cassandra_tpu.storage.mutation import Mutation
+
+    opts = {"compaction_throughput": 0}
+    if adaptive:
+        opts.update({"adaptive_compaction_enabled": True,
+                     "adaptive_compaction_interval": "1h",
+                     "adaptive_compaction_confirm_ticks": 1,
+                     "adaptive_compaction_cooldown": "1ms"})
+    settings = Settings(Config.load(opts))
+    schema = Schema()
+    schema.create_keyspace("ad")
+    params = TableParams(gc_grace_seconds=0)
+    if compaction is not None:
+        params.compaction = dict(compaction)
+    table = make_table("ad", "t", pk=["id"], ck=["c"],
+                       cols={"id": "int", "c": "int", "v": "blob"},
+                       params=params)
+    schema.add_table(table)
+    eng = StorageEngine(os.path.join(base_dir, "eng"), schema,
+                        commitlog_sync="periodic", settings=settings)
+    try:
+        cfs = eng.store("ad", "t")
+        mgr = eng.compactions
+        vcol = table.columns["v"].column_id
+        rng = np.random.default_rng(17)
+        vals = rng.integers(0, 256, (ADAPT_PARTITIONS,
+                                     ADAPT_VALUE_BYTES), dtype=np.uint8)
+
+        def tick():
+            if adaptive:
+                eng.controller.tick()
+                time.sleep(0.002)   # let the 1 ms cooldown lapse
+
+        def drain():
+            mgr.submit_background(cfs)
+            while mgr.run_pending():
+                mgr.submit_background(cfs)
+
+        # --- phase W: hour-spread write bursts
+        hour_us = 3600 * 1_000_000
+        t0 = time.perf_counter()
+        for burst in range(ADAPT_BURSTS):
+            base_ts = (1_000 + burst) * hour_us
+            muts = []
+            for p in range(ADAPT_PARTITIONS):
+                m = Mutation(table.id,
+                             table.serialize_partition_key([p]))
+                m.add(table.serialize_clustering([burst]), vcol, b"",
+                      vals[p].tobytes(), base_ts + p)
+                muts.append(m)
+            eng.apply_batch(muts)
+            cfs.flush()
+            tick()
+            drain()
+        wall_w = time.perf_counter() - t0
+
+        # --- phase T: expired-tombstone backfill purge
+        now = int(time.time())
+        t0 = time.perf_counter()
+        for f in range(ADAPT_TOMB_FLUSHES):
+            muts = []
+            for j in range(ADAPT_TOMBS_PER_FLUSH):
+                pid = 100_000 + f * ADAPT_TOMBS_PER_FLUSH + j
+                m = Mutation(table.id,
+                             table.serialize_partition_key([pid]))
+                m.add(table.serialize_clustering([0]), vcol, b"", b"",
+                      1 + f * ADAPT_TOMBS_PER_FLUSH + j,
+                      ldt=now - 7200, flags=FLAG_TOMBSTONE)
+                muts.append(m)
+            eng.apply_batch(muts)
+            cfs.flush()
+            tick()
+            drain()
+        wall_t = time.perf_counter() - t0
+
+        # --- phase R: point partition reads
+        t0 = time.perf_counter()
+        for _ in range(ADAPT_READ_PASSES):
+            for p in range(ADAPT_PARTITIONS):
+                cfs.read_partition(table.serialize_partition_key([p]))
+            tick()
+            drain()
+        wall_r = time.perf_counter() - t0
+
+        total = wall_w + wall_t + wall_r
+        # workload-constant numerator: ingested payload + rows served
+        work_mib = (ADAPT_BURSTS * ADAPT_PARTITIONS * ADAPT_VALUE_BYTES
+                    + ADAPT_READ_PASSES * ADAPT_PARTITIONS
+                    * ADAPT_BURSTS * ADAPT_VALUE_BYTES) / (1 << 20)
+        amp = cfs.amplification()
+        out = {
+            "phase_s": {"write_burst": round(wall_w, 3),
+                        "tombstone": round(wall_t, 3),
+                        "read": round(wall_r, 3)},
+            "total_s": round(total, 3),
+            "score_mib_s": round(work_mib / max(total, 1e-9), 2),
+            "write_amplification": amp["write_amplification"],
+            "space_amplification": amp["space_amplification"],
+            "sstables_end": len(cfs.live_sstables()),
+            "final_strategy": cfs.table.params.compaction["class"],
+        }
+        if adaptive:
+            out["decisions"] = [
+                {k: e.get(k) for k in ("seq", "at_ms", "keyspace",
+                                       "table", "regime", "action",
+                                       "old", "new", "applied",
+                                       "reason")}
+                for e in eng.controller.decisions()]
+        return out
+    finally:
+        eng.close()
+
+
+def run_adaptive_bench(base_dir: str) -> dict:
+    """Adaptive-compaction section (docs/adaptive-compaction.md): the
+    controller-on leg vs each pinned static strategy on the same
+    3-phase shifting workload, paired+interleaved (paired_ab) because
+    this box drifts. Headline: the controller's score geomean ratio vs
+    each static — the close-the-loop claim is that no single static
+    strategy matches the controller across ALL phases."""
+    details: dict = {}
+    paired: dict = {}
+    counters = {"n": 0}
+
+    def leg(tag, compaction, adaptive):
+        d = _adaptive_leg(
+            os.path.join(base_dir, f"{tag}{counters['n']}"),
+            compaction, adaptive)
+        counters["n"] += 1
+        details.setdefault(tag, d)
+        return d["score_mib_s"]
+
+    for name, params in ADAPT_STATICS.items():
+        paired[name] = paired_ab(
+            lambda name=name, params=params: leg(name, params, False),
+            lambda: leg("adaptive", None, True))
+
+    speedups = {n: p["speedup_geomean"] for n, p in paired.items()}
+    best_static = max(paired, key=lambda n: paired[n]["a_geomean"])
+    return {
+        "workload": {"partitions": ADAPT_PARTITIONS,
+                     "bursts": ADAPT_BURSTS,
+                     "tombstone_flushes": ADAPT_TOMB_FLUSHES,
+                     "tombstones_per_flush": ADAPT_TOMBS_PER_FLUSH,
+                     "read_passes": ADAPT_READ_PASSES},
+        "paired": paired,
+        "legs": details,
+        "decision_timeline": details.get("adaptive", {}).get(
+            "decisions", []),
+        "acceptance": {
+            "speedup_vs": speedups,
+            "best_static": best_static,
+            "vs_best_static": speedups[best_static],
+            "wins_gt_1": sum(1 for v in speedups.values() if v > 1.0),
+            "pass": bool(speedups[best_static] >= 1.0
+                         and sum(1 for v in speedups.values()
+                                 if v > 1.0) >= 2),
+        },
+    }
+
+
 def _kernel_probe(table):
     """Two tiny merge rounds through the DEVICE path (on whatever JAX
     backend is active — the pinned CPU one for host engines): the first
@@ -1219,6 +1415,15 @@ def main():
             # a breach-triggered flight-recorder bundle
             "saturation": run_saturation_bench(
                 os.path.join(base, "saturation")),
+            # adaptive compaction controller
+            # (docs/adaptive-compaction.md): controller-on vs each
+            # pinned static strategy on a 3-phase shifting workload
+            # (write burst -> tombstone purge -> read plateau),
+            # paired_ab per pairing, per-phase walls + decision
+            # timeline; acceptance = geomean >= 1.0 vs the best
+            # static and > 1.0 vs at least 2 of 3
+            "adaptive": run_adaptive_bench(
+                os.path.join(base, "adaptive")),
         }
         print(json.dumps(result))
     finally:

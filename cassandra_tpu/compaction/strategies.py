@@ -195,8 +195,9 @@ class TimeWindowCompactionStrategy(AbstractCompactionStrategy):
         from .task import CompactionTask
         expired = self._fully_expired()
         if expired:
-            # dropping needs no merge: rewrite-free task over expired only
-            return CompactionTask(self.cfs, expired)
+            # dropping needs no merge: rewrite-free task over expired
+            # only (task.py _execute_drop — deletes, never decodes)
+            return CompactionTask(self.cfs, expired, drop_only=True)
         windows: dict[int, list[SSTableReader]] = {}
         for s in self.candidates():
             windows.setdefault(self._window_of(s), []).append(s)

@@ -230,7 +230,8 @@ class CompactionTask:
                  compress_pool=None,
                  decode_ahead: bool | None = None,
                  mesh_devices: int | None = None,
-                 device_resident: bool | None = None):
+                 device_resident: bool | None = None,
+                 drop_only: bool = False):
         """engine: 'device' (TPU kernel), 'native' (C++ streaming merge),
         'numpy' (reference path). All three are tested bit-identical.
         Default (engine=None, use_device unset): the native engine when
@@ -333,6 +334,13 @@ class CompactionTask:
         self.round_cells = round_cells or (
             self.ROUND_CELLS_DEVICE if self.engine == "device"
             else self.ROUND_CELLS_HOST)
+        # drop_only: the selecting strategy asserts every input is a
+        # fully-expired tombstone sstable safe to delete without a
+        # rewrite (TWCS expired drop). execute() re-verifies the guard
+        # against the CURRENT live set/memtable and falls back to the
+        # normal merge (which purges correctly) if anything changed
+        # between selection and execution.
+        self.drop_only = bool(drop_only)
         # per-phase wall seconds, accumulated across rounds (published by
         # bench.py -- the breakdown the perf work navigates by)
         self.profile: dict = {}
@@ -652,9 +660,71 @@ class CompactionTask:
         if policy == "best_effort" and bad is not None:
             self.cfs.quarantine_sstable(bad, exc)
 
+    def _drop_safe(self) -> bool:
+        """Re-verify the fully-expired drop guard at EXECUTE time (the
+        selecting strategy checked at selection; a flush or an
+        out-of-order write may have landed since): every input all
+        expired tombstones past gc grace, a quiet memtable, and no
+        other live sstable holding data as old as the input's newest
+        cell within its token span (dropping the tombstones must not
+        resurrect anything they shadow)."""
+        cfs = self.cfs
+        gc_before = timeutil.now_seconds() - \
+            cfs.table.params.gc_grace_seconds
+        if not cfs.memtable.is_empty:
+            return False
+        in_ids = {id(r) for r in self.inputs}
+        others = [o for o in cfs.live_sstables() if id(o) not in in_ids]
+        for s in self.inputs:
+            if s.max_ldt is None or s.max_ldt >= gc_before:
+                return False
+            if s.n_tombstones < s.n_cells:
+                return False
+            if any(o.min_ts is not None and s.max_ts is not None
+                   and o.min_ts <= s.max_ts
+                   and o.min_token() <= s.max_token()
+                   and s.min_token() <= o.max_token()
+                   for o in others):
+                return False
+        return True
+
+    def _execute_drop(self) -> dict:
+        """Rewrite-free expired drop: obsolete the inputs in one
+        lifecycle txn and swap them out of the live view — no decode,
+        no merge, no output writer. Zero compacted bytes land on the
+        amplification counters: that IS the point of the drop."""
+        cfs = self.cfs
+        t0 = time.time()
+        cells_read = sum(r.n_cells for r in self.inputs)
+        txn = LifecycleTransaction(cfs.directory)
+        for r in self.inputs:
+            txn.track_obsolete(r.desc.generation)
+        txn.commit()
+        cfs.tracker.replace(self.inputs, [])
+        if cfs.row_cache is not None:
+            cfs.row_cache.clear()
+        for r in self.inputs:
+            r.release()
+        stats = {
+            "inputs": len(self.inputs), "outputs": 0,
+            "bytes_read": 0, "bytes_written": 0,
+            "cells_read": cells_read, "cells_written": 0,
+            "seconds": time.time() - t0,
+            "read_mib_s": 0.0, "write_mib_s": 0.0,
+            "dropped": True,
+        }
+        rec = getattr(cfs, "record_compaction", None)
+        if rec is not None:
+            rec(stats)
+        elif cfs.compaction_history is not None:
+            cfs.compaction_history.append(stats)
+        return stats
+
     def execute(self) -> dict:
         """Run the compaction; returns stats (reference logs these at
         CompactionTask.java:252-266)."""
+        if self.drop_only and self._drop_safe():
+            return self._execute_drop()
         cfs = self.cfs
         table = cfs.table
         t0 = time.time()

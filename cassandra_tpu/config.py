@@ -286,6 +286,25 @@ class Config:
     # min/max/last/sum-preserving.
     metrics_history_interval: float = spec("duration", 10.0,
                                            mutable=True)
+    # adaptive compaction controller (control/loop.py, ROADMAP item 1):
+    # the observe/decide/actuate loop over the metrics-history rings and
+    # amplification gauges. OFF by default — while disabled no decision
+    # thread exists and nothing is classified (the diagnostic-bus
+    # zero-cost rule); `tick()` stays callable on demand. ENGINE-scoped
+    # like metrics_history_enabled: each engine owns its controller.
+    adaptive_compaction_enabled: bool = mut(False)
+    # fixed decision interval ("30s"); hot-reloadable — a parked loop
+    # wakes and applies the new period immediately.
+    adaptive_compaction_interval: float = spec("duration", 30.0,
+                                               mutable=True)
+    # per-table cooldown after an applied strategy change: no further
+    # strategy change for the table inside this window (the anti-flap
+    # half of the hysteresis policy, docs/adaptive-compaction.md).
+    adaptive_compaction_cooldown: float = spec("duration", 300.0,
+                                               mutable=True)
+    # consecutive ticks a CANDIDATE regime must persist before the
+    # controller actuates it (the confirmation half of hysteresis).
+    adaptive_compaction_confirm_ticks: int = mut(2)
     # bound on ColumnFamilyStore.compaction_history (newest kept):
     # the per-compaction stats ring behind compactionhistory /
     # system_views.compaction_history. <= 0 = unbounded (the
@@ -386,8 +405,12 @@ class Settings:
             raise ConfigError(f"unknown setting: {name!r}")
         return getattr(self.config, name)
 
-    def set(self, name: str, value) -> None:
-        """Hot-set a mutable setting (validated/coerced like load)."""
+    def set(self, name: str, value, source: str = "operator") -> None:
+        """Hot-set a mutable setting (validated/coerced like load).
+        `source` names the ACTOR for the config.reload diagnostic event:
+        "operator" (nodetool / settings vtable, the default) or
+        "controller" (the adaptive compaction loop) — flight-recorder
+        bundles must distinguish human from controller actuation."""
         f = self._fields.get(name)
         if f is None:
             raise ConfigError(f"unknown setting: {name!r}")
@@ -395,16 +418,18 @@ class Settings:
             raise ConfigError(f"setting {name!r} is not mutable at runtime")
         coerced = Config._coerce(f, value)
         with self._lock:
+            old = getattr(self.config, name)
             setattr(self.config, name, coerced)
             listeners = list(self._listeners.get(name, []))
         for cb in listeners:
             cb(coerced)
         # hot knob reloads are diagnostic events (the flight recorder
-        # wants "what changed right before it broke"); no-op while the
-        # bus is disabled
+        # wants "what changed right before it broke — and WHO changed
+        # it"); no-op while the bus is disabled
         from .service import diagnostics
         diagnostics.publish("config.reload", name=name,
-                            value=repr(coerced))
+                            value=repr(coerced), old=repr(old),
+                            actor=source)
 
     def on_change(self, name: str, cb: Callable) -> None:
         if name not in self._fields:

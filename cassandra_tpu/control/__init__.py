@@ -1,0 +1,7 @@
+"""Adaptive control plane: the observe/decide/actuate loop closing
+ROADMAP item 1 over the PR 13 telemetry substrate. Peer of `service/`
+(which observes) and `analysis/` (which checks): this package DECIDES —
+and actuates exclusively through the existing hot-reload knob machinery
+and strategy re-selection seams, never through side-doors.
+"""
+from .loop import AdaptiveCompactionController  # noqa: F401

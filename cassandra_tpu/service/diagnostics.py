@@ -347,6 +347,18 @@ class FlightRecorder:
                     bundle["metrics_history"] = hist.recent_window()
                 except Exception:
                     bundle["metrics_history"] = {}
+            # adaptive-controller decision tail (control/loop.py):
+            # what the controller DID leading up to the event — with
+            # config.reload actor attribution, a bundle distinguishes
+            # human from controller actuation
+            ctrl = getattr(eng, "controller", None)
+            if ctrl is not None:
+                try:
+                    bundle["controller_decisions"] = \
+                        ctrl.decisions(limit=32)
+                    bundle["controller_state"] = ctrl.stats()
+                except Exception:
+                    bundle["controller_decisions"] = []
             try:
                 bundle["settings"] = [
                     {"name": n, "value": v, "mutable": m}

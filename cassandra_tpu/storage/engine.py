@@ -322,6 +322,26 @@ class StorageEngine:
         if self.settings.get("metrics_history_enabled"):
             self.metrics_history.start()
 
+        # adaptive compaction controller (control/loop.py, ROADMAP
+        # item 1): the observe/decide/actuate loop over the history
+        # rings and amplification gauges above. Engine-scoped and
+        # zero-cost while the mutable adaptive_compaction_enabled knob
+        # is off (no decision thread; tick() stays callable on demand).
+        # Actuation goes only through Settings.set(source="controller")
+        # and the ColumnFamilyStore.set_compaction_params seam.
+        from ..control.loop import AdaptiveCompactionController
+        self.controller = AdaptiveCompactionController(
+            engine=self,
+            interval_s=self.settings.get("adaptive_compaction_interval"))
+        self._controller_enabled_listener = self.controller.set_enabled
+        self.settings.on_change("adaptive_compaction_enabled",
+                                self._controller_enabled_listener)
+        self._controller_interval_listener = self.controller.set_interval
+        self.settings.on_change("adaptive_compaction_interval",
+                                self._controller_interval_listener)
+        if self.settings.get("adaptive_compaction_enabled"):
+            self.controller.start()
+
         # compaction-history ring bound: every store's per-compaction
         # stats deque follows the mutable compaction_history_entries
         # knob (newest kept); stores opened later inherit it in
@@ -600,6 +620,11 @@ class StorageEngine:
         self.settings.remove_listener("compaction_history_entries",
                                       self._ch_capacity_listener)
         self.metrics_history.stop()
+        self.settings.remove_listener("adaptive_compaction_enabled",
+                                      self._controller_enabled_listener)
+        self.settings.remove_listener("adaptive_compaction_interval",
+                                      self._controller_interval_listener)
+        self.controller.stop()
         # withdraw this engine's bus demand (a closed engine must not
         # keep the process bus enabled for nobody)
         from ..service import diagnostics

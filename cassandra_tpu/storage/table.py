@@ -369,6 +369,23 @@ class ColumnFamilyStore:
         return {"write_amplification": round(wa, 6),
                 "space_amplification": round(sa, 6)}
 
+    def set_compaction_params(self, params: dict) -> dict:
+        """Hot-swap the table's compaction params (the ALTER TABLE /
+        adaptive-controller actuation seam). `get_strategy` reads
+        `table.params.compaction` fresh on every selection, so the NEXT
+        selection sees the new strategy; a task already in flight keeps
+        its claimed inputs (CompactionManager's claim registry) and
+        finishes under the OLD plan — the swap is a single reference
+        assignment, never a mutation of the dict a running selection
+        might hold. Returns the previous params; notifies the
+        compaction listener so the new strategy gets a prompt look at
+        the existing sstable set."""
+        old = dict(self.table.params.compaction)
+        self.table.params.compaction = dict(params)
+        if self.compaction_listener:
+            self.compaction_listener(self)
+        return old
+
     def reload_sstables(self) -> None:
         """Pick up sstables written into the directory out-of-band
         (bulk load / sstableloader role). NOT safe concurrently with

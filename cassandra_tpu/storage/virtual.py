@@ -208,6 +208,32 @@ def build_engine_virtuals(engine) -> VirtualSchema:
                        "rate_per_s": 0.0}
     vs.register(VirtualTable(t_mh, mh_rows))
 
+    # --- controller_decisions (control/loop.py): the adaptive
+    # compaction controller's bounded decision ledger — every applied
+    # strategy/knob change and every hysteresis/cooldown/freeze skip,
+    # newest LEDGER_CAPACITY kept. `nodetool autocompaction history`
+    # serves the same rows.
+    t_ctrl = make_table(
+        "system_views", "controller_decisions", pk=["id"],
+        cols={"id": "bigint", "at": "bigint", "keyspace_name": "text",
+              "table_name": "text", "regime": "text", "action": "text",
+              "old": "text", "new": "text", "applied": "boolean",
+              "reason": "text"})
+
+    def controller_rows():
+        ctrl = getattr(engine, "controller", None)
+        for e in (ctrl.decisions() if ctrl else []):
+            yield {"id": e["seq"], "at": e["at_ms"],
+                   "keyspace_name": e.get("keyspace", ""),
+                   "table_name": e.get("table", ""),
+                   "regime": e.get("regime") or "",
+                   "action": e.get("action", ""),
+                   "old": str(e.get("old", "")),
+                   "new": str(e.get("new", "")),
+                   "applied": bool(e.get("applied")),
+                   "reason": e.get("reason", "")}
+    vs.register(VirtualTable(t_ctrl, controller_rows))
+
     t_slow = make_table("system_views", "slow_queries", pk=["id"],
                         cols={"id": "int", "query": "text",
                               "keyspace_name": "text",

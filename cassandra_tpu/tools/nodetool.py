@@ -820,6 +820,34 @@ def statusautocompaction(engine) -> dict:
     return {"running": not getattr(engine.compactions, "paused", False)}
 
 
+def autocompaction(engine, action: str = "status",
+                   limit: int = 20) -> dict:
+    """nodetool autocompaction [status|history|freeze|unfreeze]: the
+    adaptive compaction controller surface (control/loop.py).
+
+    - status: loop/frozen state, tick/decision counters and every
+      table's current regime + recent-window signals;
+    - history: the newest `limit` rows of the bounded decision ledger
+      (the system_views.controller_decisions vtable serves the same);
+    - freeze / unfreeze: keep the loop ticking but apply NOTHING —
+      persisted under the data dir, so the freeze survives an engine
+      restart."""
+    ctrl = engine.controller
+    if action == "status":
+        return {**ctrl.stats(), "tables": ctrl.table_regimes()}
+    if action == "history":
+        return {"decisions": ctrl.decisions(limit=int(limit))}
+    if action == "freeze":
+        ctrl.freeze()
+        return {"controller": "frozen"}
+    if action == "unfreeze":
+        ctrl.unfreeze()
+        return {"controller": "unfrozen"}
+    raise ValueError(
+        f"unknown autocompaction action {action!r} "
+        f"(status|history|freeze|unfreeze)")
+
+
 def disablehandoff(node) -> dict:
     """nodetool disablehandoff: stop storing new hints."""
     node.hints.enabled = False
@@ -1735,6 +1763,7 @@ for _name, _target in [
         ("disableautocompaction", "engine"),
         ("enableautocompaction", "engine"),
         ("statusautocompaction", "engine"),
+        ("autocompaction", "engine"),
         ("disablehandoff", "node"), ("enablehandoff", "node"),
         ("statushandoff", "node"), ("truncatehints", "node"),
         ("statusgossip", "node"), ("statusbinary", "node"),

@@ -64,7 +64,8 @@ SINGLE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 # groups" + the mesh.* group from docs/multichip.md)
 KNOWN_GROUPS = {
     "audit", "client_requests", "clients", "commitlog", "compaction",
-    "compress_pool", "cql", "flush", "hints", "history", "mesh",
+    "compress_pool", "controller", "cql", "flush", "hints", "history",
+    "mesh",
     "pipeline", "prepared_statements", "reads", "request", "slo",
     "storage", "system", "table", "verb",
 }
@@ -250,6 +251,9 @@ def smoke_emitted() -> set[str]:
             # observatory: one on-demand history sample (history.samples
             # counter) — the retained-series layer must stay catalogued
             eng.metrics_history.sample()
+            # control plane: one on-demand decision tick
+            # (controller.ticks counter)
+            eng.controller.tick()
             emitted = set(GLOBAL.snapshot())
             emitted |= set(eng.compactions.gauges())
             for st in eng.stores.values():

@@ -1,0 +1,229 @@
+"""Adaptive compaction controller (cassandra_tpu/control/loop.py):
+injected-clock cadence + hysteresis (no A->B->A flapping inside the
+cooldown window), zero-cost-off, knob hot-enable/disable mid-run,
+frozen state surviving loop and engine restarts, and the Settings.set
+actor attribution the controller's actuation rides on."""
+import time
+
+from cassandra_tpu.config import Config, Settings
+from cassandra_tpu.control.loop import (REGIME_PARAMS,
+                                        AdaptiveCompactionController)
+from cassandra_tpu.schema import Schema, TableParams, make_table
+from cassandra_tpu.service import diagnostics
+from cassandra_tpu.storage.engine import StorageEngine
+from cassandra_tpu.storage.mutation import Mutation
+
+
+def new_engine(tmp_path, **overrides):
+    settings = Settings(Config.load({
+        "compaction_throughput": 0,
+        "adaptive_compaction_confirm_ticks": 2,
+        "adaptive_compaction_cooldown": "100s",
+        **overrides}))
+    schema = Schema()
+    schema.create_keyspace("ks")
+    t = make_table("ks", "t", pk=["id"], ck=["c"],
+                   cols={"id": "int", "c": "int", "v": "text"},
+                   params=TableParams(gc_grace_seconds=0))
+    schema.add_table(t)
+    eng = StorageEngine(str(tmp_path / "data"), schema,
+                        commitlog_sync="batch", settings=settings)
+    return eng, t, eng.store("ks", "t")
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_zero_cost_off(tmp_path):
+    """Default knob off: the engine's controller exists but owns NO
+    thread — and tick() stays callable on demand."""
+    eng, t, cfs = new_engine(tmp_path)
+    assert eng.controller.enabled is False
+    assert eng.controller._thread is None
+    eng.controller.tick()   # on-demand tick needs no running loop
+    assert eng.controller.stats()["ticks"] == 1
+    eng.close()
+
+
+def test_knob_hot_enable_disable_mid_run(tmp_path):
+    """Flipping adaptive_compaction_enabled at runtime starts/stops the
+    decision thread through the knob listener; the interval knob
+    reaches the running loop."""
+    eng, t, cfs = new_engine(tmp_path)
+    eng.settings.set("adaptive_compaction_enabled", True)
+    assert eng.controller.enabled is True
+    eng.settings.set("adaptive_compaction_interval", "50ms")
+    assert eng.controller.interval_s == 0.05
+    deadline = time.monotonic() + 5.0
+    while eng.controller.stats()["ticks"] < 2 \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert eng.controller.stats()["ticks"] >= 2   # the loop is ticking
+    eng.settings.set("adaptive_compaction_enabled", False)
+    assert eng.controller.enabled is False
+    # ledger/hysteresis state survives the disable
+    assert eng.controller.stats()["ticks"] >= 2
+    eng.close()
+
+
+def test_interval_floor(tmp_path):
+    """A 0-second interval knob must not boot a busy-spin loop: the
+    MIN_INTERVAL_S floor applies on construction and on set."""
+    ctrl = AdaptiveCompactionController(interval_s=0.0)
+    assert ctrl.interval_s == ctrl.MIN_INTERVAL_S
+    ctrl.set_interval(0.0)
+    assert ctrl.interval_s == ctrl.MIN_INTERVAL_S
+
+
+def test_hysteresis_confirm_and_cooldown_no_flapping(tmp_path):
+    """Injected-clock decision cadence: a candidate regime needs
+    confirm_ticks consecutive ticks to actuate, and an applied change
+    arms a cooldown inside which the OPPOSITE confirmed regime is
+    skipped (ledger reason `cooldown`) — no A->B->A flapping."""
+    eng, t, cfs = new_engine(tmp_path)
+    clock = FakeClock()
+    ctrl = AdaptiveCompactionController(engine=eng, clock=clock)
+
+    # two write-burst windows -> confirmed at the second tick
+    cfs.metrics["writes"] += 32
+    assert ctrl.tick() == 0          # streak 1 of 2: skipped
+    cfs.metrics["writes"] += 32
+    assert ctrl.tick() >= 1          # confirmed: STCS params + posture
+    assert cfs.table.params.compaction["class"] == \
+        "SizeTieredCompactionStrategy"
+    applied_after_burst = ctrl.stats()["decisions"]
+
+    # read-heavy windows confirmed INSIDE the cooldown: skipped, params
+    # unchanged (no flap)
+    for _ in range(3):
+        cfs.metrics["reads"] += 64
+        ctrl.tick()
+    assert cfs.table.params.compaction["class"] == \
+        "SizeTieredCompactionStrategy"
+    skips = [e for e in ctrl.decisions() if e["reason"] == "cooldown"]
+    assert skips and all(not e["applied"] for e in skips)
+    assert ctrl.stats()["decisions"] == applied_after_burst
+
+    # clock past the cooldown -> the still-confirmed candidate applies
+    clock.t += float(
+        eng.settings.get("adaptive_compaction_cooldown")) + 1.0
+    cfs.metrics["reads"] += 64
+    assert ctrl.tick() >= 1
+    assert cfs.table.params.compaction == REGIME_PARAMS["read_heavy"]
+    ctrl.close()
+    eng.close()
+
+
+def test_time_series_regime_from_tombstone_mix(tmp_path):
+    """Recent-window sstables that are mostly expired tombstones steer
+    the table onto TWCS (the rewrite-free-expiry regime)."""
+    from cassandra_tpu.storage.cellbatch import FLAG_TOMBSTONE
+    eng, t, cfs = new_engine(tmp_path,
+                             adaptive_compaction_confirm_ticks=1)
+    clock = FakeClock()
+    ctrl = AdaptiveCompactionController(engine=eng, clock=clock)
+    now = int(time.time())
+    for p in range(32):
+        m = Mutation(t.id, t.columns["id"].cql_type.serialize(p))
+        ck = t.serialize_clustering([0])
+        m.add(ck, t.columns["v"].column_id, b"", b"", 1_000 + p,
+              ldt=now - 7200, flags=FLAG_TOMBSTONE)
+        eng.apply(m)
+    cfs.flush()
+    assert ctrl.tick() >= 1
+    assert cfs.table.params.compaction == REGIME_PARAMS["time_series"]
+    ctrl.close()
+    eng.close()
+
+
+def test_frozen_survives_loop_and_engine_restart(tmp_path):
+    """freeze() persists as a data-dir marker: a loop restart AND a
+    fresh engine over the same directory both come back frozen; while
+    frozen, confirmed decisions are recorded as skipped and nothing
+    actuates."""
+    eng, t, cfs = new_engine(tmp_path,
+                             adaptive_compaction_confirm_ticks=1)
+    ctrl = AdaptiveCompactionController(engine=eng, clock=FakeClock())
+    ctrl.freeze()
+    cfs.metrics["writes"] += 32
+    assert ctrl.tick() == 0
+    assert cfs.table.params.compaction == \
+        {"class": "SizeTieredCompactionStrategy"}
+    frozen_skips = [e for e in ctrl.decisions()
+                    if e["reason"] == "frozen"]
+    assert frozen_skips and not frozen_skips[0]["applied"]
+    # loop restart keeps the flag
+    ctrl.start()
+    ctrl.stop()
+    assert ctrl.frozen is True
+    ctrl.close()
+    eng.close()
+    # a NEW engine over the same data dir reads the marker back
+    eng2, t2, cfs2 = new_engine(tmp_path,
+                                adaptive_compaction_confirm_ticks=1)
+    assert eng2.controller.frozen is True
+    eng2.controller.unfreeze()
+    assert eng2.controller.frozen is False
+    eng2.close()
+    # and once unfrozen, the marker is gone for the next restart too
+    eng3, t3, cfs3 = new_engine(tmp_path)
+    assert eng3.controller.frozen is False
+    eng3.close()
+
+
+def test_settings_set_actor_attribution(tmp_path):
+    """Satellite: config.reload diagnostic events carry old value, new
+    value and the actor — operator (default) vs controller."""
+    eng, t, cfs = new_engine(tmp_path, diagnostic_events_enabled=True)
+    try:
+        eng.settings.set("concurrent_compactors", 3)
+        eng.settings.set("concurrent_compactors", 1,
+                         source="controller")
+        evs = [e for e in diagnostics.GLOBAL.events()
+               if e.type == "config.reload"
+               and e.fields.get("name") == "concurrent_compactors"]
+        assert len(evs) == 2
+        assert evs[0].fields["actor"] == "operator"
+        assert evs[0].fields["old"] == "1"
+        assert evs[0].fields["value"] == "3"
+        assert evs[1].fields["actor"] == "controller"
+        assert evs[1].fields["old"] == "3"
+        assert evs[1].fields["value"] == "1"
+    finally:
+        eng.close()
+        diagnostics.GLOBAL.reset()
+
+
+def test_decisions_surface_in_vtable_and_nodetool(tmp_path):
+    """Every ledger entry is a system_views.controller_decisions row
+    and a `nodetool autocompaction history` row; freeze/unfreeze round-
+    trips through the nodetool verb."""
+    from cassandra_tpu.tools import nodetool
+    eng, t, cfs = new_engine(tmp_path,
+                             adaptive_compaction_confirm_ticks=1)
+    cfs.metrics["writes"] += 32
+    eng.controller.tick()
+    ledger = eng.controller.decisions()
+    assert ledger
+    vt = eng.virtual_tables.get("system_views", "controller_decisions")
+    rows = list(vt.rows_fn())
+    assert len(rows) == len(ledger)
+    strat_rows = [r for r in rows if r["action"] == "strategy"]
+    assert strat_rows and strat_rows[0]["keyspace_name"] == "ks"
+    assert strat_rows[0]["applied"] is True
+    out = nodetool.run_command("autocompaction", engine=eng,
+                               action="history")
+    assert len(out["decisions"]) == len(ledger)
+    st = nodetool.run_command("autocompaction", engine=eng)
+    assert st["frozen"] is False and "ks.t" in st["tables"]
+    nodetool.run_command("autocompaction", engine=eng, action="freeze")
+    assert eng.controller.frozen is True
+    nodetool.run_command("autocompaction", engine=eng,
+                         action="unfreeze")
+    assert eng.controller.frozen is False
+    eng.close()

@@ -184,6 +184,103 @@ def test_twcs_fully_expired_drop(tmp_path):
     eng.close()
 
 
+def _component_hashes(cfs, gens):
+    """{(generation, component): sha256} for the given generations —
+    the check_compaction_ab.py byte-identity contract."""
+    import hashlib
+    import os
+    out = {}
+    for s in cfs.live_sstables():
+        if s.desc.generation not in gens:
+            continue
+        d = os.path.dirname(s.desc.path("Data.db"))
+        prefix = os.path.basename(s.desc.path("Data.db"))[:-len("Data.db")]
+        for fn in sorted(os.listdir(d)):
+            if not fn.startswith(prefix):
+                continue
+            with open(os.path.join(d, fn), "rb") as f:
+                out[(s.desc.generation, fn[len(prefix):])] = \
+                    hashlib.sha256(f.read()).hexdigest()
+    return out
+
+
+def _burst_fixture(tmp_path, table):
+    """Four identical fixed-timestamp flushes — an STCS bucket one
+    selection away from compacting. Takes a SHARED TableMetadata so
+    the two legs' sstables are byte-comparable (Statistics.db embeds
+    the table id, which make_table mints randomly)."""
+    schema = Schema()
+    schema.create_keyspace("ks")
+    schema.add_table(table)
+    eng = StorageEngine(str(tmp_path / "data"), schema,
+                        commitlog_sync="batch")
+    cfs = eng.store("ks", "t")
+    ts = 1_000_000
+    for gen in range(4):
+        for p in range(32):
+            put(eng, table, p + gen * 32, 0, "v" * 64, ts=ts)
+            ts += 1
+        cfs.flush()
+    return eng, cfs
+
+
+def test_mid_flight_strategy_flip_no_orphan_bytes_identical(tmp_path):
+    """A hot STCS->LCS flip while a compaction task is in flight (the
+    adaptive controller's actuation seam,
+    ColumnFamilyStore.set_compaction_params) must never orphan or
+    re-select the task's inputs: the manager's claim registry refuses
+    the new strategy's overlapping selection, the in-flight task
+    finishes under its OLD plan, and the resulting sstables are
+    byte-identical to a no-flip run."""
+    stcs = {"class": "SizeTieredCompactionStrategy", "min_threshold": 4}
+    table = make_table("ks", "t", pk=["id"], ck=["c"],
+                       cols={"id": "int", "c": "int", "v": "text"},
+                       params=TableParams(compaction=dict(stcs)))
+
+    # --- leg B FIRST: identical fixture, no flip (the flip leg
+    # mutates the SHARED table params, so it must run second)
+    eng_b, cfs_b = _burst_fixture(tmp_path / "b", table)
+    task_b = get_strategy(cfs_b).next_background_task()
+    assert task_b is not None
+    assert eng_b.compactions._claim(cfs_b, task_b.inputs)
+    task_b.execute()
+    eng_b.compactions._release(cfs_b, task_b.inputs)
+    live_b = {s.desc.generation for s in cfs_b.live_sstables()}
+    hashes_b = _component_hashes(cfs_b, live_b)
+    eng_b.close()
+
+    # --- leg A: flip mid-flight
+    eng_a, cfs_a = _burst_fixture(tmp_path / "a", table)
+    mgr = eng_a.compactions
+    task = get_strategy(cfs_a).next_background_task()
+    assert task is not None and len(task.inputs) == 4
+    assert mgr._claim(cfs_a, task.inputs)   # in flight now
+    inputs_a = {s.desc.generation for s in task.inputs}
+    old = cfs_a.set_compaction_params(
+        {"class": "LeveledCompactionStrategy",
+         "sstable_size_in_mb": 160, "l0_threshold": 4})
+    assert old["class"] == "SizeTieredCompactionStrategy"
+    # the NEW strategy sees the same four L0 sstables and wants them —
+    # but the claim registry holds: the manager would DROP this
+    # selection (_execute_task returns None), never double-compact
+    resel = get_strategy(cfs_a).next_background_task()
+    assert resel is not None
+    assert not mgr._claim(cfs_a, resel.inputs)
+    # the in-flight task completes under the OLD (STCS) plan
+    stats = task.execute()
+    mgr._release(cfs_a, task.inputs)
+    assert stats["inputs"] == 4
+    live_a = {s.desc.generation for s in cfs_a.live_sstables()}
+    assert not (inputs_a & live_a)   # inputs replaced, none orphaned
+    out_gens_a = live_a - inputs_a
+    hashes_a = _component_hashes(cfs_a, out_gens_a)
+    eng_a.close()
+
+    assert out_gens_a == live_b
+    assert hashes_a == hashes_b
+    assert len(hashes_a) > 0
+
+
 def test_strategy_registry_covers_all_four(tmp_path):
     """get_strategy resolves every shipped class (the ROADMAP item 3
     note that 'only STCS exists' is stale — pin the roster)."""
