@@ -27,6 +27,15 @@ from .ring import Endpoint
 # verb string, built lazily)
 _VERB_RECEIVED: dict = {}
 
+# replica-shipped trace events per response are CAPPED: a chatty
+# handler (or a pathological loop inside one) must not bloat every RSP
+# payload on the wire. The chronological HEAD is kept — the re-base
+# math on the coordinator (tracing.merge_remote) anchors on the last
+# shipped offset, so a truncated tail just shortens the merged
+# timeline. Drops count under `verb.<rsp-verb>.trace_dropped`.
+TRACE_EVENTS_CAP = 64
+_VERB_TRACE_DROPPED: dict = {}
+
 
 class Verb:
     MUTATION_REQ = "MUTATION_REQ"
@@ -220,6 +229,15 @@ class MessagingService:
 
     def respond(self, original: Message, verb: str, payload,
                 trace_events: list | None = None) -> None:
+        if trace_events is not None \
+                and len(trace_events) > TRACE_EVENTS_CAP:
+            dropped = len(trace_events) - TRACE_EVENTS_CAP
+            trace_events = trace_events[:TRACE_EVENTS_CAP]
+            name = _VERB_TRACE_DROPPED.get(verb)
+            if name is None:
+                name = _VERB_TRACE_DROPPED[verb] = \
+                    f"verb.{verb}.trace_dropped"
+            METRICS.incr(name, dropped)
         msg = Message(verb, payload, self.ep, original.sender,
                       next(self._ids), reply_to=original.id,
                       trace_session=original.trace_session,

@@ -305,6 +305,28 @@ class Config:
     # consecutive ticks a CANDIDATE regime must persist before the
     # controller actuates it (the confirmation half of hysteresis).
     adaptive_compaction_confirm_ticks: int = mut(2)
+    # continuous wall-clock profiler (service/sampler.py, observability
+    # layer 6): the always-on low-overhead ring. OFF by default —
+    # while no engine demands it no sampler thread exists and nothing
+    # is captured (the diagnostic-bus zero-cost rule); on-demand
+    # sessions (`nodetool profiler start`) run regardless of the knob,
+    # and `sample_once()` stays callable. The sampler is PROCESS-global
+    # (threads are process-wide), so the knob follows the bus demand
+    # pattern: each engine adds/withdraws only its own demand.
+    profiler_enabled: bool = mut(False)
+    # sampling period for the wall-clock profiler ("50ms" = 20 Hz);
+    # hot-reloadable — a parked sampler wakes and applies the new
+    # period immediately. Floored at 5 ms so a zero knob cannot boot a
+    # busy-spin sampler.
+    profiler_interval: float = spec("duration", 0.05, mutable=True)
+    # retrace sentinel (service/profiling.py registry): a device
+    # program whose by-shape compile count crosses this budget
+    # publishes a `profile.retrace` diagnostic event and counts every
+    # further recompile in `profile.retraces` — shape-bucket churn is
+    # caught the tick it happens. <= 0 disables the sentinel.
+    # Process-global like the registry (last writer wins across
+    # co-hosted engines, same as the shared device).
+    profiler_retrace_budget: int = mut(16)
     # bound on ColumnFamilyStore.compaction_history (newest kept):
     # the per-compaction stats ring behind compactionhistory /
     # system_views.compaction_history. <= 0 = unbounded (the

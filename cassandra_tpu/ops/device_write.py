@@ -238,10 +238,13 @@ def submit_merge_resident(batches: list[CellBatch], gc_before: int = 0,
     t1 = _time.perf_counter()
     h.out = _resident_program(operands)
     from ..service.profiling import GLOBAL as _kprof
-    _kprof.record_dispatch(
-        "merge.resident",
-        (int(operands["lanes"].shape[0]), int(operands["lanes"].shape[1])),
-        _time.perf_counter() - t1)
+    if _kprof.record_dispatch(
+            "merge.resident",
+            (int(operands["lanes"].shape[0]),
+             int(operands["lanes"].shape[1])),
+            _time.perf_counter() - t1):
+        _kprof.maybe_record_cost("merge.resident", _resident_program,
+                                 (operands,))
     h.mode = "resident"
     if prof is not None:
         prof["pack"] = prof.get("pack", 0.0) + (t1 - t0)
@@ -460,8 +463,12 @@ class DeviceWriteLane:
                 seg["ts_h"], seg["ts_l"], seg["ldt"], seg["ttl"],
                 seg["flags8"], seg["fl"], seg["vr"])
             from ..service.profiling import GLOBAL as _kprof
-            _kprof.record_dispatch("write.serialize", (n,),
-                                   _time.perf_counter() - t_k)
+            if _kprof.record_dispatch("write.serialize", (n,),
+                                      _time.perf_counter() - t_k):
+                _kprof.maybe_record_cost(
+                    "write.serialize", _meta_block_kernel,
+                    (seg["ts_h"], seg["ts_l"], seg["ldt"], seg["ttl"],
+                     seg["flags8"], seg["fl"], seg["vr"]))
             t_k = _time.perf_counter()
             meta = np.asarray(meta_d)
             _kprof.record_execute("write.serialize",

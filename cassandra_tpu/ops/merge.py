@@ -78,6 +78,15 @@ def _lsd_pass(key: jnp.ndarray, perm: jnp.ndarray) -> jnp.ndarray:
     return new_perm
 
 
+# registry-instrumented (service/profiling.py): eager host calls are
+# timed under "merge.lsd_pass"; calls from inside an enclosing trace
+# (_resident_program, shard_map bodies) pass through untimed — the
+# outer program's dispatch owns those
+from ..service.profiling import GLOBAL as _kprof_registry  # noqa: E402
+
+_lsd_pass = _kprof_registry.wrap("merge.lsd_pass", _lsd_pass)
+
+
 def _sort_keys(operands) -> list:
     """Most-significant first: validity, identity lanes, ~ts."""
     lanes = operands["lanes"]
@@ -203,6 +212,11 @@ def reconcile_kernel(operands, perm):
         operands["valid"], operands["ldt"], operands["expiring"],
         operands["cdel"], operands["death"], operands["purge_h"],
         operands["purge_l"], operands["now"], operands["gc_before"], perm)
+
+
+# dual-use like _lsd_pass: host entry ("merge.reconcile") or traced body
+reconcile_kernel = _kprof_registry.wrap("merge.reconcile",
+                                        reconcile_kernel)
 
 
 def merge_reconcile_kernel(operands):
@@ -774,13 +788,16 @@ def submit_merge(batches: list[CellBatch], gc_before: int = 0,
     if fast is not None:
         buf, cfg, meta = fast
         t2 = _time.perf_counter()
-        h.fut = _plane_program_fast(jax.device_put(buf, device), cfg)
+        buf_d = jax.device_put(buf, device)
+        h.fut = _plane_program_fast(buf_d, cfg)
         # jit compiles synchronously inside the dispatch call: the first
         # call per (kernel, padded-shape, cfg) IS the compile — the
         # profiler splits compile vs warm dispatch on exactly that key
-        _kprof.record_dispatch("merge.plane_fast",
-                               (int(buf.shape[0]), cfg),
-                               _time.perf_counter() - t2)
+        if _kprof.record_dispatch("merge.plane_fast",
+                                  (int(buf.shape[0]), cfg),
+                                  _time.perf_counter() - t2):
+            _kprof.maybe_record_cost("merge.plane_fast",
+                                     _plane_program_fast, (buf_d, cfg))
         h.mode, h.meta, h.cfg = "fast", meta, cfg
         h.kernel = "merge.plane_fast"
         if prof is not None:
@@ -803,9 +820,11 @@ def submit_merge(batches: list[CellBatch], gc_before: int = 0,
     t2 = _time.perf_counter()
     planes_d = {k: jax.device_put(v, device) for k, v in planes.items()}
     h.fut = _plane_program(planes_d, cfg)
-    _kprof.record_dispatch("merge.plane_v2",
-                           (int(planes["rank"].shape[0]), cfg),
-                           _time.perf_counter() - t2)
+    if _kprof.record_dispatch("merge.plane_v2",
+                              (int(planes["rank"].shape[0]), cfg),
+                              _time.perf_counter() - t2):
+        _kprof.maybe_record_cost("merge.plane_v2", _plane_program,
+                                 (planes_d, cfg))
     h.mode, h.cfg = "v2", cfg
     h.kernel = "merge.plane_v2"
     if prof is not None:

@@ -334,6 +334,23 @@ class FlightRecorder:
             bundle["pipeline_ledger"] = pipeline_ledger.snapshot_all()
         except Exception:
             pass
+        try:
+            # continuous-profiler section (observability layer 6): the
+            # device-program registry (compile/dispatch/execute +
+            # retraces — a retrace-sentinel event in `events` always
+            # has its per-program evidence here) and the wall-clock
+            # sampler's state + hottest ring stacks
+            from . import profiling as _profiling
+            from . import sampler as _sampler
+            bundle["profile"] = {
+                "device_programs":
+                    _profiling.GLOBAL.snapshot()["kernels"],
+                "retrace_budget": _profiling.GLOBAL.retrace_budget,
+                "sampler": _sampler.GLOBAL.stats(),
+                "flamegraph": _sampler.GLOBAL.collapsed(limit=40),
+            }
+        except Exception:
+            pass
         if eng is not None:
             bundle["node"] = {"data_dir": eng.data_dir}
             # retained metrics-history window (service/history.py):

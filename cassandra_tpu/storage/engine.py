@@ -342,6 +342,33 @@ class StorageEngine:
         if self.settings.get("adaptive_compaction_enabled"):
             self.controller.start()
 
+        # continuous profiler (service/sampler.py + the device-program
+        # registry in service/profiling.py, observability layer 6).
+        # Both are process-global — threads and the accelerator are
+        # process-wide — so the enable knob follows the diagnostic-bus
+        # demand pattern (this engine's knob adds/withdraws only ITS
+        # demand) and the interval/budget knobs land on the shared
+        # singletons (last writer wins, like the shared device).
+        from ..service import profiling as _profiling
+        from ..service import sampler as _sampler
+        self._profiler_enabled_listener = \
+            lambda v: _sampler.GLOBAL.set_demand(id(self), v)
+        self.settings.on_change("profiler_enabled",
+                                self._profiler_enabled_listener)
+        self._profiler_interval_listener = _sampler.GLOBAL.set_interval
+        self.settings.on_change("profiler_interval",
+                                self._profiler_interval_listener)
+        self._retrace_budget_listener = \
+            _profiling.GLOBAL.set_retrace_budget
+        self.settings.on_change("profiler_retrace_budget",
+                                self._retrace_budget_listener)
+        _sampler.GLOBAL.set_interval(
+            self.settings.get("profiler_interval"))
+        _profiling.GLOBAL.set_retrace_budget(
+            self.settings.get("profiler_retrace_budget"))
+        _sampler.GLOBAL.set_demand(
+            id(self), self.settings.get("profiler_enabled"))
+
         # compaction-history ring bound: every store's per-compaction
         # stats deque follows the mutable compaction_history_entries
         # knob (newest kept); stores opened later inherit it in
@@ -625,10 +652,18 @@ class StorageEngine:
         self.settings.remove_listener("adaptive_compaction_interval",
                                       self._controller_interval_listener)
         self.controller.stop()
-        # withdraw this engine's bus demand (a closed engine must not
-        # keep the process bus enabled for nobody)
+        self.settings.remove_listener("profiler_enabled",
+                                      self._profiler_enabled_listener)
+        self.settings.remove_listener("profiler_interval",
+                                      self._profiler_interval_listener)
+        self.settings.remove_listener("profiler_retrace_budget",
+                                      self._retrace_budget_listener)
+        # withdraw this engine's bus + sampler demands (a closed engine
+        # must not keep a process-global service running for nobody)
         from ..service import diagnostics
+        from ..service import sampler as _sampler
         diagnostics.GLOBAL.set_demand(id(self), False)
+        _sampler.GLOBAL.set_demand(id(self), False)
         self.flight_recorder.close()
         self.settings.remove_listener("compaction_throughput",
                                       self._throttle_listener)

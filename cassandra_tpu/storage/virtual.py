@@ -390,6 +390,61 @@ def build_engine_virtuals(engine) -> VirtualSchema:
                    "execute_seconds": secs}
     vs.register(VirtualTable(t_dp, dp_rows))
 
+    # --- device_programs (observability layer 6, the registry view):
+    # the full per-program accounting the generalized registry keeps —
+    # compile vs warm dispatch vs execute, live tracked shapes +
+    # evictions (the bounded-LRU churn signals), past-budget retraces
+    # and the XLA cost analysis where the backend provides one
+    t_dprog = make_table(
+        "system_views", "device_programs", pk=["name"],
+        cols={"name": "text", "calls": "bigint", "compiles": "bigint",
+              "retraces": "bigint", "shape_count": "bigint",
+              "shape_evictions": "bigint", "compile_seconds": "double",
+              "dispatch_seconds": "double", "execute_seconds": "double",
+              "cost_flops": "double", "cost_bytes": "double"})
+
+    def dprog_rows():
+        from ..service.profiling import GLOBAL as kprof
+        for name, k in sorted(kprof.snapshot()["kernels"].items()):
+            yield {"name": name, "calls": k["calls"],
+                   "compiles": k["compiles"],
+                   "retraces": k["retraces"],
+                   "shape_count": k["shape_count"],
+                   "shape_evictions": k["shape_evictions"],
+                   "compile_seconds": k["compile_s"],
+                   "dispatch_seconds": k["dispatch_s"],
+                   "execute_seconds": k["execute_s"],
+                   "cost_flops": k["cost_flops"],
+                   "cost_bytes": k["cost_bytes"]}
+    vs.register(VirtualTable(t_dprog, dprog_rows))
+
+    # --- profiles (observability layer 6, the wall-clock half): the
+    # sampler's folded stacks — the always-on ring plus every live and
+    # retained finished session, hottest stacks first per target
+    t_prof = make_table(
+        "system_views", "profiles", pk=["target"],
+        ck=["stack_id"],
+        cols={"target": "text", "stack_id": "int", "state": "text",
+              "thread": "text", "stack": "text", "samples": "bigint"})
+
+    def prof_rows():
+        from ..service.sampler import GLOBAL as sp
+        st = sp.stats()
+        targets = ["ring"] + st["sessions"] + st["finished_sessions"]
+        for target in targets:
+            try:
+                lines = sp.collapsed(target)
+            except ValueError:
+                continue   # session sealed between stats() and here
+            for i, line in enumerate(lines):
+                body, _, count = line.rpartition(" ")
+                state, tname, *frames = body.split(";")
+                yield {"target": target, "stack_id": i,
+                       "state": state, "thread": tname,
+                       "stack": ";".join(frames),
+                       "samples": int(count)}
+    vs.register(VirtualTable(t_prof, prof_rows))
+
     # --- settings (db/virtual/SettingsTable.java): the typed config,
     # live values, with mutability flag
     t_settings = make_table("system_views", "settings", pk=["name"],

@@ -772,6 +772,46 @@ def metricshistory(engine, name: str | None = None,
     return out
 
 
+def profiler(engine, action: str = "status",
+             session: str | None = None, limit: int = 50) -> dict:
+    """nodetool profiler [start|stop|dump|status]: the continuous
+    wall-clock profiler (service/sampler.py) + device program registry
+    (service/profiling.py) — observability layer 6.
+
+    - start [session=<name>]: open an on-demand profiling window (the
+      sampler thread boots even with `profiler_enabled` off);
+    - stop [session=<id>]: seal a window (newest if unnamed) and
+      return its cpu/blocked split;
+    - dump [session=<id>] [limit=N]: the collapsed-stack flamegraph
+      (hottest first) + split of a session, or of the always-on ring
+      when no session is named — feed the lines to flamegraph.pl
+      as-is;
+    - status: sampler state + the per-program compile/dispatch/execute
+      registry (the system_views.profiles / device_programs vtables
+      serve the same)."""
+    from ..service import profiling as _profiling
+    from ..service import sampler as _sampler
+    sp = _sampler.GLOBAL
+    if action == "start":
+        sid = sp.start_session(name=session)
+        return {"session": sid, "running": sp.running,
+                "interval_s": sp.interval_s}
+    if action == "stop":
+        return sp.stop_session(session)
+    if action == "dump":
+        target = session or "ring"
+        return {"target": target,
+                "split": sp.split(target),
+                "flamegraph": sp.collapsed(target, limit=int(limit))}
+    if action == "status":
+        return {**sp.stats(),
+                "retrace_budget": _profiling.GLOBAL.retrace_budget,
+                "device_programs":
+                    _profiling.GLOBAL.snapshot()["kernels"]}
+    raise ValueError(
+        f"unknown profiler action {action!r} (start|stop|dump|status)")
+
+
 def clusterstats(node, timeout: float = 2.0) -> dict:
     """nodetool clusterstats: the one-screen RF-aware cluster view —
     every peer's telemetry snapshot pulled over the METRICS_SNAPSHOT
@@ -1759,7 +1799,8 @@ for _name, _target in [
         ("gettraces", "engine"), ("exportmetrics", "engine"),
         ("diagnostics", "engine"), ("flightrecorder", "engine"),
         ("pipelinestats", "engine"), ("slostats", "engine"),
-        ("metricshistory", "engine"), ("clusterstats", "node"),
+        ("metricshistory", "engine"), ("profiler", "engine"),
+        ("clusterstats", "node"),
         ("disableautocompaction", "engine"),
         ("enableautocompaction", "engine"),
         ("statusautocompaction", "engine"),

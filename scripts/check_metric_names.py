@@ -66,8 +66,8 @@ KNOWN_GROUPS = {
     "audit", "client_requests", "clients", "commitlog", "compaction",
     "compress_pool", "controller", "cql", "flush", "hints", "history",
     "mesh",
-    "pipeline", "prepared_statements", "reads", "request", "slo",
-    "storage", "system", "table", "verb",
+    "pipeline", "prepared_statements", "profile", "reads", "request",
+    "slo", "storage", "system", "table", "verb",
 }
 
 
@@ -254,6 +254,10 @@ def smoke_emitted() -> set[str]:
             # control plane: one on-demand decision tick
             # (controller.ticks counter)
             eng.controller.tick()
+            # continuous profiler: one on-demand wall-clock capture
+            # (profile.samples counter) — layer 6 must stay catalogued
+            from cassandra_tpu.service.sampler import GLOBAL as _sp
+            _sp.sample_once()
             emitted = set(GLOBAL.snapshot())
             emitted |= set(eng.compactions.gauges())
             for st in eng.stores.values():

@@ -75,3 +75,19 @@ one("cold")
 one("warm1")
 one("warm2")
 one("warm3")
+
+# The same runs as the device-program registry sees them
+# (service/profiling.py — system_views.device_programs): compile vs
+# warm-dispatch vs execute split, live tracked shapes, recompile count
+# past the budget, and XLA cost analysis where the backend reports it.
+from cassandra_tpu.service import profiling  # noqa: E402
+
+snap = profiling.GLOBAL.snapshot()
+for name, k in sorted(snap["kernels"].items()):
+    print(f"{name}: calls={k['calls']} compiles={k['compiles']} "
+          f"shapes={k['shape_count']} evictions={k['shape_evictions']} "
+          f"retraces={k['retraces']} compile={k['compile_s']:.3f}s "
+          f"dispatch={k['dispatch_s']:.3f}s execute={k['execute_s']:.3f}s "
+          f"flops={k['cost_flops']:.0f} bytes={k['cost_bytes']:.0f}")
+for phase, secs in sorted(snap["phases"].items()):
+    print(f"phase {phase}: {secs:.3f}s")
