@@ -195,9 +195,12 @@ def _compact_dir(base_dir, table, cfs=None, **task_kw):
         cfs = ColumnFamilyStore(table, base_dir, commitlog=None)
     cfs.reload_sstables()
     inputs = cfs.tracker.view()
-    engine = os.environ.get("CTPU_BENCH_ENGINE", "native")
-    task = CompactionTask(cfs, inputs, engine=engine,
-                          use_device=engine == "device", **task_kw)
+    # legs may pin their own engine (the sweep's device-compress leg);
+    # everything else inherits the CTPU_BENCH_ENGINE default
+    task_kw.setdefault("engine",
+                       os.environ.get("CTPU_BENCH_ENGINE", "native"))
+    task_kw.setdefault("use_device", task_kw["engine"] == "device")
+    task = CompactionTask(cfs, inputs, **task_kw)
     t0 = time.time()
     stats = task.execute()
     stats["wall"] = time.time() - t0
@@ -275,6 +278,23 @@ def run_compressor_sweep(base_dir, table, cfg, workers=(1, 2, 4)):
                     "wall_s": round(stats["wall"], 3),
                     "compress_s": stats["profile"].get("compress", 0.0)}
         _sh.rmtree(leg_dir, ignore_errors=True)
+    # device-compress leg (ops/device_compress.py): full segments hand
+    # the io thread FINISHED compressed bytes, so the host compress
+    # stage drops out of the pipeline — its residual compress_s is the
+    # device scan + emission, billed where the pool legs bill packing.
+    # Byte identity with every host leg is CI-checked by the
+    # device-compress legs of scripts/check_compaction_ab.py.
+    leg_dir = os.path.join(base_dir, "device")
+    _sh.copytree(pristine, leg_dir)
+    stats = _compact_dir(leg_dir, table, compress_pool=0,
+                         decode_ahead=False, engine="device",
+                         use_device=True, device_compress=True)
+    out["device"] = {
+        "mib_s": round(stats["bytes_read"] / 2**20 / stats["wall"], 2),
+        "wall_s": round(stats["wall"], 3),
+        "compress_s": stats["profile"].get("compress", 0.0),
+        "io_write_s": stats["profile"].get("io_write", 0.0)}
+    _sh.rmtree(leg_dir, ignore_errors=True)
     return out
 
 
@@ -811,6 +831,62 @@ def run_read_bench(base_dir: str) -> dict:
     }
 
 
+# -------------------------------------------------------- dispatch bench --
+
+DISPATCH_WRITES_PER_LEG = 300
+
+
+def run_dispatch_bench(base_dir: str) -> dict:
+    """Verb-dispatch pool scaling (cluster/messaging.py): the QUORUM
+    write class against a 3-node RF=3 LocalCluster with every node's
+    replica-side dispatch pool pinned at 1/2/4 workers
+    (internode_dispatch_threads). verbs/s is the cluster-wide inbound
+    message rate — each QUORUM write costs one MUTATION_REQ per
+    replica plus the response legs — so it tracks replica-side handler
+    throughput, the stage the pool widens. The 1-vs-4 headline goes
+    through paired_ab because coordination rounds on this box drift
+    with scheduling; byte/ack semantics are untouched (the pool only
+    moves handlers off the distributor thread, and the worker-death
+    blast-radius pin lives in tests/test_cluster.py)."""
+    from cassandra_tpu.cluster.node import LocalCluster
+    from cassandra_tpu.cluster.replication import ConsistencyLevel
+
+    c = LocalCluster(3, os.path.join(base_dir, "cluster"), rf=3)
+    try:
+        for n in c.nodes:
+            n.default_cl = ConsistencyLevel.QUORUM
+        s = c.session(1)
+        s.execute("CREATE KEYSPACE bench WITH replication = "
+                  "{'class': 'SimpleStrategy', 'replication_factor': 3}")
+        s.execute("USE bench")
+        s.execute("CREATE TABLE kv (k int PRIMARY KEY, v text)")
+        seq = [0]
+
+        def leg(width: int) -> float:
+            for n in c.nodes:
+                n.messaging.set_dispatch_workers(width)
+            recv0 = sum(n.messaging.metrics["received"]
+                        for n in c.nodes)
+            t0 = time.time()
+            for _ in range(DISPATCH_WRITES_PER_LEG):
+                k = seq[0] = seq[0] + 1
+                s.execute(f"INSERT INTO kv (k, v) VALUES ({k}, 'v{k}')")
+            dt = time.time() - t0
+            recv = sum(n.messaging.metrics["received"]
+                       for n in c.nodes) - recv0
+            return recv / dt
+
+        leg(1)   # warm-up: schema settled, pools spawned
+        out = {f"workers_{w}": {"verbs_s": round(leg(w), 1)}
+               for w in (1, 2, 4)}
+        out["paired_1_vs_4"] = paired_ab(lambda: leg(1),
+                                         lambda: leg(4))
+        out["writes_per_leg"] = DISPATCH_WRITES_PER_LEG
+        return out
+    finally:
+        c.shutdown()
+
+
 # ------------------------------------------------------- frontdoor bench --
 
 FRONTDOOR_KEYS = 4096
@@ -920,6 +996,65 @@ def run_frontdoor_bench(base_dir: str) -> dict:
         engine.close()
 
 
+def _dispatch_p99_before_after(base_dir: str) -> dict:
+    """Matrix write-p99 before/after the verb-dispatch pool: the
+    matrix's kv/zipf QUORUM write class with every node's replica-side
+    pool pinned at 1 worker — the old single-inbound-worker replica
+    path that produced PR 11's breach verdicts — against the auto
+    width, through paired_ab on the leg's client-side write p99.
+    `p99_ratio_auto_vs_1` < 1.0 is recovered headroom."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    import stress as stress_mod
+
+    from cassandra_tpu.client import Cluster
+    from cassandra_tpu.cluster.node import LocalCluster
+    from cassandra_tpu.cluster.replication import ConsistencyLevel
+    from cassandra_tpu.transport import CQLServer
+
+    cluster = LocalCluster(3, os.path.join(base_dir, "ab"), rf=3)
+    servers = [CQLServer(n) for n in cluster.nodes]
+    ports = [srv.port for srv in servers]
+    try:
+        for nn in cluster.nodes:
+            nn.default_cl = ConsistencyLevel.QUORUM
+        s = Cluster("127.0.0.1", ports[0]).connect()
+        for ddl in stress_mod.SAT_DDL:
+            s.execute(ddl)
+        s.close()
+        seed = [100]
+
+        def leg(width: int) -> float:
+            for nn in cluster.nodes:
+                nn.messaging.set_dispatch_workers(width)
+            seed[0] += 1
+            r = stress_mod.run_scenario(
+                ports, "kv", connections=SATURATION_CONNS,
+                ops=SATURATION_OPS_PER_LEG, dist="zipf",
+                key_space=512, write_ratio=1.0, cl="QUORUM",
+                seed=seed[0])
+            return float(r["p99_us"])
+
+        leg(0)   # warm-up: schema + pools settled
+        auto_width = cluster.nodes[0].messaging.dispatch_workers
+        pair = paired_ab(lambda: leg(1), lambda: leg(0))
+        return {
+            "scenario": "kv:zipf write-only (QUORUM)",
+            "auto_width": auto_width,
+            "write_p99_us": {"workers_1": pair["a_geomean"],
+                             "auto": pair["b_geomean"]},
+            "p99_ratio_auto_vs_1": pair["speedup_geomean"],
+            "rounds": pair["rounds"],
+        }
+    finally:
+        for srv in servers:
+            try:
+                srv.close()
+            except Exception:
+                pass
+        cluster.shutdown()
+
+
 def run_saturation_bench(base_dir: str) -> dict:
     """Saturation section (ROADMAP item 5): the scenario matrix from
     scripts/stress.py — zipf/sequential/uniform key streams crossed
@@ -949,6 +1084,10 @@ def run_saturation_bench(base_dir: str) -> dict:
                 for leg in out["legs"].values())
         and ch.get("breached") and ch.get("bundle_has_breach_event")
         and ch.get("scenario_id_in_bundle"))
+    # write-p99 before/after the dispatch pool (the matrix's QUORUM
+    # write class at pool width 1 vs auto) — the headroom record the
+    # breach verdicts asked for
+    out["dispatch_before_after"] = _dispatch_p99_before_after(base_dir)
     return out
 
 
@@ -1561,6 +1700,12 @@ def main():
             # OVERLOADED shedding with in-flight <= the permit cap
             "frontdoor": run_frontdoor_bench(
                 os.path.join(base, "frontdoor")),
+            # verb-dispatch pool scaling (docs/observability.md
+            # messaging rows): cluster-wide verbs/s for the QUORUM
+            # write class at 1/2/4 replica-side dispatch workers,
+            # 1-vs-4 through paired_ab
+            "dispatch": run_dispatch_bench(
+                os.path.join(base, "dispatch")),
             # workload observatory (docs/observability.md layer 5):
             # metrics-history sampler overhead share of a real
             # flush+compaction run (< 1% required even at 40x the

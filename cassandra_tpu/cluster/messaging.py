@@ -13,6 +13,7 @@ transport slots in behind the same send() seam for real deployments.
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 import time
@@ -20,7 +21,19 @@ from dataclasses import dataclass, field
 
 from ..service import tracing
 from ..service.metrics import GLOBAL as METRICS
+from ..utils import pipeline_ledger
 from .ring import Endpoint
+
+
+def auto_dispatch_workers() -> int:
+    """0 = auto resolution for internode_dispatch_threads: replica-side
+    verb handlers are GIL-bound python plus engine calls that release it
+    (storage reads, commitlog appends with fsync), so a small multiple
+    of cores pays for itself by keeping acks flowing while one handler
+    blocks on fsync — but every in-process node spawns its own pool, so
+    the cap stays low (the 3-node dtest cluster runs 3 pools on one
+    box)."""
+    return max(1, min(os.cpu_count() or 2, 4))
 
 
 # metric-name cache for the per-verb received counters (one entry per
@@ -157,7 +170,13 @@ class MessagingService:
     """Per-node messaging endpoint: verb handlers + response callbacks with
     timeouts (net/RequestCallbacks)."""
 
-    def __init__(self, ep: Endpoint, transport: LocalTransport):
+    # how long a surplus/shut-down dispatch worker can linger blocked on
+    # an empty queue before noticing it should exit (CompressorPool's
+    # POLL_SECONDS role)
+    POLL_SECONDS = 0.2
+
+    def __init__(self, ep: Endpoint, transport: LocalTransport,
+                 dispatch_workers: int = 0):
         self.ep = ep
         self.transport = transport
         self.handlers: dict[str, callable] = {}
@@ -167,12 +186,31 @@ class MessagingService:
         self._queue: queue.Queue = queue.Queue()
         self.closed = False
         self.metrics = {"sent": 0, "received": 0, "dropped_timeout": 0,
-                        "process_failures": 0}
+                        "process_failures": 0, "dispatch_worker_deaths": 0}
+        # verb-dispatch pool (the reference's per-Verb handler stages,
+        # net/: inbound requests execute on Stage executors, not the
+        # deserialization thread): the distributor thread routes
+        # response callbacks inline — per-callback-id ordering is the
+        # single-thread total order — and hands verb-handler messages
+        # to `_pool_target` workers over `_dispatch_q`, so replica-side
+        # verbs scale with cores instead of serializing behind one
+        # fsync-bound handler. 0 = auto; hot-resized by the
+        # internode_dispatch_threads knob via set_dispatch_workers().
+        self._dispatch_q: queue.Queue = queue.Queue()
+        self._pool_lock = threading.Lock()
+        self._pool: list[threading.Thread] = []
+        self._pool_target = int(dispatch_workers) if dispatch_workers > 0 \
+            else auto_dispatch_workers()
+        # ledger stage (utils/pipeline_ledger.py): busy = handler
+        # execution, idle = workers parked on an empty dispatch queue,
+        # queue_hwm = verb backlog high-water behind the distributor
+        self._stage = pipeline_ledger.ledger("messaging").stage("dispatch")
+        self._verb_stages: dict[str, object] = {}
         # deterministic-simulation mode: a SimTransport (sim/scheduler.py)
         # carries a scheduler; deliveries and callback timeouts become
         # virtual-time events processed inline on the pumping thread, so
-        # NO worker/reaper threads exist and every interleaving replays
-        # from the scheduler's seed
+        # NO worker/reaper/pool threads exist and every interleaving
+        # replays from the scheduler's seed
         self._sim = getattr(transport, "scheduler", None)
         transport.register(ep, self)
         if self._sim is None:
@@ -181,6 +219,36 @@ class MessagingService:
             self._worker.start()
             self._reaper = threading.Thread(target=self._reap, daemon=True)
             self._reaper.start()
+
+    # ------------------------------------------------------ dispatch pool
+
+    @property
+    def dispatch_workers(self) -> int:
+        return self._pool_target
+
+    def set_dispatch_workers(self, n: int) -> None:
+        """Hot-resize (internode_dispatch_threads; 0 = auto). Growing
+        spawns immediately when the pool is live; shrinking retires
+        surplus workers after their current message."""
+        n = int(n)
+        n = n if n > 0 else auto_dispatch_workers()
+        with self._pool_lock:
+            self._pool_target = n
+            if self._pool and not self.closed:
+                self._spawn_locked()
+
+    def _spawn_locked(self) -> None:
+        while len(self._pool) < self._pool_target:
+            w = threading.Thread(target=self._dispatch_loop, daemon=True,
+                                 name=f"msg-dispatch-{self.ep.name}")
+            self._pool.append(w)
+            w.start()
+
+    def pool_width(self) -> int:
+        """Live worker count (test/telemetry surface — the worker-death
+        blast-radius pin asserts this never shrinks silently)."""
+        with self._pool_lock:
+            return len(self._pool)
 
     # ------------------------------------------------------------- sending
 
@@ -264,25 +332,85 @@ class MessagingService:
         self._queue.put(msg)
 
     def _run(self) -> None:
+        """Distributor: pulls the inbound queue, routes response
+        callbacks INLINE (this thread is the per-callback-id total
+        order — acks for one request can never reorder), and hands
+        verb-handler messages to the dispatch pool."""
         while not self.closed:
             try:
                 msg = self._queue.get(timeout=0.2)
             except queue.Empty:
                 continue
             try:
-                self._process(msg)
+                self._account(msg)
+                if msg.reply_to:
+                    self._process_response(msg)
+                else:
+                    self._dispatch_q.put(msg)
+                    self._stage.note_queue(self._dispatch_q.qsize())
+                    with self._pool_lock:
+                        self._spawn_locked()
             except Exception:
-                # a raising verb handler or response callback must cost
-                # that MESSAGE, never this node's single inbound worker
-                # — a dead worker leaves the node deaf with no trace
-                # (the PR 4/PR 6 silent-daemon-death class, ctpulint
+                # a raising response callback must cost that MESSAGE,
+                # never this node's single distributor thread — a dead
+                # distributor leaves the node deaf with no trace (the
+                # PR 4/PR 6 silent-daemon-death class, ctpulint
                 # worker-loops)
                 self.metrics["process_failures"] += 1
 
-    def _process(self, msg: Message) -> None:
-        """Handle one inbound message: response-callback dispatch or
-        verb-handler execution (the _run loop body; the deterministic
-        simulator calls this directly as a scheduled event)."""
+    def _dispatch_loop(self) -> None:
+        """Pool worker: verb handlers only. A raising handler costs
+        that MESSAGE (process_failures) and nothing else; a handler
+        that escalates past Exception kills this thread, but the death
+        is counted and the worker replaced (_respawn) — the pool never
+        shrinks silently."""
+        me = threading.current_thread()
+        try:
+            while True:
+                with self._pool_lock:
+                    if self.closed or len(self._pool) > self._pool_target:
+                        if me in self._pool:
+                            self._pool.remove(me)
+                        return
+                t_idle = time.monotonic()
+                try:
+                    msg = self._dispatch_q.get(timeout=self.POLL_SECONDS)
+                except queue.Empty:
+                    continue
+                t0 = time.monotonic()
+                self._stage.add_idle(t0 - t_idle)
+                done = False
+                try:
+                    self._process_handler(msg)
+                    done = True
+                except Exception:
+                    self.metrics["process_failures"] += 1
+                    done = True
+                finally:
+                    # BaseException escaping a handler (the kill seam):
+                    # still cost the message before the thread dies
+                    if not done:
+                        self.metrics["process_failures"] += 1
+                    self._stage.add_busy(time.monotonic() - t0)
+                    self._stage.add_items(1)
+        finally:
+            self._respawn(me)
+
+    def _respawn(self, me: threading.Thread) -> None:
+        """Replace a worker that died mid-message. Normal retirement
+        (shutdown / surplus under a shrink) already removed `me` from
+        the pool; a thread still listed here died abnormally, and the
+        pool width must not degrade behind the operator's back."""
+        with self._pool_lock:
+            if me not in self._pool:
+                return
+            self._pool.remove(me)
+            if self.closed:
+                return
+            self.metrics["dispatch_worker_deaths"] += 1
+            self._spawn_locked()
+
+    def _account(self, msg: Message) -> None:
         self.metrics["received"] += 1
         # per-verb group (InternodeInboundTable / per-verb Dropwizard
         # meters): verb.<verb>.received counters in the global registry;
@@ -292,34 +420,58 @@ class MessagingService:
             name = _VERB_RECEIVED[msg.verb] = \
                 f"verb.{msg.verb.lower()}.received"
         METRICS.incr(name)
+
+    def _process(self, msg: Message) -> None:
+        """Handle one inbound message inline: response-callback dispatch
+        or verb-handler execution (the deterministic simulator calls
+        this directly as a scheduled event, so sim runs keep the exact
+        pre-pool single-threaded interleaving)."""
+        self._account(msg)
         if msg.reply_to:
-            if msg.trace_session and msg.trace_events:
-                # replica events merge BEFORE the callback acks — the
-                # waiting coordinator may finish (and persist) the
-                # session the instant the callback fires
-                tracing.record_remote(msg.trace_session, msg.trace_events,
-                                      source=msg.sender.name)
-            with self._cb_lock:
-                cb = self._callbacks.pop(msg.reply_to, None)
-            if cb is not None:
-                on_response, on_failure, _ = cb
-                # a FAILURE_RSP (remote handler raised) is a failure,
-                # never an ack (write/hint acks must mean applied)
-                fn = on_failure if msg.verb == Verb.FAILURE_RSP \
-                    else on_response
-                if fn is not None:
-                    try:
-                        # both callbacks receive the Message, so a
-                        # failure handler can inspect the remote
-                        # error payload (callbacks reaped on timeout
-                        # get the bare id instead — see _reap)
-                        fn(msg)
-                    except Exception:
-                        pass
-            return
+            self._process_response(msg)
+        else:
+            self._process_handler(msg)
+
+    def _process_response(self, msg: Message) -> None:
+        """Response-callback dispatch: distributor-thread (or sim) only,
+        so callbacks for one request id observe a total order."""
+        if msg.trace_session and msg.trace_events:
+            # replica events merge BEFORE the callback acks — the
+            # waiting coordinator may finish (and persist) the
+            # session the instant the callback fires
+            tracing.record_remote(msg.trace_session, msg.trace_events,
+                                  source=msg.sender.name)
+        with self._cb_lock:
+            cb = self._callbacks.pop(msg.reply_to, None)
+        if cb is not None:
+            on_response, on_failure, _ = cb
+            # a FAILURE_RSP (remote handler raised) is a failure,
+            # never an ack (write/hint acks must mean applied)
+            fn = on_failure if msg.verb == Verb.FAILURE_RSP \
+                else on_response
+            if fn is not None:
+                try:
+                    # both callbacks receive the Message, so a
+                    # failure handler can inspect the remote
+                    # error payload (callbacks reaped on timeout
+                    # get the bare id instead — see _reap)
+                    fn(msg)
+                except Exception:
+                    pass
+
+    def _process_handler(self, msg: Message) -> None:
+        """Verb-handler execution (pool workers; inline in sim mode).
+        Bills the per-verb ledger stage so the where-did-the-wall-go
+        table can attribute replica-side time by verb."""
         handler = self.handlers.get(msg.verb)
         if handler is None:
             return
+        # per-verb ledger stage (pipeline.messaging.<verb>.*), created
+        # lazily for verbs this node actually handles
+        vstage = self._verb_stages.get(msg.verb)
+        if vstage is None:
+            vstage = self._verb_stages[msg.verb] = \
+                pipeline_ledger.ledger("messaging").stage(msg.verb.lower())
         rst = token = None
         if msg.trace_session:
             # replica-side session: record handler events under the
@@ -329,6 +481,7 @@ class MessagingService:
                                      source=self.ep.name)
             rst.add(f"{msg.verb} received from {msg.sender.name}")
             token = tracing.activate(rst)
+        t0 = time.monotonic()
         try:
             result = handler(msg)
         except Exception as e:
@@ -338,6 +491,8 @@ class MessagingService:
                                  trace_events=rst.events if rst else None)
             return
         finally:
+            vstage.add_busy(time.monotonic() - t0)
+            vstage.add_items(1)
             if token is not None:
                 tracing.deactivate(token)
         if result is not None:

@@ -54,6 +54,8 @@ class Node:
                 float(_settings.get("max_hint_window")) * 1000.0
             self.hints.enabled = bool(
                 _settings.get("hinted_handoff_enabled"))
+            self.messaging.set_dispatch_workers(
+                int(_settings.get("internode_dispatch_threads")))
             for name, cb_ in (
                     ("phi_convict_threshold",
                      lambda v: setattr(det, "threshold", float(v))),
@@ -61,7 +63,12 @@ class Node:
                      lambda v: setattr(self, "max_hint_window_ms",
                                        float(v) * 1000.0)),
                     ("hinted_handoff_enabled",
-                     lambda v: setattr(self.hints, "enabled", bool(v)))):
+                     lambda v: setattr(self.hints, "enabled", bool(v))),
+                    # attribute re-read at fire time, so a restarted
+                    # node's fresh MessagingService picks up later flips
+                    ("internode_dispatch_threads",
+                     lambda v: self.messaging.set_dispatch_workers(
+                         int(v)))):
                 _settings.on_change(name, cb_)
                 self._settings_subs.append((name, cb_))
         # disk/commit failure policy `stop`/`die`: the engine's failure
@@ -977,6 +984,10 @@ class LocalCluster:
         self._stopped.discard(i)
         n = self.nodes[i - 1]
         n.messaging = MessagingService(n.endpoint, self.transport)
+        _settings = getattr(n.engine, "settings", None)
+        if _settings is not None:
+            n.messaging.set_dispatch_workers(
+                int(_settings.get("internode_dispatch_threads")))
         n.gossiper = Gossiper(n.messaging, [self.nodes[0].endpoint],
                               interval=n.gossiper.interval)
         n.gossiper.on_alive = n._on_peer_alive

@@ -231,6 +231,7 @@ class CompactionTask:
                  decode_ahead: bool | None = None,
                  mesh_devices: int | None = None,
                  device_resident: bool | None = None,
+                 device_compress: bool | None = None,
                  drop_only: bool = False):
         """engine: 'device' (TPU kernel), 'native' (C++ streaming merge),
         'numpy' (reference path). All three are tested bit-identical.
@@ -291,6 +292,15 @@ class CompactionTask:
         (scripts/check_compaction_ab.py device legs). None = on for
         engine='device'; ignored for host engines and under the mesh
         execution mode (mesh shards drain through the host writer).
+        device_compress: device-side block compression for the
+        device-resident lane's full segments (ops/device_compress.py)
+        — the fused policy-scan kernel compresses META + lanes on the
+        device and the host io thread becomes a pwrite pump. None =
+        inherit the engine's hot-reloadable `compaction_device_compress`
+        knob, re-read by the writer PER SEGMENT (a mid-compaction flip
+        moves the work at the next segment boundary); True/False pins
+        it. Output bytes are identical for every choice — the native
+        packer runs the same deterministic policy encoder.
         """
         self.cfs = cfs
         self.inputs = inputs
@@ -331,6 +341,12 @@ class CompactionTask:
         if device_resident is None:
             device_resident = self.engine == "device"
         self.device_resident = device_resident
+        # tri-state like decode_ahead: None = inherit the owning
+        # engine's hot-reloadable `compaction_device_compress` knob
+        # (re-read PER SEGMENT by the writer), True/False = pinned for
+        # this task (AB legs / bench sweeps). Only consulted by the
+        # device-resident write lane; output bytes identical always.
+        self.device_compress = device_compress
         self.round_cells = round_cells or (
             self.ROUND_CELLS_DEVICE if self.engine == "device"
             else self.ROUND_CELLS_HOST)
@@ -375,6 +391,25 @@ class CompactionTask:
             return False
         fn = getattr(self.cfs, "decode_ahead_fn", None)
         return bool(fn()) if fn is not None else True
+
+    def _device_compress_gate(self):
+        """The writer's per-segment device-compress gate: False when
+        this task has no device-resident lane; a pinned bool when
+        device_compress= was explicit; else the owning store's
+        hot-reloadable `compaction_device_compress` closure (never a
+        co-hosted engine's), falling back to the config default for
+        standalone stores. The writer re-reads a callable gate per
+        segment, so mid-compaction knob flips land on segment
+        boundaries."""
+        if not self.device_resident:
+            return False
+        if self.device_compress is not None:
+            return bool(self.device_compress)
+        fn = getattr(self.cfs, "device_compress_fn", None)
+        if fn is not None:
+            return fn
+        from ..config import Config
+        return lambda: bool(Config().compaction_device_compress)
 
     def _engine_merge_fn(self, prof: dict | None,
                          defer_gather: bool = False):
@@ -761,7 +796,8 @@ class CompactionTask:
                                   sum(r.n_partitions for r in self.inputs), 16),
                               prof=prof, threaded_io=self.pipelined_io,
                               compress_pool=self.compress_pool,
-                              metrics_group="compaction")
+                              metrics_group="compaction",
+                              device_compress=self._device_compress_gate())
             w.level = self.level
             # outputs carry the MINIMUM repairedAt of the inputs
             # (CompactionTask.getMinRepairedAt): mixing repaired with

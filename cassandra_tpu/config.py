@@ -147,6 +147,16 @@ class Config:
     # device engine's serial round loop keeps its own submit/collect
     # pipelining instead.
     compaction_decode_ahead: bool = mut(True)
+    # device-side block compression (ops/device_compress.py): device-
+    # resident compaction rounds hand the host segments ALREADY
+    # LZ4-compressed by the policy encoder's fused jax kernel, leaving
+    # the host io thread a pwrite pump. Output bytes are identical on
+    # or off (the native packer runs the same deterministic policy) —
+    # this knob only moves the compress work between device and host.
+    # Engine-scoped and hot-reloadable: the writer re-reads it per
+    # segment, so a mid-compaction flip takes effect at the next
+    # segment boundary. Only device-resident tasks consult it.
+    compaction_device_compress: bool = mut(True)
     compaction_throughput: float = spec("rate", 64.0, mutable=True)
     # modern-yaml name for the same throttle (DataRateSpec
     # compaction_throughput_mib_per_sec). Negative = unset: the engine
@@ -216,6 +226,14 @@ class Config:
     # internode
     storage_port: int = 7000
     internode_compression: str = "none"         # none | all | dc
+    # verb-dispatch pool width per node (cluster/messaging.py): inbound
+    # verb handlers execute on N pool workers behind the distributor
+    # thread, so replica-side verbs scale with cores instead of
+    # serializing behind one fsync-bound handler; response callbacks
+    # stay ordered on the distributor. 0 = auto (one worker per core,
+    # capped — every in-process node runs its own pool). Hot-resizable;
+    # node shutdown withdraws the demand with the pool.
+    internode_dispatch_threads: int = mut(0)
 
     # caches (cassandra.yaml key/row/counter cache section)
     key_cache_size: int = spec("storage", 50 * 1024 * 1024, mutable=True)

@@ -41,6 +41,7 @@ import numpy as np
 
 from ..storage.cellbatch import (DEATH_FLAGS, FLAG_COUNTER,
                                  FLAG_RANGE_BOUND, CellBatch)
+from . import device_compress
 from . import merge as dmerge
 
 _U32 = jnp.uint32
@@ -455,6 +456,8 @@ class DeviceWriteLane:
         self.cols = {k: self.cols[k][n:] for k in RESIDENT_COLS}
         self.pending -= n
         lanes_np = np.ascontiguousarray(np.asarray(seg["lanes"]))
+        dc_state = None
+        dc_compress_s = 0.0
         if n == self.seg_cells:
             # full segment: the fused kernel serializes + reduces stats
             # in one device program; the host sees finished bytes
@@ -476,6 +479,39 @@ class DeviceWriteLane:
             stats = (_uts_pair_to_i64(st[0], st[1]),
                      _uts_pair_to_i64(st[2], st[3]),
                      int(st[4]), int(st[5]), int(st[6]))
+            if w._device_compress_now():
+                # second fused program: lane shuffle + order check +
+                # the policy match scans; the host keeps only the LZ4
+                # wire emission (O(sequences)) and the pwrite pump
+                t_c = _time.perf_counter()
+                try:
+                    planes_d, mbl, mbd, lbl, lbd, order_ok = \
+                        device_compress.segment_scan_kernel(
+                            meta_d, seg["lanes"])
+                    if _kprof.record_dispatch(
+                            "write.compress", (n,),
+                            _time.perf_counter() - t_c):
+                        _kprof.maybe_record_cost(
+                            "write.compress",
+                            device_compress.segment_scan_kernel,
+                            (meta_d, seg["lanes"]))
+                    t_e = _time.perf_counter()
+                    ok = bool(order_ok)
+                    planes_np = np.asarray(planes_d)
+                    scans = ((np.asarray(mbl), np.asarray(mbd)),
+                             (np.asarray(lbl), np.asarray(lbd)))
+                    _kprof.record_execute("write.compress",
+                                          _time.perf_counter() - t_e)
+                except Exception:
+                    # per-segment fallback: the host compress leg takes
+                    # this one; output bytes identical either way
+                    from ..service.metrics import GLOBAL as _METRICS
+                    _METRICS.incr("compaction.device_compress_fallback")
+                else:
+                    if not ok:
+                        raise ValueError("appended cells out of order")
+                    dc_state = (planes_np, scans)
+                dc_compress_s = _time.perf_counter() - t_c
         else:
             # final partial segment: host assembly through the one
             # shared META builder (byte-identical layout by definition)
@@ -494,6 +530,16 @@ class DeviceWriteLane:
                      int(ldt.min()), int(ldt.max()),
                      int(((flags & DEATH_FLAGS) != 0).sum()))
         payload_np = self._take_payload(n)
-        w._acct("serialize", _time.perf_counter() - t0)
+        w._acct("serialize", _time.perf_counter() - t0 - dc_compress_s)
+        if dc_compress_s:
+            w._acct("compress", dc_compress_s)
+        device_pack = None
+        if dc_state is not None:
+            planes_np, scans = dc_state
+
+            def device_pack(attempt, maxlen, _m=meta, _p=planes_np,
+                            _s=scans, _pl=payload_np):
+                return device_compress.pack_device_segment(
+                    _m, _p, _s, _pl, attempt, maxlen)
         w._emit_segment(n, meta, lanes_np, payload_np, self.pk_map,
-                        stats)
+                        stats, device_pack=device_pack)

@@ -29,16 +29,19 @@ extern "C" {
 // bytes before the end; offset in [1, 65535].
 
 static const int MINMATCH = 4;
-// 4096-entry (16KiB) hash table — the same size real lz4's fast path
-// uses (LZ4_MEMORY_USAGE=14). Measured here: a 64K-entry table halves
-// nothing and costs 4x on match-sparse data (256KiB of memset per
-// block + L2-thrashing probes); ratio moves <2% on the compressible
-// meta/lane blocks.
-static const int HASH_LOG = 12;
 
-static inline uint32_t lz4_hash(uint32_t v) {
-    return (v * 2654435761u) >> (32 - HASH_LOG);
-}
+// Restricted distance candidate set for the POLICY match search (see
+// lz4_compress below). All short lags 1..64 (columnar 25-byte META
+// strides, shuffled lane byte-planes, periodic text) plus power-of-two
+// long lags up to the format's 64KiB window. Ascending order is load-
+// bearing: ties on run length resolve to the SMALLEST distance.
+static const int LZ4_NDIST = 73;
+static const uint16_t LZ4_DIST[LZ4_NDIST] = {
+     1,  2,  3,  4,  5,  6,  7,  8,  9, 10, 11, 12, 13, 14, 15, 16,
+    17, 18, 19, 20, 21, 22, 23, 24, 25, 26, 27, 28, 29, 30, 31, 32,
+    33, 34, 35, 36, 37, 38, 39, 40, 41, 42, 43, 44, 45, 46, 47, 48,
+    49, 50, 51, 52, 53, 54, 55, 56, 57, 58, 59, 60, 61, 62, 63, 64,
+    128, 256, 512, 1024, 2048, 4096, 8192, 16384, 32768};
 
 // snappy's reference implementation sizes its table up to 2^14 —
 // tuned separately from LZ4's (the measurements behind HASH_LOG=12
@@ -60,91 +63,85 @@ int64_t lz4_max_compressed(int64_t n) {
     return n + n / 255 + 16;
 }
 
-// first-party implementation (fallback when the system liblz4 is
-// absent) — returns compressed size, or -1 if dst too small
-static int64_t lz4_compress_fb(const uint8_t* src, int64_t srcLen,
-                               uint8_t* dst, int64_t dstCap) {
+// Deterministic POLICY encoder — returns compressed size, or -1 if dst
+// too small.
+//
+// The device-side compressor (ops/device_compress.py) must emit blocks
+// BYTE-IDENTICAL to this host encoder for any pool size × device on/off
+// (check_compaction_ab.py's pinned contract), so the match search is a
+// fixed policy rather than a hash-table heuristic: at every visited
+// position take the longest forward run over the LZ4_DIST candidate
+// set (ties → smallest distance), accept iff ≥ MINMATCH, else advance
+// one byte. A hash-table matcher's output depends on probe/insertion
+// order, which a data-parallel device scan cannot reproduce; an argmax
+// over a fixed distance set is order-free and maps to one vectorized
+// shifted-equality pass per distance.
+static int64_t lz4_compress_policy(const uint8_t* src, int64_t srcLen,
+                                   uint8_t* dst, int64_t dstCap) {
     if (srcLen == 0) {
         if (dstCap < 1) return -1;
         dst[0] = 0;  // token: 0 literals, no match
         return 1;
     }
-    uint32_t table[1 << HASH_LOG];
-    memset(table, 0, sizeof(table));
-
-    const uint8_t* ip = src;
-    const uint8_t* anchor = src;
-    const uint8_t* iend = src + srcLen;
-    // matches may not cover the last 12 bytes (mflimit), and the final
-    // 5 bytes must be literals
-    const uint8_t* mflimit = srcLen > 12 ? iend - 12 : src;
     uint8_t* op = dst;
     uint8_t* oend = dst + dstCap;
-
-    // accelerated skip on incompressible stretches (the reference lz4
-    // "acceleration" scheme: the step between probe positions grows after
-    // consecutive misses, so random data costs ~1 probe per 2 bytes
-    // instead of per byte; format-compatible, ratio barely changes)
-    const int SKIP_TRIGGER = 6;
-    int64_t searchMatchNb = 1 << SKIP_TRIGGER;
-    int64_t step = 1;
-
-    if (srcLen > 12) {
-        ip++;  // first byte can't be a match target
-        while (ip < mflimit) {
-            uint32_t h = lz4_hash(read32(ip));
-            const uint8_t* match = src + table[h];
-            table[h] = (uint32_t)(ip - src);
-            if (match < ip && (ip - match) <= 65535 &&
-                read32(match) == read32(ip)) {
-                searchMatchNb = 1 << SKIP_TRIGGER;
-                step = 1;
-                // extend match forward
-                const uint8_t* mi = match + MINMATCH;
-                const uint8_t* ii = ip + MINMATCH;
-                const uint8_t* matchlimit = iend - 5;
-                while (ii < matchlimit && *ii == *mi) { ii++; mi++; }
-                int64_t matchLen = (ii - ip);
-                int64_t litLen = ip - anchor;
-                // emit sequence
-                int64_t need = 1 + litLen / 255 + 1 + litLen + 2 +
-                               (matchLen - MINMATCH) / 255 + 1;
-                if (op + need > oend) return -1;
-                uint8_t* token = op++;
-                if (litLen >= 15) {
-                    *token = 15 << 4;
-                    int64_t l = litLen - 15;
-                    while (l >= 255) { *op++ = 255; l -= 255; }
-                    *op++ = (uint8_t)l;
-                } else {
-                    *token = (uint8_t)(litLen << 4);
-                }
-                memcpy(op, anchor, litLen);
-                op += litLen;
-                uint16_t off = (uint16_t)(ip - match);
-                *op++ = (uint8_t)off;
-                *op++ = (uint8_t)(off >> 8);
-                int64_t ml = matchLen - MINMATCH;
-                if (ml >= 15) {
-                    *token |= 15;
-                    ml -= 15;
-                    while (ml >= 255) { *op++ = 255; ml -= 255; }
-                    *op++ = (uint8_t)ml;
-                } else {
-                    *token |= (uint8_t)ml;
-                }
-                ip += matchLen;
-                anchor = ip;
-                if (ip < mflimit)
-                    table[lz4_hash(read32(ip - 2))] = (uint32_t)(ip - 2 - src);
+    // matches may not start in the last 12 bytes (format rule); the
+    // final 5 bytes must be literals
+    const int64_t mflimit = srcLen - 12;
+    int64_t pos = 0, anchor = 0;
+    while (pos < mflimit) {
+        const uint32_t cur = read32(src + pos);
+        int64_t bestLen = 0, bestD = 0;
+        for (int k = 0; k < LZ4_NDIST; k++) {
+            const int64_t d = LZ4_DIST[k];
+            if (d > pos) break;  // table ascends: rest are too far back
+            // 4-byte prefilter: runs < MINMATCH are never accepted, so
+            // skipping them leaves the policy's argmax unchanged
+            if (read32(src + pos - d) != cur) continue;
+            int64_t l = MINMATCH;
+            while (pos + l < srcLen && src[pos - d + l] == src[pos + l])
+                l++;
+            if (l > bestLen) { bestLen = l; bestD = d; }
+        }
+        if (bestLen >= MINMATCH) {
+            int64_t matchLen = bestLen;
+            // clamp to the literal tail; pos < mflimit keeps the
+            // clamped length ≥ 8 ≥ MINMATCH
+            if (matchLen > srcLen - 5 - pos) matchLen = srcLen - 5 - pos;
+            int64_t litLen = pos - anchor;
+            int64_t need = 1 + litLen / 255 + 1 + litLen + 2 +
+                           (matchLen - MINMATCH) / 255 + 1;
+            if (op + need > oend) return -1;
+            uint8_t* token = op++;
+            if (litLen >= 15) {
+                *token = 15 << 4;
+                int64_t l = litLen - 15;
+                while (l >= 255) { *op++ = 255; l -= 255; }
+                *op++ = (uint8_t)l;
             } else {
-                ip += step;
-                step = searchMatchNb++ >> SKIP_TRIGGER;
+                *token = (uint8_t)(litLen << 4);
             }
+            memcpy(op, src + anchor, litLen);
+            op += litLen;
+            *op++ = (uint8_t)bestD;
+            *op++ = (uint8_t)(bestD >> 8);
+            int64_t ml = matchLen - MINMATCH;
+            if (ml >= 15) {
+                *token |= 15;
+                ml -= 15;
+                while (ml >= 255) { *op++ = 255; ml -= 255; }
+                *op++ = (uint8_t)ml;
+            } else {
+                *token |= (uint8_t)ml;
+            }
+            pos += matchLen;
+            anchor = pos;
+        } else {
+            pos++;
         }
     }
     // final literals
-    int64_t litLen = iend - anchor;
+    int64_t litLen = srcLen - anchor;
     int64_t need = 1 + litLen / 255 + 1 + litLen;
     if (op + need > oend) return -1;
     uint8_t* token = op++;
@@ -156,7 +153,7 @@ static int64_t lz4_compress_fb(const uint8_t* src, int64_t srcLen,
     } else {
         *token = (uint8_t)(litLen << 4);
     }
-    memcpy(op, anchor, litLen);
+    memcpy(op, src + anchor, litLen);
     op += litLen;
     return op - dst;
 }
@@ -472,35 +469,29 @@ static void byte_transpose(const uint8_t* src, int64_t R, int64_t C,
 }
 
 // ---- system-library fast paths ------------------------------------
-// LZ4/Snappy block formats are fixed public formats, so the system
-// libraries (lz4 1.9 SIMD-tuned, snappy-c) produce bit-compatible
-// blocks 1.4-3.4x faster than the first-party loops on this host.
-// dlopen'd lazily like zstd; the first-party code stays as the
-// fallback so the build has no hard dependency.
-static void* p_lz4_c = nullptr;    // LZ4_compress_default
+// Block formats are fixed public formats, so the system libraries
+// (lz4 1.9 SIMD-tuned, snappy-c) read/write bit-compatible blocks.
+// COMPRESSION no longer defers to liblz4: the encoder is the
+// deterministic policy above, because the device compressor must
+// reproduce its exact bytes and liblz4's hash-table output is not a
+// policy anyone else can replay. DECOMPRESSION keeps the syslib fast
+// path — any valid block decodes to the same bytes regardless of who
+// wrote it, so read speed is free. dlopen'd lazily like zstd; the
+// first-party decoder stays as the fallback so the build has no hard
+// dependency.
 static void* p_lz4_d = nullptr;    // LZ4_decompress_safe
 static pthread_once_t lz4_once = PTHREAD_ONCE_INIT;
 static void lz4_resolve_once() {
     void* h = dlopen("liblz4.so.1", RTLD_NOW | RTLD_GLOBAL);
     if (!h) h = dlopen("liblz4.so", RTLD_NOW | RTLD_GLOBAL);
     if (!h) return;
-    p_lz4_c = dlsym(h, "LZ4_compress_default");
     p_lz4_d = dlsym(h, "LZ4_decompress_safe");
-    if (!p_lz4_c || !p_lz4_d) { p_lz4_c = p_lz4_d = nullptr; }
 }
-typedef int (*lz4_c_fn)(const char*, char*, int, int);
 typedef int (*lz4_d_fn)(const char*, char*, int, int);
 
 int64_t lz4_compress(const uint8_t* src, int64_t srcLen,
                      uint8_t* dst, int64_t dstCap) {
-    pthread_once(&lz4_once, lz4_resolve_once);
-    if (p_lz4_c && srcLen > 0 && srcLen < (1 << 30)
-        && dstCap < (1 << 30)) {
-        int r = ((lz4_c_fn)p_lz4_c)((const char*)src, (char*)dst,
-                                    (int)srcLen, (int)dstCap);
-        return r > 0 ? (int64_t)r : -1;
-    }
-    return lz4_compress_fb(src, srcLen, dst, dstCap);
+    return lz4_compress_policy(src, srcLen, dst, dstCap);
 }
 
 int64_t lz4_decompress(const uint8_t* src, int64_t srcLen,

@@ -395,6 +395,14 @@ class StorageEngine:
         engine's setting never flips this engine's prefetch."""
         return bool(self.settings.get("compaction_decode_ahead"))
 
+    def _device_compress(self) -> bool:
+        """This engine's `compaction_device_compress` knob — read by
+        its device-resident tasks' writers PER SEGMENT, so the hot
+        reload needs no listener and a mid-compaction flip moves the
+        compress work between device and host at the next segment
+        boundary (output bytes identical either way)."""
+        return bool(self.settings.get("compaction_device_compress"))
+
     @property
     def _schema_path(self) -> str:
         return os.path.join(self.data_dir, "schema.json")
@@ -458,6 +466,7 @@ class StorageEngine:
         cfs.backup_enabled = lambda: self.incremental_backup
         cfs.mesh_devices_fn = self._mesh_devices
         cfs.decode_ahead_fn = self._decode_ahead
+        cfs.device_compress_fn = self._device_compress
         cfs.set_compaction_history_capacity(
             self.settings.get("compaction_history_entries"))
         self.compactions.register(cfs)

@@ -173,7 +173,8 @@ class SSTableWriter:
                  prof: dict | None = None,
                  threaded_io: bool = False,
                  compress_pool=None,
-                 metrics_group: str | None = None):
+                 metrics_group: str | None = None,
+                 device_compress=False):
         """prof: optional dict accumulating per-phase wall seconds
         ('compress' = compress+CRC — plus serialization when no pool;
         'serialize' = block prep when a pool carries the compress leg;
@@ -189,7 +190,15 @@ class SSTableWriter:
         back to the serial chain when the fused native packer is
         unavailable (encrypted tables, codecs without a native id).
         metrics_group: service/metrics group prefix ('compaction',
-        'flush') for the compress-stage queue-depth/stall metrics."""
+        'flush') for the compress-stage queue-depth/stall metrics.
+        device_compress: bool or zero-arg callable — whether the
+        device-resident write lane (ops/device_write.py) should hand
+        this writer segments it already compressed on-device. A
+        callable is re-read PER SEGMENT, so a mid-compaction
+        `compaction_device_compress` knob flip takes effect at the
+        next segment boundary; output bytes are identical either way
+        (the device runs the same deterministic policy encoder as the
+        native packer)."""
         self.desc = descriptor
         self.table = table
         self.prof = prof
@@ -207,6 +216,10 @@ class SSTableWriter:
         self._cpool = compress_pool if self._packer is not None else None
         if self._cpool is not None:
             threaded_io = True
+        # device-side block compression gate (bool or callable; the
+        # lane consults it through _device_compress_now per segment)
+        self.device_compress = device_compress if self._packer is not None \
+            else False
         self._threaded_io = threaded_io
         self._io_thread: threading.Thread | None = None
         self._io_error: list[BaseException] = []
@@ -619,6 +632,20 @@ class SSTableWriter:
                                     self._data_crc)
         return struct.pack("<QQI", stored, raw_len, crc)
 
+    def _device_compress_now(self) -> bool:
+        """Whether the NEXT segment should arrive device-compressed:
+        the gate the device write lane consults per segment. Callable
+        gates (the hot-reloadable `compaction_device_compress` knob)
+        re-read here, so a mid-compaction flip moves the compress work
+        between device and host at a segment boundary without touching
+        output bytes. Only the LZ4 policy codec has a device twin."""
+        dc = self.device_compress
+        if not dc:
+            return False
+        if self._packer is None or getattr(self._packer, "_cid", 0) != 1:
+            return False
+        return bool(dc() if callable(dc) else dc)
+
     # --------------------------------------------- parallel compress leg --
 
     def _submit_pack(self, blocks: list, attempt: list[bool],
@@ -653,6 +680,55 @@ class SSTableWriter:
             job.trace.add(f"Compress pool: segment {job.seq} submitted "
                           f"({job.n} cells)")
         self._cpool.submit(lambda: self._run_pack_job(job))
+        self._wq.put(job)   # single producer: queue order == seq order
+        if self._ledger is not None:
+            self._ledger["compress"].note_queue(self._wq.qsize())
+
+    def _submit_packed(self, blocks: list, attempt: list[bool],
+                       need: int, n: int, lane_head: bytes,
+                       lane_tail: bytes, packed, t0: float) -> None:
+        """Enqueue a segment the device already compressed: the job
+        enters the SAME ordered completion queue as pool jobs, born
+        finished (ready pre-set, stored bytes staged in a pack buffer),
+        so device-compressed and pool-compressed segments interleave in
+        submit order and the completion thread cannot tell them apart
+        — entry/digest/write bookkeeping is one code path."""
+        if self._io_error:
+            raise self._io_error[0]   # fail the producer fast
+        if self._io_thread is None:
+            self._io_thread = threading.Thread(
+                target=self._io_loop, name="sstable-io", daemon=True)
+            self._io_thread.start()
+        if faultfs.GLOBAL.active:
+            # same checkpoint the pool workers honour: an injected EIO
+            # must fail the device-compress leg like a real fault, and
+            # unwind through the task's txn rollback
+            faultfs.GLOBAL.check("sstable.compress", self._data_path)
+        total, sizes, crcs, parts = packed
+        buf = self._take_pack_buf(need)
+        if self._ledger is not None:
+            self._ledger["compress"].add_items(1, need)
+        off = 0
+        for p in parts:
+            ln = len(p)
+            buf[off:off + ln] = np.frombuffer(p, dtype=np.uint8)
+            off += ln
+        job = _PackJob(self._seq_submitted - 1, blocks, attempt, buf,
+                       n, lane_head, lane_tail)
+        if self._metrics is not None:
+            self._metrics.incr("compress_segments")
+            self._metrics.incr("device_compress_segments")
+        from ...service import tracing
+        job.trace = tracing.active()
+        if job.trace is not None:
+            job.trace.add(f"Device compress: segment {job.seq} arrived "
+                          f"finished ({job.n} cells)")
+        job.total = int(total)
+        job.sizes = sizes
+        job.crcs = crcs
+        job.compress_s = time.perf_counter() - t0
+        job.blocks = None
+        job.ready.set()
         self._wq.put(job)   # single producer: queue order == seq order
         if self._ledger is not None:
             self._ledger["compress"].note_queue(self._wq.qsize())
@@ -978,7 +1054,8 @@ class SSTableWriter:
 
     def _emit_segment(self, n: int, meta: "np.ndarray",
                       lanes_c: "np.ndarray", payload_b: "np.ndarray",
-                      pk_map: dict, seg_stats: tuple) -> None:
+                      pk_map: dict, seg_stats: tuple,
+                      device_pack=None) -> None:
         """Everything downstream of block serialization for ONE segment:
         ordering guards, partition directory + bloom, stats fold,
         adaptive-skip attempt decision, compress (pool / serial / the
@@ -988,7 +1065,15 @@ class SSTableWriter:
         with blocks its fused kernel built from device arrays — one
         tail, so the two paths cannot diverge on any sequential writer
         state. seg_stats: (min_ts, max_ts, min_ldt, max_ldt,
-        tombstones) computed by whichever side owned the columns."""
+        tombstones) computed by whichever side owned the columns.
+        device_pack: optional (attempt, maxlen) -> (total, sizes,
+        crcs, parts) closure from the device lane — the segment's
+        blocks ALREADY policy-compressed on-device
+        (ops/device_compress.pack_device_segment). Called after the
+        skip-machine attempt decision so device and host legs consume
+        identical attempt vectors; any failure falls back to the host
+        compress leg for THIS segment (counted, never fatal, bytes
+        identical)."""
         # cross-segment ordering guard; the intra-segment check runs
         # inside segment_pack's delta loop (fast path) or the numpy
         # comparison below (fallback path)
@@ -1052,6 +1137,22 @@ class SSTableWriter:
             # CRC + sequential placement, one GIL-released call
             blocks = [meta, lanes_c, payload_b]
             need = sum(b.nbytes for b in blocks)
+            packed = None
+            if device_pack is not None:
+                try:
+                    packed = device_pack(attempt, maxlen)
+                except Exception:
+                    # per-segment fallback: the host leg compresses this
+                    # one; output bytes identical (same policy encoder)
+                    if self._metrics is not None:
+                        self._metrics.incr("device_compress_fallback")
+                    packed = None
+            if packed is not None and self._cpool is not None:
+                self._submit_packed(blocks, attempt, need, n,
+                                    lane_head, lane_tail, packed, t_pack)
+                self._total_cells += n
+                self._last_lane_end = lanes_c[-1].astype(">u4").tobytes()
+                return
             if self._cpool is not None:
                 # parallel leg: the pool compresses this segment while
                 # this thread packs the NEXT one's lanes; the ordered
@@ -1064,29 +1165,50 @@ class SSTableWriter:
                 self._last_lane_end = lanes_c[-1].astype(">u4").tobytes()
                 return
             entry = struct.pack("<QI", self._data_off, n)
-            if self._threaded_io:
-                out = self._take_pack_buf(need)
+            if packed is not None:
+                # device-compressed, serial/threaded completion: same
+                # entry/digest/outcome bookkeeping as the native pack,
+                # fed from the device lane's finished bytes
+                total, sizes, crcs, parts = packed
+                outcome = []
+                for i in range(3):
+                    stored = int(sizes[i])
+                    entry += self._fold_block(stored, blocks[i].nbytes,
+                                              int(crcs[i]))
+                    outcome.append((stored, blocks[i].nbytes, attempt[i]))
+                self._acct_outcomes.put(tuple(outcome))
+                self._acct("compress", time.perf_counter() - t_pack)
+                if self._ledger is not None:
+                    self._ledger["compress"].add_items(1, need)
+                if self._metrics is not None:
+                    self._metrics.incr("device_compress_segments")
+                self._write_all(memoryview(b"".join(parts)))
+                self._data_off += int(total)
+                self._published_off = self._data_off
             else:
-                if self._pack_out is None or self._pack_out.nbytes < need:
-                    self._pack_out = np.empty(need, dtype=np.uint8)
-                out = self._pack_out
-            total, sizes, raws, crcs = self._packer.pack(
-                blocks, attempt, maxlen, shuffle_block=1,
-                lane_width=lanes_c.shape[1], out=out)
-            outcome = []
-            for i in range(3):
-                stored = int(sizes[i])
-                entry += self._fold_block(stored, blocks[i].nbytes,
-                                          int(crcs[i]))
-                outcome.append((stored, blocks[i].nbytes, attempt[i]))
-            self._acct_outcomes.put(tuple(outcome))
-            self._acct("compress", time.perf_counter() - t_pack)
-            if self._ledger is not None:
-                self._ledger["compress"].add_items(1, need)
-            self._write_all(memoryview(out)[:total],
-                            reclaim=out if self._threaded_io else None)
-            self._data_off += total
-            self._published_off = self._data_off
+                if self._threaded_io:
+                    out = self._take_pack_buf(need)
+                else:
+                    if self._pack_out is None or self._pack_out.nbytes < need:
+                        self._pack_out = np.empty(need, dtype=np.uint8)
+                    out = self._pack_out
+                total, sizes, raws, crcs = self._packer.pack(
+                    blocks, attempt, maxlen, shuffle_block=1,
+                    lane_width=lanes_c.shape[1], out=out)
+                outcome = []
+                for i in range(3):
+                    stored = int(sizes[i])
+                    entry += self._fold_block(stored, blocks[i].nbytes,
+                                              int(crcs[i]))
+                    outcome.append((stored, blocks[i].nbytes, attempt[i]))
+                self._acct_outcomes.put(tuple(outcome))
+                self._acct("compress", time.perf_counter() - t_pack)
+                if self._ledger is not None:
+                    self._ledger["compress"].add_items(1, need)
+                self._write_all(memoryview(out)[:total],
+                                reclaim=out if self._threaded_io else None)
+                self._data_off += total
+                self._published_off = self._data_off
         else:
             # per-block fallback (encrypted tables / codecs without a
             # native id). Lanes are still byte-plane shuffled — the

@@ -262,6 +262,12 @@ class ColumnFamilyStore:
         from ..config import Config as _Config
         self.decode_ahead_fn = \
             lambda: bool(_Config().compaction_decode_ahead)
+        # device-side block compression routing follows the same shape:
+        # a StorageEngine points this at ITS hot-reloadable
+        # `compaction_device_compress` setting; a standalone store
+        # reads the config default
+        self.device_compress_fn = \
+            lambda: bool(_Config().compaction_device_compress)
         # planned mesh boundaries, keyed (live generations, n_shards):
         # planning walks every live sstable's partition directory
         # (O(P log P) in total partitions) and only changes when the

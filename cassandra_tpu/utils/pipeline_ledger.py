@@ -174,8 +174,9 @@ def ledger(name: str) -> PipelineLedger:
     Established pipelines (docs/observability.md): `compaction` and
     `flush` (SSTableWriter write legs: serialize/compress/io_write +
     the flush `drain` stage), `mesh` (fanout lanes: decode/merge),
-    `compress_pool` (shared worker: pack) and `transport` (the request
-    dispatch executor)."""
+    `compress_pool` (shared worker: pack), `transport` (the request
+    dispatch executor) and `messaging` (the internode verb-dispatch
+    pool: `dispatch` plus one lazily-created stage per handled verb)."""
     led = _LEDGERS.get(name)
     if led is None:
         with _LOCK:

@@ -21,11 +21,14 @@ partitions — the payload stream enters and leaves skip mode):
      thread, a 1-worker pool and a 4-worker pool (+ decode-ahead),
      under the mesh execution mode (2 lanes, and 4 lanes combined with
      a 2-worker pool — docs/multichip.md: token-range shards drained in
-     token order), and under the DEVICE engine (device-resident rounds,
+     token order), under the DEVICE engine (device-resident rounds,
      ops/device_write.py — fused sort/reconcile/purge/serialize on the
      jax device incl. its per-round host fallbacks, plus the
-     device+mesh-2 cross) must produce sha256-identical components AND
-     equal merged-view content_digests;
+     device+mesh-2 cross), and with DEVICE-SIDE BLOCK COMPRESSION
+     (ops/device_compress.py — the policy-scan kernel compresses META +
+     lanes on-device; alone, feeding a 2-worker pool's ordered
+     completion queue, and crossed with mesh-2) must produce
+     sha256-identical components AND equal merged-view content_digests;
   2. the same mutation set flushed with CTPU_WRITE_FASTPATH=0 (serial
      sort-and-write) and =1 over 1- and 4-worker shared pools must
      produce identical sstable bytes and read-back digests.
@@ -159,12 +162,39 @@ def check_compaction(base: str) -> list[str]:
         # the residency decision must land the same bytes
         "device": dict(pipelined_io=True, compress_pool=0,
                        decode_ahead=False, engine="device",
-                       use_device=True),
+                       use_device=True, device_compress=False),
         # device engine crossed with the mesh execution mode: shards
         # fan across jax devices and drain host-side in token order
         "device_mesh2": dict(pipelined_io=True, compress_pool=0,
                              decode_ahead=False, engine="device",
                              use_device=True, mesh_devices=2),
+        # device-side block compression (ops/device_compress.py): full
+        # segments arrive at the writer ALREADY LZ4-compressed by the
+        # fused policy-scan kernel; the mixed fixture crosses skip-
+        # machine transitions, so attempted/raw decisions and the
+        # compress-vs-raw boundary must land identically to the native
+        # packer on every stream
+        "device_compress": dict(pipelined_io=True, compress_pool=0,
+                                decode_ahead=False, engine="device",
+                                use_device=True, device_compress=True),
+        # device compression feeding the ordered completion queue of a
+        # live compressor pool: device-born jobs (ready pre-set) and
+        # pool jobs (partial final segment, per-segment fallbacks)
+        # interleave in submit order
+        "device_compress_pool2": dict(pipelined_io=True,
+                                      compress_pool=CompressorPool(2),
+                                      decode_ahead=False,
+                                      engine="device", use_device=True,
+                                      device_compress=True),
+        # the mesh cross: shards drain through the host writer (the
+        # device-resident lane is a serial-round mode), so this pins
+        # that device_compress=True stays inert — and byte-identical —
+        # under the mesh execution mode
+        "device_compress_mesh2": dict(pipelined_io=True, compress_pool=0,
+                                      decode_ahead=False,
+                                      engine="device", use_device=True,
+                                      mesh_devices=2,
+                                      device_compress=True),
     }
     results = {tag: _compaction_leg(base, pristine, table, tag, **kw)
                for tag, kw in legs.items()}
@@ -299,7 +329,9 @@ def main() -> int:
         return 1
     print("compaction/flush parallel-compression A/B: zero divergence "
           "(serial vs threaded vs pool-1 vs pool-4 vs mesh-2 vs "
-          "mesh-4+pool-2 vs device-resident vs device+mesh-2)")
+          "mesh-4+pool-2 vs device-resident vs device+mesh-2 vs "
+          "device-compress vs device-compress+pool-2 vs "
+          "device-compress+mesh-2)")
     return 0
 
 

@@ -968,3 +968,68 @@ def test_conditional_batch_json_and_shared_ast(tmp_path):
         assert rs.rows[0][0] is True
     finally:
         c.shutdown()
+
+
+def test_dispatch_worker_death_blast_radius(cluster):
+    """Worker-death blast radius for the verb-dispatch pool: a handler
+    escalating past Exception kills exactly one pool worker — the
+    death is counted, the worker replaced (the pool never shrinks
+    behind the operator's back), only that message is lost, and the
+    node keeps serving replica traffic. A merely-raising handler costs
+    its message (process_failures) and nothing else."""
+    import threading
+
+    s = cluster.session(1)
+    s.keyspace = "ks"
+    target = cluster.nodes[1]
+    ms = target.messaging
+    ms.set_dispatch_workers(2)
+    # real replica load so the pool is live before the kill
+    for i in range(10):
+        s.execute(f"INSERT INTO kv (k, v) VALUES ({i}, 'v{i}')")
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and ms.pool_width() < 2:
+        time.sleep(0.01)
+    assert ms.pool_width() == 2
+
+    class _Kill(BaseException):
+        pass
+
+    ran = threading.Event()
+
+    def boom(msg):
+        ran.set()
+        raise _Kill()
+
+    ms.register_handler("TEST_BOOM", boom)
+    deaths0 = ms.metrics["dispatch_worker_deaths"]
+    fails0 = ms.metrics["process_failures"]
+    cluster.nodes[0].messaging.send_one_way("TEST_BOOM", {},
+                                            target.endpoint)
+    assert ran.wait(5.0)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and (
+            ms.metrics["dispatch_worker_deaths"] == deaths0
+            or ms.pool_width() < 2):
+        time.sleep(0.01)
+    assert ms.metrics["dispatch_worker_deaths"] == deaths0 + 1
+    assert ms.metrics["process_failures"] == fails0 + 1
+    assert ms.pool_width() == 2      # respawned, not silently narrower
+
+    def soft(msg):
+        raise RuntimeError("handler bug")
+
+    ms.register_handler("TEST_SOFT", soft)
+    failed = threading.Event()
+    cluster.nodes[0].messaging.send_with_callback(
+        "TEST_SOFT", {}, target.endpoint,
+        on_response=lambda m: None, on_failure=lambda m: failed.set(),
+        timeout=5.0)
+    # a merely-raising handler becomes a FAILURE_RSP to the sender —
+    # no worker dies, the pool stays at width
+    assert failed.wait(5.0)
+    assert ms.metrics["dispatch_worker_deaths"] == deaths0 + 1
+    # the node still serves QUORUM traffic after the kill
+    for i in range(10, 30):
+        s.execute(f"INSERT INTO kv (k, v) VALUES ({i}, 'v{i}')")
+    assert s.execute("SELECT v FROM kv WHERE k = 15").rows == [("v15",)]

@@ -1,0 +1,391 @@
+"""Device-side LZ4 block compression (ops/device_compress.py): the
+policy encoder's tri-identity (native C / numpy reference / jax
+kernel), the fused segment scan kernel against segment_pack's host
+transforms, the device pack replication of the compress-or-raw
+placement rule, and the write-path integration — byte identity,
+device↔host-fallback interleaving under adversarial completion order,
+mid-compaction knob flips, kernel-failure fallback, and EIO unwind."""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import time
+
+import numpy as np
+import pytest
+
+from cassandra_tpu.compaction.task import CompactionTask
+from cassandra_tpu.ops import device_compress as dc
+from cassandra_tpu.ops.codec import (CompressionParams, SegmentPacker,
+                                     get_compressor, lanes_shuffle)
+from cassandra_tpu.ops.native import build as native_build
+from cassandra_tpu.schema import TableParams, make_table
+from cassandra_tpu.storage import cellbatch as cb
+from cassandra_tpu.storage.sstable import Descriptor, SSTableWriter
+from cassandra_tpu.storage.sstable import writer as writer_mod
+from cassandra_tpu.storage.sstable.compress_pool import CompressorPool
+from cassandra_tpu.storage.table import ColumnFamilyStore
+from cassandra_tpu.tools import bulk
+from cassandra_tpu.utils import faultfs
+
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _native_lz4(data: bytes, cap: int):
+    """Direct native lz4_compress with an explicit output cap (the
+    Compressor front-end always passes the generous max bound)."""
+    lib = native_build.load()
+    src = np.frombuffer(data, dtype=np.uint8) if data \
+        else np.zeros(1, dtype=np.uint8)
+    dst = np.empty(max(cap, 1), dtype=np.uint8)
+    r = lib.lz4_compress(src.ctypes.data_as(_U8P), len(data),
+                         dst.ctypes.data_as(_U8P), cap)
+    return None if r < 0 else dst[:r].tobytes()
+
+
+def _fixtures() -> dict[str, bytes]:
+    rng = np.random.default_rng(42)
+    fox = b"the quick brown fox jumps over the lazy dog " * 100
+    meta_ish = np.zeros(25 * 600, dtype=np.uint8)
+    meta_ish[::25] = rng.integers(0, 4, 600)          # 25-byte strides
+    meta_ish[7::25] = np.arange(600) & 0xFF
+    runs = b"".join(bytes([b]) * ln for b, ln in
+                    zip(rng.integers(0, 256, 200),
+                        rng.integers(1, 40, 200)))
+    return {
+        "fox": fox,
+        "zeros": bytes(8192),
+        "rand16k": rng.integers(0, 256, 16384, dtype=np.uint8).tobytes(),
+        "empty": b"",
+        "tiny": b"abc",
+        "exact12": b"aaaaaaaaaaaa",                   # == mflimit floor
+        "len13": b"abcabcabcabca",
+        "meta_ish": meta_ish.tobytes(),
+        "period25": (b"x" * 24 + b"|") * 300,
+        "period44": fox[:44] * 90,
+        "two_symbol": rng.choice([65, 66], 4096).astype(np.uint8).tobytes(),
+        "low_entropy": rng.integers(0, 4, 8192, dtype=np.uint8).tobytes(),
+        "runs": runs,
+        "mixed": fox + rng.integers(0, 256, 4096,
+                                    dtype=np.uint8).tobytes() + fox,
+    }
+
+
+# --------------------------------------------------- policy tri-identity --
+
+def test_policy_tri_identity_and_roundtrip():
+    """native lz4_compress == compress_np == compress_jax on every
+    fixture, and the output decodes back through the syslib-backed
+    decompressor (standard LZ4 block format)."""
+    comp = get_compressor("LZ4Compressor")
+    for name, data in _fixtures().items():
+        ref = comp.compress(data)
+        got_np = dc.compress_np(data)
+        got_jax = dc.compress_jax(data)
+        assert got_np == ref, f"{name}: numpy diverged from native"
+        assert got_jax == ref, f"{name}: jax diverged from native"
+        assert comp.uncompress(ref, len(data)) == data, name
+
+
+def test_policy_cap_boundary_identical():
+    """The abort decision uses the native encoder's conservative
+    per-sequence `need` bound, not the exact emitted size: sweeping the
+    cap through the boundary must flip compress→None at the SAME cap
+    for native and replica (a one-byte disagreement here would flip a
+    block's compressed/raw flag and change every downstream byte)."""
+    for name, data in _fixtures().items():
+        if not data:
+            continue
+        full = len(dc.compress_np(data))
+        caps = {1, 5, len(data) // 2} | \
+            set(range(max(full - 6, 1), full + 7))
+        for cap in sorted(caps):
+            n = _native_lz4(data, cap)
+            r = dc.compress_np(data, cap)
+            assert n == r, f"{name} cap={cap}: native={n is not None} " \
+                           f"replica={r is not None}"
+
+
+def test_tie_break_smallest_distance():
+    """b'abab...' matches at every even distance with equal run length;
+    the policy must pick d=2 (ascending candidate order)."""
+    src = np.frombuffer(b"ab" * 64, dtype=np.uint8)
+    bl, bd = dc.match_scan_np(src)
+    assert bd[2] == 2 and bl[2] >= dc.MINMATCH
+    # and the jax kernel agrees everywhere
+    jbl, jbd = dc._scan_kernel(src)
+    np.testing.assert_array_equal(np.asarray(jbl, dtype=np.int64), bl)
+    np.testing.assert_array_equal(np.asarray(jbd, dtype=np.int64), bd)
+
+
+# ------------------------------------------------------- segment kernel --
+
+def _sorted_lanes(rng, n=512, k=3):
+    rows = rng.integers(0, 1 << 32, (n, k), dtype=np.uint32)
+    order = np.lexsort(tuple(rows[:, c] for c in range(k - 1, -1, -1)))
+    return rows[order]
+
+
+def test_segment_scan_kernel_matches_host_transforms():
+    rng = np.random.default_rng(3)
+    lanes = _sorted_lanes(rng)
+    meta = rng.integers(0, 8, 25 * 200, dtype=np.uint8)
+    planes, mbl, mbd, lbl, lbd, ok = dc.segment_scan_kernel(meta, lanes)
+    assert bool(ok)
+    planes_np = np.asarray(planes)
+    np.testing.assert_array_equal(planes_np, lanes_shuffle(lanes))
+    rbl, rbd = dc.match_scan_np(meta)
+    np.testing.assert_array_equal(np.asarray(mbl, dtype=np.int64), rbl)
+    np.testing.assert_array_equal(np.asarray(mbd, dtype=np.int64), rbd)
+    rbl, rbd = dc.match_scan_np(planes_np)
+    np.testing.assert_array_equal(np.asarray(lbl, dtype=np.int64), rbl)
+    np.testing.assert_array_equal(np.asarray(lbd, dtype=np.int64), rbd)
+
+
+def test_segment_scan_kernel_flags_order_violation():
+    rng = np.random.default_rng(4)
+    lanes = _sorted_lanes(rng)
+    lanes[[10, 400]] = lanes[[400, 10]]   # u32-lex violation
+    *_, ok = dc.segment_scan_kernel(
+        rng.integers(0, 8, 100, dtype=np.uint8), lanes)
+    assert not bool(ok)
+
+
+def test_pack_device_segment_matches_segment_pack():
+    """pack_device_segment replicates segment_pack verbatim: same
+    total, per-block stored sizes, CRCs, and placed bytes, for every
+    attempt combination and with the maxlen clamp engaged."""
+    rng = np.random.default_rng(5)
+    lanes = _sorted_lanes(rng, n=800)
+    meta = np.zeros(25 * 800, dtype=np.uint8)
+    meta[::25] = rng.integers(0, 4, 800)
+    payload = rng.integers(97, 122, 6000, dtype=np.uint8)  # compressible
+    packer = SegmentPacker.create(get_compressor("LZ4Compressor"))
+    assert packer is not None and packer._cid == 1
+    planes, mbl, mbd, lbl, lbd, ok = dc.segment_scan_kernel(meta, lanes)
+    assert bool(ok)
+    planes_np = np.asarray(planes)
+    scans = ((np.asarray(mbl), np.asarray(mbd)),
+             (np.asarray(lbl), np.asarray(lbd)))
+    for maxlen in (1 << 62, 1200):
+        for att in ((True,) * 3, (True, True, False),
+                    (False, True, True), (True, False, False),
+                    (False,) * 3):
+            total, sizes, crcs, parts = dc.pack_device_segment(
+                meta, planes_np, scans, payload, att, maxlen)
+            out = np.zeros(meta.size + lanes.nbytes + payload.size + 64,
+                           dtype=np.uint8)
+            rtotal, rsizes, rraws, rcrcs = packer.pack(
+                [meta, lanes, payload], list(att), maxlen,
+                shuffle_block=1, lane_width=lanes.shape[1], out=out)
+            assert total == rtotal, (maxlen, att)
+            assert sizes == list(rsizes), (maxlen, att)
+            assert crcs == list(rcrcs), (maxlen, att)
+            assert b"".join(parts) == out[:rtotal].tobytes(), (maxlen, att)
+
+
+def test_pack_device_segment_rejects_unsorted_lanes():
+    """The device order check raises the same data-integrity error the
+    native path does (ops/device_write.py re-raises on order_ok=False;
+    this pins the contract at the kernel seam)."""
+    rng = np.random.default_rng(6)
+    lanes = _sorted_lanes(rng)
+    lanes[[0, 100]] = lanes[[100, 0]]
+    *_, ok = dc.segment_scan_kernel(
+        np.zeros(50, dtype=np.uint8), lanes)
+    assert not bool(ok)
+    out = np.zeros(lanes.nbytes + 256, dtype=np.uint8)
+    packer = SegmentPacker.create(get_compressor("LZ4Compressor"))
+    with pytest.raises(ValueError, match="out of order"):
+        packer.pack([np.zeros(50, dtype=np.uint8), lanes,
+                     np.zeros(1, dtype=np.uint8)], [True] * 3,
+                    1 << 62, 1, lanes.shape[1], out)
+
+
+# ------------------------------------------------- write-path integration --
+
+def _table(name: str):
+    return make_table(
+        "devcmp", name, pk=["id"], ck=["c"],
+        cols={"id": "int", "c": "int", "v": "blob"},
+        params=TableParams(compression=CompressionParams(
+            "LZ4Compressor", chunk_length=16 * 1024)))
+
+
+def _build_inputs(cfs, table, n_ssts=3, n_per=60_000, seed=9):
+    rng = np.random.default_rng(seed)
+    for gen in range(1, n_ssts + 1):
+        pk = rng.integers(0, 300, n_per)
+        ck = rng.integers(0, 100_000, n_per)
+        text = rng.integers(97, 122, (n_per, 24), dtype=np.uint8)
+        blob = rng.integers(0, 256, (n_per, 24), dtype=np.uint8)
+        vals = np.where((pk % 2 == 0)[:, None], text, blob)
+        ts = rng.integers(1, 1 << 40, n_per).astype(np.int64)
+        w = SSTableWriter(Descriptor(cfs.directory, gen), table,
+                          estimated_partitions=300)
+        w.append(cb.merge_sorted([bulk.build_int_batch(table, pk, ck,
+                                                       vals, ts)]))
+        w.finish()
+
+
+def _hashes(directory: str) -> dict:
+    comps = ("Data.db", "Index.db", "Partitions.db", "Digest.crc32")
+    out = {}
+    for fn in sorted(os.listdir(directory)):
+        p = os.path.join(directory, fn)
+        if os.path.isfile(p) and any(fn.endswith(c) for c in comps):
+            with open(p, "rb") as f:
+                out[fn] = hashlib.sha256(f.read()).hexdigest()
+    return out
+
+
+def _compact(tmp_path, tag, table, n_per=60_000, **task_kw):
+    d = str(tmp_path / tag)
+    cfs = ColumnFamilyStore(table, d, commitlog=None)
+    _build_inputs(cfs, table, n_per=n_per)
+    cfs.reload_sstables()
+    CompactionTask(cfs, cfs.tracker.view(), **task_kw).execute()
+    h = _hashes(cfs.directory)
+    for r in cfs.live_sstables():
+        r.close()
+    return h
+
+
+def test_device_compress_identical_to_serial(tmp_path):
+    table = _table("ident")
+    serial = _compact(tmp_path, "serial", table, pipelined_io=False,
+                      compress_pool=0, decode_ahead=False)
+    devc = _compact(tmp_path, "devc", table, engine="device",
+                    use_device=True, pipelined_io=True,
+                    compress_pool=0, decode_ahead=False,
+                    device_compress=True)
+    assert serial and devc == serial
+
+
+def test_device_host_interleave_adversarial_order(tmp_path, monkeypatch):
+    """Device-packed and pool-compressed segments share one ordered io
+    queue: an alternating per-segment gate interleaves the two job
+    kinds, and delaying even segments makes successors complete FIRST.
+    The drain must still be submit-ordered — bytes identical to
+    serial."""
+    table = _table("ileave")
+    serial = _compact(tmp_path, "serial", table, pipelined_io=False,
+                      compress_pool=0, decode_ahead=False)
+
+    def delay(seq):
+        if seq % 2 == 0:
+            time.sleep(0.02)
+
+    monkeypatch.setattr(writer_mod, "_TEST_SEGMENT_DELAY", delay)
+    d = str(tmp_path / "mix")
+    cfs = ColumnFamilyStore(table, d, commitlog=None)
+    _build_inputs(cfs, table)
+    cfs.reload_sstables()
+    flips = [0]
+
+    def gate():
+        flips[0] += 1
+        return flips[0] % 2 == 1   # device, host, device, ...
+
+    cfs.device_compress_fn = gate
+    pool = CompressorPool(2)
+    try:
+        CompactionTask(cfs, cfs.tracker.view(), engine="device",
+                       use_device=True, pipelined_io=True,
+                       compress_pool=pool, decode_ahead=False).execute()
+    finally:
+        pool.shutdown(timeout=5.0)
+    assert flips[0] >= 2           # the gate really alternated
+    assert _hashes(cfs.directory) == serial
+    for r in cfs.live_sstables():
+        r.close()
+
+
+def test_device_compress_knob_flip_mid_compaction(tmp_path):
+    """The writer re-reads the engine-scoped gate per segment: flipping
+    `compaction_device_compress` off mid-compaction moves later
+    segments to the host path with identical bytes."""
+    table = _table("flip")
+    # 3 x 100k cells: >= 4 full 64Ki-cell segments, so the flip after
+    # two gate reads leaves later segments on the host path
+    pinned = _compact(tmp_path, "pinned", table, n_per=100_000,
+                      engine="device", use_device=True,
+                      pipelined_io=True, compress_pool=0,
+                      decode_ahead=False, device_compress=False)
+    d = str(tmp_path / "flipped")
+    cfs = ColumnFamilyStore(table, d, commitlog=None)
+    _build_inputs(cfs, table, n_per=100_000)
+    cfs.reload_sstables()
+    calls = [0]
+
+    def knob():
+        calls[0] += 1
+        return calls[0] <= 2       # device for two segments, then OFF
+
+    cfs.device_compress_fn = knob
+    CompactionTask(cfs, cfs.tracker.view(), engine="device",
+                   use_device=True, pipelined_io=True,
+                   compress_pool=0, decode_ahead=False).execute()
+    assert calls[0] >= 3           # gate re-read per segment
+    assert _hashes(cfs.directory) == pinned
+    for r in cfs.live_sstables():
+        r.close()
+
+
+def test_kernel_failure_falls_back_per_segment(tmp_path, monkeypatch):
+    """A raising scan kernel must not fail the compaction: the segment
+    falls back to the host compress path (metric counted), output bytes
+    unchanged."""
+    from cassandra_tpu.service.metrics import GLOBAL as METRICS
+    table = _table("fb")
+    serial = _compact(tmp_path, "serial", table, pipelined_io=False,
+                      compress_pool=0, decode_ahead=False)
+
+    def boom(*a, **k):
+        raise RuntimeError("injected kernel failure")
+
+    monkeypatch.setattr(dc, "segment_scan_kernel", boom)
+    before = METRICS.counter("compaction.device_compress_fallback")
+    devc = _compact(tmp_path, "devc", table, engine="device",
+                    use_device=True, pipelined_io=True,
+                    compress_pool=0, decode_ahead=False,
+                    device_compress=True)
+    assert devc == serial
+    assert METRICS.counter("compaction.device_compress_fallback") > before
+
+
+def test_device_compress_eio_unwinds_with_inputs_live(tmp_path):
+    """EIO injected at the compress checkpoint of the device-packed
+    submit path (the same checkpoint the pool workers honour — the
+    serial inline leg, like the serial host pack, has no compressor
+    seam to fault): the task fails through the normal unwind —
+    lifecycle txn rolled back, tmp components gone, inputs still live
+    and serving."""
+    table = _table("eio")
+    d = str(tmp_path / "store")
+    cfs = ColumnFamilyStore(table, d, commitlog=None)
+    _build_inputs(cfs, table)
+    cfs.reload_sstables()
+    inputs_before = list(cfs.tracker.view())
+    pool = CompressorPool(2)
+    try:
+        task = CompactionTask(cfs, inputs_before, engine="device",
+                              use_device=True, pipelined_io=True,
+                              compress_pool=pool, decode_ahead=False,
+                              device_compress=True)
+        with faultfs.inject("sstable.compress", "error"):
+            with pytest.raises(OSError):
+                task.execute()
+    finally:
+        faultfs.GLOBAL.disarm()
+        pool.shutdown(timeout=5.0)
+    assert list(cfs.tracker.view()) == inputs_before
+    assert not [f for f in os.listdir(cfs.directory)
+                if f.startswith("tmp-")]
+    from cassandra_tpu.storage.chunk_cache import GLOBAL as chunk_cache
+    chunk_cache.clear()
+    pk = table.serialize_partition_key([4])
+    assert len(cfs.read_partition(pk, now=int(time.time()))) > 0
+    for r in cfs.live_sstables():
+        r.close()
