@@ -831,6 +831,115 @@ def run_read_bench(base_dir: str) -> dict:
     }
 
 
+# ------------------------------------------------------------ scan bench --
+
+SCAN_GENERATIONS = 4          # one flushed sstable per generation
+SCAN_ROWS_PER_GEN = 3000
+SCAN_QUERY_REPS = 5           # queries per paired_ab run
+
+
+def run_scan_bench(base_dir: str) -> dict:
+    """Analytical scan section (docs/read-path.md): the ALLOW FILTERING
+    pushdown lane (zone-map pruning + fused device predicate kernels +
+    candidate-only Phase B) paired_ab'd against the naive materializing
+    Python scan on a selective predicate, plus the aggregation leg
+    proving count/min/max/sum/avg fold on keys with ZERO rows
+    materialized host-side. The fixture writes each flush generation
+    into a disjoint score band, so zone maps prune the other
+    generations' segments before decode — segments_skipped /
+    segments_total is the observable prune rate. Row identity between
+    the legs is asserted here and CI-pinned by scripts/check_scan_ab.py."""
+    from cassandra_tpu.cql import Session
+    from cassandra_tpu.ops import device_scan as ds
+    from cassandra_tpu.schema import Schema
+    from cassandra_tpu.service.metrics import GLOBAL as METRICS
+    from cassandra_tpu.storage.engine import StorageEngine
+
+    n_rows = SCAN_GENERATIONS * SCAN_ROWS_PER_GEN
+    eng = StorageEngine(os.path.join(base_dir, "scan"), Schema(),
+                        commitlog_sync="batch")
+    try:
+        s = Session(eng)
+        s.execute("CREATE KEYSPACE bench WITH replication = "
+                  "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+        s.execute("USE bench")
+        s.execute("CREATE TABLE scanfix (id int PRIMARY KEY, "
+                  "score int, pad text)")
+        cfs = eng.store("bench", "scanfix")
+        q = s.prepare("INSERT INTO scanfix (id, score, pad) "
+                      "VALUES (?, ?, ?)")
+        for g in range(SCAN_GENERATIONS):
+            for i in range(SCAN_ROWS_PER_GEN):
+                rid = g * SCAN_ROWS_PER_GEN + i
+                s.execute_prepared(q, (rid, g * 1000 + i % 50,
+                                       f"pad-{rid:08d}"))
+            cfs.flush()
+        # the selective predicate: 1/50th of ONE generation's band —
+        # every other generation's segments are zone-pruned
+        target = 1 * 1000 + 7
+        query = (f"SELECT id, score FROM scanfix WHERE score = {target} "
+                 "ALLOW FILTERING")
+        expect = sorted((1 * SCAN_ROWS_PER_GEN + i, target)
+                        for i in range(SCAN_ROWS_PER_GEN) if i % 50 == 7)
+
+        def _run(shadow: bool) -> float:
+            """Table rows scanned per second over SCAN_QUERY_REPS."""
+            if shadow:     # instance attrs shadow the lane off: the
+                cfs.scan_filtered = None          # executor's pushdown
+                cfs.scan_filtered_aggregate = None  # attempt falls back
+            try:
+                t0 = time.perf_counter()
+                for _ in range(SCAN_QUERY_REPS):
+                    rows = s.execute(query).rows
+                wall = time.perf_counter() - t0
+                assert sorted(rows) == expect
+                return n_rows * SCAN_QUERY_REPS / wall
+            finally:
+                cfs.__dict__.pop("scan_filtered", None)
+                cfs.__dict__.pop("scan_filtered_aggregate", None)
+
+        ab = paired_ab(lambda: _run(shadow=True),
+                       lambda: _run(shadow=False), rounds=3)
+        # prune accounting from one instrumented Phase A
+        pred = ds.compile_predicate(
+            cfs.table, [(cfs.table.columns["score"], "=", target)])
+        _, info = cfs.scan_filtered(pred)
+        # aggregation leg: the fold must answer from keys alone —
+        # scan.rows_materialized unchanged proves no row dict was built
+        m0 = METRICS.counter("scan.rows_materialized")
+        a0 = METRICS.counter("scan.agg_pushdown")
+        agg = s.execute(
+            "SELECT count(score), min(score), max(score), sum(score), "
+            f"avg(score) FROM scanfix WHERE score = {target} "
+            "ALLOW FILTERING").rows
+        n_match = len(expect)
+        assert agg == [(n_match, target, target, n_match * target,
+                        float(target))], agg
+        agg_pushed = METRICS.counter("scan.agg_pushdown") - a0
+        agg_materialized = METRICS.counter("scan.rows_materialized") - m0
+        return {
+            "fixture": {"rows": n_rows, "sstables": SCAN_GENERATIONS,
+                        "match_rows": n_match,
+                        "queries_per_leg": SCAN_QUERY_REPS},
+            # headline: naive materializing scan vs the pushdown lane,
+            # geomean of per-round ratios (target >= 2x)
+            "rows_per_s": {"naive_geomean": ab["a_geomean"],
+                           "pushdown_geomean": ab["b_geomean"]},
+            "pushdown_speedup_geomean": ab["speedup_geomean"],
+            "prune": {"segments_total": info["segments_total"],
+                      "segments_skipped": info["segments_skipped"],
+                      "sstables_skipped": info["sstables_skipped"],
+                      "candidates": info["candidates"]},
+            "aggregation": {"agg_pushdowns": agg_pushed,
+                            "rows_materialized": agg_materialized,
+                            "zero_materialization":
+                            bool(agg_pushed >= 1
+                                 and agg_materialized == 0)},
+        }
+    finally:
+        eng.close()
+
+
 # -------------------------------------------------------- dispatch bench --
 
 DISPATCH_WRITES_PER_LEG = 300
@@ -1690,6 +1799,14 @@ def main():
             # skip collation + batched partition reads vs the naive
             # every-sstable collation, bit-identical results required
             "read_path": run_read_bench(os.path.join(base, "read")),
+            # analytical scan lane (docs/read-path.md): zone-map
+            # pruning + fused predicate kernels + candidate-only
+            # Phase B vs the naive materializing ALLOW FILTERING
+            # scan through paired_ab (target >= 2x rows/s), plus the
+            # aggregation leg folding on keys with zero rows
+            # materialized; zero divergence across legs is CI-checked
+            # by scripts/check_scan_ab.py
+            "scan": run_scan_bench(os.path.join(base, "scan")),
             # write-path fast lane A/B (docs/write-path.md): group-commit
             # commitlog + sharded memtable + pipelined flush vs the
             # per-mutation-fsync serial path

@@ -1141,6 +1141,12 @@ class CompactionTask:
                 cfs.row_cache.clear()
             for r in self.inputs:
                 r.release()
+            if getattr(cfs, "index_build_fn", None) is not None:
+                # eager attached-index components for the outputs, so
+                # the first indexed query after compaction never pays
+                # the build storm (build_eager never raises)
+                for r in live_new:
+                    cfs.index_build_fn(r)
         except BaseException as exc:
             pending.clear()
             stop_prefetch()

@@ -157,6 +157,15 @@ class Config:
     # segment, so a mid-compaction flip takes effect at the next
     # segment boundary. Only device-resident tasks consult it.
     compaction_device_compress: bool = mut(True)
+    # device predicate/aggregate kernels for analytical scans
+    # (ops/device_scan.py): scan_filtered evaluates pushdown predicates
+    # with the jitted key-compare kernels instead of the numpy host
+    # reference. Results are identical on or off (the host reference is
+    # pinned bit-identical by check_scan_ab.py) — the knob only moves
+    # the mask/fold work between device and host. Engine-scoped and
+    # hot-reloadable: the scan consults it PER SEGMENT, so a mid-scan
+    # flip takes effect at the next segment boundary.
+    scan_device_filter: bool = mut(True)
     compaction_throughput: float = spec("rate", 64.0, mutable=True)
     # modern-yaml name for the same throttle (DataRateSpec
     # compaction_throughput_mib_per_sec). Negative = unset: the engine

@@ -1803,6 +1803,7 @@ class Executor:
                         for expr, _ in s.selectors)
         new_paging_state = None
         paged = False
+        pushdown_scan = False
         if index_rows is not None:
             rows = index_rows
             # an accompanying pk restriction still applies
@@ -1822,13 +1823,42 @@ class Executor:
                 batches = [(pk, cfs.read_partition(pk, limits=push))
                            for pk in pks]
         else:
-            # full scan: paged, windowed, bounded memory (QueryPagers)
-            rows, statics_by_pk, new_paging_state = self._paged_scan(
-                t, cfs, s, params, ck_rel, filters, want_meta,
-                page_size, paging_state)
-            batches = []
-            paged = True
-            ck_rel, filters = {}, []   # applied inline by the pager
+            pushed = None
+            if (filters and s.allow_filtering and paging_state is None
+                    and not page_size and hasattr(cfs, "scan_filtered")):
+                pushed = self._scan_pushdown(t, cfs, s, params, ck_rel,
+                                             filters, now)
+            if pushed is not None and pushed[0] == "agg":
+                # the whole answer folded on device/host keys — zero
+                # rows materialized (scan.rows_materialized untouched)
+                rs = pushed[1]
+                if getattr(s, "json", False):
+                    rs = _jsonify_resultset(rs)
+                return rs
+            if pushed is not None:
+                # candidate partitions ride the generic batches loop
+                # below: ck restrictions and ALL filters re-verify
+                # every row exactly, statics/phantoms/guardrail reuse
+                # the proven code — bit-identical to the naive scan by
+                # construction, minus the partitions the zone maps and
+                # kernels proved irrelevant
+                batches = pushed[1]
+                pushdown_scan = True
+            else:
+                if filters and s.allow_filtering:
+                    from ..service.metrics import GLOBAL as _SCAN_M
+                    _SCAN_M.incr("scan.fallback")
+                # full scan: paged, windowed, bounded memory
+                # (QueryPagers)
+                rows, statics_by_pk, new_paging_state = self._paged_scan(
+                    t, cfs, s, params, ck_rel, filters, want_meta,
+                    page_size, paging_state)
+                if filters and s.allow_filtering:
+                    from ..service.metrics import GLOBAL as _SCAN_M
+                    _SCAN_M.incr("scan.rows_materialized", len(rows))
+                batches = []
+                paged = True
+                ck_rel, filters = {}, []   # applied inline by the pager
         for _, batch in batches:
             saw_regular = False
             static_d = None
@@ -1852,6 +1882,9 @@ class Executor:
                 for col in t.clustering_columns + t.regular_columns:
                     phantom.setdefault(col.name, None)
                 rows.append(phantom)
+        if pushdown_scan:
+            from ..service.metrics import GLOBAL as _SCAN_M
+            _SCAN_M.incr("scan.rows_materialized", len(rows))
         # join static values (and their cell metadata) onto the rows
         # (the pager already joined + filtered + applied ppl inline)
         for d in [] if paged else rows:
@@ -1960,6 +1993,112 @@ class Executor:
                     t.keyspace, name) is not None:
                 return True
         return False
+
+    def _scan_pushdown(self, t, cfs, s, params, ck_rel, filters, now):
+        """ALLOW FILTERING fast lane (ops/device_scan.py + the ZMP1
+        zone maps): compile the first supported filter to scan-key
+        space and ask the store for just the partitions that can
+        match, instead of materializing every row of the table. Two
+        shapes:
+          * aggregate pushdown — a SELECT of builtin aggregates over
+            the filtered column (or count(*)) with a single EXACT
+            predicate folds entirely on the keys: zero rows
+            materialized host-side.
+          * row pushdown — candidates come back as (pk, merged batch)
+            and ride the generic batches loop, where ck restrictions
+            and ALL filters re-verify every row with the exact
+            `_match` — bit-identical to the naive scan by
+            construction.
+        Returns ("agg", ResultSet) | ("batches", [(pk, batch)]) |
+        None (unsupported shape: the Python path keeps the wheel)."""
+        from ..ops import device_scan as ds
+        from ..service.metrics import GLOBAL as _M
+        pred = ds.compile_predicate(t, filters)
+        if pred is None:
+            return None
+        spec = self._agg_pushdown_shape(t, s, ck_rel, filters, pred)
+        if spec is not None:
+            try:
+                cnt, vmin, vmax, sm, _info = \
+                    cfs.scan_filtered_aggregate(pred, now=now)
+            except Exception:
+                _M.incr("scan.fallback")
+                return None   # fold refused: the Python path answers
+            _M.incr("scan.pushdown")
+            _M.incr("scan.agg_pushdown")
+            if len(spec) == 1 and spec[0][0] == "count":
+                # _project's single-count shape: the name is "count"
+                # and the argument is ignored — replicated exactly
+                return ("agg", ResultSet(["count"], [(cnt,)]))
+            names, out = [], []
+            for fname, _cname, argnames, alias in spec:
+                names.append(
+                    alias or f"{fname}({', '.join(map(str, argnames))})")
+                if fname == "count":
+                    out.append(cnt)
+                elif fname == "min":
+                    out.append(vmin if cnt else None)
+                elif fname == "max":
+                    out.append(vmax if cnt else None)
+                elif fname == "sum":
+                    out.append(sm if cnt else 0)
+                else:   # avg — true division, like _project's fold
+                    out.append(sm / cnt if cnt else 0)
+            return ("agg", ResultSet(names, [tuple(out)]))
+        try:
+            batches, _info = cfs.scan_filtered(pred, now=now)
+        except Exception:
+            _M.incr("scan.fallback")
+            return None   # kernel/key surprise: results still correct
+        _M.incr("scan.pushdown")
+        return ("batches", batches)
+
+    def _agg_pushdown_shape(self, t, s, ck_rel, filters, pred):
+        """[(fname, cname, argnames, alias)] when the SELECT is a pure
+        builtin-aggregate fold the scan keys can answer EXACTLY, else
+        None. The conditions mirror _project's aggregate fold: a
+        single exact predicate on a regular column, every selector a
+        builtin aggregate over that column (count also takes */none),
+        no UDA shadowing, no grouping/ordering/limits."""
+        if (len(filters) != 1 or ck_rel or not pred.exact
+                or pred.is_static
+                or getattr(s, "group_by", None)
+                or getattr(s, "distinct", False)
+                or s.order_by or s.per_partition_limit is not None
+                or s.limit is not None):
+            return None
+        agg_fns = {"count", "min", "max", "sum", "avg"}
+        col = pred.col_name
+        spec = []
+        for expr, alias in s.selectors:
+            if not isinstance(expr, ast.FunctionCall):
+                return None
+            fname = expr.name.lower()
+            if self.udfs.get_aggregate(t.keyspace, fname) is not None:
+                return None   # UDA shadows the builtin
+            if fname not in agg_fns:
+                return None
+            argnames = []
+            for a in expr.args:
+                argnames.append(a if isinstance(a, str)
+                                else (a.value
+                                      if isinstance(a, ast.Literal)
+                                      else None))
+            cname = argnames[0] if argnames else None
+            if fname == "count":
+                if cname not in ("*", None, col):
+                    return None
+            elif cname != col:
+                return None
+            if fname in ("min", "max") and pred.kind == "f64":
+                # a NaN in the fold makes Python's min/max order-
+                # dependent; the Python path keeps its own behavior
+                return None
+            if fname in ("sum", "avg") and not (pred.kind == "i64"
+                                                and pred.width <= 4):
+                return None   # 64-bit accumulator exactness bound
+            spec.append((fname, cname, argnames, alias))
+        return spec if spec else None
 
     def _paged_scan(self, t, cfs, s, params, ck_rel, filters, want_meta,
                     page_size, paging_state):

@@ -59,6 +59,12 @@ class _AttachedIndex:
             path = self._path(reader.desc)
             loaded = self._load(path)
             if loaded is None:
+                # first-use build: only sstables that predate the index
+                # (or lost a component to corruption) land here — new
+                # sstables are covered eagerly by ensure_component in
+                # the writer tail. The counter pair proves it.
+                from ..service.metrics import GLOBAL as _M
+                _M.incr("index.lazy_builds")
                 self._build(reader)
                 loaded = self._load(path)
             if loaded is None:   # disk refused twice: serve from memory
@@ -76,6 +82,30 @@ class _AttachedIndex:
         mem = self._cfs().memtable.scan()
         if len(mem):
             yield from ssi.iter_column_cells(mem, self.col_id)
+
+    def ensure_component(self, reader) -> bool:
+        """Eagerly build+cache this sstable's component (writer tail at
+        flush/compaction) so the first query after a restart — or after
+        any flush — never pays the build storm. True if a build ran."""
+        gen = reader.desc.generation
+        if getattr(reader, "released", False):
+            return False
+        with self._lock:
+            if gen in self._cache:
+                return False
+            path = self._path(reader.desc)
+            loaded = self._load(path)
+            built = False
+            if loaded is None:
+                from ..service.metrics import GLOBAL as _M
+                _M.incr("index.builds")
+                self._build(reader)
+                loaded = self._load(path)
+                built = True
+            if loaded is None:
+                loaded = self._fresh(reader)
+            self._cache[gen] = loaded
+            return built
 
 
 class EqualityIndex(_AttachedIndex):
@@ -338,3 +368,20 @@ class IndexManager:
 
     def get(self, keyspace: str, table: str, column: str):
         return self.indexes.get((keyspace, table, column))
+
+    def build_eager(self, table: TableMetadata, reader) -> int:
+        """Writer-tail hook: build components for every index on
+        `table` against a NEW sstable (flush/compaction/rewrite), so
+        the lazy first-use path only ever covers pre-existing sstables.
+        Returns how many components were built. Never raises — index
+        build failure must not fail the flush that created the data."""
+        n = 0
+        for (ks, tb, _col), idx in list(self.indexes.items()):
+            if ks != table.keyspace or tb != table.name:
+                continue
+            try:
+                if idx.ensure_component(reader):
+                    n += 1
+            except Exception:
+                pass   # first query rebuilds lazily (counted)
+        return n

@@ -15,7 +15,12 @@ evolution is one re-scan):
             vint cklen, ck]
   vector    "VEC2" [u32 n][u32 dim][f32 matrix n*dim][i64 ts]*n
             [locators: vint pklen, pk, vint cklen, ck]*n
-Both end with [u32 crc32(body)].
+  zonemap   "ZMP1" [u32 n_segments][u32 n_columns]
+            [u32 col_id, u8 kind]*n_columns, then per column
+            [u64 kmin]*nseg [u64 kmax]*nseg [u32 live]*nseg
+            [u32 dead]*nseg — keys are the monotone u64 scan keys of
+            ops/device_scan.py; an empty segment is (U64_MAX, 0)
+All end with [u32 crc32(body)].
 """
 from __future__ import annotations
 
@@ -246,3 +251,155 @@ def load_text(path: str) -> dict[bytes, list] | None:
         return _parse_equality(body)   # identical record layout
     except (ValueError, IndexError, struct.error):
         return None
+
+
+# ---------------------------------------------------------------- zone map --
+# One component per sstable bounding every segment's live cells per
+# supported column in the u64 scan-key space (ops/device_scan.py), so
+# analytical scans prune segments — or the whole sstable — without
+# decoding them. Built in the writer tail at flush/compaction; the EQI1
+# rebuild contract applies (parse error / stale segment count -> rebuilt
+# from the sstable once). Encrypted sstables never get one: plaintext
+# min/max keys would leak TDE-protected values.
+
+_KIND_CODES = {"i64": 0, "f64": 1, "bool": 2, "prefix": 3}
+_KIND_NAMES = {v: k for k, v in _KIND_CODES.items()}
+
+
+def zonemap_path(desc) -> str:
+    return os.path.join(desc.directory,
+                        f"{desc.version}-{desc.generation}-ZoneMap.db")
+
+
+class ZoneMap:
+    """Per-segment (min key, max key, live, dead) bounds per column."""
+
+    __slots__ = ("n_segments", "cols")
+
+    def __init__(self, n_segments: int, cols: dict):
+        self.n_segments = n_segments
+        #: col_id -> (kind, kmin u64[nseg], kmax u64[nseg],
+        #:            live u32[nseg], dead u32[nseg])
+        self.cols = cols
+
+    @staticmethod
+    def from_entries(zone_cols, per_segment) -> "ZoneMap":
+        """zone_cols: [(col_id, kind, width)]; per_segment: one
+        [(kmin, kmax, live, dead)] row per segment, zone_cols order."""
+        n_seg = len(per_segment)
+        cols = {}
+        for j, (cid, kind, _w) in enumerate(zone_cols):
+            cols[cid] = (
+                kind,
+                np.array([per_segment[s][j][0] for s in range(n_seg)],
+                         dtype=np.uint64),
+                np.array([per_segment[s][j][1] for s in range(n_seg)],
+                         dtype=np.uint64),
+                np.array([per_segment[s][j][2] for s in range(n_seg)],
+                         dtype=np.uint32),
+                np.array([per_segment[s][j][3] for s in range(n_seg)],
+                         dtype=np.uint32),
+            )
+        return ZoneMap(n_seg, cols)
+
+    def keep_mask(self, pred) -> np.ndarray:
+        """bool[n_segments]: segments that may match pred and must be
+        decoded. A column the map does not cover (or whose stored kind
+        no longer matches the schema) keeps everything."""
+        ent = self.cols.get(pred.col_id)
+        if ent is None or ent[0] != pred.kind:
+            return np.ones(self.n_segments, dtype=bool)
+        from ..ops import device_scan as ds
+        return ds.prune_keep_mask(ent[1], ent[2], ent[3], pred)
+
+
+def write_zonemap(path: str, zone_cols, per_segment) -> str:
+    n_seg = len(per_segment)
+    out = bytearray()
+    out += b"ZMP1"
+    out += struct.pack("<II", n_seg, len(zone_cols))
+    for cid, kind, _w in zone_cols:
+        out += struct.pack("<IB", cid, _KIND_CODES[kind])
+    zm = ZoneMap.from_entries(zone_cols, per_segment)
+    for cid, _kind, _w in zone_cols:
+        _k, kmin, kmax, live, dead = zm.cols[cid]
+        out += kmin.astype("<u8").tobytes()
+        out += kmax.astype("<u8").tobytes()
+        out += live.astype("<u4").tobytes()
+        out += dead.astype("<u4").tobytes()
+    _write(path, bytes(out))
+    return path
+
+
+def load_zonemap(path: str,
+                 expected_segments: int | None = None) -> ZoneMap | None:
+    body = _read(path)
+    if body is None or body[:4] != b"ZMP1":
+        return None
+    try:
+        n_seg, n_cols = struct.unpack_from("<II", body, 4)
+        if expected_segments is not None and n_seg != expected_segments:
+            return None   # stale (format evolution / partial copy): rebuild
+        pos = 12
+        hdr = []
+        for _ in range(n_cols):
+            cid, code = struct.unpack_from("<IB", body, pos)
+            pos += 5
+            hdr.append((cid, _KIND_NAMES[code]))
+        cols = {}
+        for cid, kind in hdr:
+            kmin = np.frombuffer(body, "<u8", n_seg, pos).astype(np.uint64)
+            pos += 8 * n_seg
+            kmax = np.frombuffer(body, "<u8", n_seg, pos).astype(np.uint64)
+            pos += 8 * n_seg
+            live = np.frombuffer(body, "<u4", n_seg, pos).astype(np.uint32)
+            pos += 4 * n_seg
+            dead = np.frombuffer(body, "<u4", n_seg, pos).astype(np.uint32)
+            pos += 4 * n_seg
+            cols[cid] = (kind, kmin, kmax, live, dead)
+        return ZoneMap(n_seg, cols)
+    except (ValueError, KeyError, IndexError, struct.error):
+        return None   # malformed: rebuild
+
+
+def build_zonemap(reader, table: TableMetadata, write: bool = True) -> ZoneMap:
+    """Rebuild a sstable's zone map from its decoded segments (the slow
+    path a missing/torn/stale component falls back to — one re-scan,
+    like the EQI1 contract)."""
+    from ..ops import device_scan as ds
+    zone_cols = ds.zonemap_columns(table)
+    per_seg = []
+    for s in range(reader.n_segments):
+        b = reader._read_segment(s)
+        C = b.n_lanes - 9
+        per_seg.append(ds.segment_zone_entries(
+            zone_cols, b.lanes[:, 6 + C], b.flags,
+            np.asarray(b.val_start), np.asarray(b.off[1:]),
+            np.asarray(b.payload)))
+    zm = ZoneMap.from_entries(zone_cols, per_seg)
+    if write and not reader.released:
+        try:
+            write_zonemap(zonemap_path(reader.desc), zone_cols, per_seg)
+        except OSError:
+            pass   # read-only media: serve the in-memory map
+    return zm
+
+
+def zonemap_for(reader, table: TableMetadata) -> ZoneMap | None:
+    """The reader's zone map, cached on the reader: disk component if
+    fresh, else rebuilt once (counted). None for encrypted sstables."""
+    if getattr(reader, "_enc", None) is not None:
+        return None
+    cached = getattr(reader, "_zonemap_cache", None)
+    if cached is not None:
+        return cached or None          # False = negative cache
+    zm = load_zonemap(zonemap_path(reader.desc), reader.n_segments)
+    if zm is None:
+        from ..service.metrics import GLOBAL as _M
+        _M.incr("scan.zonemap_rebuilds")
+        try:
+            zm = build_zonemap(reader, table)
+        except Exception:
+            zm = None   # corrupt sstable surfaces through the scan itself
+    reader._zonemap_cache = zm if zm is not None else False
+    return zm

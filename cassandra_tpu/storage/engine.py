@@ -403,6 +403,23 @@ class StorageEngine:
         boundary (output bytes identical either way)."""
         return bool(self.settings.get("compaction_device_compress"))
 
+    def _scan_device_filter(self) -> bool:
+        """This engine's `scan_device_filter` knob — read by
+        scan_filtered PER SEGMENT, so the hot reload needs no listener
+        and a mid-scan flip moves the predicate/aggregate kernels
+        between device and host at the next segment boundary (results
+        identical either way)."""
+        return bool(self.settings.get("scan_device_filter"))
+
+    def _eager_index_build(self, cfs, reader) -> None:
+        """Build attached-index components for a NEW sstable in the
+        writer tail (flush/compaction) instead of on first query — the
+        restart scan storm the lazy path pays (counted as
+        index.lazy_builds) never happens for sstables born here."""
+        idx = getattr(self, "indexes", None)
+        if idx is not None:
+            idx.build_eager(cfs.table, reader)
+
     @property
     def _schema_path(self) -> str:
         return os.path.join(self.data_dir, "schema.json")
@@ -467,6 +484,9 @@ class StorageEngine:
         cfs.mesh_devices_fn = self._mesh_devices
         cfs.decode_ahead_fn = self._decode_ahead
         cfs.device_compress_fn = self._device_compress
+        cfs.scan_device_filter_fn = self._scan_device_filter
+        cfs.index_build_fn = lambda reader, _cfs=cfs: \
+            self._eager_index_build(_cfs, reader)
         cfs.set_compaction_history_capacity(
             self.settings.get("compaction_history_entries"))
         self.compactions.register(cfs)

@@ -47,6 +47,11 @@ def rewrite_sstable(cfs, sst, parts) -> list:
         txn.commit()
         cfs.tracker.replace([sst], new_readers)
         sst.release()
+        if getattr(cfs, "index_build_fn", None) is not None:
+            # rewritten outputs are NEW sstables: eager-build their
+            # attached-index components like flush/compaction outputs
+            for r in new_readers:
+                cfs.index_build_fn(r)
         if cfs.row_cache is not None:
             # cleanup/scrub/anticompaction CHANGE logical content (drop
             # foreign ranges / corrupt rows / restamp) — cached merges

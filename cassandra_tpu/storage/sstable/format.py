@@ -55,8 +55,11 @@ class Component:
     # optional: present only on encrypted tables (TDE envelope: key id +
     # per-component nonces — security/EncryptionContext role)
     ENCRYPTION = "Encryption.db"
+    # optional: per-segment zone maps for analytical scans (absent on
+    # encrypted tables — plaintext bounds would leak through TDE)
+    ZONEMAP = "ZoneMap.db"
     ALL = [DATA, INDEX, PARTITIONS, FILTER, STATS, DIGEST, TOC]
-    OPTIONAL = [ENCRYPTION]
+    OPTIONAL = [ENCRYPTION, ZONEMAP]
 
 
 _NAME_RE = re.compile(r"^(?P<version>[a-z]{2})-(?P<gen>\d+)-(?P<comp>.+)$")

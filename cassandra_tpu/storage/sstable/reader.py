@@ -610,6 +610,26 @@ class SSTableReader:
                                   ^ np.uint64(_BIAS)).astype(np.int64)
         return self._part_tok
 
+    def segment_range_for_tokens(self, lo: int, hi: int
+                                 ) -> tuple[int, int] | None:
+        """[s0, s1) segment indexes covering partitions with token in
+        (lo, hi], or None when the window misses this sstable — the
+        analytical scan's unit of zone-map pruning: it decides per
+        SEGMENT what to decode, where scan_tokens decodes the whole
+        covering range."""
+        toks = self.partition_tokens
+        side0 = "left" if lo == -(1 << 63) else "right"
+        i0 = int(np.searchsorted(toks, lo, side=side0))
+        i1 = int(np.searchsorted(toks, hi, side="right"))
+        if i0 >= i1:
+            return None
+        c0 = int(self._part_cell0[i0])
+        c1 = int(self._part_cell0[i1]) if i1 < self.n_partitions \
+            else self.n_cells
+        s0 = int(np.searchsorted(self._seg_cell0, c0, side="right")) - 1
+        s1 = int(np.searchsorted(self._seg_cell0, c1, side="left"))
+        return s0, max(s1, s0 + 1)
+
     def scan_tokens(self, lo: int, hi: int) -> CellBatch | None:
         """Cells of partitions with token in (lo, hi] — the bounded range
         read primitive (paging windows / vnode-range scans). Decodes only

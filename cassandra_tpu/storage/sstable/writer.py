@@ -326,6 +326,14 @@ class SSTableWriter:
         self._pending: list[CellBatch] = []
         self._pending_cells = 0
         self._total_cells = 0
+        # flush/compaction-time zone maps (index/sstable_index.py ZMP1):
+        # _emit_segment accumulates per-segment per-column min/max scan
+        # keys + live/dead counts on the appending thread (covers the
+        # serial, pooled and device-packed legs alike); finish() writes
+        # the component. Encrypted tables skip it — plaintext bounds
+        # would leak TDE data.
+        self._zone_cols = None   # resolved lazily from the table schema
+        self._zone_acc: list | None = [] if self._enc is None else None
         self._stats = {
             "min_ts": None, "max_ts": None, "min_ldt": None, "max_ldt": None,
             "tombstones": 0,
@@ -400,6 +408,7 @@ class SSTableWriter:
         self._write_filter()
         stats = self._write_stats()
         self._write_digest()
+        self._write_zonemap()
         comps = list(Component.ALL)
         if self._enc is not None:
             _ctx, kid, nonces = self._enc
@@ -1052,6 +1061,41 @@ class SSTableWriter:
         self._emit_segment(n, meta, lanes_c, payload_b, seg.pk_map,
                            seg_stats)
 
+    def _accumulate_zone(self, n: int, meta: "np.ndarray",
+                         lanes_c: "np.ndarray",
+                         payload_b: "np.ndarray") -> None:
+        """Fold one segment's per-column (min key, max key, live, dead)
+        zone entries from the already-serialized blocks — the cells are
+        in META/LANES form here whichever leg built them, so this is
+        the one place that covers host, pooled and device serialize
+        paths identically."""
+        from ...ops import device_scan as _ds
+        if self._zone_cols is None:
+            self._zone_cols = _ds.zonemap_columns(self.table)
+        if not self._zone_cols:
+            self._zone_acc = None   # nothing to map for this schema
+            return
+        flags = meta[16 * n:17 * n]
+        frame = meta[17 * n:21 * n].copy().view("<u4").astype(np.int64)
+        vrel = meta[21 * n:25 * n].copy().view("<u4").astype(np.int64)
+        off = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(frame, out=off[1:])
+        C = lanes_c.shape[1] - 9
+        self._zone_acc.append(_ds.segment_zone_entries(
+            self._zone_cols, lanes_c[:, 6 + C], flags,
+            off[:-1] + vrel, off[1:], payload_b))
+
+    def _write_zonemap(self) -> None:
+        """ZoneMap.db, written to its FINAL path outside the TOC (the
+        attached-index contract: a missing/stale component is rebuilt
+        from the sstable, so it needs no commit-point coupling)."""
+        if self._zone_acc is None or self._zone_cols is None \
+                or not self._zone_cols:
+            return
+        from ...index import sstable_index as ssi
+        ssi.write_zonemap(ssi.zonemap_path(self.desc),
+                          self._zone_cols, self._zone_acc)
+
     def _emit_segment(self, n: int, meta: "np.ndarray",
                       lanes_c: "np.ndarray", payload_b: "np.ndarray",
                       pk_map: dict, seg_stats: tuple,
@@ -1089,6 +1133,12 @@ class SSTableWriter:
                 rows = np.arange(n - 1)
                 if ((a[rows, fi] > b[rows, fi]) & anyneq).any():
                     raise ValueError("appended cells out of order")
+
+        # zone-map accumulation: once per segment, in append order, on
+        # the appending thread — BEFORE the compress legs fork, so the
+        # serial, pooled and device-packed paths all feed it
+        if self._zone_acc is not None:
+            self._accumulate_zone(n, meta, lanes_c, payload_b)
 
         # --- partition directory + bloom: one native pass over the
         # lanes finds the rows where the 4 pk lanes change (the numpy

@@ -268,6 +268,17 @@ class ColumnFamilyStore:
         # reads the config default
         self.device_compress_fn = \
             lambda: bool(_Config().compaction_device_compress)
+        # analytical-scan device kernel routing, same shape again: a
+        # StorageEngine points this at ITS hot-reloadable
+        # `scan_device_filter` setting; scan_filtered re-reads it PER
+        # SEGMENT (results identical either way)
+        self.scan_device_filter_fn = \
+            lambda: bool(_Config().scan_device_filter)
+        # eager attached-index builds: a StorageEngine points this at
+        # IndexManager.build_eager so new sstables (flush/compaction)
+        # get their index components in the writer tail; a standalone
+        # store has no index registry to feed
+        self.index_build_fn = None
         # planned mesh boundaries, keyed (live generations, n_shards):
         # planning walks every live sstable's partition directory
         # (O(P log P) in total partitions) and only changes when the
@@ -596,6 +607,11 @@ class ColumnFamilyStore:
                         e, getattr(writer, "_data_path", ""))
                 raise
             self.tracker.add(reader)
+            if self.index_build_fn is not None:
+                # eager attached-index components for the new sstable
+                # (build_eager never raises — a failed build falls back
+                # to the lazy first-use path, counted)
+                self.index_build_fn(reader)
             from ..service import diagnostics
             diagnostics.publish("flush", keyspace=self.table.keyspace,
                                 table=self.table.name,
@@ -1145,6 +1161,182 @@ class ColumnFamilyStore:
             from .cellbatch import lanes_for_table
             return CellBatch.empty(lanes_for_table(self.table))
         return merge_sorted(sources, now=now)
+
+    def scan_filtered(self, pred, now: int | None = None,
+                      use_device=None) -> tuple[list, dict]:
+        """Analytical scan fast lane. Phase A discovers the partitions
+        that MAY hold a row matching `pred` without assembling any
+        rows: per sstable, zone maps (index/sstable_index.py ZMP1)
+        prune whole segments — and whole sstables — before decode, and
+        the surviving segments' value lanes run through the
+        ops/device_scan.py predicate kernels (host numpy reference per
+        segment on fallback, results identical). Phase B reads JUST the
+        candidate partitions through read_partitions, so callers get
+        exactly the merged, reconciled view a naive full scan would
+        have produced for those partitions — Phase A is a provable
+        superset (a winning live cell exists in some source and its
+        segment/zone bounds contain its key), and the executor
+        re-verifies every candidate row with the exact predicate.
+
+        With the mesh lanes on, Phase A fans token-range shards across
+        the fanout exactly like scan_all; candidates drain in token
+        order. `use_device`: None = consult the engine's hot-reloadable
+        `scan_device_filter` knob PER SEGMENT; bool = pin; callable =
+        consulted per segment (the device_compress gate pattern — a
+        mid-scan flip moves work at the next segment boundary).
+
+        Returns ([(pk, merged CellBatch)] in token order, info dict
+        with the prune accounting)."""
+        self.failures.check_can_read()
+        now = now if now is not None else timeutil.now_seconds()
+        from ..index import sstable_index as ssi_mod
+        from ..ops import device_scan as ds
+        from ..service.metrics import GLOBAL as _M
+        from ..utils import pipeline_ledger
+        from .cellbatch import batch_tokens, pk_lanes
+        led = pipeline_ledger.ledger("scan")
+        st_prune = led.stage("prune")
+        st_filter = led.stage("filter")
+        st_gather = led.stage("gather")
+
+        if use_device is None:
+            gate = self.scan_device_filter_fn
+        elif callable(use_device):
+            gate = use_device
+        else:
+            gate = lambda _v=bool(use_device): _v  # noqa: E731
+
+        _KEYS = ("segments_total", "segments_skipped",
+                 "sstables_skipped", "device_segments", "host_segments")
+        info = dict.fromkeys(_KEYS, 0)
+
+        def _scan_sources(view, lo, hi):
+            """Candidate pks among `view` for tokens in (lo, hi]."""
+            pks = set()
+            loc = dict.fromkeys(_KEYS, 0)
+            for sst in view:
+                try:
+                    span = sst.segment_range_for_tokens(lo, hi)
+                    if span is None:
+                        continue
+                    s0, s1 = span
+                    with st_prune.busy():
+                        zm = ssi_mod.zonemap_for(sst, self.table)
+                        keep = zm.keep_mask(pred)[s0:s1] \
+                            if zm is not None \
+                            else np.ones(s1 - s0, dtype=bool)
+                    loc["segments_total"] += s1 - s0
+                    n_keep = int(keep.sum())
+                    loc["segments_skipped"] += (s1 - s0) - n_keep
+                    if n_keep == 0:
+                        loc["sstables_skipped"] += 1
+                        continue
+                    for s in range(s0, s1):
+                        if not keep[s - s0]:
+                            continue
+                        batch = sst._read_segment(s)
+                        with st_filter.busy():
+                            sel, keys = ds.batch_predicate_cells(
+                                batch, pred, reconciled=False)
+                            if not len(sel):
+                                continue
+                            mask, on_dev = ds.segment_mask(
+                                keys, pred, bool(gate()))
+                        loc["device_segments" if on_dev
+                            else "host_segments"] += 1
+                        st_filter.add_items(len(sel))
+                        hit = sel[mask]
+                        if not len(hit):
+                            continue
+                        toks = batch_tokens(batch)[hit]
+                        for i in hit[(toks > lo) & (toks <= hi)]:
+                            pks.add(batch.partition_key(int(i)))
+                except (CorruptSSTableError, OSError) as e:
+                    # sharded scans degrade per SOURCE like scan_window
+                    self._degrade_on_corruption(sst, e)
+                    continue
+            return pks, loc
+
+        pks: set = set()
+        # memtable: always scanned on the coordinator (small, always
+        # fresh, no zone maps to consult)
+        mem = self.memtable.scan()
+        if len(mem):
+            with st_filter.busy():
+                sel, keys = ds.batch_predicate_cells(mem, pred,
+                                                     reconciled=False)
+                if len(sel):
+                    mask, _ = ds.segment_mask(keys, pred, bool(gate()))
+                    for i in sel[mask]:
+                        pks.add(mem.partition_key(int(i)))
+        view = self.tracker.view()
+        from ..parallel import fanout as fanout_mod
+        n_mesh = self.mesh_devices_fn()
+        fan = fanout_mod.get_fanout() if n_mesh > 0 else None
+        ranges = None
+        if fan is not None and view:
+            from ..parallel.boundaries import boundaries_to_ranges
+            bounds = self._mesh_boundaries(n_mesh)
+            if bounds is not None and len(bounds):
+                ranges = boundaries_to_ranges(bounds, len(bounds) + 1)
+        if ranges is not None:
+            _M.incr("scan.mesh_scans")
+            outs = fan.map_shards(
+                lambda s: _scan_sources(view, ranges[s][0],
+                                        ranges[s][1]),
+                len(ranges))
+            for ps, loc in outs:
+                pks |= ps
+                for k in _KEYS:
+                    info[k] += loc[k]
+        elif view:
+            ps, loc = _scan_sources(view, -(1 << 63), (1 << 63) - 1)
+            pks |= ps
+            for k in _KEYS:
+                info[k] += loc[k]
+        for k in _KEYS:
+            if info[k]:
+                _M.incr(f"scan.{k}", info[k])
+        _M.incr("scan.candidates", len(pks))
+        # lane order IS token order (the bias-xor is order-preserving)
+        ordered = sorted(pks, key=pk_lanes)
+        info["candidates"] = len(ordered)
+        with st_gather.busy():
+            out = self.read_partitions(ordered, now=now) if ordered \
+                else []
+        st_gather.add_items(len(out))
+        return out, info
+
+    def scan_filtered_aggregate(self, pred, now: int | None = None,
+                                use_device=None) -> tuple:
+        """Exact (count, min, max, int_sum, info) of the predicate
+        column over the reconciled candidate partitions — the
+        aggregation leg that never materializes a row dict host-side.
+        Only valid for EXACT predicate kinds (pred.exact): there the
+        key-space mask equals the executor's `_match` row for row on
+        reconciled batches, so the device fold IS the aggregate."""
+        from ..ops import device_scan as ds
+        from .cellbatch import CellBatch
+        batches, info = self.scan_filtered(pred, now=now,
+                                           use_device=use_device)
+        if use_device is None:
+            gate = self.scan_device_filter_fn
+        elif callable(use_device):
+            gate = use_device
+        else:
+            gate = lambda _v=bool(use_device): _v  # noqa: E731
+        parts = [b for _pk, b in batches if len(b)]
+        if not parts:
+            info["fold_on_device"] = False
+            return 0, None, None, 0, info
+        big = CellBatch.concat(parts) if len(parts) > 1 else parts[0]
+        cnt, kmn, kmx, sm, on_dev = ds.fold_batch(big, pred,
+                                                  bool(gate()))
+        info["fold_on_device"] = on_dev
+        if cnt == 0:
+            return 0, None, None, 0, info
+        return (cnt, ds.value_of_key(pred.kind, kmn),
+                ds.value_of_key(pred.kind, kmx), sm, info)
 
     def next_partition_tokens(self, after: int, k: int) -> list[int]:
         """The first k distinct partition tokens > after, across the

@@ -65,9 +65,9 @@ SINGLE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 KNOWN_GROUPS = {
     "audit", "client_requests", "clients", "commitlog", "compaction",
     "compress_pool", "controller", "cql", "flush", "hints", "history",
-    "mesh",
+    "index", "mesh",
     "pipeline", "prepared_statements", "profile", "reads", "request",
-    "slo", "storage", "system", "table", "verb",
+    "scan", "slo", "storage", "system", "table", "verb",
 }
 
 
@@ -129,7 +129,7 @@ _HIST_SUFFIXES = (".count", ".mean_us", ".p50_us", ".p95_us",
                   ".p99_us", ".max_us")
 # components replaced by X during normalization: the smoke run's
 # keyspace/table names and any `<placeholder>` from the docs
-_SMOKE_DYNAMIC = {"smoke", "t"}
+_SMOKE_DYNAMIC = {"smoke", "t", "sc"}
 
 
 def normalize_name(name: str) -> str:
@@ -258,6 +258,33 @@ def smoke_emitted() -> set[str]:
             # (profile.samples counter) — layer 6 must stay catalogued
             from cassandra_tpu.service.sampler import GLOBAL as _sp
             _sp.sample_once()
+            # analytical scan lane (ops/device_scan.py + the ZMP1 zone
+            # maps): eager index build at flush, pushdown row +
+            # aggregate queries, a provably-empty predicate (segment
+            # AND sstable prune), a host-pinned reference leg, a torn
+            # zone map (rebuild path) and an unsupported-kind fallback
+            s.execute("CREATE TABLE sc (k int PRIMARY KEY, "
+                      "v int, w varint)")
+            s.execute("CREATE INDEX ON sc (v)")
+            scs = eng.store("smoke", "sc")
+            for i in range(64):
+                s.execute(f"INSERT INTO sc (k, v, w) VALUES "
+                          f"({i}, {i % 8}, {i})")
+            scs.flush()                          # -> index.builds
+            from cassandra_tpu.index import sstable_index as _ssi
+            for r in scs.live_sstables():        # torn component ->
+                os.remove(_ssi.zonemap_path(r.desc))   # ..rebuilds
+            s.execute("SELECT k FROM sc WHERE v = 3 ALLOW FILTERING")
+            s.execute("SELECT count(*) FROM sc WHERE v = 1000 "
+                      "ALLOW FILTERING")          # every segment pruned
+            s.execute("SELECT k FROM sc WHERE w = 5 "
+                      "ALLOW FILTERING")          # varint: fallback
+            from cassandra_tpu.ops import device_scan as _ds
+            scs.scan_filtered(_ds.compile_predicate(  # host leg
+                scs.table, [(scs.table.columns["v"], "=", 1)]),
+                use_device=False)
+            s.execute("CREATE INDEX ON sc (w)")  # post-flush index:
+            s.execute("SELECT k FROM sc WHERE w = 5")  # lazy build
             emitted = set(GLOBAL.snapshot())
             emitted |= set(eng.compactions.gauges())
             for st in eng.stores.values():

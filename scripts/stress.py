@@ -402,6 +402,8 @@ SAT_DDL = [
     "(key int PRIMARY KEY, v text)",
     f"CREATE TABLE IF NOT EXISTS {SAT_KEYSPACE}.rmw "
     "(key int PRIMARY KEY, v text)",
+    f"CREATE TABLE IF NOT EXISTS {SAT_KEYSPACE}.facts "
+    "(key int PRIMARY KEY, bucket int, score int)",
 ]
 
 
@@ -425,6 +427,9 @@ def _sat_tables():
                             cols={"key": "int", "v": "text"}),
         "rmw": make_table(ks, "rmw", pk=["key"],
                           cols={"key": "int", "v": "text"}),
+        "facts": make_table(ks, "facts", pk=["key"],
+                            cols={"key": "int", "bucket": "int",
+                                  "score": "int"}),
     }
 
 
@@ -583,6 +588,40 @@ def _scn_rmw(sess, tables):
     return op
 
 
+def _scn_analytical(sess, tables):
+    """HTAP mix: OLTP point inserts into a fact table interleaved with
+    selective ALLOW FILTERING scans and key-space aggregate folds —
+    the analytical pushdown lane (zone maps + device kernels) under
+    concurrent write pressure, where flushes keep minting fresh zone
+    maps while scans consult them."""
+    from cassandra_tpu.client import serialize_params
+    t = tables["facts"]
+    wq = sess.prepare(
+        f"INSERT INTO {SAT_KEYSPACE}.facts (key, bucket, score) "
+        "VALUES (?, ?, ?)")
+
+    def op(k, i, rng, is_write, worker, cl):
+        if is_write:
+            sess.execute_prepared(
+                wq, serialize_params(
+                    t, ["key", "bucket", "score"],
+                    [k, int(k) % 64, int(rng.integers(0, 1000))]),
+                consistency=cl)
+        elif i % 3 == 0:
+            # aggregate pushdown: folds on keys, zero rows host-side
+            sess.execute(
+                f"SELECT count(*) FROM {SAT_KEYSPACE}.facts "
+                f"WHERE bucket = {int(k) % 64} ALLOW FILTERING",
+                consistency=cl)
+        else:
+            # selective row pushdown (~1/64 of the table matches)
+            sess.execute(
+                f"SELECT key FROM {SAT_KEYSPACE}.facts "
+                f"WHERE bucket = {int(k) % 64} ALLOW FILTERING",
+                consistency=cl)
+    return op
+
+
 # scenario -> (setup factory, default write ratio). write_ratio None =
 # the op is intrinsically mixed (rmw)
 SCENARIOS = {
@@ -593,6 +632,7 @@ SCENARIOS = {
     "lwt": (_scn_lwt, 0.7),
     "batch": (_scn_batch, 0.5),
     "rmw": (_scn_rmw, None),
+    "analytical": (_scn_analytical, 0.7),
 }
 
 # the default matrix: every workload class, with the kv baseline run
@@ -602,7 +642,7 @@ DEFAULT_LEGS = [
     ("kv", "zipf"), ("kv", "uniform"), ("kv", "sequential"),
     ("wide", "uniform"), ("timeseries", "sequential"),
     ("counter", "zipf"), ("lwt", "zipf"), ("batch", "uniform"),
-    ("rmw", "zipf"),
+    ("rmw", "zipf"), ("analytical", "uniform"),
 ]
 
 
