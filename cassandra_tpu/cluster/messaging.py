@@ -69,6 +69,15 @@ class Verb:
     SCHEMA_FORWARD = "SCHEMA_FORWARD"
     STREAM_REQ = "STREAM_REQ"
     STREAM_DATA = "STREAM_DATA"
+    # sessioned streaming (cluster/stream_session.py): manifest-planned
+    # chunked transfer with acks, retransmit and resume
+    STREAM_SESSION_REQ = "STREAM_SESSION_REQ"
+    STREAM_MANIFEST = "STREAM_MANIFEST"
+    STREAM_CHUNK = "STREAM_CHUNK"
+    STREAM_ACK = "STREAM_ACK"
+    STREAM_SESSION_DONE = "STREAM_SESSION_DONE"
+    STREAM_PULL_REQ = "STREAM_PULL_REQ"
+    STREAM_PULL_RSP = "STREAM_PULL_RSP"
     REPAIR_VALIDATION_REQ = "REPAIR_VALIDATION_REQ"
     REPAIR_VALIDATION_RSP = "REPAIR_VALIDATION_RSP"
     REPAIR_SYNC_REQ = "REPAIR_SYNC_REQ"

@@ -68,7 +68,15 @@ class Node:
                     # node's fresh MessagingService picks up later flips
                     ("internode_dispatch_threads",
                      lambda v: self.messaging.set_dispatch_workers(
-                         int(v)))):
+                         int(v))),
+                    # same re-read pattern: restart_node swaps in a
+                    # fresh StreamService and later flips must land on
+                    # the live one's token bucket
+                    ("stream_throughput_outbound",
+                     lambda v: self.streams.set_throughput(float(v))),
+                    ("inter_dc_stream_throughput_outbound",
+                     lambda v: self.streams.set_throughput(
+                         float(v), inter_dc=True))):
                 _settings.on_change(name, cb_)
                 self._settings_subs.append((name, cb_))
         # disk/commit failure policy `stop`/`die`: the engine's failure
@@ -663,8 +671,6 @@ class Node:
         prematurely served. Returns cells streamed. Also supports the
         legacy already-in-ring flow (sources computed from a pre-join
         clone)."""
-        from ..storage import cellbatch as cbmod
-        from .repair import filter_token_range
         from .replication import ReplicationStrategy
 
         total = 0
@@ -699,63 +705,67 @@ class Node:
                             f"(owners: {cur_replicas})")
                     continue   # genuinely unowned (empty pre-ring)
                 for tname, table in ks.tables.items():
-                    cfs = self.engine.store(ks.name, tname)
                     arcs = [(-(1 << 63), hi),
                             (lo, (1 << 63) - 1)] if lo > hi else [(lo, hi)]
-                    batches = []
-                    landed_gens = []
                     for alo, ahi in arcs:
-                        # entire-sstable streaming: whole in-range
-                        # sstables arrive as component FILES (zero
-                        # re-serialization, attached indexes included);
-                        # only boundary-straddling data comes as batches
-                        files, leftover = self.streams.fetch_range(
+                        # sessioned entire-sstable streaming: whole
+                        # in-range sstables arrive as chunked component
+                        # FILES (zero re-serialization, attached indexes
+                        # included) and land atomically (TOC last);
+                        # only boundary-straddling data re-serializes.
+                        # The session is resumable and throttled — a
+                        # big join no longer rides one giant message
+                        res = self.streams.stream_range(
                             owners[0], ks.name, tname, alo, ahi,
-                            self.proxy.timeout)
-                        for comps in files:
-                            landed_gens.append(
-                                self.streams.land_sstable(cfs, comps))
-                        if len(leftover):
-                            batches.append(leftover)
-                    if batches:
-                        batch = cbmod.merge_sorted(batches)
-                        from ..storage.sstable import (Descriptor,
-                                                       SSTableWriter)
-                        gen = cfs.next_generation()
-                        w = SSTableWriter(Descriptor(cfs.directory, gen),
-                                          table)
-                        w.append(batch)
-                        w.finish()
-                        total += len(batch)
-                    if landed_gens or batches:
-                        cfs.reload_sstables()
-                        gens = set(landed_gens)
-                        total += sum(s.n_cells
-                                     for s in cfs.live_sstables()
-                                     if s.desc.generation in gens)
+                            timeout=max(self.proxy.timeout, 30.0))
+                        total += int(res["cells"])
         return total
 
     def decommission(self) -> int:
-        """Push all local data to its post-removal owners, then leave the
-        ring (tcm/sequences/Leave + unbootstrap streaming role)."""
-        snapshots = {}
-        for ks in list(self.schema.keyspaces.values()):
-            for tname in ks.tables:
-                batch = self.engine.store(ks.name, tname).scan_all()
-                if len(batch):
-                    snapshots[(ks.name, tname)] = batch
-        self.ring.remove_node(self.endpoint)   # new ownership takes effect
+        """Stream every locally-replicated range to the owners that GAIN
+        it once this node leaves, then leave the ring (tcm/sequences/
+        Leave + unbootstrap streaming role). The "push" is modelled as a
+        remote pull (STREAM_PULL_REQ): each gaining owner runs a
+        receiver session against this node, so the transfer is chunked,
+        throttled and atomically landed like any other session — and
+        the mover's landing is local on the gaining side."""
+        from .replication import ReplicationStrategy
+        me = self.endpoint
+        future = self.ring.clone_without(me)
         total = 0
-        for (ksn, tname), batch in snapshots.items():
-            table = self.schema.get_table(ksn, tname)
-            self.repair.apply_batch_to_owners(ksn, table, batch)
-            total += len(batch)
+        for ks in list(self.schema.keyspaces.values()):
+            strat = ReplicationStrategy.create(ks.params.replication)
+            # iterate the CURRENT ring's ranges: each maps into exactly
+            # one future range (the future ring merges ours), so the
+            # gained-replica set is constant across a current range —
+            # the future ring's coarser ranges would NOT give constant
+            # current-replica sets and could skip data
+            for lo, hi in self.ring.all_ranges():
+                cur = strat.replicas(self.ring, hi)
+                if me not in cur:
+                    continue
+                fut = strat.replicas(future, hi)
+                gained = [e for e in fut
+                          if e not in cur and self.is_alive(e)]
+                if not gained:
+                    continue
+                arcs = [(-(1 << 63), hi),
+                        (lo, (1 << 63) - 1)] if lo > hi else [(lo, hi)]
+                for tname in ks.tables:
+                    for ep in gained:
+                        for alo, ahi in arcs:
+                            res = self.streams.request_pull(
+                                ep, ks.name, tname, alo, ahi,
+                                max(self.proxy.timeout, 35.0))
+                            total += int(res.get("cells", 0))
+        self.ring.remove_node(me)   # new ownership takes effect
         self.shutdown()
         return total
 
     def shutdown(self):
         self._stop_hints.set()
         self.counters.close()
+        self.streams.close()
         self.gossiper.stop()
         self.messaging.close()
         for cfg_name, cb_ in getattr(self.proxy, "_settings_subs", []):
@@ -976,6 +986,7 @@ class LocalCluster:
         n = self.nodes[i - 1]
         self._stopped.add(i)
         n._stop_hints.set()
+        n.streams.close()   # in-flight sessions die; durable state stays
         n.gossiper.stop()
         n.messaging.close()
 
@@ -991,6 +1002,19 @@ class LocalCluster:
         n.gossiper = Gossiper(n.messaging, [self.nodes[0].endpoint],
                               interval=n.gossiper.interval)
         n.gossiper.on_alive = n._on_peer_alive
+        # re-seed peer liveness into the fresh detector (same both-ways
+        # seeding as startup/add_node): without it the restarted node
+        # convicts every peer until gossip rounds catch up and refuses
+        # to coordinate QUORUM traffic from its still-open CQL server
+        from .gossip import EndpointState
+        down = {self.nodes[j - 1].endpoint for j in self._stopped}
+        for other in self.nodes:
+            if other is n or other.endpoint in down:
+                continue
+            st = n.gossiper.states.setdefault(other.endpoint,
+                                              EndpointState(generation=1))
+            n.gossiper.detector.report(other.endpoint, st,
+                                       n.gossiper.clock())
         n._register_verbs()
         n.proxy = StorageProxy(n)
         # re-register sidecar verb handlers on the fresh MessagingService
@@ -1003,6 +1027,7 @@ class LocalCluster:
         n.repair = RepairService(n)
         n.counters.close()
         n.counters = CounterService(n)
+        n.streams.close()
         n.streams = StreamService(n)
         n.gossiper.start()
         n._stop_hints = threading.Event()

@@ -420,19 +420,10 @@ class RepairService:
         if ep == node.endpoint:
             return filter_token_range(
                 self._local_batch(keyspace, table_name), lo, hi)
-        holder = {}
-        ev = threading.Event()
-
-        def on_rsp(m):
-            holder["batch"] = cb_deserialize(m.payload)
-            ev.set()
-
-        node.messaging.send_with_callback(
-            Verb.REPAIR_SYNC_REQ, (keyspace, table_name, lo, hi), ep,
-            on_response=on_rsp, timeout=timeout)
-        if not ev.wait(timeout):
-            raise TimeoutError(f"sync fetch from {ep} timed out")
-        batch = holder["batch"]
+        # sessioned fetch (chunked + CRC + retransmit): a sync over a
+        # flaky wire retries and converges instead of timing out whole
+        batch = node.streams.fetch_batch(ep, keyspace, table_name,
+                                         lo, hi, timeout)
         # deserialized batches lose the ck composite translator; range
         # tombstone reconciliation needs it back
         t = node.schema.get_table(keyspace, table_name)

@@ -11,8 +11,18 @@ re-serialized as cell batches.
 The receiver lands each shipped sstable under a FRESH local generation
 (component contents never embed the generation — it lives only in the
 file names), TOC written last as the commit point, then reloads the
-store. Used by bootstrap; repair keeps its merkle-ranged batch sync
-(its transfers are narrow by construction).
+store.
+
+Two transports live here:
+
+  * the SESSIONED plan/chunk/ack protocol in cluster/stream_session.py
+    (StreamManager) — what bootstrap, rebuild, decommission and
+    repair's range sync actually ride: resumable, throttled, bounded;
+  * the legacy one-message STREAM_REQ/STREAM_DATA exchange below —
+    kept as a compat path (and pinned by test) but CAPPED: a request
+    whose in-range bytes exceed LEGACY_MAX_BYTES fails with a typed
+    StreamPayloadTooLarge instead of materializing an unbounded
+    response on the shared dispatch worker.
 """
 from __future__ import annotations
 
@@ -22,6 +32,8 @@ import threading
 from ..storage import cellbatch as cb
 from .coordinator import cb_serialize, cb_deserialize
 from .messaging import Verb
+from .stream_session import StreamManager, filter_token_range \
+    as _filter_token_range
 
 
 MIN_TOKEN = -(1 << 63)
@@ -31,18 +43,16 @@ MIN_TOKEN = -(1 << 63)
 VERSION_KEY = "__format_version__"
 
 
-def _filter_token_range(batch, lo: int, hi: int):
-    import numpy as np
-    keep = cb.token_range_mask(cb.batch_tokens(batch), [(lo, hi)])
-    idx = np.flatnonzero(keep)
-    if len(idx) == len(batch):
-        return batch
-    out = batch.apply_permutation(idx)
-    out.sorted = True
-    return out
+class StreamPayloadTooLarge(RuntimeError):
+    """A legacy single-message STREAM_REQ asked for more bytes than the
+    dispatch worker may materialize at once — use a session instead."""
 
 
 class StreamService:
+    # legacy single-message ceiling: everything bigger must ride a
+    # sessioned transfer (chunked, acked, resumable)
+    LEGACY_MAX_BYTES = 64 * 1024 * 1024
+
     def __init__(self, node):
         self.node = node
         # completed/failed session records (system_views.streaming /
@@ -52,6 +62,36 @@ class StreamService:
         self.sessions: "deque[dict]" = deque(maxlen=256)
         node.messaging.register_handler(Verb.STREAM_REQ,
                                         self._handle_req)
+        self.manager = StreamManager(node, record=self.sessions.append)
+
+    # ------------------------------------------------- sessioned transfers --
+
+    def stream_range(self, owner, keyspace: str, table_name: str,
+                     lo: int, hi: int, timeout: float | None = None) -> dict:
+        return self.manager.stream_range(owner, keyspace, table_name,
+                                         lo, hi, timeout)
+
+    def fetch_batch(self, owner, keyspace: str, table_name: str,
+                    lo: int, hi: int, timeout: float | None = None):
+        return self.manager.fetch_batch(owner, keyspace, table_name,
+                                        lo, hi, timeout)
+
+    def resume_incomplete(self, timeout: float | None = None) -> list[dict]:
+        return self.manager.resume_incomplete(timeout)
+
+    def request_pull(self, target, keyspace: str, table_name: str,
+                     lo: int, hi: int, timeout: float) -> dict:
+        return self.manager.request_pull(target, keyspace, table_name,
+                                         lo, hi, timeout)
+
+    def progress(self) -> list[dict]:
+        return self.manager.progress()
+
+    def set_throughput(self, mib_per_s: float, inter_dc: bool = False):
+        self.manager.set_throughput(mib_per_s, inter_dc)
+
+    def close(self) -> None:
+        self.manager.close()
 
     # -------------------------------------------------------------- source --
 
@@ -74,6 +114,20 @@ class StreamService:
                 whole.append(sst)
             else:
                 partial.append(sst)
+        # size the response BEFORE materializing a byte of it: the
+        # legacy path builds the whole payload in dispatch-worker
+        # memory, so an oversized ask fails typed instead of OOMing
+        est = 0
+        prefixes = [f"{s.desc.version}-{s.desc.generation}-"
+                    for s in whole + partial]
+        for fn in os.listdir(cfs.directory):
+            if any(fn.startswith(p) for p in prefixes):
+                est += os.path.getsize(os.path.join(cfs.directory, fn))
+        if est > self.LEGACY_MAX_BYTES:
+            raise StreamPayloadTooLarge(
+                f"{keyspace}.{table_name} ({lo}, {hi}] is ~{est} bytes; "
+                f"the single-message path caps at "
+                f"{self.LEGACY_MAX_BYTES} — use a stream session")
         files = []
         for sst in whole:
             prefix = f"{sst.desc.version}-{sst.desc.generation}-"
@@ -120,17 +174,27 @@ class StreamService:
             holder["p"] = m.payload
             ev.set()
 
+        def on_fail(arg):
+            holder["err"] = self.node.messaging.failure_kind(
+                getattr(arg, "payload", None))
+            ev.set()
+
         self.node.messaging.send_with_callback(
             Verb.STREAM_REQ, (keyspace, table_name, lo, hi), owner,
-            on_response=on_rsp, timeout=timeout)
-        if not ev.wait(timeout):
+            on_response=on_rsp, on_failure=on_fail, timeout=timeout)
+        if not ev.wait(timeout) or "err" in holder:
             self.sessions.append(
                 {"peer": owner.name, "direction": "in",
                  "keyspace": keyspace, "table": table_name,
                  "status": "failed", "files": 0, "bytes": 0})
+            kind = holder.get("err")
+            if kind == "StreamPayloadTooLarge":
+                raise StreamPayloadTooLarge(
+                    f"stream of {keyspace}.{table_name} ({lo}, {hi}] "
+                    f"from {owner.name} exceeds the single-message cap")
             raise TimeoutError(
                 f"stream of {keyspace}.{table_name} ({lo}, {hi}] from "
-                f"{owner.name} timed out")
+                f"{owner.name} {'failed: ' + kind if kind else 'timed out'}")
         files, leftover_b = holder["p"]
         leftover = cb_deserialize(leftover_b)
         self.sessions.append(

@@ -176,10 +176,10 @@ class Config:
     sstable_preemptive_open_interval: int = spec("storage",
                                                  50 * 1024 * 1024)
 
-    # streaming / hints (cassandra.yaml / hints section)
-    # ctpulint: allow(knob-wiring, reason=sstable shipping is a single-message RPC processed on the shared messaging dispatch worker today; a blocking throttle there would stall gossip acks and reads node-wide. The limiter binds when ROADMAP item 3 re-hosts streaming on dedicated pipeline stages.)
+    # streaming / hints (cassandra.yaml / hints section); both throughput
+    # knobs feed the stream sender's token bucket (cluster/
+    # stream_session.py), hot-reloadable via the Node settings listeners
     stream_throughput_outbound: float = spec("rate", 24.0, mutable=True)
-    # ctpulint: allow(knob-wiring, reason=same as stream_throughput_outbound; additionally no DC-aware stream path exists yet - every transfer is intra-DC)
     inter_dc_stream_throughput_outbound: float = spec("rate", 24.0,
                                                       mutable=True)
     hinted_handoff_enabled: bool = mut(True)

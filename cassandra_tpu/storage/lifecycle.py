@@ -116,8 +116,25 @@ def replay_directory(directory: str) -> None:
                 _delete_sstable_files(directory, gen)
         os.remove(path)
     for fn in list(os.listdir(directory)):
-        if fn.startswith("tmp-"):
+        if fn.startswith("tmp-") or fn.endswith(".stream"):
+            # .stream: a stream receiver's staged component rename that
+            # never happened (crash mid-landing)
             try:
                 os.remove(os.path.join(directory, fn))
             except FileNotFoundError:
                 pass
+    # stream landings commit by writing the TOC last: a generation with
+    # components but no TOC is a crashed landing — invisible to
+    # Descriptor.discover, and swept here so it can't leak disk forever
+    toc_gens, part_gens = set(), set()
+    for fn in os.listdir(directory):
+        parts = fn.split("-", 2)
+        if len(parts) != 3 or not parts[1].isdigit() \
+                or not parts[0].isalpha():
+            continue
+        gen = int(parts[1])
+        part_gens.add(gen)
+        if parts[2] == Component.TOC:
+            toc_gens.add(gen)
+    for gen in part_gens - toc_gens:
+        _delete_sstable_files(directory, gen)

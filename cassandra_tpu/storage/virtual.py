@@ -754,6 +754,36 @@ def build_node_virtuals(node) -> VirtualSchema:
                    "files": s["files"], "bytes": s["bytes"]}
     vs.register(VirtualTable(t_stream, stream_rows))
 
+    # --- live sessioned transfers (cluster/stream_session.py): chunk
+    # and byte progress while a session is IN FLIGHT — the `streaming`
+    # table above holds only terminal summaries
+    t_streams = make_table("system_views", "streams", pk=["id"],
+                           cols={"id": "text", "peer": "text",
+                                 "direction": "text",
+                                 "keyspace_name": "text",
+                                 "table_name": "text", "kind": "text",
+                                 "status": "text",
+                                 "chunks_total": "bigint",
+                                 "chunks_done": "bigint",
+                                 "bytes_total": "bigint",
+                                 "bytes_done": "bigint"})
+
+    def live_stream_rows():
+        svc = getattr(node, "streams", None)
+        if svc is None or not hasattr(svc, "progress"):
+            return
+        for s in svc.progress():
+            yield {"id": s["sid"], "peer": s["peer"],
+                   "direction": s["direction"],
+                   "keyspace_name": s["keyspace"],
+                   "table_name": s["table"], "kind": s["kind"],
+                   "status": s["status"],
+                   "chunks_total": s["chunks_total"],
+                   "chunks_done": s["chunks_done"],
+                   "bytes_total": s["bytes_total"],
+                   "bytes_done": s["bytes_done"]}
+    vs.register(VirtualTable(t_streams, live_stream_rows))
+
     # --- repair sessions
     t_rep = make_table("system_views", "repairs", pk=["id"],
                        cols={"id": "int", "keyspace_name": "text",

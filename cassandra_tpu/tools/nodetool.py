@@ -553,9 +553,14 @@ def garbagecollect(engine, keyspace: str | None = None,
 # ------------------------------------------------- round-3 command set --
 
 def netstats(node) -> dict:
-    """nodetool netstats: streaming sessions + internode counters."""
+    """nodetool netstats: live sessioned-transfer progress (chunks and
+    bytes, mid-flight), terminal session summaries, internode counters."""
     from ..storage.virtual import _snapshot
-    return {"streaming": _snapshot(getattr(node.streams, "sessions", [])),
+    svc = getattr(node, "streams", None)
+    live = svc.progress() if svc is not None \
+        and hasattr(svc, "progress") else []
+    return {"streams": live,
+            "streaming": _snapshot(getattr(node.streams, "sessions", [])),
             "messaging": dict(node.messaging.metrics)}
 
 
@@ -1132,7 +1137,6 @@ def rebuild(node, keyspace: str | None = None) -> dict:
     as merged batches. Used after disk loss or to fill a node that
     joined without bootstrap."""
     from ..cluster.replication import ReplicationStrategy
-    from ..storage import cellbatch as cbmod
     MIN, MAX = -(1 << 63), (1 << 63) - 1
     total_files = 0
     total_cells = 0
@@ -1158,32 +1162,14 @@ def rebuild(node, keyspace: str | None = None) -> dict:
                         f"of {ks.name} (replicas {replicas})")
                 continue
             ranges_done += 1
-            for tname, table in ks.tables.items():
-                cfs = node.engine.store(ks.name, tname)
+            for tname in ks.tables:
                 arcs = [(MIN, hi), (lo, MAX)] if lo > hi else [(lo, hi)]
-                batches = []
-                landed = []
                 for alo, ahi in arcs:
-                    files, leftover = node.streams.fetch_range(
+                    res = node.streams.stream_range(
                         sources[0], ks.name, tname, alo, ahi,
-                        node.proxy.timeout)
-                    for comps in files:
-                        landed.append(
-                            node.streams.land_sstable(cfs, comps))
-                        total_files += 1
-                    if len(leftover):
-                        batches.append(leftover)
-                if batches:
-                    batch = cbmod.merge_sorted(batches)
-                    from ..storage.sstable import Descriptor, SSTableWriter
-                    gen = cfs.next_generation()
-                    w = SSTableWriter(Descriptor(cfs.directory, gen),
-                                      table)
-                    w.append(batch)
-                    w.finish()
-                    total_cells += len(batch)
-                if landed or batches:
-                    cfs.reload_sstables()
+                        timeout=max(node.proxy.timeout, 30.0))
+                    total_files += int(res["files"])
+                    total_cells += int(res["cells"])
     return {"ranges": ranges_done, "files_streamed": total_files,
             "cells_streamed": total_cells}
 

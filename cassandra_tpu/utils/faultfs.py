@@ -19,6 +19,16 @@ Failure points wired into the codebase (docs/fault-tolerance.md):
                       covers memtable flush AND compaction output
     commitlog.fsync   the fsync inside CommitLog._do_sync
     hints.read        the hint-file read in HintsService.dispatch
+    stream.read       the sender pump's snapshot-chunk read
+                      (cluster/stream_session.py)
+    stream.net        the sender pump's chunk send — the only point
+                      where the network modes (disconnect/latency)
+                      bind; the path is the snapshot file behind the
+                      chunk, so path_substr scopes by component
+    stream.land       the receiver's staging writes AND the final
+                      component landing (path = the component file, so
+                      path_substr="TOC.txt" kills exactly the commit
+                      point)
 
 Modes:
     error        raise OSError(errno, ...) at the checkpoint (default
@@ -27,6 +37,10 @@ Modes:
                  CRC machinery downstream must detect it)
     short_read   deliver one byte less than requested
     torn_write   persist only the first `tear_bytes` bytes, then raise
+    disconnect   drop the message crossing a network checkpoint (the
+                 sender observes nothing — retransmit must recover)
+    latency      delay the message crossing a network checkpoint by
+                 `delay_s` seconds before delivering it intact
 
 Arming is process-global (faults don't respect object boundaries any
 more than disks do) and zero-cost when nothing is armed: every
@@ -45,14 +59,16 @@ class FaultPoint:
     registry lock."""
 
     __slots__ = ("point", "mode", "errno_", "times", "after",
-                 "path_substr", "bit_offset", "tear_bytes",
+                 "path_substr", "bit_offset", "tear_bytes", "delay_s",
                  "hits", "fires")
 
     def __init__(self, point: str, mode: str = "error",
                  errno_: int = _errno.EIO, times: int | None = None,
                  after: int = 0, path_substr: str | None = None,
-                 bit_offset: int | None = None, tear_bytes: int = 0):
-        if mode not in ("error", "bitflip", "short_read", "torn_write"):
+                 bit_offset: int | None = None, tear_bytes: int = 0,
+                 delay_s: float = 0.05):
+        if mode not in ("error", "bitflip", "short_read", "torn_write",
+                        "disconnect", "latency"):
             raise ValueError(f"unknown fault mode {mode!r}")
         self.point = point
         self.mode = mode
@@ -62,6 +78,7 @@ class FaultPoint:
         self.path_substr = path_substr
         self.bit_offset = bit_offset  # byte to flip (None = middle)
         self.tear_bytes = tear_bytes  # bytes persisted before the tear
+        self.delay_s = delay_s        # latency-mode injected delay
         self.hits = 0
         self.fires = 0
 
@@ -168,6 +185,19 @@ class FaultRegistry:
             target[i] ^= 0x01
         return got
 
+    def on_net(self, point: str, path: str = "") -> bool:
+        """Network checkpoint (the stream sender's chunk send): error
+        raises; latency sleeps `delay_s` and delivers; disconnect
+        returns True — the caller must DROP the message silently (a
+        dead wire acks nothing; only retransmit recovers)."""
+        self.check(point, path)
+        fp = self._take(point, path, ("latency",))
+        if fp is not None and fp.delay_s > 0:
+            import time
+            time.sleep(fp.delay_s)
+        fp = self._take(point, path, ("disconnect",))
+        return fp is not None
+
     def on_write(self, point: str, path: str, mv):
         """Write checkpoint: returns (bytes_to_write, error_to_raise).
         error raises before anything lands; torn_write returns the
@@ -203,6 +233,14 @@ def disarm(point: str | None = None) -> None:
 def check(point: str, path: str = "") -> None:
     if GLOBAL.active:
         GLOBAL.check(point, path)
+
+
+def on_net(point: str, path: str = "") -> bool:
+    """True = drop the message (disconnect armed); may sleep (latency)
+    or raise (error). Zero-cost when nothing is armed."""
+    if GLOBAL.active:
+        return GLOBAL.on_net(point, path)
+    return False
 
 
 class inject:

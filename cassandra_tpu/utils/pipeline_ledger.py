@@ -175,8 +175,9 @@ def ledger(name: str) -> PipelineLedger:
     `flush` (SSTableWriter write legs: serialize/compress/io_write +
     the flush `drain` stage), `mesh` (fanout lanes: decode/merge),
     `compress_pool` (shared worker: pack), `transport` (the request
-    dispatch executor) and `messaging` (the internode verb-dispatch
-    pool: `dispatch` plus one lazily-created stage per handled verb)."""
+    dispatch executor), `messaging` (the internode verb-dispatch
+    pool: `dispatch` plus one lazily-created stage per handled verb)
+    and `stream` (the sessioned-transfer legs: read/net/land)."""
     led = _LEDGERS.get(name)
     if led is None:
         with _LOCK:

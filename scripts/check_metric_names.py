@@ -67,7 +67,7 @@ KNOWN_GROUPS = {
     "compress_pool", "controller", "cql", "flush", "hints", "history",
     "index", "mesh",
     "pipeline", "prepared_statements", "profile", "reads", "request",
-    "scan", "slo", "storage", "system", "table", "verb",
+    "scan", "slo", "storage", "streaming", "system", "table", "verb",
 }
 
 

@@ -853,6 +853,61 @@ def run_matrix(base_dir: str, *, connections: int = 6,
             "replayed_total": n1.hints.metrics.get("replayed", 0),
         }
 
+        # ---- elasticity leg: a 4th node bootstraps over the sessioned
+        # streaming path while QUORUM write traffic stays live and the
+        # SLO poller stays armed. The key stream is sequential with
+        # key_space <= per-connection ops, so every key in
+        # [0, elastic_space) is written at least once; zero write errors
+        # plus a full QUORUM read-back of that range = zero lost writes.
+        elastic_id = "elastic:kv:sequential"
+        svc.set_context(scenario=elastic_id)
+        svc.reset()
+        settings.set("slo_targets",
+                     {name: target_ms
+                      for name in read_objs + write_objs})
+        e_ops = max(ops_per_leg, 2 * connections)
+        elastic_space = max(e_ops // 2, connections)
+        eh: dict = {}
+
+        def _elastic_traffic():
+            eh["r"] = run_scenario(
+                ports, "kv", connections=connections, ops=e_ops,
+                dist="sequential", key_space=elastic_space,
+                write_ratio=1.0, cl="QUORUM", seed=seed + 17)
+
+        et = threading.Thread(target=_elastic_traffic, daemon=True)
+        et.start()
+        time.sleep(0.05)   # writes in flight before the join starts
+        n4 = cluster.add_node()
+        et.join()
+        er = eh["r"]
+        sessions_done = sum(
+            1 for rec in n4.streams.sessions
+            if rec.get("status") == "complete")
+        rb = Cluster("127.0.0.1", ports[0]).connect()
+        try:
+            lost = [k for k in range(elastic_space)
+                    if not rb.execute(
+                        f"SELECT v FROM {SAT_KEYSPACE}.kv "
+                        f"WHERE key = {k}",
+                        consistency="QUORUM").rows]
+        finally:
+            rb.close()
+        everdicts = {v["objective"]: v for v in svc.check()}
+        out["elasticity_leg"] = {
+            "joined_node": n4.endpoint.name,
+            "writes_ok": er["ok"], "errors": er["errors"],
+            "ops_s": er["ops_s"], "p99_us": er["p99_us"],
+            "bootstrap_sessions_completed": sessions_done,
+            "keys_checked": elastic_space, "keys_lost": len(lost),
+            "slo": {name: {"p99_us": v["p99_us"],
+                           "breaches": v["breaches"]}
+                    for name, v in everdicts.items()},
+            "verdict": "ok" if not er["errors"] and not lost
+            else ("write_errors" if er["errors"] else "lost_writes"),
+        }
+        svc.clear_context()
+
         # ---- chaos leg: faultfs storage faults mid-run on node2's
         # sstables + a tightened read target — must end in a
         # breach-triggered bundle stamped with the scenario id
